@@ -1,0 +1,25 @@
+"""analytics_zoo_tpu — a TPU-native analytics + AI platform.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of Analytics Zoo
+(reference: yang-gis/analytics-zoo): sharded data pipelines, a Keras-style model
+API with an autograd DSL, a unified Estimator for distributed training, a
+built-in model zoo, AutoML time-series forecasting, and low-latency serving.
+
+Where the reference federates four execution engines (BigDL-JVM, TF-JNI, JEP
+PyTorch, OpenVINO) over Spark/Flink/Ray (reference `README.md:6`), this stack is
+one engine: jit/pjit-compiled XLA programs over a `jax.sharding.Mesh`, with
+GSPMD collectives replacing all five of the reference's gradient transports
+(reference survey §2.5).
+"""
+
+__version__ = "0.1.0"
+
+from analytics_zoo_tpu.common.context import (  # noqa: F401
+    init_zoo_context,
+    init_orca_context,
+    stop_orca_context,
+    ZooContext,
+    OrcaContext,
+)
+from analytics_zoo_tpu.common.mesh import DeviceMesh  # noqa: F401
+from analytics_zoo_tpu.common.config import ZooConfig  # noqa: F401

@@ -1,0 +1,10 @@
+from analytics_zoo_tpu.common.config import ZooConfig  # noqa: F401
+from analytics_zoo_tpu.common.context import (  # noqa: F401
+    init_zoo_context,
+    init_orca_context,
+    stop_orca_context,
+    ZooContext,
+    OrcaContext,
+)
+from analytics_zoo_tpu.common.mesh import DeviceMesh  # noqa: F401
+from analytics_zoo_tpu.common import triggers  # noqa: F401

@@ -1,0 +1,37 @@
+"""Test harness: single host stands in for a pod.
+
+Mirrors the reference's test strategy (SURVEY.md §4): everything distributed
+runs on one machine — there, `local[N]` Spark / local Ray; here, an 8-device
+virtual CPU mesh via `--xla_force_host_platform_device_count=8`. Must be set
+before jax initializes its backends, hence module-level in conftest.
+"""
+
+import os
+
+# Force-override: the machine env pins JAX_PLATFORMS to the TPU plugin, and a
+# sitecustomize preimports jax — so set both the env and the live jax config
+# (backends initialize lazily, so this still takes effect).
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep CPU tests deterministic and fast.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,124 @@
+"""Core runtime tests: config, mesh, context, triggers."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from analytics_zoo_tpu.common.config import MeshConfig, ZooConfig
+from analytics_zoo_tpu.common.context import (OrcaContext, ZooContext,
+                                              get_context, init_orca_context,
+                                              stop_orca_context)
+from analytics_zoo_tpu.common.mesh import DeviceMesh
+from analytics_zoo_tpu.common import triggers as tg
+
+
+class TestConfig:
+    def test_defaults_roundtrip(self, tmp_path):
+        cfg = ZooConfig()
+        p = str(tmp_path / "cfg.json")
+        cfg.save(p)
+        loaded = ZooConfig.load(p)
+        assert loaded.to_dict() == cfg.to_dict()
+
+    def test_from_dict_nested(self):
+        cfg = ZooConfig.from_dict({"mesh": {"tensor": 4}, "seed": 7})
+        assert cfg.mesh.tensor == 4 and cfg.seed == 7
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError):
+            ZooConfig.from_dict({"bogus": 1})
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("ZOO_MESH_TENSOR", "2")
+        monkeypatch.setenv("ZOO_SEED", "42")
+        monkeypatch.setenv("ZOO_LOG_LEVEL", "DEBUG")
+        cfg = ZooConfig.from_env()
+        assert cfg.mesh.tensor == 2
+        assert cfg.seed == 42
+        assert cfg.log_level == "DEBUG"
+
+
+class TestMesh:
+    def test_all_data_parallel(self, devices8):
+        mesh = DeviceMesh()
+        assert mesh.n_devices == len(jax.devices())
+        assert mesh.axis_sizes["data"] == mesh.n_devices
+
+    def test_2d_mesh(self, devices8):
+        mesh = DeviceMesh(MeshConfig(data=-1, tensor=4))
+        assert mesh.axis_sizes["tensor"] == 4
+        assert mesh.axis_sizes["data"] == 2
+
+    def test_bad_mesh_rejected(self, devices8):
+        with pytest.raises(ValueError):
+            DeviceMesh(MeshConfig(data=3, tensor=5))
+
+    def test_sharded_matmul_runs(self, devices8):
+        mesh = DeviceMesh(MeshConfig(data=-1, tensor=2))
+        x = np.ones((16, 8), np.float32)
+        w = np.ones((8, 4), np.float32)
+        xs = jax.device_put(x, mesh.sharding(("data", "fsdp"), None))
+        ws = jax.device_put(w, mesh.sharding(None, "tensor"))
+        y = jax.jit(lambda a, b: a @ b)(xs, ws)
+        np.testing.assert_allclose(np.asarray(y), x @ w)
+
+    def test_batch_sharding_spec(self, devices8):
+        mesh = DeviceMesh()
+        assert mesh.batch_sharding().spec == PartitionSpec(("data", "fsdp"))
+
+
+class TestContext:
+    def test_init_and_get(self):
+        ctx = init_orca_context(cluster_mode="local")
+        assert get_context() is ctx
+        r1, r2 = ctx.next_rng(), ctx.next_rng()
+        assert not np.array_equal(np.asarray(r1), np.asarray(r2))
+        stop_orca_context()
+
+    def test_spark_kwargs_accepted(self):
+        ctx = init_orca_context(cluster_mode="local", cores=4, memory="2g",
+                                num_nodes=1)
+        assert ctx.mesh.n_devices >= 1
+        stop_orca_context()
+
+    def test_global_flags(self):
+        OrcaContext.pandas_read_backend = "pandas"
+        assert ZooContext.pandas_read_backend == "pandas"
+        with pytest.raises(ValueError):
+            OrcaContext.pandas_read_backend = "dask"
+        with pytest.raises(ValueError):
+            OrcaContext.train_data_store = "PMEM_MISSING"
+        OrcaContext.train_data_store = "DISK_AND_DRAM"
+        assert OrcaContext.train_data_store == "DISK_AND_DRAM"
+
+
+class TestTriggers:
+    def test_every_epoch(self):
+        t = tg.EveryEpoch()
+        assert t(tg.TriggerState(epoch=1, epoch_finished=True))
+        assert not t(tg.TriggerState(iteration=5))
+
+    def test_several_iteration(self):
+        t = tg.SeveralIteration(3)
+        fires = [i for i in range(1, 10)
+                 if t(tg.TriggerState(iteration=i))]
+        assert fires == [3, 6, 9]
+
+    def test_max_epoch_and_or(self):
+        t = tg.Or(tg.MaxEpoch(2), tg.MinLoss(0.1))
+        assert t(tg.TriggerState(epoch=2))
+        assert t(tg.TriggerState(loss=0.05))
+        assert not t(tg.TriggerState(epoch=1, loss=1.0))
+        t2 = tg.And(tg.MaxIteration(10), tg.MaxScore(0.9))
+        assert t2(tg.TriggerState(iteration=10, score=0.95))
+        assert not t2(tg.TriggerState(iteration=10, score=0.5))
+
+    def test_from_string(self):
+        assert isinstance(tg.Trigger.from_string("every_epoch"), tg.EveryEpoch)
+        t = tg.Trigger.from_string("max_epoch:5")
+        assert isinstance(t, tg.MaxEpoch) and t.max_epoch == 5
+        with pytest.raises(ValueError):
+            tg.Trigger.from_string("bogus")
