@@ -138,8 +138,9 @@ class ZooConfig:
     @classmethod
     def from_env(cls, base: Optional["ZooConfig"] = None) -> "ZooConfig":
         """Apply `ZOO_<FIELD>` / `ZOO_<SECTION>_<FIELD>` env overrides, e.g.
-        `ZOO_MESH_TENSOR=4`, `ZOO_LOG_LEVEL=DEBUG`."""
-        cfg = base or cls()
+        `ZOO_MESH_TENSOR=4`, `ZOO_LOG_LEVEL=DEBUG`. `base` is not mutated."""
+        import copy
+        cfg = copy.deepcopy(base) if base is not None else cls()
         hints = typing.get_type_hints(cls)
         for f in dataclasses.fields(cfg):
             cur = getattr(cfg, f.name)

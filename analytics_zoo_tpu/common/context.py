@@ -41,8 +41,10 @@ class _ContextMeta(type):
 
     @log_output.setter
     def log_output(cls, value: bool):
+        # Only toggles output capture, never the configured verbosity
+        # (matches the reference, where log_output redirects executor stdout,
+        # `nncontext.py:274`).
         _ContextMeta._log_output = value
-        _configure_logging("DEBUG" if value else "INFO")
 
     @property
     def pandas_read_backend(cls) -> str:
@@ -110,8 +112,11 @@ def init_zoo_context(config: Optional[ZooConfig] = None,
       "multi-host" — `jax.distributed.initialize` with coordinator settings
                      from config or TPU-pod env (like yarn/k8s modes).
     """
-    config = ZooConfig.from_env(config or ZooConfig())
+    config = ZooConfig.from_env(config)  # copies; caller's object untouched
     _configure_logging(config.log_level)
+    # Wire config fields into the global context flags (setters validate).
+    ZooContext.log_output = config.log_output
+    ZooContext.pandas_read_backend = config.pandas_read_backend
 
     if cluster_mode in ("multi-host", "yarn", "k8s", "standalone"):
         # One rendezvous replaces the reference's five (survey §5): barrier
@@ -136,6 +141,12 @@ def init_zoo_context(config: Optional[ZooConfig] = None,
         raise ValueError(f"Unknown cluster_mode: {cluster_mode}")
 
     if mesh_axes:
+        valid = set(MeshConfig.__dataclass_fields__)
+        unknown = set(mesh_axes) - valid
+        if unknown:
+            raise TypeError(
+                f"Unknown mesh axis kwarg(s) {sorted(unknown)}; "
+                f"valid axes: {sorted(valid)}")
         for k, v in mesh_axes.items():
             setattr(config.mesh, k, v)
     mesh = DeviceMesh(config.mesh)
@@ -158,8 +169,17 @@ def init_orca_context(cluster_mode: str = "local",
     Spark-centric kwargs (cores/memory/num_nodes) are accepted for source
     compatibility; on TPU they are informational — the mesh is defined by the
     attached devices, not by executor sizing."""
-    mesh_axes = {k: v for k, v in kwargs.items()
-                 if k in MeshConfig.__dataclass_fields__}
+    known_spark = {"driver_cores", "driver_memory", "num_executors",
+                   "executor_cores", "executor_memory", "extra_python_lib",
+                   "conf", "init_ray_on_spark"}
+    mesh_axes = {}
+    for k, v in kwargs.items():
+        if k in MeshConfig.__dataclass_fields__:
+            mesh_axes[k] = v
+        elif k not in known_spark:
+            raise TypeError(
+                f"init_orca_context got unknown kwarg {k!r}; mesh axes are "
+                f"{sorted(MeshConfig.__dataclass_fields__)}")
     if cluster_mode in ("yarn", "yarn-client", "yarn-cluster", "k8s",
                         "standalone"):
         cluster_mode = "multi-host"

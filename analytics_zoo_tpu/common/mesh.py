@@ -37,6 +37,10 @@ BATCH_AXES: Tuple[str, ...] = ("data", "fsdp")
 
 def _infer_axis_sizes(n_devices: int, cfg: MeshConfig) -> Dict[str, int]:
     sizes = {name: getattr(cfg, name) for name in AXIS_NAMES}
+    for name, v in sizes.items():
+        if v != -1 and v < 1:
+            raise ValueError(
+                f"Mesh axis {name}={v} invalid: must be >=1, or -1 to infer")
     fixed = math.prod(v for v in sizes.values() if v != -1)
     free = [k for k, v in sizes.items() if v == -1]
     if len(free) > 1:

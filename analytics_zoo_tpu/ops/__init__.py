@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.ops import objectives  # noqa: F401
+from analytics_zoo_tpu.ops import metrics  # noqa: F401
+from analytics_zoo_tpu.ops import optimizers  # noqa: F401

@@ -1,0 +1,230 @@
+"""Validation metrics with the reference's compile-string registry.
+
+Mirrors `keras/metrics/*.scala` and the dispatch of `KerasUtils.toBigDLMetrics`
+(`KerasUtils.scala:218-248`): `"accuracy"`/`"acc"` resolve *by loss string* to
+Sparse/Categorical/Binary accuracy, plus top5/mae/auc/loss; orca's python names
+(`orca/learn/metrics.py:26-156`) map onto the same classes.
+
+Design: metrics are functional accumulators safe inside jit —
+`init() -> state`, `update(state, y_true, y_pred) -> state` (pure, jittable),
+`compute(state) -> float`. States are pytrees of arrays so they cross the
+host/device boundary and `jax.lax.scan` cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+State = Dict[str, Array]
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+class Metric:
+    name = "metric"
+
+    def init(self) -> State:
+        return {"total": jnp.zeros((), jnp.float32),
+                "count": jnp.zeros((), jnp.float32)}
+
+    def update(self, state: State, y_true: Array, y_pred: Array) -> State:
+        value, weight = self._batch(y_true, y_pred)
+        return {"total": state["total"] + value,
+                "count": state["count"] + weight}
+
+    def compute(self, state: State) -> Array:
+        return state["total"] / jnp.maximum(state["count"], 1.0)
+
+    def _batch(self, y_true, y_pred) -> Tuple[Array, Array]:
+        """Return (sum-of-metric, weight) for one batch."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class SparseCategoricalAccuracy(Metric):
+    """0-based integer labels vs argmax over last axis."""
+    name = "sparse_categorical_accuracy"
+
+    def _batch(self, y_true, y_pred):
+        labels = jnp.asarray(y_true, jnp.int32)
+        if labels.ndim == jnp.ndim(y_pred):
+            labels = jnp.squeeze(labels, -1)
+        hits = (jnp.argmax(y_pred, -1).astype(jnp.int32) == labels)
+        return _f32(hits).sum(), _f32(jnp.size(hits))
+
+
+class CategoricalAccuracy(Metric):
+    """One-hot labels (`metrics/Accuracy.scala` CategoricalAccuracy)."""
+    name = "categorical_accuracy"
+
+    def _batch(self, y_true, y_pred):
+        hits = (jnp.argmax(y_pred, -1) == jnp.argmax(y_true, -1))
+        return _f32(hits).sum(), _f32(jnp.size(hits))
+
+
+class BinaryAccuracy(Metric):
+    name = "binary_accuracy"
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def _batch(self, y_true, y_pred):
+        pred = (_f32(y_pred) > self.threshold)
+        hits = (pred == (_f32(y_true) > self.threshold))
+        return _f32(hits).sum(), _f32(jnp.size(hits))
+
+
+class Top5Accuracy(Metric):
+    """`ZooTop5Accuracy` (`keras/metrics`)."""
+    name = "top5_accuracy"
+
+    def __init__(self, k: int = 5):
+        self.k = k
+
+    def _batch(self, y_true, y_pred):
+        labels = jnp.asarray(y_true, jnp.int32)
+        if labels.ndim == jnp.ndim(y_pred):
+            labels = jnp.squeeze(labels, -1)
+        _, topk = jax.lax.top_k(_f32(y_pred), self.k)
+        hits = jnp.any(topk == labels[..., None], axis=-1)
+        return _f32(hits).sum(), _f32(jnp.size(hits))
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def _batch(self, y_true, y_pred):
+        err = jnp.abs(_f32(y_pred) - _f32(y_true))
+        return err.sum(), _f32(jnp.size(err))
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def _batch(self, y_true, y_pred):
+        err = jnp.square(_f32(y_pred) - _f32(y_true))
+        return err.sum(), _f32(jnp.size(err))
+
+
+class Loss(Metric):
+    """Averages a loss objective as a validation metric
+    (`toBigDLMetrics` "loss")."""
+    name = "loss"
+
+    def __init__(self, objective=None):
+        from analytics_zoo_tpu.ops import objectives
+        self.objective = (objectives.get(objective)
+                          if objective is not None
+                          else objectives.MeanSquaredError())
+
+    def _batch(self, y_true, y_pred):
+        n = _f32(jnp.shape(y_pred)[0] if jnp.ndim(y_pred) else 1)
+        return self.objective(y_true, y_pred) * n, n
+
+
+class AUC(Metric):
+    """Area under ROC via fixed-threshold binning (jit-friendly, matches
+    BigDL's thresholded AUC semantics; `orca/learn/metrics.py` AUC).
+
+    Accumulates TP/FP counts at `num_thresholds` evenly spaced thresholds and
+    trapezoid-integrates at compute()."""
+    name = "auc"
+
+    def __init__(self, num_thresholds: int = 200):
+        self.num_thresholds = num_thresholds
+
+    def init(self) -> State:
+        z = jnp.zeros((self.num_thresholds,), jnp.float32)
+        return {"tp": z, "fp": z, "pos": jnp.zeros((), jnp.float32),
+                "neg": jnp.zeros((), jnp.float32)}
+
+    def update(self, state, y_true, y_pred):
+        score = _f32(y_pred).reshape(-1)
+        label = (_f32(y_true).reshape(-1) > 0.5)
+        # thresholds in (0,1); epsilon margins like tf.keras AUC
+        thr = jnp.linspace(0.0, 1.0, self.num_thresholds)
+        pred_pos = score[None, :] >= thr[:, None]          # [T, N]
+        tp = jnp.sum(pred_pos & label[None, :], axis=1)
+        fp = jnp.sum(pred_pos & ~label[None, :], axis=1)
+        return {"tp": state["tp"] + _f32(tp),
+                "fp": state["fp"] + _f32(fp),
+                "pos": state["pos"] + _f32(label).sum(),
+                "neg": state["neg"] + _f32(~label).sum()}
+
+    def compute(self, state):
+        tpr = state["tp"] / jnp.maximum(state["pos"], 1.0)
+        fpr = state["fp"] / jnp.maximum(state["neg"], 1.0)
+        # thresholds descend fpr; integrate |∫ tpr d(fpr)|
+        return jnp.abs(jnp.trapezoid(tpr, fpr))
+
+
+class Accuracy(Metric):
+    """Orca's loss-agnostic Accuracy (`orca/learn/metrics.py:26`): picks
+    sparse vs categorical by label rank at update time is not jit-friendly, so
+    we resolve on first update by shape."""
+    name = "accuracy"
+
+    def _batch(self, y_true, y_pred):
+        if jnp.ndim(y_true) == jnp.ndim(y_pred) and \
+                jnp.shape(y_true)[-1] == jnp.shape(y_pred)[-1] and \
+                jnp.shape(y_pred)[-1] > 1:
+            return CategoricalAccuracy()._batch(y_true, y_pred)
+        if jnp.ndim(y_pred) >= 2 and jnp.shape(y_pred)[-1] > 1:
+            return SparseCategoricalAccuracy()._batch(y_true, y_pred)
+        return BinaryAccuracy()._batch(y_true, y_pred)
+
+
+# ---------------------------------------------------------------------------
+# Registry + loss-aware dispatch (`KerasUtils.scala:218-248`)
+# ---------------------------------------------------------------------------
+_ACC_BY_LOSS = {
+    "sparse_categorical_crossentropy": SparseCategoricalAccuracy,
+    "categorical_crossentropy": CategoricalAccuracy,
+    "binary_crossentropy": BinaryAccuracy,
+}
+
+
+def get(metric: Any, loss: Optional[str] = None) -> Metric:
+    """Resolve one metric string; `"accuracy"`/`"acc"` need the loss string for
+    the reference's loss-aware dispatch."""
+    if isinstance(metric, Metric):
+        return metric
+    key = str(metric).lower()
+    if key in ("accuracy", "acc"):
+        if loss is None:
+            return Accuracy()
+        loss_key = str(loss).lower()
+        if loss_key not in _ACC_BY_LOSS:
+            raise ValueError(
+                f"Unsupported metric: accuracy and loss: {loss} combination")
+        return _ACC_BY_LOSS[loss_key]()
+    table = {
+        "top5accuracy": Top5Accuracy,
+        "top5acc": Top5Accuracy,
+        "mae": MAE,
+        "mse": MSE,
+        "auc": AUC,
+        "loss": Loss,
+        "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+        "categorical_accuracy": CategoricalAccuracy,
+        "binary_accuracy": BinaryAccuracy,
+    }
+    if key not in table:
+        raise ValueError(f"Unsupported metric: {metric}")
+    return table[key]()
+
+
+def resolve(metrics: Optional[Sequence[Any]], loss: Optional[str] = None
+            ) -> List[Metric]:
+    """Resolve a metrics list against a loss, like `toBigDLMetrics`."""
+    if metrics is None:
+        return []
+    return [get(m, loss) for m in metrics]

@@ -1,0 +1,107 @@
+"""Optimizers and LR schedules as optax transforms, with the reference's
+compile-string registry.
+
+Mirrors `KerasUtils.toBigDLOptimMethod` (`KerasUtils.scala:207-216`) — same
+strings, same default hyperparameters — plus the Zoo-specific methods:
+`AdamWeightDecay` (BERT-style decoupled weight decay with linear warmup then
+linear decay, `keras/optimizers/AdamWeightDecay.scala:30-133`), `PolyEpochDecay`
+(`keras/optimizers/Adam.scala:141`), and the `Fixed` schedule
+(`common/Optim.scala:29`). On TPU an optimizer is a pure
+`optax.GradientTransformation`; its state lives sharded alongside the
+parameters under pjit, which subsumes the reference's slice-local optimizer
+state (`docs/docs/wp-bigdl.md:150-166`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import optax
+
+
+# ---------------------------------------------------------------------------
+# Schedules
+# ---------------------------------------------------------------------------
+def warmup_linear_decay(lr: float, total_steps: int,
+                        warmup_portion: float = -1.0) -> optax.Schedule:
+    """The reference's `warmupMethod` (`AdamWeightDecay.scala:54-58,117`):
+    with x = step/total, lr_factor = x/warmup while x < warmup, else 1 - x
+    (linear decay to zero at `total`). warmup_portion=-1 → no warmup, constant.
+    """
+    if warmup_portion is None or warmup_portion < 0:
+        return optax.constant_schedule(lr)
+    warmup_steps = max(int(total_steps * warmup_portion), 1)
+
+    def schedule(step):
+        x = step / total_steps
+        import jax.numpy as jnp
+        return lr * jnp.where(x < warmup_portion,
+                              x / warmup_portion,
+                              1.0 - x)
+    return schedule
+
+
+def poly_epoch_decay(lr: float, power: float, max_epochs: int,
+                     steps_per_epoch: int) -> optax.Schedule:
+    """`PolyEpochDecay` (`Adam.scala:141-151`): lr * (1 - epoch/maxEpochs)^power,
+    epoch-granular."""
+    def schedule(step):
+        import jax.numpy as jnp
+        epoch = jnp.minimum(step // steps_per_epoch, max_epochs)
+        return lr * (1.0 - epoch / max_epochs) ** power
+    return schedule
+
+
+def fixed(lr: float) -> optax.Schedule:
+    """`Fixed` schedule (`common/Optim.scala:29`)."""
+    return optax.constant_schedule(lr)
+
+
+# ---------------------------------------------------------------------------
+# Optimizers
+# ---------------------------------------------------------------------------
+def adam_weight_decay(lr: float = 1e-3,
+                      warmup_portion: float = -1.0,
+                      total_steps: int = -1,
+                      schedule: str = "linear",
+                      beta1: float = 0.9,
+                      beta2: float = 0.999,
+                      epsilon: float = 1e-6,
+                      weight_decay: float = 0.01,
+                      mask: Optional[Any] = None) -> optax.GradientTransformation:
+    """BERT AdamWeightDecay (`AdamWeightDecay.scala:40-52` defaults): decoupled
+    weight decay 0.01, eps 1e-6, linear warmup over `warmup_portion` of
+    `total_steps` then linear decay to zero."""
+    if schedule != "linear":
+        raise ValueError(f"Unsupported warmup schedule: {schedule}")
+    if total_steps > 0:
+        sched = warmup_linear_decay(lr, total_steps, warmup_portion)
+    else:
+        sched = optax.constant_schedule(lr)
+    return optax.adamw(sched, b1=beta1, b2=beta2, eps=epsilon,
+                       weight_decay=weight_decay, mask=mask)
+
+
+# Registry — exact strings + defaults of `KerasUtils.toBigDLOptimMethod`
+# (`KerasUtils.scala:207-216`).
+_REGISTRY: Dict[str, Callable[[], optax.GradientTransformation]] = {
+    "sgd": lambda: optax.sgd(learning_rate=0.01),
+    "rmsprop": lambda: optax.rmsprop(learning_rate=0.001, decay=0.9),
+    "adamax": lambda: optax.adamax(learning_rate=0.002, eps=1e-8),
+    "adagrad": lambda: optax.adagrad(learning_rate=0.01),
+    "adadelta": lambda: optax.adadelta(learning_rate=1.0, rho=0.95, eps=1e-8),
+    "adam": lambda: optax.adam(learning_rate=0.001),
+    "adamw": lambda: adam_weight_decay(),
+    "adam_weight_decay": lambda: adam_weight_decay(),
+}
+
+
+def get(optimizer: Any) -> optax.GradientTransformation:
+    """Resolve an optimizer compile string (or pass a GradientTransformation
+    through). Unknown strings raise, matching the reference."""
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    key = str(optimizer).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unsupported optimizer: {optimizer}")
+    return _REGISTRY[key]()

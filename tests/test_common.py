@@ -91,8 +91,25 @@ class TestContext:
             OrcaContext.pandas_read_backend = "dask"
         with pytest.raises(ValueError):
             OrcaContext.train_data_store = "PMEM_MISSING"
-        OrcaContext.train_data_store = "DISK_AND_DRAM"
-        assert OrcaContext.train_data_store == "DISK_AND_DRAM"
+        try:
+            OrcaContext.train_data_store = "DISK_AND_DRAM"
+            assert OrcaContext.train_data_store == "DISK_AND_DRAM"
+        finally:
+            OrcaContext.train_data_store = "DRAM"  # flags are process-global
+
+    def test_unknown_kwargs_rejected(self):
+        with pytest.raises(TypeError, match="tenosr"):
+            init_orca_context(cluster_mode="local", tenosr=4)
+        with pytest.raises(ValueError, match="must be >=1"):
+            init_orca_context(cluster_mode="local", tensor=0)
+        stop_orca_context()
+
+    def test_config_not_mutated(self):
+        cfg = ZooConfig()
+        init_orca_context(cluster_mode="local", config=cfg,
+                          data=len(jax.devices()))
+        assert cfg.mesh.data == -1  # caller's object untouched
+        stop_orca_context()
 
 
 class TestTriggers:

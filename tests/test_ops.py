@@ -1,0 +1,196 @@
+"""Objectives/metrics/optimizers numeric tests (reference test pattern:
+per-op specs with fixed values, `keras/layers/*Spec.scala`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from analytics_zoo_tpu.ops import metrics, objectives, optimizers
+
+
+class TestObjectives:
+    def test_registry_strings(self):
+        for name in ["binary_crossentropy", "categorical_crossentropy", "mse",
+                     "mean_squared_error", "mae", "mean_absolute_error",
+                     "hinge", "mape", "mean_absolute_percentage_error", "msle",
+                     "mean_squared_logarithmic_error", "squared_hinge",
+                     "sparse_categorical_crossentropy", "kld",
+                     "kullback_leibler_divergence", "cosine_proximity",
+                     "poisson", "rank_hinge"]:
+            assert isinstance(objectives.get(name), objectives.Objective)
+        with pytest.raises(ValueError, match="Unsupported loss"):
+            objectives.get("focal")
+
+    def test_mse_mae_values(self):
+        yt = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        yp = np.array([[1.5, 2.0], [2.0, 6.0]], np.float32)
+        np.testing.assert_allclose(
+            objectives.get("mse")(yt, yp), np.mean((yp - yt) ** 2), rtol=1e-6)
+        np.testing.assert_allclose(
+            objectives.get("mae")(yt, yp), np.mean(np.abs(yp - yt)), rtol=1e-6)
+
+    def test_binary_crossentropy_matches_manual(self):
+        yt = np.array([1.0, 0.0, 1.0, 0.0], np.float32)
+        p = np.array([0.9, 0.1, 0.4, 0.6], np.float32)
+        expected = -np.mean(yt * np.log(p) + (1 - yt) * np.log(1 - p))
+        got = objectives.BinaryCrossEntropy()(yt, p)
+        np.testing.assert_allclose(got, expected, rtol=1e-5)
+        # logits path agrees with probability path
+        logits = np.log(p / (1 - p))
+        got_logits = objectives.BinaryCrossEntropy(from_logits=True)(yt, logits)
+        np.testing.assert_allclose(got_logits, expected, rtol=1e-5)
+
+    def test_sparse_vs_dense_categorical_agree(self):
+        logits = np.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.0]], np.float32)
+        labels = np.array([0, 1], np.int32)
+        onehot = np.eye(3, dtype=np.float32)[labels]
+        sp = objectives.SparseCategoricalCrossEntropy(from_logits=True)(labels, logits)
+        den = objectives.CategoricalCrossEntropy(from_logits=True)(onehot, logits)
+        np.testing.assert_allclose(sp, den, rtol=1e-6)
+
+    def test_hinge_family(self):
+        yt = np.array([1.0, -1.0], np.float32)
+        yp = np.array([0.5, 0.5], np.float32)
+        np.testing.assert_allclose(
+            objectives.Hinge()(yt, yp), np.mean([0.5, 1.5]), rtol=1e-6)
+        np.testing.assert_allclose(
+            objectives.SquaredHinge()(yt, yp),
+            np.mean([0.25, 2.25]), rtol=1e-6)
+
+    def test_rank_hinge_pairs(self):
+        # scores alternate pos/neg: pairs (0.8,0.3) margin ok=0.5, (0.2,0.9) loss 1.7
+        scores = np.array([0.8, 0.3, 0.2, 0.9], np.float32)
+        got = objectives.RankHinge()(None, scores)
+        np.testing.assert_allclose(got, np.mean([0.5, 1.7]), rtol=1e-6)
+
+    def test_kld_poisson_cosine(self):
+        yt = np.array([[0.5, 0.5]], np.float32)
+        yp = np.array([[0.25, 0.75]], np.float32)
+        expected_kld = np.sum(yt * np.log(yt / yp))
+        np.testing.assert_allclose(
+            objectives.get("kld")(yt, yp), expected_kld, rtol=1e-5)
+        np.testing.assert_allclose(
+            objectives.Poisson()(yt, yp),
+            np.mean(yp - yt * np.log(yp + 1e-7)), rtol=1e-5)
+        cos = objectives.CosineProximity()(yt, yt)
+        np.testing.assert_allclose(cos, -1.0, rtol=1e-5)
+
+    def test_losses_are_jittable_and_gradable(self):
+        yt = jnp.ones((4, 3)) / 3.0
+        yp = jax.nn.softmax(jnp.arange(12, dtype=jnp.float32).reshape(4, 3))
+        for name in ["mse", "categorical_crossentropy", "kld", "poisson"]:
+            loss = objectives.get(name)
+            g = jax.jit(jax.grad(lambda p: loss(yt, p)))(yp)
+            assert g.shape == yp.shape
+            assert np.all(np.isfinite(np.asarray(g)))
+
+
+class TestMetrics:
+    def _run(self, metric, batches):
+        state = metric.init()
+        for yt, yp in batches:
+            state = jax.jit(metric.update)(state, yt, yp)
+        return float(metric.compute(state))
+
+    def test_sparse_accuracy_accumulates(self):
+        m = metrics.get("accuracy", loss="sparse_categorical_crossentropy")
+        assert isinstance(m, metrics.SparseCategoricalAccuracy)
+        b1 = (np.array([0, 1]), np.array([[0.9, 0.1], [0.2, 0.8]]))
+        b2 = (np.array([1, 1]), np.array([[0.9, 0.1], [0.2, 0.8]]))
+        assert self._run(m, [b1, b2]) == pytest.approx(0.75)
+
+    def test_loss_aware_dispatch(self):
+        assert isinstance(metrics.get("acc", "categorical_crossentropy"),
+                          metrics.CategoricalAccuracy)
+        assert isinstance(metrics.get("accuracy", "binary_crossentropy"),
+                          metrics.BinaryAccuracy)
+        with pytest.raises(ValueError, match="combination"):
+            metrics.get("accuracy", "mse")
+        with pytest.raises(ValueError, match="Unsupported metric"):
+            metrics.get("f1")
+
+    def test_top5(self):
+        m = metrics.get("top5accuracy")
+        yp = np.tile(np.arange(10, dtype=np.float32), (2, 1))
+        yt = np.array([9, 0])  # 9 is top-1, 0 is rank 10
+        assert self._run(m, [(yt, yp)]) == pytest.approx(0.5)
+
+    def test_mae_mse(self):
+        yt = np.array([1.0, 2.0]); yp = np.array([2.0, 4.0])
+        assert self._run(metrics.get("mae"), [(yt, yp)]) == pytest.approx(1.5)
+        assert self._run(metrics.get("mse"), [(yt, yp)]) == pytest.approx(2.5)
+
+    def test_auc_perfect_and_random(self):
+        m = metrics.get("auc")
+        yt = np.array([0, 0, 1, 1], np.float32)
+        perfect = np.array([0.1, 0.2, 0.8, 0.9], np.float32)
+        assert self._run(m, [(yt, perfect)]) == pytest.approx(1.0, abs=0.02)
+        inverted = 1.0 - perfect
+        assert self._run(m, [(yt, inverted)]) == pytest.approx(0.0, abs=0.02)
+
+    def test_loss_metric(self):
+        m = metrics.get("loss")
+        yt = np.array([1.0, 2.0]); yp = np.array([2.0, 4.0])
+        assert self._run(m, [(yt, yp)]) == pytest.approx(2.5)
+
+
+class TestOptimizers:
+    def test_registry(self):
+        for name in ["sgd", "rmsprop", "adamax", "adagrad", "adadelta",
+                     "adam", "adamw"]:
+            assert isinstance(optimizers.get(name),
+                              optax.GradientTransformation)
+        with pytest.raises(ValueError, match="Unsupported optimizer"):
+            optimizers.get("lion9000")
+
+    @pytest.mark.parametrize("name", ["sgd", "adam", "rmsprop", "adagrad"])
+    def test_optimizers_descend_quadratic(self, name):
+        opt = optimizers.get(name)
+        params = jnp.array([5.0, -3.0])
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            grads = jax.grad(lambda p: jnp.sum(p ** 2))(params)
+            updates, state = opt.update(grads, state, params)
+            return optax.apply_updates(params, updates), state
+
+        loss0 = float(jnp.sum(params ** 2))
+        for _ in range(200):
+            params, state = step(params, state)
+        # default lrs differ wildly (adam 1e-3 vs sgd 1e-2); just require
+        # monotone progress on the quadratic
+        assert float(jnp.sum(params ** 2)) < loss0 * 0.95
+
+    def test_warmup_linear_decay_shape(self):
+        # AdamWeightDecay.scala:54-58: x<warmup → x/warmup else 1-x
+        sched = optimizers.warmup_linear_decay(lr=1.0, total_steps=100,
+                                               warmup_portion=0.1)
+        assert float(sched(0)) == pytest.approx(0.0)
+        assert float(sched(5)) == pytest.approx(0.5)
+        # at x == warmup the reference switches to the 1-x branch → 0.9
+        assert float(sched(10)) == pytest.approx(0.9)
+        assert float(sched(55)) == pytest.approx(0.45)
+        assert float(sched(100)) == pytest.approx(0.0)
+        # no warmup → constant
+        const = optimizers.warmup_linear_decay(1.0, 100, -1)
+        assert float(const(50)) == pytest.approx(1.0)
+
+    def test_poly_epoch_decay(self):
+        sched = optimizers.poly_epoch_decay(lr=2.0, power=2.0, max_epochs=10,
+                                            steps_per_epoch=5)
+        assert float(sched(0)) == pytest.approx(2.0)
+        assert float(sched(25)) == pytest.approx(2.0 * (1 - 5 / 10) ** 2)
+
+    def test_adam_weight_decay_trains(self):
+        opt = optimizers.adam_weight_decay(lr=0.1, warmup_portion=0.1,
+                                           total_steps=100)
+        params = {"w": jnp.array([3.0])}
+        state = opt.init(params)
+        for _ in range(30):
+            grads = jax.grad(lambda p: jnp.sum(p["w"] ** 2))(params)
+            updates, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        assert abs(float(params["w"][0])) < 3.0
