@@ -1,0 +1,491 @@
+"""Keras-style model engine: Layer base, symbolic graph, Sequential/Model.
+
+The TPU-native analogue of the reference's Keras API
+(`zoo/.../pipeline/api/keras/models/Topology.scala`: `KerasNet` `:67`,
+`compile` `:139`, `fit` `:347`, `evaluate` `:504`, `predict`, `Model` `:631`,
+`Sequential` `:854`; python mirror `pyzoo/zoo/pipeline/api/keras/engine/
+topology.py:200-246`). Design differences are deliberate and TPU-first:
+
+- A layer is a *pure function* plus a parameter pytree — no mutable module
+  state. `build(rng, input_shape) -> params`, `call(params, x)`.
+- `Sequential`/`Model` compose layers into one pure `apply(params, inputs)`
+  which jit-compiles to a single fused XLA program (the reference instead
+  interprets a JVM graph node-by-node per minibatch).
+- The same symbolic `Node` graph that powers the functional `Model` API also
+  powers the autograd `Variable` DSL (`ops/autograd.py`), mirroring how the
+  reference's autograd builds on its graph nodes (`autograd/math.scala:378`).
+- `fit` delegates to the distributed trainer (`learn/trainer.py`): batch
+  sharding over the mesh's data axes; one train step = one XLA program.
+
+Keras semantics preserved: `input_shape` excludes the batch dim; compile
+strings for loss/optimizer/metrics resolve through the reference registries
+(`ops/objectives.py`, `ops/optimizers.py`, `ops/metrics.py`).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Shape = Tuple[Optional[int], ...]
+Params = Dict[str, Any]
+
+_name_counters: Dict[str, int] = collections.defaultdict(int)
+
+
+def _auto_name(cls_name: str) -> str:
+    _name_counters[cls_name] += 1
+    return f"{cls_name.lower()}_{_name_counters[cls_name]}"
+
+
+def reset_name_scope() -> None:
+    _name_counters.clear()
+
+
+class Layer:
+    """Base layer. Subclasses implement `build`, `call`,
+    `compute_output_shape`. Stateless: parameters live in the pytree returned
+    by build and are passed back into call."""
+
+    def __init__(self, input_shape: Optional[Shape] = None,
+                 name: Optional[str] = None):
+        self.name = name or _auto_name(type(self).__name__)
+        # Keras contract: input_shape excludes the batch dimension.
+        self.input_shape = (None,) + tuple(input_shape) if input_shape else None
+
+    # True for layers carrying non-gradient state (e.g. BatchNorm moving
+    # stats); they implement call_and_state.
+    stateful = False
+
+    # -- subclass API ------------------------------------------------------
+    def build(self, rng: jax.Array, input_shape: Shape) -> Params:
+        return {}
+
+    def call(self, params: Params, x, *, training: bool = False,
+             rng: Optional[jax.Array] = None):
+        raise NotImplementedError
+
+    def call_and_state(self, params: Params, x, *, training: bool = False,
+                       rng: Optional[jax.Array] = None):
+        """Stateful layers return (y, updated-param-entries); the trainer
+        merges the updates back into params outside the gradient path."""
+        return self.call(params, x, training=training, rng=rng), {}
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        return input_shape
+
+    # -- graph building ----------------------------------------------------
+    def __call__(self, inputs: Union["Node", Sequence["Node"]]) -> "Node":
+        """Symbolic call: layer applied to graph node(s) yields a node."""
+        nodes = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if not all(isinstance(n, Node) for n in nodes):
+            raise TypeError(
+                f"{self.name} called on non-Node inputs; use Input(shape) to "
+                "start a functional graph, or Sequential for linear stacks")
+        in_shapes = [n.shape for n in nodes]
+        shape_in = in_shapes if len(in_shapes) > 1 else in_shapes[0]
+        out_shape = self.compute_output_shape(shape_in)
+        return Node(layer=self, inputs=list(nodes), shape=out_shape)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name})"
+
+
+class Node:
+    """A symbolic tensor in the layer graph (the reference's `ModuleNode`/
+    autograd `Variable` substrate)."""
+
+    def __init__(self, layer: Optional[Layer], inputs: List["Node"],
+                 shape: Shape):
+        self.layer = layer
+        self.inputs = inputs
+        self.shape = shape
+
+    # Autograd DSL operators are attached by ops/autograd.py to avoid a
+    # circular import; see `autograd._install_operators`.
+
+    def __repr__(self):
+        lname = self.layer.name if self.layer else "input"
+        return f"Node({lname}, shape={self.shape})"
+
+
+def Input(shape: Shape, name: Optional[str] = None) -> Node:
+    """Entry node of a functional graph. `shape` excludes the batch dim
+    (Keras contract, `keras/models/Topology.scala` Input)."""
+    return Node(layer=None, inputs=[], shape=(None,) + tuple(shape))
+
+
+def _topo_sort(outputs: Sequence[Node]) -> List[Node]:
+    order: List[Node] = []
+    seen: set = set()
+
+    def visit(n: Node):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        for i in n.inputs:
+            visit(i)
+        order.append(n)
+
+    for out in outputs:
+        visit(out)
+    return order
+
+
+class KerasNet:
+    """Shared compile/fit/evaluate/predict surface (`Topology.scala:67`)."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or _auto_name(type(self).__name__)
+        self.loss = None
+        self.optimizer = None
+        self.metrics: List[Any] = []
+        self._tensorboard_dir: Optional[str] = None
+        self._checkpoint_path: Optional[str] = None
+        self.params: Optional[Params] = None
+        self._built_shape: Optional[Shape] = None
+
+    # -- subclass API ------------------------------------------------------
+    def build(self, rng: jax.Array, input_shape) -> Params:
+        raise NotImplementedError
+
+    def apply(self, params: Params, inputs, *, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        raise NotImplementedError
+
+    def apply_and_state(self, params: Params, inputs, *,
+                        training: bool = False,
+                        rng: Optional[jax.Array] = None):
+        """Like apply, but also returns {layer_name: updated entries} from
+        stateful layers (BatchNorm moving stats)."""
+        return self.apply(params, inputs, training=training, rng=rng), {}
+
+    def compute_output_shape(self, input_shape):
+        raise NotImplementedError
+
+    # -- Keras surface -----------------------------------------------------
+    def compile(self, optimizer, loss, metrics: Optional[Sequence] = None):
+        """`Topology.scala:139`: resolve compile strings through the
+        registries; `"accuracy"` dispatches on the loss string."""
+        from analytics_zoo_tpu.ops import metrics as zmetrics
+        from analytics_zoo_tpu.ops import objectives, optimizers
+        loss_str = loss if isinstance(loss, str) else None
+        self.loss = objectives.get(loss)
+        self.optimizer = optimizers.get(optimizer)
+        self.metrics = zmetrics.resolve(metrics, loss_str)
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        """`Topology.scala:208`."""
+        self._tensorboard_dir = f"{log_dir.rstrip('/')}/{app_name}"
+
+    def set_checkpoint(self, path: str, over_write: bool = True):
+        """`Topology.scala:249`."""
+        self._checkpoint_path = path
+
+    def ensure_built(self, sample_input, rng: Optional[jax.Array] = None):
+        """Initialise parameters from a sample batch (shape source)."""
+        if self.params is not None:
+            return self.params
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        shape = jax.tree_util.tree_map(
+            lambda a: (None,) + tuple(np.shape(a))[1:], sample_input,
+            is_leaf=lambda a: hasattr(a, "shape") or isinstance(a, np.ndarray))
+        self.params = self.build(rng, shape)
+        return self.params
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 1,
+            validation_data=None, distributed: bool = True, **kwargs):
+        """`Topology.scala:347` / `topology.py:200`. Delegates to the
+        distributed trainer; returns the history dict."""
+        from analytics_zoo_tpu.learn.trainer import fit_keras
+        return fit_keras(self, x, y, batch_size=batch_size, epochs=nb_epoch,
+                         validation_data=validation_data,
+                         distributed=distributed, **kwargs)
+
+    def evaluate(self, x, y=None, batch_per_thread: int = 32, **kwargs):
+        """`Topology.scala:504`: per-device batch for eval (the reference's
+        batch-per-thread contract, `tf_dataset.py:116-157`)."""
+        from analytics_zoo_tpu.learn.trainer import evaluate_keras
+        return evaluate_keras(self, x, y, batch_per_thread=batch_per_thread,
+                              **kwargs)
+
+    def predict(self, x, batch_per_thread: int = 32, **kwargs):
+        from analytics_zoo_tpu.learn.trainer import predict_keras
+        return predict_keras(self, x, batch_per_thread=batch_per_thread,
+                             **kwargs)
+
+    # -- persistence (`models/common/ZooModel.scala` save/load) -----------
+    def save_weights(self, path: str):
+        import json
+        from analytics_zoo_tpu.learn import checkpoint as ckpt
+        if self.params is None:
+            raise ValueError("Model has no parameters yet; call fit or "
+                             "ensure_built first")
+        ckpt.save_pytree(path, self.params)
+        order = self._layer_order()
+        if order:
+            with open(self._order_path(path), "w") as fh:
+                json.dump(order, fh)
+
+    def load_weights(self, path: str):
+        import json
+        import os
+        from analytics_zoo_tpu.learn import checkpoint as ckpt
+        loaded = ckpt.load_pytree(path)
+        order = None
+        if os.path.exists(self._order_path(path)):
+            with open(self._order_path(path)) as fh:
+                order = json.load(fh)
+        self.params = self._remap_loaded(loaded, order)
+        return self
+
+    @staticmethod
+    def _order_path(path: str) -> str:
+        base = path[:-4] if path.endswith(".npz") else path
+        return base + ".layers.json"
+
+    def _layer_order(self) -> List[str]:
+        return []
+
+    def _remap_loaded(self, loaded: Params,
+                      order: Optional[List[str]] = None) -> Params:
+        return loaded
+
+    def summary(self):
+        lines = [f"Model: {self.name}", "-" * 60]
+        for layer, shape, count in self._summary_rows():
+            lines.append(f"{layer:<30} {str(shape):<20} {count}")
+        lines.append("-" * 60)
+        total = sum(r[2] for r in self._summary_rows())
+        lines.append(f"Total params: {total}")
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+    def _summary_rows(self):
+        return []
+
+    @staticmethod
+    def _count(params) -> int:
+        return sum(int(np.prod(np.shape(p)))
+                   for p in jax.tree_util.tree_leaves(params))
+
+
+class Sequential(KerasNet):
+    """Linear stack (`Topology.scala:854`). First layer must carry
+    `input_shape`, like Keras."""
+
+    def __init__(self, layers: Optional[Sequence[Layer]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.layers: List[Layer] = []
+        for l in (layers or []):
+            self.add(l)
+
+    def add(self, layer: Layer) -> "Sequential":
+        if not self.layers and layer.input_shape is None \
+                and not isinstance(layer, (Sequential, Model)):
+            # allowed: shape may come later via ensure_built(sample)
+            pass
+        self.layers.append(layer)
+        return self
+
+    def build(self, rng: jax.Array, input_shape: Shape) -> Params:
+        if self.layers and self.layers[0].input_shape is not None:
+            input_shape = self.layers[0].input_shape
+        if input_shape is None:
+            raise ValueError(
+                "Cannot build Sequential: no input_shape on first layer")
+        params: Params = {}
+        shape = input_shape
+        for layer in self.layers:
+            rng, sub = jax.random.split(rng)
+            params[layer.name] = layer.build(sub, shape)
+            shape = layer.compute_output_shape(shape)
+        self._built_shape = shape
+        return params
+
+    def apply(self, params: Params, inputs, *, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        x = inputs
+        for layer in self.layers:
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x = layer.call(params[layer.name], x, training=training, rng=sub)
+        return x
+
+    def apply_and_state(self, params: Params, inputs, *,
+                        training: bool = False,
+                        rng: Optional[jax.Array] = None):
+        x = inputs
+        updates: Params = {}
+        for layer in self.layers:
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            x, upd = layer.call_and_state(params[layer.name], x,
+                                          training=training, rng=sub)
+            if upd:
+                updates[layer.name] = upd
+        return x, updates
+
+    def compute_output_shape(self, input_shape: Shape) -> Shape:
+        shape = input_shape
+        for layer in self.layers:
+            shape = layer.compute_output_shape(shape)
+        return shape
+
+    # Sequential itself can be nested as a layer or called on a Node.
+    def call(self, params, x, *, training=False, rng=None):
+        return self.apply(params, x, training=training, rng=rng)
+
+    def call_and_state(self, params, x, *, training=False, rng=None):
+        return self.apply_and_state(params, x, training=training, rng=rng)
+
+    stateful = True  # may contain stateful layers
+
+    def __call__(self, inputs):
+        return Layer.__call__(self, inputs)
+
+    @property
+    def input_shape(self):
+        return self.layers[0].input_shape if self.layers else None
+
+    @input_shape.setter
+    def input_shape(self, v):
+        pass  # satisfied by first layer
+
+    def _summary_rows(self):
+        rows = []
+        if self.params:
+            for layer in self.layers:
+                rows.append((f"{layer.name} ({type(layer).__name__})",
+                             "-", self._count(self.params.get(layer.name))))
+        return rows
+
+    def _layer_order(self):
+        return [l.name for l in self.layers]
+
+    def _remap_loaded(self, loaded: Params, order=None) -> Params:
+        """Auto-generated layer names differ across instances; a Sequential's
+        weights map positionally via the saved stack order."""
+        if set(loaded) == {l.name for l in self.layers}:
+            return loaded
+        if len(loaded) != len(self.layers):
+            raise ValueError(
+                f"Saved weights have {len(loaded)} layers, model has "
+                f"{len(self.layers)}")
+        saved_order = order if order is not None else list(loaded.keys())
+        return {layer.name: loaded[saved_name]
+                for layer, saved_name in zip(self.layers, saved_order)}
+
+
+class Model(KerasNet):
+    """Functional graph model (`Topology.scala:631`): built from `Input`
+    nodes and symbolic layer calls."""
+
+    def __init__(self, inputs: Union[Node, Sequence[Node]],
+                 outputs: Union[Node, Sequence[Node]],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        self.outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+        self._order = _topo_sort(self.outputs)
+        # deduplicate shared layers (weight sharing): one param set per layer
+        # *object*; two distinct layers with the same name is an error (Keras
+        # raises too — silent aliasing would corrupt weights)
+        self._layers: List[Layer] = []
+        seen: Dict[int, Layer] = {}
+        by_name: Dict[str, Layer] = {}
+        for node in self._order:
+            if node.layer is not None and id(node.layer) not in seen:
+                dup = by_name.get(node.layer.name)
+                if dup is not None and dup is not node.layer:
+                    raise ValueError(
+                        f"Duplicate layer name {node.layer.name!r} for two "
+                        "distinct layers in one graph")
+                seen[id(node.layer)] = node.layer
+                by_name[node.layer.name] = node.layer
+                self._layers.append(node.layer)
+
+    def build(self, rng: jax.Array, input_shape=None) -> Params:
+        params: Params = {}
+        shapes: Dict[int, Shape] = {}
+        for node in self._order:
+            if node.layer is None:
+                shapes[id(node)] = node.shape
+            else:
+                in_shapes = [shapes[id(i)] for i in node.inputs]
+                shape_in = in_shapes if len(in_shapes) > 1 else in_shapes[0]
+                if node.layer.name not in params:
+                    rng, sub = jax.random.split(rng)
+                    params[node.layer.name] = node.layer.build(sub, shape_in)
+                shapes[id(node)] = node.layer.compute_output_shape(shape_in)
+        return params
+
+    def apply(self, params: Params, inputs, *, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        out, _ = self.apply_and_state(params, inputs, training=training,
+                                      rng=rng)
+        return out
+
+    def apply_and_state(self, params: Params, inputs, *,
+                        training: bool = False,
+                        rng: Optional[jax.Array] = None):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if len(xs) != len(self.inputs):
+            raise ValueError(
+                f"Model {self.name} expects {len(self.inputs)} inputs, "
+                f"got {len(xs)}")
+        values: Dict[int, Any] = {id(n): x for n, x in zip(self.inputs, xs)}
+        updates: Params = {}
+        for node in self._order:
+            if id(node) in values:
+                continue
+            if node.layer is None:
+                raise ValueError("Disconnected input node in graph")
+            args = [values[id(i)] for i in node.inputs]
+            arg = args if len(args) > 1 else args[0]
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            y, upd = node.layer.call_and_state(
+                params[node.layer.name], arg, training=training, rng=sub)
+            values[id(node)] = y
+            if upd:
+                updates.setdefault(node.layer.name, {}).update(upd)
+        outs = [values[id(o)] for o in self.outputs]
+        return (outs if len(outs) > 1 else outs[0]), updates
+
+    def compute_output_shape(self, input_shape):
+        outs = [o.shape for o in self.outputs]
+        return outs if len(outs) > 1 else outs[0]
+
+    # nested-as-layer support
+    def call(self, params, x, *, training=False, rng=None):
+        return self.apply(params, x, training=training, rng=rng)
+
+    def call_and_state(self, params, x, *, training=False, rng=None):
+        return self.apply_and_state(params, x, training=training, rng=rng)
+
+    stateful = True  # may contain stateful layers
+
+    def __call__(self, inputs):
+        return Layer.__call__(self, inputs)
+
+    def _summary_rows(self):
+        rows = []
+        if self.params:
+            for layer in self._layers:
+                rows.append((f"{layer.name} ({type(layer).__name__})",
+                             "-", self._count(self.params.get(layer.name))))
+        return rows

@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.learn import checkpoint, trainer  # noqa: F401

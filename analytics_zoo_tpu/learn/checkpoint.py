@@ -1,0 +1,215 @@
+"""Checkpointing with the reference's on-disk naming contract.
+
+Layout follows `InternalDistriOptimizer` + `tf_optimizer.py:398-413`:
+    <ckptDir>/<yyyyMMdd_HHmmss>/model.<iteration>
+    <ckptDir>/<yyyyMMdd_HHmmss>/optimMethod-<name>.<iteration>
+`load_checkpoint(path, version)` selects by version number like
+`load_orca_checkpoint` (`orca/learn/tf/estimator.py:125`); resume restores
+optimizer state so epoch continuation matches `Topology.scala:379-394`.
+
+Format: each file is a numpy .npz of the flattened pytree plus a JSON sidecar
+of the tree structure — portable, no pickle of code objects.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Pytree <-> flat ndarray dict
+# ---------------------------------------------------------------------------
+def _walk(tree: Any, path: List[List[Any]], paths: List[Any],
+          leaves: List[np.ndarray]) -> None:
+    """Record every node: leaves carry data; empty containers carry a marker
+    so parameterless layers ({} in params) survive the roundtrip (jax's
+    tree_flatten silently drops them)."""
+    if isinstance(tree, dict):
+        if not tree:
+            paths.append({"path": path, "empty": "dict"})
+            return
+        for k in tree:  # preserve insertion order
+            _walk(tree[k], path + [["k", k]], paths, leaves)
+    elif isinstance(tree, (list, tuple)):
+        if not tree:
+            paths.append({"path": path, "empty": "list"})
+            return
+        for i, v in enumerate(tree):
+            _walk(v, path + [["i", i]], paths, leaves)
+    else:
+        paths.append({"path": path, "leaf": len(leaves)})
+        leaves.append(np.asarray(tree))
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """Write a pytree to `<path>` (npz + structure json)."""
+    paths: List[Any] = []
+    leaves: List[np.ndarray] = []
+    _walk(tree, [], paths, leaves)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    flat = {f"leaf_{i}": l for i, l in enumerate(leaves)}
+    np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
+    with open(_struct_path(path), "w") as fh:
+        json.dump({"nodes": paths}, fh)
+
+
+def _struct_path(path: str) -> str:
+    base = path[:-4] if path.endswith(".npz") else path
+    return base + ".structure.json"
+
+
+def load_pytree(path: str) -> Any:
+    """Load a pytree written by save_pytree; reconstructs nested
+    dicts/lists (tuples come back as lists)."""
+    npz = np.load(path if path.endswith(".npz") else path + ".npz")
+    with open(_struct_path(path)) as fh:
+        meta = json.load(fh)
+    root: Any = None
+    for node in meta["nodes"]:
+        if "leaf" in node:
+            value: Any = npz[f"leaf_{node['leaf']}"]
+        else:
+            value = {} if node["empty"] == "dict" else []
+        root = _insert(root, node["path"], value)
+    return root if root is not None else {}
+
+
+def _insert(root, parts, value):
+    if not parts:
+        return value
+    kind, key = parts[0]
+    if kind == "i":
+        key = int(key)
+        if root is None:
+            root = []
+        while len(root) <= key:
+            root.append(None)
+        root[key] = _insert(root[key], parts[1:], value)
+        return root
+    if root is None:
+        root = {}
+    root[key] = _insert(root.get(key), parts[1:], value)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# Reference-layout training checkpoints
+# ---------------------------------------------------------------------------
+_STAMP_FMT = "%Y%m%d_%H%M%S"
+
+
+class CheckpointManager:
+    """Writes `model.<iter>` + `optimMethod-<name>.<iter>` into a timestamped
+    subdir (created once per training run, `Topology.scala:1245-1252`)."""
+
+    def __init__(self, root: str, optim_name: str = "default", keep: int = 3):
+        self.root = root
+        self.optim_name = optim_name
+        self.keep = keep
+        stamp = datetime.datetime.now().strftime(_STAMP_FMT)
+        self.run_dir = os.path.join(root, stamp)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._saved: List[int] = []
+
+    def save(self, iteration: int, params: Any, opt_state: Any = None,
+             extra: Optional[Dict[str, Any]] = None) -> str:
+        mpath = os.path.join(self.run_dir, f"model.{iteration}")
+        save_pytree(mpath, params)
+        if opt_state is not None:
+            opath = os.path.join(self.run_dir,
+                                 f"optimMethod-{self.optim_name}.{iteration}")
+            save_pytree(opath, _optstate_to_tree(opt_state))
+        if extra:
+            with open(mpath + ".meta.json", "w") as fh:
+                json.dump(extra, fh)
+        self._saved.append(iteration)
+        self._gc()
+        return mpath
+
+    def _gc(self):
+        while len(self._saved) > self.keep:
+            it = self._saved.pop(0)
+            for pat in (f"model.{it}", f"optimMethod-{self.optim_name}.{it}"):
+                for suffix in (".npz", ".structure.json", ".meta.json"):
+                    p = os.path.join(self.run_dir, pat + suffix)
+                    if os.path.exists(p):
+                        os.remove(p)
+
+
+def latest_checkpoint(root: str) -> Optional[Tuple[str, int]]:
+    """Find (run_dir, version) of the newest model.<iter> under root —
+    mirrors `find_latest_checkpoint` (`orca/learn/tf/utils.py`)."""
+    best: Optional[Tuple[str, int]] = None
+    if not os.path.isdir(root):
+        return None
+    candidates = [root] + [os.path.join(root, d) for d in sorted(os.listdir(root))
+                           if os.path.isdir(os.path.join(root, d))]
+    for run_dir in candidates:
+        if not os.path.isdir(run_dir):
+            continue
+        for f in os.listdir(run_dir):
+            m = re.match(r"model\.(\d+)\.npz$", f)
+            if m:
+                version = int(m.group(1))
+                if best is None or version >= best[1]:
+                    best = (run_dir, version)
+    return best
+
+
+def load_checkpoint(path: str, version: Optional[int] = None,
+                    optim_name: str = "default"):
+    """Load (params, opt_tree, meta) from a checkpoint dir. `path` may be the
+    ckpt root or a run dir; `version=None` → latest."""
+    if version is None:
+        found = latest_checkpoint(path)
+        if found is None:
+            raise FileNotFoundError(f"No checkpoint under {path}")
+        run_dir, version = found
+    else:
+        run_dir = path
+        mfile = os.path.join(run_dir, f"model.{version}.npz")
+        if not os.path.exists(mfile):
+            found = latest_checkpoint(path)
+            if found and os.path.exists(
+                    os.path.join(found[0], f"model.{version}.npz")):
+                run_dir = found[0]
+            else:
+                raise FileNotFoundError(f"No model.{version} under {path}")
+    params = load_pytree(os.path.join(run_dir, f"model.{version}"))
+    opt_tree = None
+    opath = os.path.join(run_dir, f"optimMethod-{optim_name}.{version}")
+    if os.path.exists(opath + ".npz"):
+        opt_tree = load_pytree(opath)
+    meta = {}
+    mpath = os.path.join(run_dir, f"model.{version}.meta.json")
+    if os.path.exists(mpath):
+        with open(mpath) as fh:
+            meta = json.load(fh)
+    return params, opt_tree, meta
+
+
+def _optstate_to_tree(opt_state: Any) -> Any:
+    """Optax states are namedtuple pytrees; store leaves + paths only."""
+    return jax.tree_util.tree_map(np.asarray, opt_state)
+
+
+def restore_opt_state(template: Any, tree: Any) -> Any:
+    """Pour saved leaves back into an optax state built by opt.init."""
+    leaves_saved = jax.tree_util.tree_leaves(tree)
+    treedef = jax.tree_util.tree_structure(template)
+    leaves_tmpl = jax.tree_util.tree_leaves(template)
+    if len(leaves_saved) != len(leaves_tmpl):
+        raise ValueError(
+            f"Optimizer state mismatch: saved {len(leaves_saved)} leaves, "
+            f"template has {len(leaves_tmpl)}")
+    cast = [np.asarray(s, dtype=np.asarray(t).dtype)
+            for s, t in zip(leaves_saved, leaves_tmpl)]
+    return jax.tree_util.tree_unflatten(treedef, cast)

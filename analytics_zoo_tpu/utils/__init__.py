@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.utils import tensorboard  # noqa: F401

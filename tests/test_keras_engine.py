@@ -1,0 +1,385 @@
+"""Keras engine + layers + trainer tests (reference pattern: layer specs with
+fixed values + tiny end-to-end fits, `DistriEstimatorSpec.scala`,
+`TrainingSpec.scala`)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.keras import Input, Model, Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.learn import checkpoint as ckpt
+from analytics_zoo_tpu.utils import tensorboard as tb
+
+
+@pytest.fixture(autouse=True)
+def ctx():
+    c = zoo.init_orca_context(cluster_mode="local")
+    yield c
+    zoo.stop_orca_context()
+
+
+def _build(layer, shape, seed=0):
+    params = layer.build(jax.random.PRNGKey(seed), (None,) + shape)
+    return params
+
+
+class TestLayers:
+    def test_dense_forward(self):
+        d = L.Dense(3, input_shape=(4,))
+        p = _build(d, (4,))
+        x = np.ones((2, 4), np.float32)
+        y = d.call(p, x)
+        assert y.shape == (2, 3)
+        np.testing.assert_allclose(
+            np.asarray(y), x @ np.asarray(p["kernel"]) + np.asarray(p["bias"]),
+            rtol=1e-5)
+        assert d.compute_output_shape((None, 4)) == (None, 3)
+
+    def test_dense_on_3d(self):
+        d = L.Dense(5)
+        p = _build(d, (7, 4))
+        y = d.call(p, np.ones((2, 7, 4), np.float32))
+        assert y.shape == (2, 7, 5)
+
+    def test_activation_registry(self):
+        a = L.Activation("relu")
+        y = a.call({}, jnp.array([-1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(y), [0.0, 2.0])
+        with pytest.raises(ValueError):
+            L.Activation("mish9000")
+
+    def test_dropout_train_vs_eval(self):
+        dr = L.Dropout(0.5)
+        x = np.ones((4, 10), np.float32)
+        y_eval = dr.call({}, x, training=False)
+        np.testing.assert_array_equal(np.asarray(y_eval), x)
+        y_train = dr.call({}, x, training=True, rng=jax.random.PRNGKey(0))
+        arr = np.asarray(y_train)
+        assert set(np.unique(arr)).issubset({0.0, 2.0})
+        with pytest.raises(ValueError, match="rng"):
+            dr.call({}, x, training=True)
+
+    def test_reshape_flatten_permute(self):
+        r = L.Reshape((2, 6))
+        assert r.compute_output_shape((None, 3, 4)) == (None, 2, 6)
+        assert r.call({}, np.zeros((5, 3, 4))).shape == (5, 2, 6)
+        r2 = L.Reshape((-1, 3))
+        assert r2.compute_output_shape((None, 12)) == (None, 4, 3)
+        f = L.Flatten()
+        assert f.call({}, np.zeros((5, 3, 4))).shape == (5, 12)
+        pm = L.Permute((2, 1))
+        assert pm.call({}, np.zeros((5, 3, 4))).shape == (5, 4, 3)
+
+    def test_embedding(self):
+        e = L.Embedding(10, 4)
+        p = _build(e, (3,))
+        ids = np.array([[1, 2, 9]])
+        out = e.call(p, ids)
+        assert out.shape == (1, 3, 4)
+        np.testing.assert_allclose(np.asarray(out[0, 0]),
+                                   np.asarray(p["embeddings"][1]))
+        # pretrained frozen
+        mat = np.arange(20, dtype=np.float32).reshape(5, 4)
+        w = L.WordEmbedding(mat)
+        pw = _build(w, (2,))
+        out = w.call(pw, np.array([[0, 4]]))
+        np.testing.assert_allclose(np.asarray(out[0, 1]), mat[4])
+
+    def test_batchnorm_layernorm(self):
+        bn = L.BatchNormalization()
+        p = _build(bn, (4,))
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32) * 3 + 1
+        y = bn.call(p, x, training=True)
+        np.testing.assert_allclose(np.asarray(y).mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(y).std(0), 1.0, atol=1e-2)
+        ln = L.LayerNormalization()
+        pl = _build(ln, (4,))
+        y2 = ln.call(pl, x)
+        np.testing.assert_allclose(np.asarray(y2).mean(-1), 0.0, atol=1e-4)
+
+    def test_conv2d_known_values(self):
+        c = L.Convolution2D(1, 2, 2, use_bias=False)
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        kernel = np.ones((2, 2, 1, 1), np.float32)
+        y = c.call({"kernel": jnp.asarray(kernel)}, x)
+        assert y.shape == (1, 3, 3, 1)
+        # top-left window: 0+1+4+5 = 10
+        assert float(y[0, 0, 0, 0]) == 10.0
+        assert c.compute_output_shape((None, 4, 4, 1)) == (None, 3, 3, 1)
+
+    def test_conv1d_and_same_padding(self):
+        c = L.Convolution1D(2, 3, border_mode="same")
+        p = _build(c, (8, 4))
+        y = c.call(p, np.zeros((2, 8, 4), np.float32))
+        assert y.shape == (2, 8, 2)
+
+    def test_pooling(self):
+        mp = L.MaxPooling2D()
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        y = mp.call({}, x)
+        assert y.shape == (1, 2, 2, 1)
+        assert float(y[0, 0, 0, 0]) == 5.0  # max of [[0,1],[4,5]]
+        ap = L.AveragePooling2D()
+        ya = ap.call({}, x)
+        assert float(ya[0, 0, 0, 0]) == 2.5
+        g = L.GlobalAveragePooling2D()
+        assert g.call({}, x).shape == (1, 1)
+        g1 = L.GlobalMaxPooling1D()
+        assert g1.call({}, np.zeros((2, 5, 3))).shape == (2, 3)
+
+    def test_lstm_gru_shapes(self):
+        for cls in (L.LSTM, L.GRU, L.SimpleRNN):
+            rnn = cls(6)
+            p = _build(rnn, (5, 3))
+            x = np.random.RandomState(0).randn(2, 5, 3).astype(np.float32)
+            y = rnn.call(p, x)
+            assert y.shape == (2, 6)
+            rnn_seq = cls(6, return_sequences=True)
+            p2 = _build(rnn_seq, (5, 3))
+            y2 = rnn_seq.call(p2, x)
+            assert y2.shape == (2, 5, 6)
+            # gradients flow through scan
+            g = jax.grad(lambda pp: jnp.sum(rnn.call(pp, x)))(p)
+            assert np.isfinite(np.asarray(g["kernel"])).all()
+
+    def test_bidirectional(self):
+        bi = L.Bidirectional(L.LSTM(4, return_sequences=True))
+        p = _build(bi, (5, 3))
+        y = bi.call(p, np.zeros((2, 5, 3), np.float32))
+        assert y.shape == (2, 5, 8)
+        assert bi.compute_output_shape((None, 5, 3)) == (None, 5, 8)
+
+    def test_time_distributed(self):
+        td = L.TimeDistributed(L.Dense(7))
+        p = _build(td, (5, 3))
+        y = td.call(p, np.zeros((2, 5, 3), np.float32))
+        assert y.shape == (2, 5, 7)
+
+    def test_merge_modes(self):
+        a = np.ones((2, 3), np.float32)
+        b = 2 * np.ones((2, 3), np.float32)
+        m = L.Merge("sum")
+        np.testing.assert_allclose(np.asarray(m.call({}, [a, b])), 3.0)
+        np.testing.assert_allclose(
+            np.asarray(L.Merge("mul").call({}, [a, b])), 2.0)
+        assert L.Merge("concat").call({}, [a, b]).shape == (2, 6)
+        dot = L.Merge("dot").call({}, [a, b])
+        np.testing.assert_allclose(np.asarray(dot), 6.0)
+        cos = L.Merge("cos").call({}, [a, a])
+        np.testing.assert_allclose(np.asarray(cos), 1.0, rtol=1e-6)
+
+
+class TestSequentialModel:
+    def test_sequential_fit_converges(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 8).astype(np.float32)
+        w_true = rs.randn(8, 1).astype(np.float32)
+        y = x @ w_true
+        model = Sequential()
+        model.add(L.Dense(16, activation="relu", input_shape=(8,)))
+        model.add(L.Dense(1))
+        model.compile(optimizer="adam", loss="mse")
+        history = model.fit(x, y, batch_size=32, nb_epoch=30)
+        assert history["loss"][-1] < history["loss"][0] * 0.2
+
+    def test_functional_model_multi_input(self):
+        a = Input(shape=(4,))
+        b = Input(shape=(4,))
+        shared = L.Dense(8, activation="relu")
+        ha, hb = shared(a), shared(b)
+        merged = L.merge([ha, hb], mode="concat")
+        out = L.Dense(1)(merged)
+        model = Model([a, b], out)
+        xa = np.random.RandomState(1).randn(64, 4).astype(np.float32)
+        xb = np.random.RandomState(2).randn(64, 4).astype(np.float32)
+        y = (xa.sum(1, keepdims=True) - xb.sum(1, keepdims=True)).astype(np.float32)
+        model.compile(optimizer="adam", loss="mse")
+        h = model.fit([xa, xb], y, batch_size=16, nb_epoch=10)
+        assert h["loss"][-1] < h["loss"][0]
+        # weight sharing: single param set for the shared layer
+        assert shared.name in model.params
+        preds = model.predict([xa, xb], batch_per_thread=16)
+        assert preds.shape == (64, 1)
+
+    def test_classification_with_metrics(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(200, 10).astype(np.float32)
+        labels = (x[:, 0] > 0).astype(np.int32)
+        model = Sequential([
+            L.Dense(16, activation="relu", input_shape=(10,)),
+            L.Dense(2, activation="softmax"),
+        ])
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        model.fit(x, labels, batch_size=40, nb_epoch=25)
+        res = model.evaluate(x, labels, batch_per_thread=25)
+        assert res["sparse_categorical_accuracy"] > 0.8
+
+    def test_batch_contract_enforced(self, devices8):
+        model = Sequential([L.Dense(1, input_shape=(4,))])
+        model.compile("sgd", "mse")
+        x = np.zeros((64, 4), np.float32)
+        y = np.zeros((64, 1), np.float32)
+        with pytest.raises(ValueError, match="multiple of the"):
+            model.fit(x, y, batch_size=12, nb_epoch=1)  # 12 % 8 != 0
+
+    def test_fit_requires_compile(self):
+        model = Sequential([L.Dense(1, input_shape=(4,))])
+        with pytest.raises(RuntimeError, match="compiled"):
+            model.fit(np.zeros((32, 4), np.float32),
+                      np.zeros((32, 1), np.float32), batch_size=8)
+
+    def test_save_load_weights_roundtrip(self, tmp_path):
+        model = Sequential([L.Dense(3, input_shape=(4,))])
+        model.compile("sgd", "mse")
+        x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+        y = np.zeros((32, 3), np.float32)
+        model.fit(x, y, batch_size=8, nb_epoch=1)
+        p = str(tmp_path / "weights")
+        model.save_weights(p)
+        preds1 = model.predict(x)
+        model2 = Sequential([L.Dense(3, input_shape=(4,))])
+        model2.compile("sgd", "mse")
+        model2.load_weights(p)
+        # same layer naming is required for reload into a fresh model
+        preds2 = [model2.params[k] for k in model2.params]
+        assert len(preds2) == 1
+        got = model2.predict(x)
+        np.testing.assert_allclose(preds1, got, rtol=1e-6)
+
+
+class TestCheckpointManager:
+    def test_layout_and_resume(self, tmp_path):
+        root = str(tmp_path / "ckpts")
+        mgr = ckpt.CheckpointManager(root, optim_name="adam", keep=2)
+        params = {"dense": {"kernel": np.ones((2, 2), np.float32)}}
+        opt_state = {"momentum": np.zeros(4, np.float32)}
+        for it in [10, 20, 30]:
+            mgr.save(it, params, opt_state, extra={"epoch": it // 10})
+        files = os.listdir(mgr.run_dir)
+        # keep=2 → iteration 10 garbage-collected
+        assert not any("model.10" in f for f in files)
+        assert any(f.startswith("model.30") for f in files)
+        assert any(f.startswith("optimMethod-adam.30") for f in files)
+        found = ckpt.latest_checkpoint(root)
+        assert found is not None and found[1] == 30
+        loaded, opt_tree, meta = ckpt.load_checkpoint(root, optim_name="adam")
+        np.testing.assert_allclose(loaded["dense"]["kernel"],
+                                   params["dense"]["kernel"])
+        assert meta["epoch"] == 3
+        assert opt_tree is not None
+
+    def test_fit_writes_checkpoints(self, tmp_path):
+        model = Sequential([L.Dense(1, input_shape=(4,))])
+        model.compile("sgd", "mse")
+        model.set_checkpoint(str(tmp_path / "train_ckpt"))
+        x = np.zeros((32, 4), np.float32)
+        y = np.zeros((32, 1), np.float32)
+        model.fit(x, y, batch_size=8, nb_epoch=2)
+        found = ckpt.latest_checkpoint(str(tmp_path / "train_ckpt"))
+        assert found is not None
+
+    def test_pytree_roundtrip_nested(self, tmp_path):
+        tree = {"a": {"b": np.arange(3.0)}, "c": [np.ones(2), np.zeros(1)]}
+        p = str(tmp_path / "tree")
+        ckpt.save_pytree(p, tree)
+        back = ckpt.load_pytree(p)
+        np.testing.assert_allclose(back["a"]["b"], tree["a"]["b"])
+        np.testing.assert_allclose(back["c"][0], tree["c"][0])
+
+    def test_pytree_preserves_empty_subtrees(self, tmp_path):
+        # parameterless layers (Activation/Dropout/Flatten) build {} — these
+        # must survive the roundtrip or reload breaks
+        tree = {"dense_1": {"kernel": np.ones(2)}, "activation_1": {},
+                "dense_2": {"kernel": np.zeros(3)}}
+        p = str(tmp_path / "tree2")
+        ckpt.save_pytree(p, tree)
+        back = ckpt.load_pytree(p)
+        assert back["activation_1"] == {}
+        assert list(back) == ["dense_1", "activation_1", "dense_2"]
+
+    def test_save_load_with_parameterless_layers(self, tmp_path):
+        model = Sequential([L.Dense(4, input_shape=(4,)),
+                            L.Activation("relu"), L.Dense(1)])
+        model.compile("sgd", "mse")
+        x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+        y = np.zeros((32, 1), np.float32)
+        model.fit(x, y, batch_size=8, nb_epoch=1)
+        p = str(tmp_path / "w")
+        model.save_weights(p)
+        m2 = Sequential([L.Dense(4, input_shape=(4,)),
+                         L.Activation("relu"), L.Dense(1)])
+        m2.compile("sgd", "mse")
+        m2.load_weights(p)
+        np.testing.assert_allclose(model.predict(x), m2.predict(x), rtol=1e-6)
+
+
+class TestStatefulLayers:
+    def test_batchnorm_moving_stats_updated_by_fit(self):
+        model = Sequential([L.Dense(4, input_shape=(4,)),
+                            L.BatchNormalization(momentum=0.5), L.Dense(1)])
+        model.compile("sgd", "mse")
+        rs = np.random.RandomState(0)
+        x = (rs.randn(256, 4) * 5 + 3).astype(np.float32)
+        y = rs.randn(256, 1).astype(np.float32)
+        model.fit(x, y, batch_size=32, nb_epoch=3)
+        bn_name = model.layers[1].name
+        mm = np.asarray(model.params[bn_name]["moving_mean"])
+        mv = np.asarray(model.params[bn_name]["moving_var"])
+        assert not np.allclose(mm, 0.0)   # stats actually moved
+        assert not np.allclose(mv, 1.0)
+
+    def test_batchnorm_axis1(self):
+        bn = L.BatchNormalization(axis=1)
+        p = bn.build(jax.random.PRNGKey(0), (None, 3, 8))
+        x = np.random.RandomState(0).randn(4, 3, 8).astype(np.float32)
+        y = bn.call(p, x, training=True)
+        assert y.shape == x.shape
+        # per-channel (axis=1) normalization
+        np.testing.assert_allclose(np.asarray(y).mean(axis=(0, 2)), 0.0,
+                                   atol=1e-4)
+
+    def test_duplicate_layer_names_rejected(self):
+        a = Input(shape=(4,))
+        l1 = L.Dense(8, name="proj")
+        l2 = L.Dense(16, name="proj")
+        out = l2(l1(a))
+        with pytest.raises(ValueError, match="Duplicate layer name"):
+            Model(a, out)
+
+    def test_small_dataset_clear_error(self):
+        model = Sequential([L.Dense(1, input_shape=(4,))])
+        model.compile("sgd", "mse")
+        with pytest.raises(ValueError, match="batch_size"):
+            model.fit(np.zeros((5, 4), np.float32),
+                      np.zeros((5, 1), np.float32), batch_size=8)
+
+
+class TestTensorBoard:
+    def test_scalar_roundtrip(self, tmp_path):
+        d = str(tmp_path / "tb")
+        with tb.SummaryWriter(d) as w:
+            for i in range(5):
+                w.scalar("Loss", 1.0 / (i + 1), i)
+            w.scalar("Throughput", 1000.0, 4)
+        back = tb.read_scalars(d)
+        assert [s for s, _ in back["Loss"]] == [0, 1, 2, 3, 4]
+        np.testing.assert_allclose([v for _, v in back["Loss"]],
+                                   [1.0, 0.5, 1 / 3, 0.25, 0.2], rtol=1e-6)
+        assert back["Throughput"][0] == (4, 1000.0)
+
+    def test_fit_writes_tensorboard(self, tmp_path):
+        model = Sequential([L.Dense(1, input_shape=(4,))])
+        model.compile("sgd", "mse")
+        model.set_tensorboard(str(tmp_path), "app")
+        x = np.zeros((32, 4), np.float32)
+        y = np.zeros((32, 1), np.float32)
+        model.fit(x, y, batch_size=8, nb_epoch=2)
+        back = tb.read_scalars(str(tmp_path / "app" / "train"))
+        assert "Loss" in back and "Throughput" in back
