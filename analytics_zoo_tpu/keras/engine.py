@@ -80,16 +80,28 @@ class Layer:
 
     # -- graph building ----------------------------------------------------
     def __call__(self, inputs: Union["Node", Sequence["Node"]]) -> "Node":
-        """Symbolic call: layer applied to graph node(s) yields a node."""
-        nodes = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        if not all(isinstance(n, Node) for n in nodes):
-            raise TypeError(
-                f"{self.name} called on non-Node inputs; use Input(shape) to "
-                "start a functional graph, or Sequential for linear stacks")
+        """Symbolic call: layer applied to graph node(s) yields a node.
+        Node-wrapper objects (autograd Variables — anything exposing `.node`
+        as a Node) are accepted; the result is re-wrapped in the same type."""
+        raw = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        wrapper_cls = None
+        nodes = []
+        for item in raw:
+            if isinstance(item, Node):
+                nodes.append(item)
+            elif isinstance(getattr(item, "node", None), Node):
+                wrapper_cls = type(item)
+                nodes.append(item.node)
+            else:
+                raise TypeError(
+                    f"{self.name} called on non-Node inputs; use Input(shape) "
+                    "to start a functional graph, or Sequential for linear "
+                    "stacks")
         in_shapes = [n.shape for n in nodes]
         shape_in = in_shapes if len(in_shapes) > 1 else in_shapes[0]
         out_shape = self.compute_output_shape(shape_in)
-        return Node(layer=self, inputs=list(nodes), shape=out_shape)
+        out = Node(layer=self, inputs=nodes, shape=out_shape)
+        return wrapper_cls(node=out) if wrapper_cls is not None else out
 
     def __repr__(self):
         return f"{type(self).__name__}(name={self.name})"
@@ -396,8 +408,15 @@ class Model(KerasNet):
                  outputs: Union[Node, Sequence[Node]],
                  name: Optional[str] = None):
         super().__init__(name)
-        self.inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
-        self.outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+
+        def unwrap(x):  # accept autograd Variables interchangeably with Nodes
+            return x.node if hasattr(x, "node") else x
+        inputs = [unwrap(i) for i in inputs] \
+            if isinstance(inputs, (list, tuple)) else [unwrap(inputs)]
+        outputs = [unwrap(o) for o in outputs] \
+            if isinstance(outputs, (list, tuple)) else [unwrap(outputs)]
+        self.inputs = inputs
+        self.outputs = outputs
         self._order = _topo_sort(self.outputs)
         # deduplicate shared layers (weight sharing): one param set per layer
         # *object*; two distinct layers with the same name is an error (Keras

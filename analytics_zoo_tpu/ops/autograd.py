@@ -1,0 +1,251 @@
+"""Autograd DSL: symbolic `Variable` math, `Lambda` layers, `CustomLoss`.
+
+The reference builds a symbolic math DSL over its graph nodes
+(`zoo/.../pipeline/api/autograd/math.scala:378` `Variable`,
+`autograd/Lambda.scala:49`, `autograd/CustomLoss.scala:66`; python mirror
+`pyzoo/zoo/pipeline/api/autograd.py`) so users can write custom ops/losses
+without writing a layer. Here every `Variable` op is thin sugar over jax: an
+op records a pure jnp function into the same `Node` graph the functional
+`Model` API uses; shape inference is `jax.eval_shape` (no hand-written shape
+rules to drift). Under jit the whole expression fuses — a Variable DSL loss
+costs nothing over hand-written jax.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.keras.engine import Input, Layer, Model, Node
+
+
+def _infer_shape(fn: Callable, in_shapes: Sequence) -> tuple:
+    """Shape inference by abstract evaluation; None batch dims become 1."""
+    def dummy(s):
+        return jax.ShapeDtypeStruct(
+            tuple(1 if d is None else d for d in s), jnp.float32)
+
+    outs = jax.eval_shape(fn, *[dummy(s) for s in in_shapes])
+    shape = outs.shape
+    # restore the None batch dim if inputs had one
+    if in_shapes and in_shapes[0] and in_shapes[0][0] is None and shape:
+        shape = (None,) + tuple(shape[1:])
+    return tuple(shape)
+
+
+class LambdaLayer(Layer):
+    """A parameterless layer from a pure function (`Lambda.scala:49`)."""
+
+    def __init__(self, function: Callable, **kw):
+        super().__init__(**kw)
+        self.function = function
+
+    def call(self, params, x, *, training=False, rng=None):
+        if isinstance(x, (list, tuple)):
+            return self.function(*x)
+        return self.function(x)
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape if isinstance(input_shape, list) else [input_shape]
+        return _infer_shape(self.function, shapes)
+
+
+# keep the pyzoo name
+Lambda = LambdaLayer
+
+
+class Variable:
+    """Symbolic tensor with math operators (`math.scala:378`). Wraps a graph
+    Node; interchangeable with Keras functional-API nodes."""
+
+    def __init__(self, input_shape=None, node: Optional[Node] = None,
+                 name: Optional[str] = None):
+        if node is not None:
+            self.node = node
+        elif input_shape is not None:
+            self.node = Input(shape=tuple(input_shape), name=name)
+        else:
+            raise ValueError("Variable needs input_shape or node")
+
+    @property
+    def shape(self):
+        return self.node.shape
+
+    # -- op plumbing -------------------------------------------------------
+    @staticmethod
+    def _lift(fn: Callable, *vs: "Variable", name: str = "op") -> "Variable":
+        layer = LambdaLayer(fn, name=None)
+        layer.name = layer.name.replace("lambdalayer", name)
+        nodes = [v.node for v in vs]
+        out = layer(nodes if len(nodes) > 1 else nodes[0])
+        return Variable(node=out)
+
+    def _binop(self, other, fn, name):
+        if isinstance(other, Variable):
+            return Variable._lift(fn, self, other, name=name)
+        const = other
+        return Variable._lift(lambda a: fn(a, const), self, name=name)
+
+    def _rbinop(self, other, fn, name):
+        const = other
+        return Variable._lift(lambda a: fn(const, a), self, name=name)
+
+    # -- operators ---------------------------------------------------------
+    def __add__(self, other):
+        return self._binop(other, lambda a, b: a + b, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, lambda a, b: a - b, "sub")
+
+    def __rsub__(self, other):
+        return self._rbinop(other, lambda a, b: a - b, "rsub")
+
+    def __mul__(self, other):
+        return self._binop(other, lambda a, b: a * b, "mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, lambda a, b: a / b, "div")
+
+    def __rtruediv__(self, other):
+        return self._rbinop(other, lambda a, b: a / b, "rdiv")
+
+    def __pow__(self, p):
+        return self._binop(p, lambda a, b: a ** b, "pow")
+
+    def __neg__(self):
+        return Variable._lift(lambda a: -a, self, name="neg")
+
+    def __getitem__(self, idx):
+        return Variable._lift(lambda a: a[idx], self, name="slice")
+
+
+# ---------------------------------------------------------------------------
+# Module-level math functions (`pyzoo/zoo/pipeline/api/autograd.py` surface)
+# ---------------------------------------------------------------------------
+def _unary(fn, name):
+    def op(v: Variable) -> Variable:
+        return Variable._lift(fn, v, name=name)
+    op.__name__ = name
+    return op
+
+
+abs = _unary(jnp.abs, "abs")          # noqa: A001
+square = _unary(jnp.square, "square")
+sqrt = _unary(jnp.sqrt, "sqrt")
+exp = _unary(jnp.exp, "exp")
+log = _unary(jnp.log, "log")
+neg = _unary(lambda a: -a, "neg")
+erf = _unary(jax.lax.erf, "erf")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+softplus = _unary(jax.nn.softplus, "softplus")
+
+
+def sum(v: Variable, axis: int = 0, keepdims: bool = False) -> Variable:  # noqa: A001
+    """Reference semantics (`autograd.py` sum): axis counts non-batch dims?
+    The pyzoo surface passes the raw axis; we keep jnp semantics."""
+    return Variable._lift(
+        lambda a: jnp.sum(a, axis=axis, keepdims=keepdims), v, name="sum")
+
+
+def mean(v: Variable, axis: int = 0, keepdims: bool = False) -> Variable:
+    return Variable._lift(
+        lambda a: jnp.mean(a, axis=axis, keepdims=keepdims), v, name="mean")
+
+
+def clip(v: Variable, min: float, max: float) -> Variable:  # noqa: A002
+    return Variable._lift(lambda a: jnp.clip(a, min, max), v, name="clip")
+
+
+def pow(v: Variable, a: float) -> Variable:  # noqa: A001
+    return v ** a
+
+
+def maximum(a: Variable, b) -> Variable:
+    if isinstance(b, Variable):
+        return Variable._lift(jnp.maximum, a, b, name="maximum")
+    return Variable._lift(lambda x: jnp.maximum(x, b), a, name="maximum")
+
+
+def mm(x: Variable, y: Variable, axes: Optional[Sequence[int]] = None
+       ) -> Variable:
+    """Batched matmul contracting the given axes (`autograd.py mm`)."""
+    if axes is None:
+        return Variable._lift(jnp.matmul, x, y, name="mm")
+    ax, ay = axes
+
+    def fn(a, b):
+        return jax.lax.dot_general(
+            a, b, dimension_numbers=(((ax,), (ay,)), ((0,), (0,))))
+    return Variable._lift(fn, x, y, name="mm")
+
+
+def dot(x: Variable, y: Variable, axes=None, normalize: bool = False
+        ) -> Variable:
+    def fn(a, b):
+        if normalize:
+            a = a / jnp.clip(jnp.linalg.norm(a, axis=-1, keepdims=True),
+                             1e-7, None)
+            b = b / jnp.clip(jnp.linalg.norm(b, axis=-1, keepdims=True),
+                             1e-7, None)
+        return jnp.sum(a * b, axis=-1, keepdims=True)
+    return Variable._lift(fn, x, y, name="dot")
+
+
+def softmax(v: Variable, axis: int = -1) -> Variable:
+    return Variable._lift(lambda a: jax.nn.softmax(a, axis=axis), v,
+                          name="softmax")
+
+
+def expand_dims(v: Variable, axis: int) -> Variable:
+    return Variable._lift(lambda a: jnp.expand_dims(a, axis), v,
+                          name="expand_dims")
+
+
+def squeeze(v: Variable, axis: int) -> Variable:
+    return Variable._lift(lambda a: jnp.squeeze(a, axis), v, name="squeeze")
+
+
+def stack(vs: Sequence[Variable], axis: int = 1) -> Variable:
+    return Variable._lift(lambda *xs: jnp.stack(xs, axis=axis), *vs,
+                          name="stack")
+
+
+def concatenate(vs: Sequence[Variable], axis: int = -1) -> Variable:
+    return Variable._lift(lambda *xs: jnp.concatenate(xs, axis=axis), *vs,
+                          name="concat")
+
+
+# ---------------------------------------------------------------------------
+# CustomLoss (`CustomLoss.scala:66`, pyzoo CustomLoss)
+# ---------------------------------------------------------------------------
+class CustomLoss:
+    """Build a loss objective from a Variable expression over
+    (y_true, y_pred) placeholders:
+
+    >>> y_true = Variable(input_shape=(3,))
+    >>> y_pred = Variable(input_shape=(3,))
+    >>> loss = CustomLoss(mean(square(y_true - y_pred), axis=1), y_true, y_pred)
+    >>> model.compile("adam", loss)
+    """
+
+    def __init__(self, loss_var: Variable, y_true: Variable,
+                 y_pred: Variable):
+        self._model = Model([y_true.node, y_pred.node], loss_var.node)
+        self._params = self._model.build(jax.random.PRNGKey(0))
+
+    def __call__(self, y_true, y_pred):
+        out = self._model.apply(self._params, [y_true, y_pred])
+        return jnp.mean(out)
+
+
+def custom_loss_from_fn(fn: Callable) -> Callable:
+    """Wrap a plain jax fn(y_true, y_pred)->scalar as a loss (the TPU-native
+    shortcut the DSL compiles down to anyway)."""
+    return fn
+

@@ -1,0 +1,122 @@
+"""Flash attention — Pallas TPU kernel with reference fallback.
+
+The reference has no fused attention at all (its longest-sequence support is
+full O(L²) attention on one device, survey §5 long-context note); this module
+is part of the beyond-reference long-context capability. The Pallas kernel
+tiles Q over the grid and streams K/V blocks through VMEM with online softmax
+(the standard flash algorithm, see `/opt/skills/guides/pallas_guide.md`), so
+memory is O(block² · heads) instead of O(L²).
+
+`flash_attention` falls back to a jnp implementation when Pallas is
+unavailable for the current backend (e.g. CPU tests) — same numerics, no
+tiling.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _reference_attention(q, k, v, mask=None):
+    """Exact O(L²) attention — the shared non-flash numerics (also what
+    `keras.transformer.dot_product_attention` delegates to)."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(depth)
+    scores = scores.astype(jnp.float32)
+    if mask is not None:
+        scores = scores + mask
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def _flash_supported(mask) -> bool:
+    """The Pallas kernel runs on TPU and supports padding masks
+    ([B,1,1,T]); full [B,1,T,T] masks or other backends use the exact
+    reference path (decided statically — no exception-driven fallback)."""
+    if jax.default_backend() != "tpu":
+        return False
+    if mask is not None and mask.ndim == 4 and mask.shape[2] != 1:
+        return False
+    return True
+
+
+def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """q,k,v: [B, H, T, Dh]. mask: additive [B,1,1,T] (padding) or
+    [B,1,T,T] (full; reference path only). Returns [B, H, T, Dh]."""
+    if not (_flash_supported(mask) or interpret):
+        return _reference_attention(q, k, v, mask)
+    return _flash_pallas(q, k, v, mask, block_q, block_k, interpret)
+
+
+def _flash_pallas(q, k, v, mask, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    block = math.lcm(block_q, block_k)
+    if T % block:
+        # pad sequence to the lcm of both block sizes with masked-out keys
+        pad = (-T) % block
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        if mask is None:
+            mask = jnp.zeros((B, 1, 1, T), jnp.float32)
+        maskp = jnp.pad(mask, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                        constant_values=-1e9)
+        out = _flash_pallas(qp, kp, vp, maskp, block_q, block_k, interpret)
+        return out[:, :, :T]
+
+    if mask is None:
+        mask = jnp.zeros((B, 1, 1, T), jnp.float32)
+    scale = 1.0 / math.sqrt(D)
+    n_kb = T // block_k
+
+    def kernel(q_ref, k_ref, v_ref, m_ref, o_ref):
+        # One Q block vs all K/V blocks with online softmax; 2D-shaped
+        # carries because TPU vector ops want >=2D (pallas_guide.md).
+        qb = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+        acc = jnp.zeros((block_q, D), jnp.float32)
+        m_i = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+        l_i = jnp.zeros((block_q, 1), jnp.float32)
+
+        def body(s, carry):
+            acc, m_i, l_i = carry
+            kb = k_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
+            vb = v_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
+            mb = m_ref[0, :, pl.ds(s * block_k, block_k)]   # [1, bk]
+            scores = qb @ kb.T + mb                         # [bq, bk]
+            m_new = jnp.maximum(m_i, scores.max(axis=1, keepdims=True))
+            alpha = jnp.exp(m_i - m_new)
+            p = jnp.exp(scores - m_new)
+            acc = acc * alpha + p @ vb
+            l_i = l_i * alpha + p.sum(axis=1, keepdims=True)
+            return acc, m_new, l_i
+
+        acc, m_i, l_i = jax.lax.fori_loop(0, n_kb, body, (acc, m_i, l_i))
+        o_ref[0] = (acc / l_i).astype(o_ref.dtype)
+
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    mf = jnp.repeat(mask[:, 0, :, :], H, axis=0)            # [B*H, 1, T]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, T), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=bool(interpret) if interpret is not None else False,
+    )(qf, kf, vf, mf)
+    return out.reshape(B, H, T, D)

@@ -1,0 +1,140 @@
+"""Autograd DSL tests (reference: `pyzoo/test/zoo/pipeline/api/test_autograd.py`
+pattern — expression values vs manual computation, CustomLoss end-to-end)."""
+
+import jax
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.keras import Model, Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.ops import autograd as A
+
+
+@pytest.fixture(autouse=True)
+def ctx():
+    c = zoo.init_orca_context(cluster_mode="local")
+    yield c
+    zoo.stop_orca_context()
+
+
+def _eval(out_var, in_vars, values):
+    m = Model([v.node for v in in_vars], out_var.node)
+    params = m.build(jax.random.PRNGKey(0))
+    return np.asarray(m.apply(params, values))
+
+
+class TestVariableMath:
+    def test_arithmetic(self):
+        a = A.Variable(input_shape=(3,))
+        b = A.Variable(input_shape=(3,))
+        expr = (a + b) * 2.0 - a / 2.0
+        x = np.array([[1.0, 2.0, 3.0]], np.float32)
+        y = np.array([[4.0, 5.0, 6.0]], np.float32)
+        got = _eval(expr, [a, b], [x, y])
+        np.testing.assert_allclose(got, (x + y) * 2 - x / 2, rtol=1e-6)
+
+    def test_radd_rsub_pow_neg(self):
+        a = A.Variable(input_shape=(2,))
+        x = np.array([[2.0, 3.0]], np.float32)
+        np.testing.assert_allclose(_eval(1.0 - a, [a], [x]), 1 - x)
+        np.testing.assert_allclose(_eval(10.0 / a, [a], [x]), 10 / x)
+        np.testing.assert_allclose(_eval(a ** 2, [a], [x]), x ** 2)
+        np.testing.assert_allclose(_eval(-a, [a], [x]), -x)
+
+    def test_unary_functions(self):
+        a = A.Variable(input_shape=(3,))
+        x = np.array([[0.5, 1.0, 2.0]], np.float32)
+        np.testing.assert_allclose(_eval(A.square(a), [a], [x]), x ** 2)
+        np.testing.assert_allclose(_eval(A.sqrt(a), [a], [x]), np.sqrt(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_eval(A.exp(a), [a], [x]), np.exp(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_eval(A.log(a), [a], [x]), np.log(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_eval(A.clip(a, 0.8, 1.5), [a], [x]),
+                                   np.clip(x, 0.8, 1.5))
+
+    def test_reductions_and_mm(self):
+        a = A.Variable(input_shape=(2, 3))
+        x = np.arange(6, dtype=np.float32).reshape(1, 2, 3)
+        got = _eval(A.sum(a, axis=2), [a], [x])
+        np.testing.assert_allclose(got, x.sum(axis=2))
+        got = _eval(A.mean(a, axis=1, keepdims=True), [a], [x])
+        np.testing.assert_allclose(got, x.mean(axis=1, keepdims=True))
+        b = A.Variable(input_shape=(3, 4))
+        yv = np.ones((1, 3, 4), np.float32)
+        got = _eval(A.mm(a, b), [a, b], [x, yv])
+        np.testing.assert_allclose(got, x @ yv)
+
+    def test_softmax_stack_concat(self):
+        a = A.Variable(input_shape=(3,))
+        x = np.array([[1.0, 2.0, 3.0]], np.float32)
+        got = _eval(A.softmax(a), [a], [x])
+        e = np.exp(x - x.max())
+        np.testing.assert_allclose(got, e / e.sum(), rtol=1e-6)
+        b = A.Variable(input_shape=(3,))
+        y = 2 * x
+        got = _eval(A.concatenate([a, b]), [a, b], [x, y])
+        assert got.shape == (1, 6)
+        got = _eval(A.stack([a, b], axis=1), [a, b], [x, y])
+        assert got.shape == (1, 2, 3)
+
+    def test_erf_matches_lax(self):
+        a = A.Variable(input_shape=(3,))
+        x = np.array([[0.1, -0.5, 2.0]], np.float32)
+        got = _eval(A.erf(a), [a], [x])
+        np.testing.assert_allclose(got, np.asarray(jax.lax.erf(x)), rtol=1e-6)
+
+
+class TestLambdaLayer:
+    def test_lambda_in_sequential(self):
+        model = Sequential([
+            L.Dense(4, input_shape=(4,)),
+            A.Lambda(lambda t: t * 2.0),
+        ])
+        model.compile("sgd", "mse")
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        direct = model.predict(x, batch_per_thread=2)
+        assert direct.shape == (16, 4)
+
+    def test_lambda_shape_inference(self):
+        lam = A.Lambda(lambda t: t.sum(axis=-1))
+        assert lam.compute_output_shape((None, 5, 3)) == (None, 5)
+
+
+class TestCustomLoss:
+    def test_custom_mse_equals_builtin(self):
+        y_true = A.Variable(input_shape=(3,))
+        y_pred = A.Variable(input_shape=(3,))
+        custom = A.CustomLoss(A.mean(A.square(y_true - y_pred), axis=1),
+                              y_true, y_pred)
+        yt = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        yp = np.random.RandomState(1).randn(8, 3).astype(np.float32)
+        from analytics_zoo_tpu.ops import objectives
+        np.testing.assert_allclose(float(custom(yt, yp)),
+                                   float(objectives.get("mse")(yt, yp)),
+                                   rtol=1e-5)
+
+    def test_model_trains_with_custom_loss(self):
+        y_true = A.Variable(input_shape=(1,))
+        y_pred = A.Variable(input_shape=(1,))
+        loss = A.CustomLoss(A.mean(A.abs(y_true - y_pred), axis=1),
+                            y_true, y_pred)
+        model = Sequential([L.Dense(1, input_shape=(4,))])
+        model.compile("adam", loss)
+        rs = np.random.RandomState(0)
+        x = rs.randn(128, 4).astype(np.float32)
+        y = x.sum(1, keepdims=True).astype(np.float32)
+        h = model.fit(x, y, batch_size=32, nb_epoch=10)
+        assert h["loss"][-1] < h["loss"][0]
+
+    def test_variables_through_keras_layer(self):
+        # layers accept Variables directly (install_operators)
+        v = A.Variable(input_shape=(4,))
+        out = L.Dense(2)(v)
+        assert isinstance(out, A.Variable)
+        m = Model(v, out)
+        m.compile("sgd", "mse")
+        pred = m.predict(np.zeros((8, 4), np.float32), batch_per_thread=1)
+        assert pred.shape == (8, 2)
