@@ -1,0 +1,147 @@
+"""TPUDataset — the TFDataset-equivalent bridge from data to device batches.
+
+Mirrors the contract of `pyzoo/zoo/tfpark/tf_dataset.py:115-173` exactly:
+training takes a *global* `batch_size` that must divide by the total
+data-parallel size; inference/eval take per-device `batch_per_thread`;
+setting both is an error. `hard_code_batch_size` semantics are the default
+here — TPU programs want static shapes, so training batches are always
+whole (`drop_remainder`) and eval tails compile a second (smaller) program.
+
+Sources: ndarrays, XShards of {"x": ..., "y": ...}, pandas DataFrames
+(feature/label columns, the `to_dataset` path of
+`orca/learn/tf/estimator.py:225-276`), and python generators.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.data.shards import XShards
+
+
+class TPUDataset:
+    """Feed abstraction carrying (x, y) numpy structures + batching rules."""
+
+    def __init__(self, x, y=None, batch_size: int = -1,
+                 batch_per_thread: int = -1, shuffle: bool = True):
+        if batch_size != -1 and batch_per_thread != -1:
+            raise ValueError(
+                "bath_size and batch_per_thread should not be set simultaneously"
+            )  # message mirrors tf_dataset.py:134
+        self.x, self.y = x, y
+        self.batch_size = batch_size
+        self.batch_per_thread = batch_per_thread
+        self.shuffle = shuffle
+
+    # -- constructors (`TFDataset.from_*`) ---------------------------------
+    @staticmethod
+    def from_ndarrays(tensors, batch_size: int = -1,
+                      batch_per_thread: int = -1, val_tensors=None,
+                      shuffle: bool = True) -> "TPUDataset":
+        """`TFDataset.from_ndarrays` (`tf_dataset.py:378`): tensors is
+        (x, y) or {"x":..., "y":...} or a single x structure."""
+        if isinstance(tensors, dict):
+            x, y = tensors["x"], tensors.get("y")
+        elif isinstance(tensors, (tuple, list)) and len(tensors) == 2:
+            x, y = tensors
+        else:
+            x, y = tensors, None
+        ds = TPUDataset(x, y, batch_size, batch_per_thread, shuffle)
+        if val_tensors is not None:
+            # val inherits the caller's batching (the reference's
+            # from_ndarrays carries val through with the same batch), no
+            # shuffle
+            ds.val = TPUDataset.from_ndarrays(
+                val_tensors, batch_size=batch_size,
+                batch_per_thread=batch_per_thread, shuffle=False)
+        else:
+            ds.val = None
+        return ds
+
+    @staticmethod
+    def from_xshards(shards: XShards, batch_size: int = -1,
+                     batch_per_thread: int = -1,
+                     shuffle: bool = True) -> "TPUDataset":
+        """XShards of {"x": ndarray|tuple, "y": ...} → dataset
+        (`to_dataset` XShards path, `orca/learn/tf/utils.py:23-54`)."""
+        merged = shards.to_numpy()
+        if isinstance(merged, dict):
+            x, y = merged["x"], merged.get("y")
+        else:
+            raise ValueError(
+                'XShards for training must hold {"x": ..., "y": ...} dicts; '
+                "got " + type(merged).__name__)
+        return TPUDataset(x, y, batch_size, batch_per_thread, shuffle)
+
+    @staticmethod
+    def from_dataframe(df, feature_cols: Sequence[str],
+                       label_cols: Optional[Sequence[str]] = None,
+                       batch_size: int = -1, batch_per_thread: int = -1,
+                       shuffle: bool = True) -> "TPUDataset":
+        """pandas DataFrame + feature/label columns (`to_dataset` DataFrame
+        path, `orca/learn/tf/estimator.py:251-265`)."""
+        feats = [np.stack(df[c].to_numpy()) for c in feature_cols]
+        x = feats[0] if len(feats) == 1 else tuple(feats)
+        y = None
+        if label_cols:
+            labels = [np.stack(df[c].to_numpy()) for c in label_cols]
+            y = labels[0] if len(labels) == 1 else tuple(labels)
+        return TPUDataset(x, y, batch_size, batch_per_thread, shuffle)
+
+    @staticmethod
+    def from_feature_set(fs, batch_size: int = -1,
+                         batch_per_thread: int = -1) -> "TPUDataset":
+        return fs.to_dataset(batch_size=batch_size,
+                             batch_per_thread=batch_per_thread)
+
+    # -- consumption -------------------------------------------------------
+    def n_samples(self) -> int:
+        import jax
+        return len(jax.tree_util.tree_leaves(self.x)[0])
+
+    def global_batch(self, data_parallel: int) -> int:
+        """Resolve the per-step global batch, enforcing the reference's
+        divisibility contract (`tf_dataset.py:142-147`)."""
+        if self.batch_size != -1:
+            if self.batch_size % data_parallel:
+                raise ValueError(
+                    f"batch_size ({self.batch_size}) must be a multiple of "
+                    f"the data-parallel size ({data_parallel})")
+            return self.batch_size
+        per = self.batch_per_thread if self.batch_per_thread != -1 else 32
+        return per * data_parallel
+
+    def iter_train(self, data_parallel: int, seed: int = 0):
+        from analytics_zoo_tpu.learn.trainer import iter_batches
+        batch = self.global_batch(data_parallel)
+        return iter_batches(self.x, self.y, batch, shuffle=self.shuffle,
+                            seed=seed, drop_remainder=True)
+
+    def __repr__(self):
+        return (f"TPUDataset(n={self.n_samples()}, "
+                f"batch_size={self.batch_size}, "
+                f"batch_per_thread={self.batch_per_thread})")
+
+
+class _FeatureSetDataset(TPUDataset):
+    """Lazy bridge over a disk-tier FeatureSet: batches gather from the
+    memmapped store per step instead of materializing the whole set."""
+
+    def __init__(self, fs, batch_size: int = -1, batch_per_thread: int = -1):
+        super().__init__(x=None, y=None, batch_size=batch_size,
+                         batch_per_thread=batch_per_thread)
+        self._fs = fs
+
+    def n_samples(self) -> int:
+        return len(self._fs)
+
+    def iter_train(self, data_parallel: int, seed: int = 0):
+        batch = self.global_batch(data_parallel)
+        for b in self._fs.iter_batches(batch, shuffle=self.shuffle,
+                                       seed=seed):
+            if isinstance(b, dict) and "x" in b:
+                yield b["x"], b.get("y"), batch
+            else:
+                yield b, None, batch

@@ -1,0 +1,117 @@
+"""FeatureSet — cached training data with pluggable memory tiers.
+
+The reference's `FeatureSet` (`zoo/.../feature/FeatureSet.scala:643`)
+caches the training RDD in DRAM, PMEM (via a JNI memkind allocator,
+`pmem/PersistentMemoryAllocator.java:37`), or DISK_AND_DRAM with a
+configurable DRAM slice (`FeatureSet.scala:662-692`). The TPU-host analogue:
+
+- DRAM        — plain numpy arrays in host RAM (default);
+- DISK        — numpy memmaps spilled to a cache dir; the OS page cache is
+                the "DRAM portion" (this also covers the PMEM tier: memkind
+                PMEM is exactly a file-backed mmap on fsdax);
+- DISK_AND_DRAM(n) — first `n` percent pinned in RAM, rest memmapped
+                (`DISK_AND_DRAM.numSlice` semantics).
+
+Shuffle is index-level per epoch (cheap) rather than data movement.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class FeatureSet:
+    def __init__(self, data, memory_type: str = "DRAM",
+                 cache_dir: Optional[str] = None):
+        """data: pytree of ndarrays with a shared leading dim (or an XShards
+        of such)."""
+        import jax
+        from analytics_zoo_tpu.data.shards import XShards
+        if isinstance(data, XShards):
+            data = data.to_numpy()
+        self.memory_type = memory_type.upper()
+        leaves, self._treedef = jax.tree_util.tree_flatten(data)
+        if not leaves:
+            raise ValueError("Empty FeatureSet")
+        self._n = len(leaves[0])
+        dram_fraction = 1.0
+        if self.memory_type.startswith("DISK_AND_DRAM"):
+            # DISK_AND_DRAM(n) → n percent DRAM (numSlice analogue)
+            inside = self.memory_type[len("DISK_AND_DRAM"):].strip("()")
+            dram_fraction = (int(inside) / 100.0) if inside else 0.5
+        elif self.memory_type == "DISK":
+            dram_fraction = 0.0
+        elif self.memory_type in ("DRAM", "PMEM"):
+            dram_fraction = 1.0
+        else:
+            raise ValueError(f"Unsupported memory_type: {memory_type}")
+
+        self._split = int(self._n * dram_fraction)
+        if self._split < self._n:
+            # always a fresh private subdir: two FeatureSets sharing a
+            # cache_dir must not truncate each other's live memmaps
+            self._cache_dir = tempfile.mkdtemp(
+                prefix="zoo_featureset_", dir=cache_dir)
+            self._leaves = []
+            for i, leaf in enumerate(leaves):
+                arr = np.asarray(leaf)
+                head = arr[:self._split].copy()
+                path = os.path.join(self._cache_dir, f"leaf_{i}.npy")
+                np.save(path, arr[self._split:])
+                tail = np.load(path, mmap_mode="r")
+                self._leaves.append((head, tail))
+        else:
+            self._leaves = [(np.asarray(l), None) for l in leaves]
+
+    # -- data access -------------------------------------------------------
+    def __len__(self):
+        return self._n
+
+    def take(self, idx: np.ndarray):
+        """Gather rows by (possibly shuffled) indices into a pytree batch."""
+        import jax
+        out = []
+        for head, tail in self._leaves:
+            if tail is None:
+                out.append(head[idx])
+            else:
+                in_head = idx < self._split
+                rows = np.empty((len(idx),) + head.shape[1:], head.dtype)
+                rows[in_head] = head[idx[in_head]]
+                rows[~in_head] = tail[idx[~in_head] - self._split]
+                out.append(rows)
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
+    def iter_batches(self, batch_size: int, shuffle: bool = True,
+                     seed: int = 0, drop_remainder: bool = True):
+        idx = np.arange(self._n)
+        if shuffle:
+            np.random.RandomState(seed).shuffle(idx)
+        nb = self._n // batch_size if drop_remainder \
+            else -(-self._n // batch_size)
+        for b in range(nb):
+            sel = idx[b * batch_size:(b + 1) * batch_size]
+            if len(sel) < batch_size and drop_remainder:
+                break
+            yield self.take(sel)
+
+    def to_dataset(self, batch_size: int = -1, batch_per_thread: int = -1):
+        """DRAM tier materializes; spilled tiers wrap lazily so the DISK
+        design survives the dataset bridge (no full-RAM gather)."""
+        from analytics_zoo_tpu.data.dataset import (TPUDataset,
+                                                    _FeatureSetDataset)
+        if self._split == self._n:
+            full = self.take(np.arange(self._n))
+            if isinstance(full, dict) and "x" in full:
+                return TPUDataset(full["x"], full.get("y"), batch_size,
+                                  batch_per_thread)
+            return TPUDataset(full, None, batch_size, batch_per_thread)
+        return _FeatureSetDataset(self, batch_size, batch_per_thread)
+
+    def __repr__(self):
+        return (f"FeatureSet(n={self._n}, memory_type={self.memory_type}, "
+                f"dram_rows={self._split})")

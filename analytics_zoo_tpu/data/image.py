@@ -1,0 +1,203 @@
+"""ImageSet + image preprocessing pipeline.
+
+The reference's distributed image pipeline (`zoo/.../feature/image/
+ImageSet.scala:368` + OpenCV-backed `ImageProcessing` transforms inherited
+from BigDL: Resize/Crop/Normalize/Brightness/Flip, python mirrors
+`pyzoo/zoo/feature/image/imagePreprocessing.py`). Same composable-transform
+surface here over numpy/cv2 on the host; the output feeds the mesh as NHWC
+float batches (TPU-native layout). Host-side augmentation parallelizes over
+XShards; device-side normalization could fuse into the jit program but is
+kept host-side for reference parity.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import cv2
+    _HAS_CV2 = True
+except ImportError:  # pragma: no cover - cv2 is present in the base image
+    _HAS_CV2 = False
+
+
+def _require_cv2():
+    if not _HAS_CV2:
+        raise ImportError(
+            "opencv-python (cv2) is required for image decoding/resizing; "
+            "it is unavailable in this environment")
+
+
+class ImageProcessing:
+    """Composable transform; `>>` or `chain` composes (the reference's
+    `->` pipeline operator)."""
+
+    def apply(self, img: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, img):
+        return self.apply(img)
+
+    def __rshift__(self, other: "ImageProcessing") -> "ChainedPreprocessing":
+        return ChainedPreprocessing([self, other])
+
+
+class ChainedPreprocessing(ImageProcessing):
+    def __init__(self, transforms: Sequence[ImageProcessing]):
+        self.transforms = list(transforms)
+
+    def apply(self, img):
+        for t in self.transforms:
+            img = t.apply(img)
+        return img
+
+    def __rshift__(self, other):
+        return ChainedPreprocessing(self.transforms + [other])
+
+
+class ImageResize(ImageProcessing):
+    """`ImageResize` (bilinear, W×H)."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = resize_h, resize_w
+
+    def apply(self, img):
+        _require_cv2()
+        return cv2.resize(img, (self.w, self.h),
+                          interpolation=cv2.INTER_LINEAR)
+
+
+class ImageCenterCrop(ImageProcessing):
+    def __init__(self, crop_h: int, crop_w: int):
+        self.h, self.w = crop_h, crop_w
+
+    def apply(self, img):
+        H, W = img.shape[:2]
+        if H < self.h or W < self.w:
+            raise ValueError(f"Image {H}x{W} smaller than crop "
+                             f"{self.h}x{self.w}")
+        y0 = (H - self.h) // 2
+        x0 = (W - self.w) // 2
+        return img[y0:y0 + self.h, x0:x0 + self.w]
+
+
+class ImageRandomCrop(ImageProcessing):
+    def __init__(self, crop_h: int, crop_w: int, seed: Optional[int] = None):
+        self.h, self.w = crop_h, crop_w
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, img):
+        H, W = img.shape[:2]
+        if H < self.h or W < self.w:
+            raise ValueError(f"Image {H}x{W} smaller than crop "
+                             f"{self.h}x{self.w}")
+        y0 = self.rng.randint(0, H - self.h + 1)
+        x0 = self.rng.randint(0, W - self.w + 1)
+        return img[y0:y0 + self.h, x0:x0 + self.w]
+
+
+class ImageHFlip(ImageProcessing):
+    """Horizontal flip with probability p (`ImageHFlip`)."""
+
+    def __init__(self, p: float = 0.5, seed: Optional[int] = None):
+        self.p = p
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, img):
+        if self.rng.rand() < self.p:
+            return img[:, ::-1].copy()
+        return img
+
+
+class ImageBrightness(ImageProcessing):
+    """Additive brightness jitter in [delta_low, delta_high]
+    (`ImageBrightness`)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0,
+                 seed: Optional[int] = None):
+        self.low, self.high = delta_low, delta_high
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, img):
+        return img.astype(np.float32) + self.rng.uniform(self.low, self.high)
+
+
+class ImageChannelNormalize(ImageProcessing):
+    """(x - mean) / std per channel (`ImageChannelNormalize`)."""
+
+    def __init__(self, mean_r: float, mean_g: float, mean_b: float,
+                 std_r: float = 1.0, std_g: float = 1.0, std_b: float = 1.0):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+
+    def apply(self, img):
+        return (img.astype(np.float32) - self.mean) / self.std
+
+
+class ImageMatToTensor(ImageProcessing):
+    """To float32; NHWC stays native (TPU conv layout) unless
+    format='NCHW' requested (`ImageMatToTensor` toChw)."""
+
+    def __init__(self, format: str = "NHWC"):
+        self.format = format
+
+    def apply(self, img):
+        img = img.astype(np.float32)
+        if self.format == "NCHW":
+            return np.transpose(img, (2, 0, 1))
+        return img
+
+
+class ImageSet:
+    """Collection of images + optional labels (`ImageSet.scala:368`
+    read/transform surface), sharded like XShards."""
+
+    def __init__(self, images: List[np.ndarray],
+                 labels: Optional[np.ndarray] = None,
+                 paths: Optional[List[str]] = None):
+        self.images = images
+        self.labels = labels
+        self.paths = paths
+
+    @staticmethod
+    def read(path: str, with_label: bool = False,
+             one_based_label: bool = True) -> "ImageSet":
+        """Read image file/dir (optionally `dir/<class>/img.jpg` layout for
+        labels, like `ImageSet.read` + label resolution)."""
+        if os.path.isdir(path):
+            files = sorted(glob.glob(os.path.join(path, "**", "*.*"),
+                                     recursive=True))
+            files = [f for f in files if f.rsplit(".", 1)[-1].lower() in
+                     ("jpg", "jpeg", "png", "bmp")]
+        else:
+            files = [path]
+        if not files:
+            raise FileNotFoundError(f"No images under {path}")
+        _require_cv2()
+        images = [cv2.cvtColor(cv2.imread(f), cv2.COLOR_BGR2RGB)
+                  for f in files]
+        labels = None
+        if with_label:
+            classes = sorted({os.path.basename(os.path.dirname(f))
+                              for f in files})
+            base = 1 if one_based_label else 0
+            cls_idx = {c: i + base for i, c in enumerate(classes)}
+            labels = np.array([cls_idx[os.path.basename(os.path.dirname(f))]
+                               for f in files], np.int32)
+        return ImageSet(images, labels, files)
+
+    def transform(self, transformer: ImageProcessing) -> "ImageSet":
+        return ImageSet([transformer(im) for im in self.images],
+                        self.labels, self.paths)
+
+    def to_dataset(self, batch_size: int = -1, batch_per_thread: int = -1):
+        from analytics_zoo_tpu.data.dataset import TPUDataset
+        x = np.stack(self.images)
+        return TPUDataset(x, self.labels, batch_size, batch_per_thread)
+
+    def __len__(self):
+        return len(self.images)

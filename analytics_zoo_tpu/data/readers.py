@@ -1,0 +1,81 @@
+"""File readers producing XShards — orca's `zoo.orca.data.pandas` surface.
+
+`read_csv`/`read_json` mirror `orca/data/pandas/preprocessing.py:26-120`
+(file-or-directory paths, per-file shards, pandas backend per the
+`OrcaContext.pandas_read_backend` flag); `read_parquet` covers the parquet
+image-dataset reader (`orca/data/image/parquet_dataset.py`). Each file (or
+row-group) becomes one shard so preprocessing parallelizes like the
+reference's per-partition reads.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, List, Optional, Sequence
+
+from analytics_zoo_tpu.data.shards import XShards
+
+
+def _expand(file_path: str, extensions: Sequence[str]) -> List[str]:
+    if os.path.isdir(file_path):
+        files = sorted(
+            f for f in glob.glob(os.path.join(file_path, "*"))
+            if f.rsplit(".", 1)[-1].lower() in extensions)
+    elif any(ch in file_path for ch in "*?["):
+        files = sorted(glob.glob(file_path))
+    else:
+        files = [file_path]
+    if not files:
+        raise FileNotFoundError(f"No input files under {file_path}")
+    return files
+
+
+def read_csv(file_path: str, num_shards: Optional[int] = None,
+             **kwargs) -> XShards:
+    """Read csv file/dir/glob into XShards of pandas DataFrames
+    (`zoo.orca.data.pandas.read_csv`)."""
+    import pandas as pd
+    files = _expand(file_path, ("csv",))
+    shards = [pd.read_csv(f, **kwargs) for f in files]
+    out = XShards(shards)
+    if num_shards and num_shards != out.num_partitions():
+        out = _repartition_df(out, num_shards)
+    return out
+
+
+def read_json(file_path: str, num_shards: Optional[int] = None,
+              **kwargs) -> XShards:
+    import pandas as pd
+    files = _expand(file_path, ("json", "jsonl"))
+    shards = [pd.read_json(f, **kwargs) for f in files]
+    out = XShards(shards)
+    if num_shards and num_shards != out.num_partitions():
+        out = _repartition_df(out, num_shards)
+    return out
+
+
+def read_parquet(file_path: str, columns: Optional[Sequence[str]] = None,
+                 num_shards: Optional[int] = None) -> XShards:
+    """Parquet → XShards, one shard per row-group/file
+    (`orca/data/image/parquet_dataset.py` read side)."""
+    import pandas as pd
+    import pyarrow.parquet as pq
+    files = _expand(file_path, ("parquet", "pq"))
+    shards = []
+    for f in files:
+        pf = pq.ParquetFile(f)
+        for rg in range(pf.num_row_groups):
+            shards.append(pf.read_row_group(rg, columns=columns).to_pandas())
+    out = XShards(shards)
+    if num_shards and num_shards != out.num_partitions():
+        out = _repartition_df(out, num_shards)
+    return out
+
+
+def _repartition_df(shards: XShards, n: int) -> XShards:
+    import numpy as np
+    import pandas as pd
+    df = pd.concat(shards.collect(), ignore_index=True)
+    parts = np.array_split(np.arange(len(df)), n)
+    return XShards([df.iloc[idx].reset_index(drop=True) for idx in parts])

@@ -1,0 +1,302 @@
+"""Data layer tests (reference pattern: pyzoo/test/zoo/orca/data with tiny
+file fixtures generated on the fly)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data import (FeatureSet, TPUDataset, XShards, read_csv,
+                                    read_json, read_parquet)
+from analytics_zoo_tpu.data.image import (ImageBrightness, ImageCenterCrop,
+                                          ImageChannelNormalize, ImageHFlip,
+                                          ImageMatToTensor, ImageRandomCrop,
+                                          ImageResize, ImageSet)
+from analytics_zoo_tpu.data.minibatch import (PaddingParam, batch_samples,
+                                              pad_sequences)
+from analytics_zoo_tpu.data.text import TextSet, load_glove
+
+
+class TestXShards:
+    def test_partition_and_collect(self):
+        data = {"x": np.arange(20).reshape(10, 2), "y": np.arange(10)}
+        shards = XShards.partition(data, 4)
+        assert shards.num_partitions() == 4
+        assert len(shards) == 10
+        merged = shards.to_numpy()
+        np.testing.assert_array_equal(merged["x"], data["x"])
+
+    def test_transform_shard(self):
+        shards = XShards.partition(np.arange(8.0), 2)
+        doubled = shards.transform_shard(lambda a: a * 2)
+        np.testing.assert_array_equal(doubled.to_numpy(), np.arange(8.0) * 2)
+        par = shards.transform_shard(lambda a: a + 1, parallel=True)
+        np.testing.assert_array_equal(par.to_numpy(), np.arange(8.0) + 1)
+
+    def test_repartition(self):
+        shards = XShards.partition(np.arange(12), 3).repartition(4)
+        assert shards.num_partitions() == 4
+        np.testing.assert_array_equal(shards.to_numpy(), np.arange(12))
+
+    def test_partition_by_and_zip(self):
+        import pandas as pd
+        df = pd.DataFrame({"k": [1, 2, 1, 2, 3], "v": range(5)})
+        shards = XShards([df.iloc[:2], df.iloc[2:]])
+        byk = shards.partition_by("k", 2)
+        assert byk.num_partitions() == 2
+        # all rows of one key land in exactly one partition
+        for key in (1, 2, 3):
+            holders = [i for i, part in enumerate(byk.collect())
+                       if (part["k"] == key).any()]
+            assert len(holders) == 1
+        assert sum(len(p) for p in byk.collect()) == 5
+        z = shards.zip(shards)
+        assert z.num_partitions() == 2
+
+    def test_repartition_dataframe_keeps_schema(self):
+        import pandas as pd
+        df = pd.DataFrame({"a": range(6), "b": [f"s{i}" for i in range(6)]})
+        shards = XShards([df.iloc[:3], df.iloc[3:]]).repartition(3)
+        assert shards.num_partitions() == 3
+        for part in shards.collect():
+            assert list(part.columns) == ["a", "b"]
+            assert part["b"].dtype == df["b"].dtype
+
+    def test_mismatched_lengths_rejected(self):
+        with pytest.raises(ValueError, match="leading dim"):
+            XShards.partition({"x": np.arange(5), "y": np.arange(4)}, 2)
+
+    def test_save_load_pickle(self, tmp_path):
+        shards = XShards.partition(np.arange(6), 2)
+        p = str(tmp_path / "shards.pkl")
+        shards.save_pickle(p)
+        back = XShards.load_pickle(p)
+        np.testing.assert_array_equal(back.to_numpy(), np.arange(6))
+
+
+class TestReaders:
+    def test_read_csv_dir(self, tmp_path):
+        import pandas as pd
+        for i in range(3):
+            pd.DataFrame({"a": [i, i + 1], "b": [0.5, 1.5]}).to_csv(
+                tmp_path / f"part{i}.csv", index=False)
+        shards = read_csv(str(tmp_path))
+        assert shards.num_partitions() == 3
+        assert len(shards) == 6
+        two = read_csv(str(tmp_path), num_shards=2)
+        assert two.num_partitions() == 2 and len(two) == 6
+
+    def test_read_json(self, tmp_path):
+        import pandas as pd
+        pd.DataFrame({"a": [1, 2]}).to_json(tmp_path / "d.json")
+        shards = read_json(str(tmp_path / "d.json"))
+        assert len(shards) == 2
+
+    def test_read_parquet(self, tmp_path):
+        import pandas as pd
+        df = pd.DataFrame({"a": np.arange(10), "b": np.arange(10) * 1.5})
+        df.to_parquet(tmp_path / "d.parquet")
+        shards = read_parquet(str(tmp_path / "d.parquet"))
+        assert len(shards) == 10
+        np.testing.assert_array_equal(shards.to_numpy()["a"], np.arange(10))
+
+    def test_missing_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            read_csv("/nonexistent/dir/data.csv")
+
+
+class TestTPUDataset:
+    def test_both_batch_args_rejected(self):
+        with pytest.raises(ValueError, match="simultaneously"):
+            TPUDataset(np.zeros((4, 2)), batch_size=4, batch_per_thread=2)
+
+    def test_global_batch_contract(self):
+        ds = TPUDataset.from_ndarrays((np.zeros((64, 2)), np.zeros(64)),
+                                      batch_size=32)
+        assert ds.global_batch(8) == 32
+        with pytest.raises(ValueError, match="multiple"):
+            ds.global_batch(5)
+        per = TPUDataset.from_ndarrays(np.zeros((64, 2)), batch_per_thread=4)
+        assert per.global_batch(8) == 32
+
+    def test_from_xshards(self):
+        shards = XShards.partition(
+            {"x": np.arange(16).reshape(8, 2), "y": np.arange(8)}, 2)
+        ds = TPUDataset.from_xshards(shards, batch_size=4)
+        assert ds.n_samples() == 8
+        batches = list(ds.iter_train(1))
+        assert len(batches) == 2
+        with pytest.raises(ValueError, match="x"):
+            TPUDataset.from_xshards(XShards.partition(np.arange(4), 2))
+
+    def test_from_dataframe(self):
+        import pandas as pd
+        df = pd.DataFrame({"f": [np.array([1.0, 2.0])] * 4,
+                           "l": [0, 1, 0, 1]})
+        ds = TPUDataset.from_dataframe(df, ["f"], ["l"], batch_size=2)
+        assert ds.x.shape == (4, 2)
+        assert ds.y.shape == (4,)
+
+
+class TestFeatureSet:
+    @pytest.mark.parametrize("memory_type", ["DRAM", "DISK",
+                                             "DISK_AND_DRAM(50)", "PMEM"])
+    def test_tiers_roundtrip(self, memory_type, tmp_path):
+        data = {"x": np.arange(40).reshape(20, 2).astype(np.float32),
+                "y": np.arange(20, dtype=np.int32)}
+        fs = FeatureSet(data, memory_type=memory_type,
+                        cache_dir=str(tmp_path))
+        assert len(fs) == 20
+        got = fs.take(np.arange(20))
+        np.testing.assert_array_equal(got["x"], data["x"])
+        np.testing.assert_array_equal(got["y"], data["y"])
+        # shuffled batch iteration covers all rows
+        seen = []
+        for batch in fs.iter_batches(5, shuffle=True, seed=1):
+            seen.extend(batch["y"].tolist())
+        assert sorted(seen) == list(range(20))
+
+    def test_bad_tier_rejected(self):
+        with pytest.raises(ValueError, match="memory_type"):
+            FeatureSet({"x": np.arange(4)}, memory_type="GPU_HBM")
+
+    def test_to_dataset(self):
+        fs = FeatureSet({"x": np.zeros((8, 2)), "y": np.zeros(8)})
+        ds = fs.to_dataset(batch_size=4)
+        assert ds.n_samples() == 8
+
+    def test_disk_tier_dataset_is_lazy(self, tmp_path):
+        fs = FeatureSet({"x": np.arange(32).reshape(16, 2).astype(np.float32),
+                         "y": np.arange(16, dtype=np.int32)},
+                        memory_type="DISK", cache_dir=str(tmp_path))
+        ds = fs.to_dataset(batch_size=4)
+        assert ds.x is None  # not materialized
+        assert ds.n_samples() == 16
+        seen = []
+        for xb, yb, real in ds.iter_train(data_parallel=1, seed=0):
+            assert xb.shape == (4, 2)
+            seen.extend(yb.tolist())
+        assert sorted(seen) == list(range(16))
+
+    def test_shared_cache_dir_isolated(self, tmp_path):
+        a = FeatureSet({"x": np.ones((8, 2), np.float32)},
+                       memory_type="DISK", cache_dir=str(tmp_path))
+        b = FeatureSet({"x": np.zeros((8, 2), np.float32)},
+                       memory_type="DISK", cache_dir=str(tmp_path))
+        np.testing.assert_array_equal(a.take(np.arange(8))["x"], 1.0)
+        np.testing.assert_array_equal(b.take(np.arange(8))["x"], 0.0)
+
+
+class TestMiniBatch:
+    def test_batch_uniform(self):
+        samples = [{"x": np.ones((3,)), "y": np.array(1)} for _ in range(4)]
+        b = batch_samples(samples)
+        assert b["x"].shape == (4, 3)
+        assert b["y"].shape == (4,)
+
+    def test_ragged_padding_to_max(self):
+        samples = [np.arange(2), np.arange(4), np.arange(3)]
+        b = batch_samples(samples, PaddingParam(value=-1))
+        assert b.shape == (3, 4)
+        np.testing.assert_array_equal(b[0], [0, 1, -1, -1])
+
+    def test_fixed_length_padding(self):
+        samples = [np.arange(2), np.arange(3)]
+        b = batch_samples(samples, PaddingParam(value=0, fixed_length=[6]))
+        assert b.shape == (2, 6)
+        with pytest.raises(ValueError, match="exceeds"):
+            batch_samples([np.arange(9)], PaddingParam(fixed_length=[4]))
+
+    def test_pad_sequences_modes(self):
+        out = pad_sequences([[1, 2, 3], [4]], maxlen=2, truncating="pre")
+        np.testing.assert_array_equal(out, [[2, 3], [4, 0]])
+        out = pad_sequences([[1, 2, 3]], maxlen=2, truncating="post")
+        np.testing.assert_array_equal(out, [[1, 2]])
+        out = pad_sequences([[5]], maxlen=3, padding="pre")
+        np.testing.assert_array_equal(out, [[0, 0, 5]])
+
+
+class TestImagePipeline:
+    def _img(self, h=32, w=32):
+        rs = np.random.RandomState(0)
+        return rs.randint(0, 255, (h, w, 3)).astype(np.uint8)
+
+    def test_transform_chain(self):
+        pipeline = (ImageResize(24, 24) >> ImageCenterCrop(16, 16)
+                    >> ImageChannelNormalize(127.0, 127.0, 127.0, 128.0,
+                                             128.0, 128.0)
+                    >> ImageMatToTensor())
+        out = pipeline(self._img())
+        assert out.shape == (16, 16, 3)
+        assert out.dtype == np.float32
+        assert abs(float(out.mean())) < 1.5
+
+    def test_random_ops_and_flip(self):
+        img = self._img()
+        crop = ImageRandomCrop(16, 16, seed=0)(img)
+        assert crop.shape == (16, 16, 3)
+        flipped = ImageHFlip(p=1.0)(img)
+        np.testing.assert_array_equal(flipped, img[:, ::-1])
+        bright = ImageBrightness(10, 10)(img)
+        np.testing.assert_allclose(bright, img.astype(np.float32) + 10)
+
+    def test_imageset_read_with_labels(self, tmp_path):
+        import cv2
+        for cls in ("cats", "dogs"):
+            os.makedirs(tmp_path / cls)
+            for i in range(2):
+                cv2.imwrite(str(tmp_path / cls / f"{i}.png"), self._img())
+        iset = ImageSet.read(str(tmp_path), with_label=True)
+        assert len(iset) == 4
+        assert sorted(np.unique(iset.labels)) == [1, 2]
+        resized = iset.transform(ImageResize(8, 8))
+        ds = resized.to_dataset(batch_size=2)
+        assert ds.x.shape == (4, 8, 8, 3)
+        assert ds.y.shape == (4,)
+
+    def test_nchw_option(self):
+        out = ImageMatToTensor(format="NCHW")(self._img())
+        assert out.shape == (3, 32, 32)
+
+
+class TestTextPipeline:
+    def test_full_pipeline(self):
+        texts = ["Hello world hello", "JAX on TPU, hello TPU"]
+        ts = (TextSet.from_texts(texts, [0, 1])
+              .tokenize().normalize()
+              .word2idx()
+              .shape_sequence(len=6))
+        x, y = ts.generate_sample()
+        assert x.shape == (2, 6)
+        assert y.tolist() == [0, 1]
+        wi = ts.get_word_index()
+        assert wi["hello"] >= 1  # most frequent word present
+        assert 0 not in wi.values()  # 0 reserved for padding
+
+    def test_word2idx_knobs(self):
+        texts = ["a a a b b c"]
+        ts = TextSet.from_texts(texts).tokenize().normalize()
+        ts.word2idx(remove_topN=1)  # drop "a"
+        assert "a" not in ts.get_word_index()
+        ts2 = TextSet.from_texts(texts).tokenize().normalize()
+        ts2.word2idx(min_freq=2)
+        assert "c" not in ts2.get_word_index()
+        ts3 = TextSet.from_texts(texts).tokenize().normalize()
+        ts3.word2idx(existing_map={"b": 1})
+        x, _ = ts3.shape_sequence(len=4).generate_sample()
+        assert set(x.flatten().tolist()) <= {0, 1}
+
+    def test_glove_loading(self, tmp_path):
+        p = tmp_path / "glove.txt"
+        p.write_text("hello 0.1 0.2\nworld 0.3 0.4\n")
+        mat = load_glove(str(p), {"hello": 1, "world": 2}, dim=2)
+        assert mat.shape == (3, 2)
+        np.testing.assert_allclose(mat[1], [0.1, 0.2])
+        np.testing.assert_allclose(mat[0], 0.0)  # pad row
+
+    def test_pipeline_order_enforced(self):
+        ts = TextSet.from_texts(["abc"])
+        with pytest.raises(ValueError, match="tokenize"):
+            ts.normalize()
+        with pytest.raises(ValueError, match="shape_sequence"):
+            TextSet.from_texts(["a"]).tokenize().word2idx().generate_sample()
