@@ -34,6 +34,7 @@ class TPUDataset:
         self.batch_size = batch_size
         self.batch_per_thread = batch_per_thread
         self.shuffle = shuffle
+        self.val: Optional["TPUDataset"] = None  # optional validation split
 
     # -- constructors (`TFDataset.from_*`) ---------------------------------
     @staticmethod
@@ -56,8 +57,6 @@ class TPUDataset:
             ds.val = TPUDataset.from_ndarrays(
                 val_tensors, batch_size=batch_size,
                 batch_per_thread=batch_per_thread, shuffle=False)
-        else:
-            ds.val = None
         return ds
 
     @staticmethod

@@ -40,7 +40,7 @@ def read_csv(file_path: str, num_shards: Optional[int] = None,
     shards = [pd.read_csv(f, **kwargs) for f in files]
     out = XShards(shards)
     if num_shards and num_shards != out.num_partitions():
-        out = _repartition_df(out, num_shards)
+        out = out.repartition(num_shards)
     return out
 
 
@@ -51,7 +51,7 @@ def read_json(file_path: str, num_shards: Optional[int] = None,
     shards = [pd.read_json(f, **kwargs) for f in files]
     out = XShards(shards)
     if num_shards and num_shards != out.num_partitions():
-        out = _repartition_df(out, num_shards)
+        out = out.repartition(num_shards)
     return out
 
 
@@ -69,13 +69,5 @@ def read_parquet(file_path: str, columns: Optional[Sequence[str]] = None,
             shards.append(pf.read_row_group(rg, columns=columns).to_pandas())
     out = XShards(shards)
     if num_shards and num_shards != out.num_partitions():
-        out = _repartition_df(out, num_shards)
+        out = out.repartition(num_shards)
     return out
-
-
-def _repartition_df(shards: XShards, n: int) -> XShards:
-    import numpy as np
-    import pandas as pd
-    df = pd.concat(shards.collect(), ignore_index=True)
-    parts = np.array_split(np.arange(len(df)), n)
-    return XShards([df.iloc[idx].reset_index(drop=True) for idx in parts])

@@ -800,13 +800,28 @@ class LSTM(_Recurrent):
 
 
 class GRU(_Recurrent):
-    """Gate order z, r, h (Keras convention)."""
+    """Gate order z, r, h (Keras convention). `reset_after=True` applies the
+    recurrent bias inside the reset gate product (torch/CuDNN semantics),
+    needed for exact torch-weight conversion."""
     n_gates = 3
+
+    def __init__(self, *args, reset_after: bool = False, **kw):
+        super().__init__(*args, **kw)
+        self.reset_after = reset_after
+
+    def build(self, rng, input_shape):
+        p = super().build(rng, input_shape)
+        if self.reset_after:
+            p["recurrent_bias"] = jnp.zeros(
+                (self.n_gates * self.output_dim,), jnp.float32)
+        return p
 
     def step(self, params, h, x_t):
         d = self.output_dim
         xz = x_t @ params["kernel"] + params["bias"]
         hz = h @ params["recurrent"]
+        if self.reset_after:
+            hz = hz + params["recurrent_bias"]
         z = self.inner_activation(xz[:, :d] + hz[:, :d])
         r = self.inner_activation(xz[:, d:2 * d] + hz[:, d:2 * d])
         hh = self.activation(xz[:, 2 * d:] + r * hz[:, 2 * d:])

@@ -1,0 +1,249 @@
+"""Orca-style unified Estimator — the north-star `zoo.orca.learn` entry point.
+
+Mirrors the surface of `Estimator.from_graph/from_keras/from_torch`
+(`orca/learn/tf/estimator.py:148`, `orca/learn/tf2/tf_ray_estimator.py:183`,
+`orca/learn/pytorch/estimator.py:50`) and the engine-agnostic Scala Estimator
+(`zoo/.../pipeline/estimator/Estimator.scala:68`). One implementation instead
+of the reference's five per-engine wrappers: everything lowers to the same
+pjit'd train loop (`learn/trainer.py`).
+
+- `from_keras(model)` — a `analytics_zoo_tpu.keras` model (Sequential/Model).
+- `from_fn(forward_fn, init_fn, loss, optimizer)` — the `from_graph`
+  analogue: a pure forward function + parameter initializer.
+- `from_torch(model, loss, optimizer)` — converts a torch.nn module's
+  architecture+weights to the native layer library (the reference instead
+  embeds CPython in the JVM via JEP, `TorchModel.scala:34`; on TPU the model
+  must become an XLA program, so conversion replaces embedding).
+
+Failure handling reproduces `InternalDistriOptimizer.train`'s retry loop
+(`Topology.scala:1255-1337`): on a training exception, reload the latest
+snapshot and resume, up to `retry_times` failures within a sliding window.
+
+Data: accepts TPUDataset, XShards of {"x","y"}, (x, y) ndarrays, pandas
+DataFrame (+feature/label cols) — the `to_dataset` conversion surface
+(`orca/learn/tf/estimator.py:225-276`).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.common import triggers as tg
+from analytics_zoo_tpu.common.context import get_context
+from analytics_zoo_tpu.data.dataset import TPUDataset
+from analytics_zoo_tpu.data.shards import XShards
+from analytics_zoo_tpu.keras.engine import KerasNet
+from analytics_zoo_tpu.learn import checkpoint as ckpt_mod
+from analytics_zoo_tpu.learn import trainer
+
+log = logging.getLogger("analytics_zoo_tpu.estimator")
+
+
+def to_dataset(data, batch_size: int = -1, batch_per_thread: int = -1,
+               feature_cols: Optional[Sequence[str]] = None,
+               label_cols: Optional[Sequence[str]] = None) -> TPUDataset:
+    """Normalize any supported data form into a TPUDataset."""
+    if isinstance(data, TPUDataset):
+        return data
+    if isinstance(data, XShards):
+        return TPUDataset.from_xshards(data, batch_size, batch_per_thread)
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            if not feature_cols:
+                raise ValueError("DataFrame input needs feature_cols")
+            return TPUDataset.from_dataframe(data, feature_cols, label_cols,
+                                             batch_size, batch_per_thread)
+    except ImportError:
+        pass
+    return TPUDataset.from_ndarrays(data, batch_size, batch_per_thread)
+
+
+class Estimator:
+    """Unified estimator facade (`orca/learn/base_estimator.py:43`)."""
+
+    def __init__(self, model: KerasNet, model_dir: Optional[str] = None):
+        self.model = model
+        self.model_dir = model_dir
+        self._load_ckpt: Optional[Tuple[str, Optional[int]]] = None
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_keras(keras_model: KerasNet, model_dir: Optional[str] = None,
+                   optimizer=None, loss=None, metrics=None) -> "Estimator":
+        """`Estimator.from_keras`. The model may already be compiled; compile
+        args given here override."""
+        if optimizer is not None or loss is not None:
+            keras_model.compile(optimizer or "adam", loss or "mse", metrics)
+        return Estimator(keras_model, model_dir)
+
+    @staticmethod
+    def from_fn(forward_fn: Callable, init_fn: Callable,
+                loss, optimizer, metrics=None,
+                model_dir: Optional[str] = None) -> "Estimator":
+        """`from_graph` analogue: forward_fn(params, x, training, rng) plus
+        init_fn(rng, input_shape)->params."""
+        model = _FnModel(forward_fn, init_fn)
+        model.compile(optimizer, loss, metrics)
+        return Estimator(model, model_dir)
+
+    @staticmethod
+    def from_torch(model, loss=None, optimizer=None, metrics=None,
+                   model_dir: Optional[str] = None) -> "Estimator":
+        """Convert a torch.nn module (Sequential-style) into the native layer
+        library, carrying its trained weights. Supported: Linear, Conv2d,
+        ReLU/Tanh/Sigmoid/Softmax/GELU, MaxPool2d/AvgPool2d, Flatten,
+        Dropout, BatchNorm1d/2d, Embedding, LSTM/GRU (single layer)."""
+        from analytics_zoo_tpu.learn.torch_bridge import convert_torch_module
+        native = convert_torch_module(model)
+        native.compile(optimizer or "adam", loss or "mse", metrics)
+        return Estimator(native, model_dir)
+
+    # -- training with retry/resume ---------------------------------------
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
+            validation_data=None, checkpoint_trigger=None,
+            feature_cols=None, label_cols=None, seed: int = 0
+            ) -> Dict[str, List[float]]:
+        ds = to_dataset(data, batch_size=batch_size or 32,
+                        feature_cols=feature_cols, label_cols=label_cols)
+        # a pre-built TPUDataset's own batch/shuffle settings win over fit()
+        # defaults (the dataset carries the contract, `tf_dataset.py:116`)
+        if ds.batch_size != -1:
+            batch_size = ds.batch_size
+        elif batch_size is None:
+            batch_size = 32
+        dp = get_context().mesh.data_parallel_size
+        lazy = ds.x is None  # disk-tier FeatureSet bridge
+        batch_iter_factory = (
+            (lambda epoch: ds.iter_train(dp, seed=seed + epoch))
+            if lazy else None)
+
+        val = None
+        if validation_data is not None:
+            vds = to_dataset(validation_data, batch_size=batch_size,
+                             feature_cols=feature_cols, label_cols=label_cols)
+            val = (vds.x, vds.y)
+        elif ds.val is not None:
+            val = (ds.val.x, ds.val.y)
+
+        cfg = get_context().config
+        if self.model_dir:
+            self.model.set_checkpoint(self.model_dir)
+        if self._load_ckpt is not None:
+            self._restore(*self._load_ckpt)
+            self._load_ckpt = None
+
+        failures: List[float] = []
+        epoch_done = getattr(self, "_resume_epoch", 0)
+        history: Dict[str, List[float]] = {}
+        while epoch_done < epochs:
+            try:
+                h = trainer.fit_keras(
+                    self.model, ds.x, ds.y, batch_size=batch_size,
+                    epochs=epochs - epoch_done, validation_data=val,
+                    shuffle=ds.shuffle,
+                    checkpoint_trigger=checkpoint_trigger,
+                    seed=seed + epoch_done,
+                    batch_iter_factory=batch_iter_factory)
+                for k, v in h.items():
+                    history.setdefault(k, []).extend(v)
+                break
+            except (KeyboardInterrupt, jax.errors.JaxRuntimeError):
+                raise
+            except ValueError:
+                raise  # config errors are not retryable (IllegalArgument)
+            except Exception as e:  # noqa: BLE001 — retry semantics
+                now = time.time()
+                failures = [t for t in failures
+                            if now - t < cfg.failure.retry_time_interval_s]
+                failures.append(now)
+                if len(failures) > cfg.failure.retry_times:
+                    log.error("Exceeded %d failures within %ds window; "
+                              "giving up", cfg.failure.retry_times,
+                              cfg.failure.retry_time_interval_s)
+                    raise
+                log.warning("Training failure (%s: %s); restoring latest "
+                            "snapshot and retrying (%d/%d)",
+                            type(e).__name__, e, len(failures),
+                            cfg.failure.retry_times)
+                epoch_done = self._restore_latest() or epoch_done
+        self._resume_epoch = 0
+        return history
+
+    def _restore_latest(self) -> Optional[int]:
+        if not self.model_dir:
+            return None
+        found = ckpt_mod.latest_checkpoint(self.model_dir)
+        if found is None:
+            return None
+        params, _, meta = ckpt_mod.load_checkpoint(self.model_dir)
+        self.model.params = self.model._remap_loaded(params)
+        return int(meta.get("epoch", 0)) if meta else None
+
+    def _restore(self, path: str, version: Optional[int]):
+        params, _, meta = ckpt_mod.load_checkpoint(path, version)
+        # remap saved layer names onto this instance's auto-generated names
+        # (save order == stack order; the pytree store preserves dict order)
+        self.model.params = self.model._remap_loaded(params)
+        self._resume_epoch = int(meta.get("epoch", 0)) if meta else 0
+
+    # -- inference ---------------------------------------------------------
+    def predict(self, data, batch_per_thread: int = 32, feature_cols=None
+                ) -> np.ndarray:
+        ds = to_dataset(data, batch_per_thread=batch_per_thread,
+                        feature_cols=feature_cols)
+        preds = self.model.predict(ds.x, batch_per_thread=batch_per_thread)
+        return preds
+
+    def evaluate(self, data, batch_per_thread: int = 32, metrics=None,
+                 feature_cols=None, label_cols=None) -> Dict[str, float]:
+        ds = to_dataset(data, batch_per_thread=batch_per_thread,
+                        feature_cols=feature_cols, label_cols=label_cols)
+        from analytics_zoo_tpu.ops import metrics as zmetrics
+        ms = zmetrics.resolve(metrics) if metrics else None
+        return self.model.evaluate(ds.x, ds.y,
+                                   batch_per_thread=batch_per_thread,
+                                   metrics=ms)
+
+    # -- persistence (`orca` save/load + load_orca_checkpoint) ------------
+    def get_model(self):
+        return self.model
+
+    def save(self, path: str) -> str:
+        self.model.save_weights(path)
+        return path
+
+    def load(self, path: str) -> "Estimator":
+        self.model.load_weights(path)
+        return self
+
+    def load_orca_checkpoint(self, path: str,
+                             version: Optional[int] = None) -> "Estimator":
+        """Resume from a `model.<version>` checkpoint
+        (`orca/learn/tf/estimator.py:125` semantics; version=None → latest)."""
+        self._load_ckpt = (path, version)
+        return self
+
+
+class _FnModel(KerasNet):
+    """Adapter: pure forward/init functions behave like a KerasNet so the
+    shared trainer drives them (the `from_graph` lowering)."""
+
+    def __init__(self, forward_fn: Callable, init_fn: Callable):
+        super().__init__()
+        self.forward_fn = forward_fn
+        self.init_fn = init_fn
+
+    def build(self, rng, input_shape):
+        return self.init_fn(rng, input_shape)
+
+    def apply(self, params, inputs, *, training=False, rng=None):
+        return self.forward_fn(params, inputs, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        return None

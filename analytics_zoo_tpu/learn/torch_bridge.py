@@ -1,0 +1,208 @@
+"""torch.nn → native layer conversion for `Estimator.from_torch`.
+
+The reference runs PyTorch *inside* executor JVMs through JEP, flattening
+weights into a JVM tensor for allreduce (`pipeline/api/net/TorchModel.scala:
+34-77`, `TorchOptim.scala:41`). On TPU a torch module cannot execute in the
+hot path — the model must lower to XLA — so the bridge converts supported
+architectures (module tree + trained weights) into the native layer library
+once, after which training/inference is pure jax. Weight layout notes:
+
+- torch Linear stores [out, in] → transposed to [in, out] kernels;
+- torch Conv2d stores [out, in, kh, kw] (NCHW) → HWIO kernels, NHWC layout
+  (inputs are transposed by the inserted dim_ordering="th" conv);
+- LSTM/GRU gate order is remapped (torch i,f,g,o == keras i,f,c,o; torch GRU
+  r,z,n → keras z,r,h).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.keras.engine import Sequential
+
+
+def convert_torch_module(module) -> Sequential:
+    import torch.nn as nn
+
+    layers = _convert(module)
+    model = Sequential(layers)
+    return model
+
+
+def _convert(module) -> List:
+    import torch.nn as nn
+
+    if isinstance(module, nn.Sequential):
+        out = []
+        for child in module:
+            out.extend(_convert(child))
+        return out
+
+    if isinstance(module, nn.Linear):
+        layer = L.Dense(module.out_features,
+                        use_bias=module.bias is not None,
+                        input_shape=(module.in_features,))
+        w = module.weight.detach().numpy().T.copy()
+        params = {"kernel": w}
+        if module.bias is not None:
+            params["bias"] = module.bias.detach().numpy().copy()
+        return [_with_weights(layer, params)]
+
+    if isinstance(module, nn.Conv2d):
+        # 'same' is only equivalent to torch's symmetric padding when
+        # pad == k//2 with odd kernels and stride 1
+        pad = module.padding
+        if pad == "same":
+            same = True
+        elif pad in ((0, 0), 0, "valid"):
+            same = False
+        elif (isinstance(pad, tuple)
+              and all(p == k // 2 and k % 2 == 1
+                      for p, k in zip(pad, module.kernel_size))
+              and tuple(module.stride) == (1, 1)):
+            same = True
+        else:
+            raise ValueError(
+                f"Unsupported Conv2d padding {pad} for kernel "
+                f"{module.kernel_size} stride {module.stride}: only valid "
+                "(0) or exact-same (pad=k//2, odd k, stride 1) convert")
+        layer = L.Convolution2D(
+            module.out_channels, module.kernel_size[0], module.kernel_size[1],
+            subsample=module.stride, border_mode="same" if same else "valid",
+            dim_ordering="th", use_bias=module.bias is not None)
+        w = module.weight.detach().numpy()            # [O, I, H, W]
+        params = {"kernel": np.transpose(w, (2, 3, 1, 0)).copy()}  # HWIO
+        if module.bias is not None:
+            params["bias"] = module.bias.detach().numpy().copy()
+        return [_with_weights(layer, params)]
+
+    if isinstance(module, (nn.MaxPool2d, nn.AvgPool2d)):
+        if module.padding not in (0, (0, 0)):
+            raise ValueError("Pooling with padding does not convert")
+        if getattr(module, "ceil_mode", False):
+            raise ValueError("Pooling with ceil_mode does not convert")
+        if getattr(module, "dilation", 1) not in (1, (1, 1)):
+            raise ValueError("Pooling with dilation does not convert")
+        ks = module.kernel_size if isinstance(module.kernel_size, tuple) \
+            else (module.kernel_size,) * 2
+        st = module.stride if isinstance(module.stride, tuple) \
+            else (module.stride,) * 2 if module.stride else ks
+        cls = L.MaxPooling2D if isinstance(module, nn.MaxPool2d) \
+            else L.AveragePooling2D
+        return [cls(pool_size=ks, strides=st, dim_ordering="th")]
+
+    if isinstance(module, nn.Flatten):
+        return [L.Flatten()]
+
+    if isinstance(module, nn.Dropout):
+        return [L.Dropout(module.p)]
+
+    if isinstance(module, (nn.BatchNorm1d, nn.BatchNorm2d)):
+        axis = 1 if isinstance(module, nn.BatchNorm2d) else -1
+        layer = L.BatchNormalization(epsilon=module.eps,
+                                     momentum=1.0 - (module.momentum or 0.1),
+                                     axis=axis)
+        C = module.num_features
+        params = {
+            "gamma": (module.weight.detach().numpy().copy()
+                      if module.weight is not None
+                      else np.ones(C, np.float32)),
+            "beta": (module.bias.detach().numpy().copy()
+                     if module.bias is not None
+                     else np.zeros(C, np.float32)),
+            "moving_mean": (module.running_mean.detach().numpy().copy()
+                            if module.running_mean is not None
+                            else np.zeros(C, np.float32)),
+            "moving_var": (module.running_var.detach().numpy().copy()
+                           if module.running_var is not None
+                           else np.ones(C, np.float32)),
+        }
+        return [_with_weights(layer, params)]
+
+    if isinstance(module, nn.Embedding):
+        layer = L.Embedding(module.num_embeddings, module.embedding_dim)
+        return [_with_weights(
+            layer, {"embeddings": module.weight.detach().numpy().copy()})]
+
+    act_map = {
+        "ReLU": "relu", "Tanh": "tanh", "Sigmoid": "sigmoid",
+        "Softmax": "softmax", "GELU": "gelu", "SiLU": "silu", "ELU": "elu",
+        "LogSoftmax": "log_softmax", "Softplus": "softplus",
+    }
+    name = type(module).__name__
+    if name in act_map:
+        return [L.Activation(act_map[name])]
+
+    if isinstance(module, (nn.LSTM, nn.GRU)):
+        return [_convert_rnn(module)]
+
+    raise ValueError(
+        f"Unsupported torch module for conversion: {type(module).__name__}. "
+        "Supported: Sequential, Linear, Conv2d, pooling, Flatten, Dropout, "
+        "BatchNorm1d/2d, Embedding, common activations, LSTM, GRU")
+
+
+def _convert_rnn(module):
+    import torch.nn as nn
+
+    if module.num_layers != 1 or module.bidirectional:
+        raise ValueError("Only single-layer unidirectional LSTM/GRU convert")
+    if not module.batch_first:
+        raise ValueError("Only batch_first=True RNNs convert (TPU batches "
+                         "lead)")
+    hidden = module.hidden_size
+    w_ih = module.weight_ih_l0.detach().numpy()   # [G*H, in]
+    w_hh = module.weight_hh_l0.detach().numpy()   # [G*H, H]
+    b_ih = module.bias_ih_l0.detach().numpy()     # [G*H]
+    b_hh = module.bias_hh_l0.detach().numpy()
+
+    if isinstance(module, nn.LSTM):
+        # torch gates i,f,g,o ; keras order i,f,c(=g),o → identical. torch
+        # uses exact sigmoid, not Keras' default hard_sigmoid. The two bias
+        # vectors always add.
+        layer = L.LSTM(hidden, inner_activation="sigmoid",
+                       return_sequences=False)
+        perm = list(range(4))
+    else:
+        # torch GRU gates r,z,n ; keras order z,r,h. torch applies b_hh
+        # inside the reset product (n-gate) → reset_after carries it
+        # separately.
+        layer = L.GRU(hidden, inner_activation="sigmoid",
+                      return_sequences=False, reset_after=True)
+        perm = [1, 0, 2]
+
+    def reorder(w):
+        blocks = np.split(w, len(perm), axis=0)
+        return np.concatenate([blocks[p] for p in perm], axis=0)
+
+    params = {"kernel": reorder(w_ih).T.copy(),
+              "recurrent": reorder(w_hh).T.copy()}
+    if isinstance(module, nn.LSTM):
+        params["bias"] = reorder((b_ih + b_hh)[:, None])[:, 0].copy()
+    else:
+        params["bias"] = reorder(b_ih[:, None])[:, 0].copy()
+        params["recurrent_bias"] = reorder(b_hh[:, None])[:, 0].copy()
+    return _with_weights(layer, params)
+
+
+def _with_weights(layer, params):
+    """Pin converted weights: build() returns them instead of random init."""
+    pinned = {k: np.asarray(v, np.float32) for k, v in params.items()}
+
+    original_build = layer.build
+
+    def build(rng, input_shape):
+        built = original_build(rng, input_shape)
+        for k, v in pinned.items():
+            if k in built and np.shape(built[k]) != np.shape(v):
+                raise ValueError(
+                    f"{layer.name}.{k}: converted weight shape {np.shape(v)} "
+                    f"!= expected {np.shape(built[k])}")
+        built.update(pinned)
+        return built
+
+    layer.build = build
+    return layer

@@ -159,23 +159,35 @@ def build_eval_step(apply_fn: Callable, metrics: Sequence) -> Callable:
 def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               validation_data=None, distributed: bool = True,
               shuffle: bool = True, checkpoint_trigger=None,
-              end_trigger=None, seed: int = 0) -> Dict[str, List[float]]:
-    """`KerasNet.fit` backend. Returns a Keras-style history dict."""
+              end_trigger=None, seed: int = 0,
+              batch_iter_factory: Optional[Callable] = None
+              ) -> Dict[str, List[float]]:
+    """`KerasNet.fit` backend. Returns a Keras-style history dict.
+    `batch_iter_factory(epoch) -> iterator of (xb, yb, real)` overrides the
+    default in-memory batching (lazy/disk-tier datasets)."""
     ctx = get_context()
     mesh = ctx.mesh if distributed else None
     dp = mesh.data_parallel_size if mesh else 1
     check_global_batch(batch_size, dp)
 
-    n = _tree_len(x)
-    if n < batch_size:
-        raise ValueError(
-            f"Dataset has {n} samples but global batch_size is {batch_size}; "
-            "training batches are whole-batch only (static shapes). Lower "
-            "batch_size or add data.")
+    if batch_iter_factory is None:
+        n = _tree_len(x)
+        if n < batch_size:
+            raise ValueError(
+                f"Dataset has {n} samples but global batch_size is "
+                f"{batch_size}; training batches are whole-batch only "
+                "(static shapes). Lower batch_size or add data.")
+
+        def batch_iter_factory(epoch):  # noqa: F811 — default factory
+            return iter_batches(x, y, batch_size, shuffle=shuffle,
+                                seed=seed + epoch)
 
     rng = jax.random.PRNGKey(seed)
     rng, init_rng = jax.random.split(rng)
-    sample = next(iter_batches(x, y, batch_size))[0]
+    try:
+        sample = next(iter(batch_iter_factory(0)))[0]
+    except StopIteration:
+        raise ValueError("Dataset produced no full batches; lower batch_size")
     model.ensure_built(sample, init_rng)
 
     optimizer = model.optimizer
@@ -206,8 +218,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         ep_loss, ep_batches = 0.0, 0
         t0 = time.time()
         n_seen = 0
-        for xb, yb, real in iter_batches(x, y, batch_size, shuffle=shuffle,
-                                         seed=seed + epoch):
+        for xb, yb, real in batch_iter_factory(epoch):
             xb = _put_batch(xb, mesh)
             yb = _put_batch(yb, mesh) if yb is not None else None
             rng, step_rng = jax.random.split(rng)

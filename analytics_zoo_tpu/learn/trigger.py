@@ -1,0 +1,8 @@
+"""Orca trigger names (`pyzoo/zoo/orca/learn/trigger.py:76`) — re-exports of
+the shared trigger family."""
+
+from analytics_zoo_tpu.common.triggers import (  # noqa: F401
+    EveryEpoch, MaxEpoch, MaxIteration, MaxScore, MinLoss, SeveralIteration)
+
+__all__ = ["EveryEpoch", "SeveralIteration", "MaxEpoch", "MaxIteration",
+           "MinLoss", "MaxScore"]

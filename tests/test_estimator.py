@@ -1,0 +1,251 @@
+"""Orca Estimator tests (reference pattern: `pyzoo/test/zoo/orca/learn/...`
+— fit/evaluate/predict over shards, checkpoint resume, torch parity)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.data import TPUDataset, XShards
+from analytics_zoo_tpu.keras import Sequential, layers as L
+from analytics_zoo_tpu.learn import trigger as otrigger
+from analytics_zoo_tpu.learn.estimator import Estimator, to_dataset
+
+
+@pytest.fixture(autouse=True)
+def ctx():
+    c = zoo.init_orca_context(cluster_mode="local")
+    yield c
+    zoo.stop_orca_context()
+
+
+def _toy_model():
+    m = Sequential([L.Dense(8, activation="relu", input_shape=(4,)),
+                    L.Dense(2, activation="softmax")])
+    m.compile("adam", "sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    return m
+
+
+def _toy_data(n=128):
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    return x, y
+
+
+class TestFromKeras:
+    def test_fit_evaluate_predict_ndarrays(self):
+        est = Estimator.from_keras(_toy_model())
+        x, y = _toy_data()
+        h = est.fit((x, y), epochs=40, batch_size=32)
+        assert h["loss"][-1] < h["loss"][0]
+        res = est.evaluate((x, y))
+        assert res["sparse_categorical_accuracy"] > 0.7
+        preds = est.predict(x)
+        assert preds.shape == (128, 2)
+
+    def test_fit_from_xshards(self):
+        x, y = _toy_data(64)
+        shards = XShards.partition({"x": x, "y": y}, 4)
+        est = Estimator.from_keras(_toy_model())
+        h = est.fit(shards, epochs=3, batch_size=16)
+        assert len(h["loss"]) == 3
+
+    def test_fit_from_dataframe(self):
+        import pandas as pd
+        x, y = _toy_data(64)
+        df = pd.DataFrame({"feat": list(x), "label": y})
+        est = Estimator.from_keras(_toy_model())
+        est.fit(df, epochs=2, batch_size=16, feature_cols=["feat"],
+                label_cols=["label"])
+        res = est.evaluate(df, feature_cols=["feat"], label_cols=["label"])
+        assert "sparse_categorical_accuracy" in res
+
+    def test_checkpoint_and_resume(self, tmp_path):
+        x, y = _toy_data(64)
+        d = str(tmp_path / "run")
+        est = Estimator.from_keras(_toy_model(), model_dir=d)
+        est.fit((x, y), epochs=2, batch_size=16,
+                checkpoint_trigger=otrigger.EveryEpoch())
+        from analytics_zoo_tpu.learn import checkpoint as ck
+        found = ck.latest_checkpoint(d)
+        assert found is not None
+        # fresh estimator resumes from checkpoint
+        est2 = Estimator.from_keras(_toy_model(), model_dir=d)
+        est2.load_orca_checkpoint(d)
+        h = est2.fit((x, y), epochs=3, batch_size=16)
+        assert h["loss"]  # continued after restore (2 epochs done → 1 left)
+        assert len(h["loss"]) == 1
+
+    def test_save_load(self, tmp_path):
+        x, y = _toy_data(64)
+        est = Estimator.from_keras(_toy_model())
+        est.fit((x, y), epochs=2, batch_size=16)
+        p = str(tmp_path / "w")
+        est.save(p)
+        est2 = Estimator.from_keras(_toy_model())
+        est2.load(p)
+        np.testing.assert_allclose(est.predict(x), est2.predict(x),
+                                   rtol=1e-6)
+
+
+class TestFromFn:
+    def test_linear_regression(self):
+        import jax
+        import jax.numpy as jnp
+
+        def init_fn(rng, input_shape):
+            return {"w": jnp.zeros((4, 1)), "b": jnp.zeros((1,))}
+
+        def forward_fn(params, x, training=False, rng=None):
+            return x @ params["w"] + params["b"]
+
+        import optax
+        est = Estimator.from_fn(forward_fn, init_fn, loss="mse",
+                                optimizer=optax.adam(0.05))
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 4).astype(np.float32)
+        y = (x @ np.array([[1.0], [2.0], [-1.0], [0.5]])).astype(np.float32)
+        h = est.fit((x, y), epochs=30, batch_size=64)
+        assert h["loss"][-1] < h["loss"][0] * 0.5
+
+
+class TestFromTorch:
+    def test_mlp_weights_carry_over(self):
+        import torch
+        import torch.nn as nn
+        tm = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        est = Estimator.from_torch(tm, loss="mse", optimizer="sgd")
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        with torch.no_grad():
+            expected = tm(torch.from_numpy(x)).numpy()
+        got = est.predict(x, batch_per_thread=4)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_conversion_matches_torch(self):
+        import torch
+        import torch.nn as nn
+        tm = nn.LSTM(input_size=3, hidden_size=5, batch_first=True)
+        from analytics_zoo_tpu.learn.torch_bridge import _convert_rnn
+        layer = _convert_rnn(tm)
+        import jax
+        p = layer.build(jax.random.PRNGKey(0), (None, 7, 3))
+        x = np.random.RandomState(0).randn(2, 7, 3).astype(np.float32)
+        with torch.no_grad():
+            out, (h_n, _) = tm(torch.from_numpy(x))
+        got = layer.call(p, x)
+        np.testing.assert_allclose(np.asarray(got), h_n[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_conversion_matches_torch(self):
+        import torch
+        import torch.nn as nn
+        tm = nn.GRU(input_size=3, hidden_size=5, batch_first=True)
+        from analytics_zoo_tpu.learn.torch_bridge import _convert_rnn
+        layer = _convert_rnn(tm)
+        import jax
+        p = layer.build(jax.random.PRNGKey(0), (None, 7, 3))
+        x = np.random.RandomState(0).randn(2, 7, 3).astype(np.float32)
+        with torch.no_grad():
+            _, h_n = tm(torch.from_numpy(x))
+        got = layer.call(p, x)
+        np.testing.assert_allclose(np.asarray(got), h_n[0].numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unconvertible_padding_rejected(self):
+        import torch.nn as nn
+        with pytest.raises(ValueError, match="padding"):
+            Estimator.from_torch(
+                nn.Sequential(nn.Conv2d(3, 4, 5, padding=1)))
+        with pytest.raises(ValueError, match="ceil_mode"):
+            Estimator.from_torch(
+                nn.Sequential(nn.MaxPool2d(2, ceil_mode=True)))
+
+    def test_conv_model_converts(self):
+        import torch
+        import torch.nn as nn
+        tm = nn.Sequential(nn.Conv2d(3, 4, (3, 3)), nn.ReLU(),
+                           nn.Flatten(), nn.Linear(4 * 6 * 6, 2))
+        est = Estimator.from_torch(tm, loss="mse", optimizer="sgd")
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        with torch.no_grad():
+            expected = tm(torch.from_numpy(x)).numpy()
+        got = est.predict(x, batch_per_thread=2)
+        np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+    def test_unsupported_module_rejected(self):
+        import torch.nn as nn
+        with pytest.raises(ValueError, match="Unsupported torch module"):
+            Estimator.from_torch(nn.Sequential(nn.Transformer()))
+
+
+class TestRetry:
+    def test_retry_restores_from_snapshot(self, tmp_path, monkeypatch):
+        from analytics_zoo_tpu.learn import trainer as tr
+        x, y = _toy_data(64)
+        d = str(tmp_path / "runs")
+        est = Estimator.from_keras(_toy_model(), model_dir=d)
+        est.fit((x, y), epochs=1, batch_size=16,
+                checkpoint_trigger=otrigger.EveryEpoch())
+
+        calls = {"n": 0}
+        real_fit = tr.fit_keras
+
+        def flaky_fit(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("simulated worker failure")
+            return real_fit(*args, **kwargs)
+
+        monkeypatch.setattr(tr, "fit_keras", flaky_fit)
+        h = est.fit((x, y), epochs=2, batch_size=16)
+        assert calls["n"] == 2  # failed once, retried successfully
+        assert h["loss"]
+
+    def test_retry_budget_exhausted(self, tmp_path, monkeypatch):
+        from analytics_zoo_tpu.learn import trainer as tr
+        from analytics_zoo_tpu.common.config import ZooConfig
+        zoo.stop_orca_context()
+        cfg = ZooConfig()
+        cfg.failure.retry_times = 1
+        zoo.init_orca_context(cluster_mode="local", config=cfg)
+        est = Estimator.from_keras(_toy_model(),
+                                   model_dir=str(tmp_path / "r"))
+
+        def always_fail(*a, **k):
+            raise RuntimeError("permanent failure")
+
+        monkeypatch.setattr(tr, "fit_keras", always_fail)
+        x, y = _toy_data(32)
+        with pytest.raises(RuntimeError, match="permanent failure"):
+            est.fit((x, y), epochs=1, batch_size=16)
+
+
+class TestToDataset:
+    def test_passthrough_and_errors(self):
+        ds = TPUDataset(np.zeros((4, 2)), batch_size=4)
+        assert to_dataset(ds) is ds
+        import pandas as pd
+        with pytest.raises(ValueError, match="feature_cols"):
+            to_dataset(pd.DataFrame({"a": [1]}))
+
+    def test_dataset_batch_size_wins(self):
+        # a pre-built dataset's batch contract overrides fit() defaults
+        x, y = _toy_data(64)
+        ds = TPUDataset.from_ndarrays((x, y), batch_size=64, shuffle=False)
+        est = Estimator.from_keras(_toy_model())
+        h = est.fit(ds, epochs=2)  # no batch_size passed
+        assert len(h["loss"]) == 2  # one batch of 64 per epoch ran
+
+    def test_disk_tier_featureset_fits(self, tmp_path):
+        from analytics_zoo_tpu.data import FeatureSet
+        x, y = _toy_data(64)
+        fs = FeatureSet({"x": x, "y": y}, memory_type="DISK",
+                        cache_dir=str(tmp_path))
+        ds = fs.to_dataset(batch_size=16)
+        assert ds.x is None
+        est = Estimator.from_keras(_toy_model())
+        h = est.fit(ds, epochs=2)
+        assert len(h["loss"]) == 2
