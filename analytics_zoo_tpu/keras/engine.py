@@ -261,12 +261,69 @@ class KerasNet:
         base = path[:-4] if path.endswith(".npz") else path
         return base + ".layers.json"
 
-    def _layer_order(self) -> List[str]:
+    def _ordered_layers(self) -> List[Layer]:
+        """Deterministic layer order for positional weight remapping;
+        subclasses with named sub-layers override."""
         return []
+
+    def _layer_order(self) -> List[str]:
+        return [l.name for l in self._ordered_layers()]
 
     def _remap_loaded(self, loaded: Params,
                       order: Optional[List[str]] = None) -> Params:
-        return loaded
+        """Auto-generated layer names differ across instances; remap saved
+        params onto this instance's names, recursing into nested
+        Sequential/Model blocks. Matching is per-class-prefix by the numeric
+        suffix of the auto names (creation order within a class equals
+        structural order for identical architectures) — dict ordering is NOT
+        relied on, since jax tree ops re-sort dict keys."""
+        import re
+        layers = self._ordered_layers()
+        if not layers:
+            return loaded
+        if order is not None and (len(order) != len(loaded)
+                                  or set(order) != set(loaded)):
+            raise ValueError(
+                f"Stale/mismatched layer-order sidecar: order has "
+                f"{len(order)} names, saved params have {len(loaded)}")
+        if len(loaded) != len(layers):
+            raise ValueError(
+                f"Saved weights have {len(loaded)} layers, model has "
+                f"{len(layers)}")
+
+        def remap_child(layer: Layer, value):
+            if isinstance(layer, KerasNet):
+                return layer._remap_loaded(value)
+            return value
+
+        if set(loaded) == {l.name for l in layers}:
+            return {l.name: remap_child(l, loaded[l.name]) for l in layers}
+
+        def split(name: str):
+            m = re.match(r"^(.*)_(\d+)$", name)
+            return (m.group(1), int(m.group(2))) if m else (name, 0)
+
+        saved_by_prefix: Dict[str, List] = {}
+        for name in loaded:
+            p, n = split(name)
+            saved_by_prefix.setdefault(p, []).append((n, name))
+        cur_by_prefix: Dict[str, List] = {}
+        for layer in layers:
+            p, n = split(layer.name)
+            cur_by_prefix.setdefault(p, []).append((n, layer))
+        if {p: len(v) for p, v in saved_by_prefix.items()} != \
+                {p: len(v) for p, v in cur_by_prefix.items()}:
+            raise ValueError(
+                f"Saved layer classes {sorted(saved_by_prefix)} do not match "
+                f"model layer classes {sorted(cur_by_prefix)}")
+        result: Params = {}
+        for p, cur_list in cur_by_prefix.items():
+            for (_, layer), (_, sname) in zip(sorted(cur_list,
+                                                     key=lambda t: t[0]),
+                                              sorted(saved_by_prefix[p],
+                                                     key=lambda t: t[0])):
+                result[layer.name] = remap_child(layer, loaded[sname])
+        return result
 
     def summary(self):
         lines = [f"Model: {self.name}", "-" * 60]
@@ -383,21 +440,8 @@ class Sequential(KerasNet):
                              "-", self._count(self.params.get(layer.name))))
         return rows
 
-    def _layer_order(self):
-        return [l.name for l in self.layers]
-
-    def _remap_loaded(self, loaded: Params, order=None) -> Params:
-        """Auto-generated layer names differ across instances; a Sequential's
-        weights map positionally via the saved stack order."""
-        if set(loaded) == {l.name for l in self.layers}:
-            return loaded
-        if len(loaded) != len(self.layers):
-            raise ValueError(
-                f"Saved weights have {len(loaded)} layers, model has "
-                f"{len(self.layers)}")
-        saved_order = order if order is not None else list(loaded.keys())
-        return {layer.name: loaded[saved_name]
-                for layer, saved_name in zip(self.layers, saved_order)}
+    def _ordered_layers(self):
+        return self.layers
 
 
 class Model(KerasNet):
@@ -508,3 +552,6 @@ class Model(KerasNet):
                 rows.append((f"{layer.name} ({type(layer).__name__})",
                              "-", self._count(self.params.get(layer.name))))
         return rows
+
+    def _ordered_layers(self):
+        return self._layers

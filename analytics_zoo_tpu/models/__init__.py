@@ -1,0 +1,9 @@
+from analytics_zoo_tpu.models.common import ZooModel  # noqa: F401
+from analytics_zoo_tpu.models.recommendation import (  # noqa: F401
+    NeuralCF, SessionRecommender, UserItemFeature, WideAndDeep)
+from analytics_zoo_tpu.models.anomalydetection import (  # noqa: F401
+    AnomalyDetector, detect_anomalies, unroll)
+from analytics_zoo_tpu.models.textclassification import TextClassifier  # noqa: F401
+from analytics_zoo_tpu.models.textmatching import KNRM  # noqa: F401
+from analytics_zoo_tpu.models.seq2seq import Seq2seq  # noqa: F401
+from analytics_zoo_tpu.models.image import ImageClassifier, resnet  # noqa: F401

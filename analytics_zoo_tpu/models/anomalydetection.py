@@ -1,0 +1,95 @@
+"""Anomaly detection over time series — LSTM forecaster + threshold detector.
+
+Architecture per the reference (`models/anomalydetection/
+AnomalyDetector.scala:40`, py `anomaly_detector.py:61-76`): stacked LSTMs
+(return_sequences except last) with dropouts, Dense(1) head trained on MSE;
+anomalies = top-N prediction errors (`anomaly_detector.py:126` `detect_anomalies`).
+Also carries the unroll helper (`anomaly_detector.py:105`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.models.common import ZooModel
+
+
+class AnomalyDetector(ZooModel):
+    def __init__(self, feature_shape: Tuple[int, int],
+                 hidden_layers: Sequence[int] = (8, 32, 15),
+                 dropouts: Sequence[float] = (0.2, 0.2, 0.2)):
+        super().__init__()
+        if len(hidden_layers) != len(dropouts):
+            raise ValueError("hidden_layers and dropouts lengths differ")
+        self._config = dict(feature_shape=list(feature_shape),
+                            hidden_layers=list(hidden_layers),
+                            dropouts=list(dropouts))
+        self.feature_shape = tuple(feature_shape)
+        self.hidden_layers = list(hidden_layers)
+        self.dropouts = list(dropouts)
+        self.model = self.build_model()
+
+    def build_model(self) -> Sequential:
+        m = Sequential()
+        m.add(L.LSTM(self.hidden_layers[0], input_shape=self.feature_shape,
+                     return_sequences=True))
+        m.add(L.Dropout(self.dropouts[0]))
+        for units, drop in zip(self.hidden_layers[1:-1], self.dropouts[1:-1]):
+            m.add(L.LSTM(units, return_sequences=True))
+            m.add(L.Dropout(drop))
+        m.add(L.LSTM(self.hidden_layers[-1], return_sequences=False))
+        m.add(L.Dropout(self.dropouts[-1]))
+        m.add(L.Dense(1))
+        return m
+
+
+def unroll(data: np.ndarray, unroll_length: int,
+           predict_step: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """Sliding windows: x[i] = data[i : i+L], y[i] = data[i+L+step-1, 0]
+    (`anomaly_detector.py:105` unroll semantics)."""
+    data = np.asarray(data, np.float32)
+    if data.ndim == 1:
+        data = data[:, None]
+    n = len(data) - unroll_length - predict_step + 1
+    if n <= 0:
+        raise ValueError("series too short for the requested unroll")
+    x = np.stack([data[i:i + unroll_length] for i in range(n)])
+    y = data[unroll_length + predict_step - 1:
+             unroll_length + predict_step - 1 + n, 0]
+    return x, y
+
+
+def detect_anomalies(y_truth: np.ndarray, y_predict: np.ndarray,
+                     anomaly_size: int) -> np.ndarray:
+    """Indices of the `anomaly_size` largest absolute errors
+    (`detect_anomalies`, `anomaly_detector.py:126`)."""
+    err = np.abs(np.asarray(y_truth).reshape(-1)
+                 - np.asarray(y_predict).reshape(-1))
+    thresh = np.sort(err)[-anomaly_size]
+    return np.where(err >= thresh)[0][:anomaly_size]
+
+
+class ThresholdDetector:
+    """`zouwu/model/anomaly.py` ThresholdDetector: fixed or percentile-based
+    threshold on forecast error."""
+
+    def __init__(self, threshold: Optional[float] = None,
+                 ratio: float = 0.01):
+        self.threshold = threshold
+        self.ratio = ratio
+
+    def fit(self, y_truth: np.ndarray, y_predict: np.ndarray):
+        err = np.abs(np.asarray(y_truth) - np.asarray(y_predict)).reshape(-1)
+        if self.threshold is None:
+            self.threshold = float(np.quantile(err, 1.0 - self.ratio))
+        return self
+
+    def score(self, y_truth: np.ndarray, y_predict: np.ndarray) -> np.ndarray:
+        if self.threshold is None:
+            raise ValueError("fit() first or pass an explicit threshold")
+        err = np.abs(np.asarray(y_truth) - np.asarray(y_predict)).reshape(-1)
+        return (err > self.threshold).astype(np.int32)

@@ -1,0 +1,81 @@
+"""ZooModel — shared plumbing for the built-in model zoo.
+
+Mirrors `zoo/.../models/common/ZooModel.scala` + `KerasZooModel` (save/load,
+summary, predict) and the python `zoo.models.common` base. A ZooModel wraps a
+constructed Keras-style graph plus its hyperparameters; `save_model`/
+`load_model` persist config + weights so a model reloads without re-specifying
+the architecture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.keras.engine import KerasNet
+
+
+class ZooModel:
+    """Base: subclasses set `self.model` (a KerasNet) in build_model() and
+    register their constructor kwargs via `self._config`."""
+
+    def __init__(self):
+        self.model: Optional[KerasNet] = None
+        self._config: Dict[str, Any] = {}
+
+    # -- Keras passthrough -------------------------------------------------
+    def compile(self, optimizer, loss, metrics=None):
+        self.model.compile(optimizer, loss, metrics)
+
+    def fit(self, x, y=None, batch_size=32, nb_epoch=1, **kw):
+        return self.model.fit(x, y, batch_size=batch_size, nb_epoch=nb_epoch,
+                              **kw)
+
+    def evaluate(self, x, y=None, batch_per_thread=32, **kw):
+        return self.model.evaluate(x, y, batch_per_thread=batch_per_thread,
+                                   **kw)
+
+    def predict(self, x, batch_per_thread=32, **kw):
+        return self.model.predict(x, batch_per_thread=batch_per_thread, **kw)
+
+    def predict_classes(self, x, batch_per_thread=32, zero_based_label=True):
+        """`Recommender.predict_classes`-style helper: argmax over the class
+        axis; the reference's labels are 1-based by default."""
+        probs = self.predict(x, batch_per_thread=batch_per_thread)
+        cls = np.argmax(probs, axis=-1)
+        return cls if zero_based_label else cls + 1
+
+    def summary(self):
+        return self.model.summary()
+
+    # -- persistence -------------------------------------------------------
+    def save_model(self, path: str, over_write: bool = False):
+        """`ZooModel.saveModel`: config json + weights."""
+        os.makedirs(path, exist_ok=True)
+        cfg_path = os.path.join(path, "config.json")
+        if os.path.exists(cfg_path) and not over_write:
+            raise FileExistsError(f"{path} exists; pass over_write=True")
+        with open(cfg_path, "w") as fh:
+            json.dump({"class": type(self).__name__,
+                       "config": self._config}, fh)
+        self.model.save_weights(os.path.join(path, "weights"))
+
+    @classmethod
+    def load_model(cls, path: str) -> "ZooModel":
+        with open(os.path.join(path, "config.json")) as fh:
+            blob = json.load(fh)
+        if blob["class"] != cls.__name__:
+            raise ValueError(
+                f"Checkpoint is a {blob['class']}, not {cls.__name__}")
+        inst = cls(**blob["config"])
+        inst.model.load_weights(os.path.join(path, "weights"))
+        return inst
+
+    def set_checkpoint(self, path: str):
+        self.model.set_checkpoint(path)
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        self.model.set_tensorboard(log_dir, app_name)

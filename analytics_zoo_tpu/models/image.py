@@ -1,0 +1,110 @@
+"""Image classification: ResNet builder + ImageClassifier wrapper.
+
+The reference ships pretrained-model *loaders* plus a ResNet-50 training
+example (`zoo/.../examples/resnet/`, `models/image/imageclassification/`).
+Zero-egress here, so the zoo provides the architectures natively: a ResNet
+v1.5 family (18/34/50) built NHWC with BatchNorm — the layout/blocking the
+MXU wants — and an `ImageClassifier` that pairs a model with its
+preprocessing pipeline (`ImageClassifier.scala` + label-map surface).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.models.common import ZooModel
+
+_CONFIGS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+}
+
+
+def _conv_bn(x, filters, k, stride=1, activation="relu"):
+    x = L.Convolution2D(filters, k, k, subsample=(stride, stride),
+                        border_mode="same", use_bias=False)(x)
+    x = L.BatchNormalization()(x)
+    if activation:
+        x = L.Activation(activation)(x)
+    return x
+
+
+def _basic_block(x, filters, stride):
+    shortcut = x
+    y = _conv_bn(x, filters, 3, stride)
+    y = _conv_bn(y, filters, 3, 1, activation=None)
+    if stride != 1 or x.shape[-1] != filters:
+        shortcut = _conv_bn(x, filters, 1, stride, activation=None)
+    out = L.merge([y, shortcut], mode="sum")
+    return L.Activation("relu")(out)
+
+
+def _bottleneck_block(x, filters, stride):
+    shortcut = x
+    y = _conv_bn(x, filters, 1, 1)
+    y = _conv_bn(y, filters, 3, stride)
+    y = _conv_bn(y, 4 * filters, 1, 1, activation=None)
+    if stride != 1 or x.shape[-1] != 4 * filters:
+        shortcut = _conv_bn(x, 4 * filters, 1, stride, activation=None)
+    out = L.merge([y, shortcut], mode="sum")
+    return L.Activation("relu")(out)
+
+
+def resnet(depth: int = 50, class_num: int = 1000,
+           input_shape: Sequence[int] = (224, 224, 3),
+           include_top: bool = True) -> Model:
+    """ResNet v1.5 (stride-2 on the 3x3 conv of bottlenecks, the standard
+    TPU/GPU variant)."""
+    if depth not in _CONFIGS:
+        raise ValueError(f"Unsupported depth {depth}; choose {list(_CONFIGS)}")
+    kind, reps = _CONFIGS[depth]
+    block = _basic_block if kind == "basic" else _bottleneck_block
+
+    inp = Input(shape=tuple(input_shape))
+    x = L.Convolution2D(64, 7, 7, subsample=(2, 2), border_mode="same",
+                        use_bias=False)(inp)
+    x = L.BatchNormalization()(x)
+    x = L.Activation("relu")(x)
+    x = L.MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                       border_mode="same")(x)
+    filters = 64
+    for stage, n in enumerate(reps):
+        for i in range(n):
+            stride = 2 if (i == 0 and stage > 0) else 1
+            x = block(x, filters, stride)
+        filters *= 2
+    x = L.GlobalAveragePooling2D()(x)
+    if include_top:
+        x = L.Dense(class_num, activation="softmax")(x)
+    return Model(inp, x)
+
+
+class ImageClassifier(ZooModel):
+    """Model + preprocessing + label map (`models/image/imageclassification/
+    ImageClassifier.scala` surface)."""
+
+    def __init__(self, depth: int = 50, class_num: int = 1000,
+                 input_shape: Sequence[int] = (224, 224, 3),
+                 label_map: Optional[Dict[int, str]] = None):
+        super().__init__()
+        self._config = dict(depth=depth, class_num=class_num,
+                            input_shape=list(input_shape))
+        self.label_map = label_map or {}
+        self.model = resnet(depth, class_num, input_shape)
+
+    def predict_image_set(self, image_set, top_n: int = 5,
+                          batch_per_thread: int = 8) -> List[List]:
+        """Classify an ImageSet; returns per-image top-N (label, prob)."""
+        x = np.stack(image_set.images).astype(np.float32)
+        probs = self.predict(x, batch_per_thread=batch_per_thread)
+        out = []
+        for p in probs:
+            top = np.argsort(-p)[:top_n]
+            out.append([(self.label_map.get(int(i), int(i)), float(p[i]))
+                        for i in top])
+        return out

@@ -1,0 +1,68 @@
+"""TextClassifier — CNN/LSTM/GRU encoders over (pretrained) embeddings.
+
+Reference: `models/textclassification/TextClassifier.scala:43-67` — embedding
+→ encoder (cnn: Conv1D(k=5, relu)+GlobalMaxPooling1D; lstm/gru: recurrent
+final state) → Dense(128) → Dropout(0.2) → Dense(class_num, softmax).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.models.common import ZooModel
+
+
+class TextClassifier(ZooModel):
+    def __init__(self, class_num: int, embedding_dim: Optional[int] = None,
+                 vocab_size: Optional[int] = None,
+                 sequence_length: int = 500, encoder: str = "cnn",
+                 encoder_output_dim: int = 256,
+                 embedding_weights: Optional[np.ndarray] = None):
+        super().__init__()
+        if embedding_weights is None and (embedding_dim is None
+                                          or vocab_size is None):
+            raise ValueError("Provide embedding_weights or "
+                             "(vocab_size, embedding_dim)")
+        self._config = dict(class_num=class_num, embedding_dim=embedding_dim,
+                            vocab_size=vocab_size,
+                            sequence_length=sequence_length, encoder=encoder,
+                            encoder_output_dim=encoder_output_dim)
+        self.class_num = class_num
+        self.sequence_length = sequence_length
+        self.encoder = encoder.lower()
+        self.encoder_output_dim = encoder_output_dim
+        self.embedding_weights = embedding_weights
+        self.vocab_size = vocab_size if embedding_weights is None \
+            else embedding_weights.shape[0]
+        self.embedding_dim = embedding_dim if embedding_weights is None \
+            else embedding_weights.shape[1]
+        self.model = self.build_model()
+
+    def build_model(self) -> Sequential:
+        m = Sequential()
+        if self.embedding_weights is not None:
+            m.add(L.WordEmbedding(self.embedding_weights,
+                                  input_shape=(self.sequence_length,)))
+        else:
+            m.add(L.Embedding(self.vocab_size, self.embedding_dim,
+                              input_shape=(self.sequence_length,)))
+        if self.encoder == "cnn":
+            m.add(L.Convolution1D(self.encoder_output_dim, 5,
+                                  activation="relu"))
+            m.add(L.GlobalMaxPooling1D())
+        elif self.encoder == "lstm":
+            m.add(L.LSTM(self.encoder_output_dim))
+        elif self.encoder == "gru":
+            m.add(L.GRU(self.encoder_output_dim))
+        else:
+            raise ValueError(f"Unsupported encoder: {self.encoder} "
+                             "(use cnn | lstm | gru)")
+        m.add(L.Dense(128))
+        m.add(L.Dropout(0.2))
+        m.add(L.Activation("relu"))
+        m.add(L.Dense(self.class_num, activation="softmax"))
+        return m

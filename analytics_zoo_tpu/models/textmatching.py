@@ -1,0 +1,89 @@
+"""KNRM — kernel-pooling neural ranking model for text matching.
+
+Reference: `models/textmatching/KNRM.scala:75-103`. Takes the concatenation
+[B, L1+L2] of query and doc ids (embedding weight sharing is expressed by
+slicing one embedding output, as the reference notes), computes the
+translation matrix via batched dot, applies `kernel_num` RBF kernels
+(mu spaced over [-1, 1], exact-match kernel sigma), log-sum pools, and scores
+with a Dense(1) head — sigmoid for classification, linear for ranking
+(paired with the `rank_hinge` loss).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.ops.autograd import Lambda
+
+
+class KNRM(ZooModel):
+    def __init__(self, text1_length: int, text2_length: int,
+                 vocab_size: Optional[int] = None,
+                 embed_size: int = 300,
+                 embed_weights: Optional[np.ndarray] = None,
+                 train_embed: bool = True, kernel_num: int = 21,
+                 sigma: float = 0.1, exact_sigma: float = 0.001,
+                 target_mode: str = "ranking"):
+        super().__init__()
+        if kernel_num < 2:
+            raise ValueError("kernel_num must be >= 2")
+        if target_mode not in ("ranking", "classification"):
+            raise ValueError(f"Unsupported target_mode: {target_mode}")
+        self._config = dict(text1_length=text1_length,
+                            text2_length=text2_length,
+                            vocab_size=vocab_size, embed_size=embed_size,
+                            train_embed=train_embed, kernel_num=kernel_num,
+                            sigma=sigma, exact_sigma=exact_sigma,
+                            target_mode=target_mode)
+        self.text1_length = text1_length
+        self.text2_length = text2_length
+        self.embed_weights = embed_weights
+        self.vocab_size = vocab_size if embed_weights is None \
+            else embed_weights.shape[0]
+        self.embed_size = embed_size if embed_weights is None \
+            else embed_weights.shape[1]
+        self.train_embed = train_embed
+        self.kernel_num = kernel_num
+        self.sigma = sigma
+        self.exact_sigma = exact_sigma
+        self.target_mode = target_mode
+        self.model = self.build_model()
+
+    def build_model(self) -> Model:
+        L1, L2 = self.text1_length, self.text2_length
+        kernel_num = self.kernel_num
+        sigma, exact_sigma = self.sigma, self.exact_sigma
+
+        inp = Input(shape=(L1 + L2,))
+        embed = L.Embedding(self.vocab_size, self.embed_size,
+                            weights=self.embed_weights,
+                            trainable=self.train_embed)(inp)
+
+        def kernel_pooling(e):
+            q = e[:, :L1]                       # [B, L1, D]
+            d = e[:, L1:]                       # [B, L2, D]
+            mm = jnp.einsum("bld,bmd->blm", q, d)   # translation matrix
+            feats = []
+            for i in range(kernel_num):
+                mu = 1.0 / (kernel_num - 1) + (2.0 * i) / (kernel_num - 1) - 1.0
+                s = sigma
+                if mu > 1.0:  # exact-match kernel (`KNRM.scala:87-90`)
+                    mu, s = 1.0, exact_sigma
+                mm_exp = jnp.exp(-0.5 * (mm - mu) ** 2 / (s * s))
+                mm_doc_sum = jnp.sum(mm_exp, axis=2)        # [B, L1]
+                mm_log = jnp.log(mm_doc_sum + 1.0)
+                feats.append(jnp.sum(mm_log, axis=1))       # [B]
+            return jnp.stack(feats, axis=1)                  # [B, K]
+
+        phi = Lambda(kernel_pooling)(embed)
+        if self.target_mode == "ranking":
+            out = L.Dense(1, init="uniform")(phi)
+        else:
+            out = L.Dense(1, init="uniform", activation="sigmoid")(phi)
+        return Model(inp, out)

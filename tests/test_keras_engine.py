@@ -304,6 +304,35 @@ class TestCheckpointManager:
         assert back["activation_1"] == {}
         assert list(back) == ["dense_1", "activation_1", "dense_2"]
 
+    def test_save_load_nested_sequential(self, tmp_path):
+        def build():
+            inner = Sequential([L.Dense(4, input_shape=(4,)),
+                                L.Activation("relu")])
+            outer = Sequential([inner, L.Dense(2)])
+            outer.compile("sgd", "mse")
+            return outer
+        m1 = build()
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        m1.fit(x, np.zeros((16, 2), np.float32), batch_size=8, nb_epoch=1)
+        p = str(tmp_path / "nested")
+        m1.save_weights(p)
+        m2 = build()  # different auto names at every level
+        m2.load_weights(p)
+        np.testing.assert_allclose(m1.predict(x), m2.predict(x), rtol=1e-6)
+
+    def test_stale_order_sidecar_rejected(self, tmp_path):
+        import json
+        m = Sequential([L.Dense(2, input_shape=(2,))])
+        m.compile("sgd", "mse")
+        m.ensure_built(np.zeros((1, 2), np.float32))
+        p = str(tmp_path / "w")
+        m.save_weights(p)
+        with open(m._order_path(p), "w") as fh:
+            json.dump(["bogus_1", "bogus_2"], fh)  # stale sidecar
+        m2 = Sequential([L.Dense(2, input_shape=(2,))])
+        with pytest.raises(ValueError, match="sidecar"):
+            m2.load_weights(p)
+
     def test_save_load_with_parameterless_layers(self, tmp_path):
         model = Sequential([L.Dense(4, input_shape=(4,)),
                             L.Activation("relu"), L.Dense(1)])
