@@ -296,6 +296,27 @@ class KerasNet:
                 return layer._remap_loaded(value)
             return value
 
+        if order is not None:
+            # The sidecar records saved names in STRUCTURAL order — map
+            # positionally onto this instance's structural order. Handles
+            # custom layer names and same-class layers created out of
+            # add() order (where prefix/suffix matching would mis-map).
+            # Auto-generated names ("<class>_<n>") still carry their class:
+            # cross-class positional assignment is an architecture mismatch.
+            import re
+            for layer, sname in zip(layers, order):
+                saved_auto = re.match(r"^(.*)_(\d+)$", sname)
+                cur_auto = re.match(r"^(.*)_(\d+)$", layer.name)
+                if saved_auto and cur_auto \
+                        and cur_auto.group(1) == type(layer).__name__.lower() \
+                        and saved_auto.group(1) != cur_auto.group(1):
+                    raise ValueError(
+                        f"Saved layer {sname!r} does not match model layer "
+                        f"{layer.name!r} ({type(layer).__name__}) at the "
+                        "same structural position")
+            return {layer.name: remap_child(layer, loaded[sname])
+                    for layer, sname in zip(layers, order)}
+
         if set(loaded) == {l.name for l in layers}:
             return {l.name: remap_child(l, loaded[l.name]) for l in layers}
 

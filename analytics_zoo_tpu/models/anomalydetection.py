@@ -35,14 +35,22 @@ class AnomalyDetector(ZooModel):
 
     def build_model(self) -> Sequential:
         m = Sequential()
-        m.add(L.LSTM(self.hidden_layers[0], input_shape=self.feature_shape,
-                     return_sequences=True))
-        m.add(L.Dropout(self.dropouts[0]))
-        for units, drop in zip(self.hidden_layers[1:-1], self.dropouts[1:-1]):
-            m.add(L.LSTM(units, return_sequences=True))
-            m.add(L.Dropout(drop))
-        m.add(L.LSTM(self.hidden_layers[-1], return_sequences=False))
-        m.add(L.Dropout(self.dropouts[-1]))
+        if len(self.hidden_layers) == 1:
+            m.add(L.LSTM(self.hidden_layers[0],
+                         input_shape=self.feature_shape,
+                         return_sequences=False))
+            m.add(L.Dropout(self.dropouts[0]))
+        else:
+            m.add(L.LSTM(self.hidden_layers[0],
+                         input_shape=self.feature_shape,
+                         return_sequences=True))
+            m.add(L.Dropout(self.dropouts[0]))
+            for units, drop in zip(self.hidden_layers[1:-1],
+                                   self.dropouts[1:-1]):
+                m.add(L.LSTM(units, return_sequences=True))
+                m.add(L.Dropout(drop))
+            m.add(L.LSTM(self.hidden_layers[-1], return_sequences=False))
+            m.add(L.Dropout(self.dropouts[-1]))
         m.add(L.Dense(1))
         return m
 

@@ -92,9 +92,12 @@ class ImageClassifier(ZooModel):
                  input_shape: Sequence[int] = (224, 224, 3),
                  label_map: Optional[Dict[int, str]] = None):
         super().__init__()
+        # json keys are strings: normalize to int here, stringify in config
+        self.label_map = {int(k): v for k, v in (label_map or {}).items()}
         self._config = dict(depth=depth, class_num=class_num,
-                            input_shape=list(input_shape))
-        self.label_map = label_map or {}
+                            input_shape=list(input_shape),
+                            label_map={str(k): v
+                                       for k, v in self.label_map.items()})
         self.model = resnet(depth, class_num, input_shape)
 
     def predict_image_set(self, image_set, top_n: int = 5,

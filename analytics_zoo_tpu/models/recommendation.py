@@ -246,7 +246,6 @@ class SessionRecommender(Recommender):
                               zero_based_label: bool = True):
         probs = self.predict(sessions)
         top = np.argsort(-probs, axis=-1)[:, :max_items]
-        if not zero_based_label:
-            top = top + 1
-        return [list(zip(t.tolist(), probs[i, t].tolist()))
+        shift = 0 if zero_based_label else 1
+        return [list(zip((t + shift).tolist(), probs[i, t].tolist()))
                 for i, t in enumerate(top)]

@@ -21,25 +21,35 @@ class TextClassifier(ZooModel):
                  vocab_size: Optional[int] = None,
                  sequence_length: int = 500, encoder: str = "cnn",
                  encoder_output_dim: int = 256,
-                 embedding_weights: Optional[np.ndarray] = None):
+                 embedding_weights: Optional[np.ndarray] = None,
+                 pretrained: bool = False):
         super().__init__()
         if embedding_weights is None and (embedding_dim is None
                                           or vocab_size is None):
             raise ValueError("Provide embedding_weights or "
                              "(vocab_size, embedding_dim)")
-        self._config = dict(class_num=class_num, embedding_dim=embedding_dim,
-                            vocab_size=vocab_size,
-                            sequence_length=sequence_length, encoder=encoder,
-                            encoder_output_dim=encoder_output_dim)
         self.class_num = class_num
         self.sequence_length = sequence_length
         self.encoder = encoder.lower()
         self.encoder_output_dim = encoder_output_dim
+        if embedding_weights is None and pretrained:
+            # reload path: rebuild the frozen-WordEmbedding structure with a
+            # placeholder matrix; real weights come from the checkpoint
+            embedding_weights = np.zeros((vocab_size, embedding_dim),
+                                         np.float32)
         self.embedding_weights = embedding_weights
         self.vocab_size = vocab_size if embedding_weights is None \
             else embedding_weights.shape[0]
         self.embedding_dim = embedding_dim if embedding_weights is None \
             else embedding_weights.shape[1]
+        # persist DERIVED sizes (+ pretrained flag) so load_model can rebuild
+        # a weights-constructed instance
+        self._config = dict(class_num=class_num,
+                            embedding_dim=int(self.embedding_dim),
+                            vocab_size=int(self.vocab_size),
+                            sequence_length=sequence_length, encoder=encoder,
+                            encoder_output_dim=encoder_output_dim,
+                            pretrained=embedding_weights is not None)
         self.model = self.build_model()
 
     def build_model(self) -> Sequential:

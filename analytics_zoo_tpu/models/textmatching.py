@@ -35,12 +35,6 @@ class KNRM(ZooModel):
             raise ValueError("kernel_num must be >= 2")
         if target_mode not in ("ranking", "classification"):
             raise ValueError(f"Unsupported target_mode: {target_mode}")
-        self._config = dict(text1_length=text1_length,
-                            text2_length=text2_length,
-                            vocab_size=vocab_size, embed_size=embed_size,
-                            train_embed=train_embed, kernel_num=kernel_num,
-                            sigma=sigma, exact_sigma=exact_sigma,
-                            target_mode=target_mode)
         self.text1_length = text1_length
         self.text2_length = text2_length
         self.embed_weights = embed_weights
@@ -48,6 +42,16 @@ class KNRM(ZooModel):
             else embed_weights.shape[0]
         self.embed_size = embed_size if embed_weights is None \
             else embed_weights.shape[1]
+        # persist DERIVED sizes so a weights-constructed KNRM reloads (the
+        # Embedding layer structure is identical either way; checkpoint
+        # weights overwrite the fresh init)
+        self._config = dict(text1_length=text1_length,
+                            text2_length=text2_length,
+                            vocab_size=int(self.vocab_size),
+                            embed_size=int(self.embed_size),
+                            train_embed=train_embed, kernel_num=kernel_num,
+                            sigma=sigma, exact_sigma=exact_sigma,
+                            target_mode=target_mode)
         self.train_embed = train_embed
         self.kernel_num = kernel_num
         self.sigma = sigma

@@ -1,0 +1,11 @@
+"""Parallelism strategies over the device mesh.
+
+The reference is data-parallel only (five transports, SURVEY §2.5); this
+package supplies the parallelism the TPU build adds as first-class features:
+tensor/FSDP sharding rules (GSPMD PartitionSpecs), sequence/context parallel
+ring attention (`shard_map` + `ppermute`), and pipeline stages.
+"""
+
+from analytics_zoo_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules, TRANSFORMER_RULES, param_specs, shard_params,
+    shard_batch, build_sharded_train_step)

@@ -1,0 +1,225 @@
+"""Parallelism tests on the 8-device virtual CPU mesh (conftest), the
+single-host stand-in for a pod — the reference's `local[N]` test strategy
+(SURVEY §4) mapped to TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.common.config import MeshConfig
+from analytics_zoo_tpu.common.mesh import DeviceMesh
+from analytics_zoo_tpu.pallas.flash_attention import _reference_attention
+from analytics_zoo_tpu.parallel.ring_attention import ring_attention
+from analytics_zoo_tpu.parallel.sharding import (
+    TRANSFORMER_RULES, build_sharded_train_step, param_specs, shard_batch,
+    shard_params)
+
+
+@pytest.fixture(scope="module")
+def tp_mesh():
+    return DeviceMesh(MeshConfig(data=2, fsdp=2, tensor=2))
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return DeviceMesh(MeshConfig(data=2, sequence=4))
+
+
+class TestShardingRules:
+    def test_transformer_specs(self, tp_mesh):
+        params = {"blk": {"attn": {
+            "qkv_kernel": np.zeros((64, 192)),
+            "qkv_bias": np.zeros((192,)),
+            "out_kernel": np.zeros((64, 64)),
+            "out_bias": np.zeros((64,)),
+        }, "ln1": {"gamma": np.zeros((64,))}}}
+        specs = param_specs(params, tp_mesh, TRANSFORMER_RULES)
+        attn = specs["blk"]["attn"]
+        assert attn["qkv_kernel"] == P("fsdp", "tensor")
+        assert attn["qkv_bias"] == P("tensor")
+        assert attn["out_kernel"] == P("tensor", "fsdp")
+        assert attn["out_bias"] == P()
+
+    def test_non_divisible_falls_back(self, tp_mesh):
+        # dim 3 not divisible by tensor=2 -> axis dropped
+        specs = param_specs({"x_qkv_kernel": np.zeros((6, 3))}, tp_mesh)
+        assert specs["x_qkv_kernel"] == P("fsdp")
+
+    def test_fsdp_fallback_largest_dim(self, tp_mesh):
+        specs = param_specs({"some_weight": np.zeros((3, 8))}, tp_mesh)
+        assert specs["some_weight"] == P(None, "fsdp")
+
+    def test_shard_params_places_on_mesh(self, tp_mesh):
+        params = {"a_qkv_kernel": np.ones((8, 12), np.float32)}
+        sharded = shard_params(params, tp_mesh)
+        shard_shapes = {s.data.shape
+                        for s in sharded["a_qkv_kernel"].addressable_shards}
+        assert shard_shapes == {(4, 6)}  # fsdp=2 x tensor=2
+
+
+class TestShardedTrainStep:
+    def test_tp_fsdp_training_decreases_loss(self, tp_mesh):
+        """End-to-end: tiny BERT sharded dp x fsdp x tp, loss goes down and
+        the sharded result matches single-device training numerically."""
+        from __graft_entry__ import _build_bert_classifier
+        from analytics_zoo_tpu.ops import objectives
+
+        forward, params0 = _build_bert_classifier(
+            vocab=64, hidden=16, n_block=1, n_head=2, seq_len=8,
+            intermediate=32, n_classes=2, rng=jax.random.PRNGKey(0))
+        # host copies: the train step donates its inputs, so each run()
+        # must start from fresh device buffers
+        params0 = jax.tree_util.tree_map(np.asarray, params0)
+        loss_obj = objectives.get("sparse_categorical_crossentropy",
+                                  from_logits=True)
+        opt = optax.adam(1e-2)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 64, (8, 8)).astype(np.int32)
+        mask = np.ones((8, 8), np.float32)
+        labels = rng.randint(0, 2, (8,)).astype(np.int32)
+
+        def apply_fn(p, xb, training=False, rng=None):
+            return forward(p, xb["ids"], xb["mask"], training=training,
+                           rng=rng)
+
+        def run(mesh):
+            if mesh is None:
+                params = jax.tree_util.tree_map(jnp.asarray, params0)
+                xb = {"ids": jnp.asarray(ids), "mask": jnp.asarray(mask)}
+                yb = jnp.asarray(labels)
+            else:
+                params = shard_params(params0, mesh)
+                xb = shard_batch({"ids": ids, "mask": mask}, mesh)
+                yb = shard_batch(labels, mesh)
+            opt_state = opt.init(params)
+            step = build_sharded_train_step(apply_fn, loss_obj, opt)
+            losses = []
+            key = jax.random.PRNGKey(1)
+            for _ in range(10):
+                params, opt_state, loss = step(params, opt_state, xb, yb,
+                                               key)
+                losses.append(float(loss))
+            return losses
+
+        sharded_losses = run(tp_mesh)
+        single_losses = run(None)
+        assert sharded_losses[-1] < sharded_losses[0]
+        np.testing.assert_allclose(sharded_losses, single_losses,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestRingAttention:
+    @pytest.fixture(scope="class")
+    def qkv(self):
+        rng = np.random.RandomState(0)
+        B, H, T, D = 4, 2, 32, 8
+        return tuple(jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+                     for _ in range(3))
+
+    def test_matches_reference_no_mask(self, sp_mesh, qkv):
+        q, k, v = qkv
+        out = ring_attention(q, k, v, None, mesh=sp_mesh)
+        ref = _reference_attention(q, k, v, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_matches_reference_with_mask(self, sp_mesh, qkv):
+        q, k, v = qkv
+        mask = np.zeros((4, 32), np.float32)
+        mask[:, 20:] = -10000.0
+        out = ring_attention(q, k, v, jnp.asarray(mask), mesh=sp_mesh)
+        ref = _reference_attention(q, k, v, jnp.asarray(mask)[:, None, None])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_with_tensor_axis_too(self, qkv):
+        mesh = DeviceMesh(MeshConfig(data=2, sequence=2, tensor=2))
+        q, k, v = qkv
+        out = ring_attention(q, k, v, None, mesh=mesh)
+        ref = _reference_attention(q, k, v, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_jit_and_grad(self, sp_mesh, qkv):
+        q, k, v = qkv
+
+        @jax.jit
+        def f(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, None, mesh=sp_mesh) ** 2)
+
+        g = jax.grad(f)(q, k, v)
+        ref_g = jax.grad(
+            lambda q, k, v: jnp.sum(
+                _reference_attention(q, k, v, None) ** 2))(q, k, v)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g),
+                                   atol=1e-4)
+
+
+class TestPipeline:
+    @pytest.fixture(scope="class")
+    def stages(self):
+        rng = np.random.RandomState(0)
+        S, d = 4, 16
+        W = jnp.asarray(rng.randn(S, d, d) * 0.3, jnp.float32)
+        b = jnp.asarray(rng.randn(S, d) * 0.1, jnp.float32)
+        x = jnp.asarray(rng.randn(32, d), jnp.float32)
+        return W, b, x
+
+    @staticmethod
+    def _stage_fn(p, x):
+        return jnp.tanh(x @ p["W"] + p["b"])
+
+    @staticmethod
+    def _ref(W, b, x):
+        for s in range(W.shape[0]):
+            x = jnp.tanh(x @ W[s] + b[s])
+        return x
+
+    def test_forward_matches_sequential(self, stages):
+        from analytics_zoo_tpu.parallel.pipeline import (
+            from_microbatches, pipeline_apply, to_microbatches)
+        W, b, x = stages
+        mesh = DeviceMesh(MeshConfig(pipeline=4, data=2))
+        mbs = to_microbatches(x, 8)
+        y = from_microbatches(
+            pipeline_apply(self._stage_fn, {"W": W, "b": b}, mbs, mesh))
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(self._ref(W, b, x)), atol=1e-6)
+
+    def test_gradient_matches(self, stages):
+        from analytics_zoo_tpu.parallel.pipeline import (
+            pipeline_apply, to_microbatches)
+        W, b, x = stages
+        mesh = DeviceMesh(MeshConfig(pipeline=4, data=2))
+        mbs = to_microbatches(x, 8)
+        g = jax.grad(lambda W: jnp.sum(pipeline_apply(
+            self._stage_fn, {"W": W, "b": b}, mbs, mesh) ** 2))(W)
+        g_ref = jax.grad(
+            lambda W: jnp.sum(self._ref(W, b, x) ** 2))(W)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=1e-4)
+
+    def test_single_stage_axis_fallback(self, stages):
+        from analytics_zoo_tpu.parallel.pipeline import (
+            from_microbatches, pipeline_apply, to_microbatches)
+        W, b, x = stages
+        mesh = DeviceMesh(MeshConfig(data=8))
+        mbs = to_microbatches(x, 8)
+        y = from_microbatches(
+            pipeline_apply(self._stage_fn, {"W": W, "b": b}, mbs, mesh))
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(self._ref(W, b, x)), atol=1e-6)
+
+    def test_microbatch_roundtrip_validation(self):
+        from analytics_zoo_tpu.parallel.pipeline import to_microbatches
+        with pytest.raises(ValueError):
+            to_microbatches(jnp.zeros((10, 3)), 4)
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip(self):
+        from __graft_entry__ import dryrun_multichip
+        dryrun_multichip(8)
