@@ -32,6 +32,20 @@ def _require_cv2():
             "it is unavailable in this environment")
 
 
+def load_image(value) -> np.ndarray:
+    """Image path or raw encoded bytes -> RGB HWC uint8 ndarray (the serving
+    client's image ingestion; reference ships b64 JPEG, `client.py:114`)."""
+    _require_cv2()
+    if isinstance(value, (bytes, bytearray)):
+        arr = cv2.imdecode(np.frombuffer(bytes(value), np.uint8),
+                           cv2.IMREAD_COLOR)
+    else:
+        arr = cv2.imread(str(value))
+    if arr is None:
+        raise ValueError("Could not decode image input")
+    return cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+
+
 class ImageProcessing:
     """Composable transform; `>>` or `chain` composes (the reference's
     `->` pipeline operator)."""

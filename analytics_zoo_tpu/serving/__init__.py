@@ -1,0 +1,17 @@
+"""Inference service layer — the Cluster Serving analogue (SURVEY §2.9).
+
+The reference runs a Flink job between Redis streams and a JNI-wrapped model
+(`serving/ClusterServing.scala:70`); here a host-side serving loop batches
+queue records into shape-bucketed jit'd forwards on the TPU. The client
+protocol surface (`InputQueue`/`OutputQueue`, `pyzoo/zoo/serving/client.py`)
+is preserved; the transport is a pluggable broker (in-memory, TCP, or Redis
+when available) instead of a hard Redis dependency.
+"""
+
+from analytics_zoo_tpu.serving.inference_model import InferenceModel  # noqa: F401
+from analytics_zoo_tpu.serving.broker import (  # noqa: F401
+    MemoryBroker, TCPBroker, TCPBrokerServer, connect_broker)
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue  # noqa: F401
+from analytics_zoo_tpu.serving.server import ClusterServing  # noqa: F401
+from analytics_zoo_tpu.serving.timer import Timer  # noqa: F401
+from analytics_zoo_tpu.serving.http_frontend import FrontEnd  # noqa: F401

@@ -1,0 +1,301 @@
+"""Queue brokers — the serving data plane.
+
+The reference's data plane is a Redis stream with consumer groups
+(`FlinkRedisSource.scala:66-87` xgroupCreate/xreadGroup, results HSET back,
+`FlinkRedisSink.scala:67`). Same contract here — `xadd` records, `read_group`
+batches with at-least-once redelivery via pending-ack, `hset`/`hget` results —
+over three interchangeable transports:
+
+- MemoryBroker: in-process (single-host serving, tests).
+- TCPBroker(Server): stdlib-socket line protocol so clients in other
+  processes/hosts can enqueue (this image has no redis server/client).
+- RedisBroker: drop-in when `redis` is importable; keys/streams named as the
+  reference (`serving_stream`, result hashes).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def encode_ndarray(arr: np.ndarray) -> Dict:
+    """b64 ndarray encoding, the client protocol of `serving/client.py:114`
+    (reference uses b64 of arrow/raw bytes; raw bytes here)."""
+    arr = np.ascontiguousarray(arr)
+    return {"b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+            "dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def decode_ndarray(blob: Dict) -> np.ndarray:
+    data = base64.b64decode(blob["b64"])
+    return np.frombuffer(data, dtype=np.dtype(blob["dtype"])).reshape(
+        blob["shape"]).copy()
+
+
+class Broker:
+    """Stream + result-hash contract."""
+
+    def xadd(self, stream: str, record: Dict) -> str:
+        raise NotImplementedError
+
+    def read_group(self, stream: str, group: str, consumer: str,
+                   count: int, block_ms: int = 100
+                   ) -> List[Tuple[str, Dict]]:
+        raise NotImplementedError
+
+    def ack(self, stream: str, group: str, ids: List[str]) -> None:
+        raise NotImplementedError
+
+    def hset(self, key: str, field: str, value: str) -> None:
+        raise NotImplementedError
+
+    def hget(self, key: str, field: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def hgetall(self, key: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def hdel(self, key: str, field: str) -> None:
+        raise NotImplementedError
+
+
+class MemoryBroker(Broker):
+    def __init__(self, redeliver_after_s: float = 30.0):
+        self._lock = threading.Condition()
+        self._streams: Dict[str, OrderedDict] = {}
+        self._pending: Dict[Tuple[str, str], Dict[str, float]] = {}
+        self._hashes: Dict[str, Dict[str, str]] = {}
+        self._seq = 0
+        self.redeliver_after_s = redeliver_after_s
+
+    def xadd(self, stream, record):
+        with self._lock:
+            self._seq += 1
+            rid = f"{int(time.time() * 1000)}-{self._seq}"
+            self._streams.setdefault(stream, OrderedDict())[rid] = record
+            self._lock.notify_all()
+            return rid
+
+    def read_group(self, stream, group, consumer, count, block_ms=100):
+        deadline = time.time() + block_ms / 1000.0
+        with self._lock:
+            while True:
+                out = []
+                s = self._streams.get(stream, OrderedDict())
+                pend = self._pending.setdefault((stream, group), {})
+                now = time.time()
+                for rid, rec in s.items():
+                    if len(out) >= count:
+                        break
+                    taken = pend.get(rid)
+                    # undelivered, or delivered-but-unacked past the
+                    # redelivery window (consumer died: at-least-once)
+                    if taken is None or now - taken > self.redeliver_after_s:
+                        pend[rid] = now
+                        out.append((rid, rec))
+                if out or time.time() >= deadline:
+                    return out
+                self._lock.wait(timeout=max(deadline - time.time(), 0.001))
+
+    def ack(self, stream, group, ids):
+        with self._lock:
+            s = self._streams.get(stream, OrderedDict())
+            pend = self._pending.get((stream, group), {})
+            for rid in ids:
+                s.pop(rid, None)
+                pend.pop(rid, None)
+
+    def hset(self, key, field, value):
+        with self._lock:
+            self._hashes.setdefault(key, {})[field] = value
+            self._lock.notify_all()
+
+    def hget(self, key, field):
+        with self._lock:
+            return self._hashes.get(key, {}).get(field)
+
+    def hgetall(self, key):
+        with self._lock:
+            return dict(self._hashes.get(key, {}))
+
+    def hdel(self, key, field):
+        with self._lock:
+            self._hashes.get(key, {}).pop(field, None)
+
+
+# ---------------------------------------------------------------------------
+# TCP transport: newline-delimited JSON RPC onto a shared MemoryBroker
+# ---------------------------------------------------------------------------
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+                fn = getattr(self.server.broker, req["op"])
+                result = fn(*req.get("args", []))
+                resp = {"ok": True, "result": result}
+            except Exception as e:  # noqa: BLE001 — serve must not die
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class TCPBrokerServer:
+    """Serve a MemoryBroker over TCP (the image has no Redis server)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 broker: Optional[MemoryBroker] = None):
+        self.broker = broker or MemoryBroker()
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.broker = self.broker
+        self.host, self.port = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "TCPBrokerServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class TCPBroker(Broker):
+    """Client for TCPBrokerServer; one socket per thread."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379):
+        self.host, self.port = host, port
+        self._local = threading.local()
+
+    def _conn(self):
+        if getattr(self._local, "sock", None) is None:
+            sock = socket.create_connection((self.host, self.port), timeout=30)
+            self._local.sock = sock
+            self._local.rfile = sock.makefile("rb")
+        return self._local.sock, self._local.rfile
+
+    def _call(self, op: str, *args):
+        try:
+            sock, rfile = self._conn()
+            sock.sendall((json.dumps({"op": op, "args": list(args)}) + "\n")
+                         .encode())
+            resp = json.loads(rfile.readline())
+        except Exception:
+            # drop the (possibly dead) cached socket so the next call on
+            # this thread reconnects instead of reusing a poisoned one
+            sock = getattr(self._local, "sock", None)
+            if sock is not None:
+                try:
+                    sock.close()
+                finally:
+                    self._local.sock = None
+            raise
+        if not resp.get("ok"):
+            raise RuntimeError(f"broker error: {resp.get('error')}")
+        result = resp["result"]
+        if op == "read_group" and result is not None:
+            result = [tuple(item) for item in result]
+        return result
+
+    def xadd(self, stream, record):
+        return self._call("xadd", stream, record)
+
+    def read_group(self, stream, group, consumer, count, block_ms=100):
+        return self._call("read_group", stream, group, consumer, count,
+                          block_ms)
+
+    def ack(self, stream, group, ids):
+        return self._call("ack", stream, group, ids)
+
+    def hset(self, key, field, value):
+        return self._call("hset", key, field, value)
+
+    def hget(self, key, field):
+        return self._call("hget", key, field)
+
+    def hgetall(self, key):
+        return self._call("hgetall", key)
+
+    def hdel(self, key, field):
+        return self._call("hdel", key, field)
+
+
+class RedisBroker(Broker):
+    """Real Redis backend (reference-faithful), gated on the `redis` package."""
+
+    def __init__(self, host: str = "localhost", port: int = 6379):
+        import redis  # optional dep; ImportError surfaces to the caller
+        self._r = redis.Redis(host=host, port=port, decode_responses=True)
+        self._groups_made = set()
+
+    def xadd(self, stream, record):
+        return self._r.xadd(stream, {"json": json.dumps(record)})
+
+    def _ensure_group(self, stream, group):
+        if (stream, group) in self._groups_made:
+            return
+        try:
+            self._r.xgroup_create(stream, group, id="0", mkstream=True)
+        except Exception:  # noqa: BLE001 — BUSYGROUP: already exists
+            pass
+        self._groups_made.add((stream, group))
+
+    def read_group(self, stream, group, consumer, count, block_ms=100):
+        self._ensure_group(stream, group)
+        resp = self._r.xreadgroup(group, consumer, {stream: ">"},
+                                  count=count, block=block_ms)
+        out = []
+        for _, entries in resp or []:
+            for rid, fields in entries:
+                out.append((rid, json.loads(fields["json"])))
+        return out
+
+    def ack(self, stream, group, ids):
+        if ids:
+            self._r.xack(stream, group, *ids)
+            self._r.xdel(stream, *ids)
+
+    def hset(self, key, field, value):
+        self._r.hset(key, field, value)
+
+    def hget(self, key, field):
+        return self._r.hget(key, field)
+
+    def hgetall(self, key):
+        return self._r.hgetall(key)
+
+    def hdel(self, key, field):
+        self._r.hdel(key, field)
+
+
+def connect_broker(url: Optional[str] = None) -> Broker:
+    """"memory", "tcp://host:port", or "redis://host:port"; default memory."""
+    if url in (None, "", "memory"):
+        return MemoryBroker()
+    if url.startswith("tcp://"):
+        host, _, port = url[6:].partition(":")
+        return TCPBroker(host or "127.0.0.1", int(port or 6379))
+    if url.startswith("redis://"):
+        host, _, port = url[8:].partition(":")
+        return RedisBroker(host or "localhost", int(port or 6379))
+    raise ValueError(f"Unsupported broker url: {url}")
+
+
+def new_consumer_name() -> str:
+    return f"consumer-{uuid.uuid4().hex[:8]}"

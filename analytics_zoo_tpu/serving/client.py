@@ -1,0 +1,97 @@
+"""Serving client — `InputQueue`/`OutputQueue` (`pyzoo/zoo/serving/client.py`).
+
+Protocol preserved from the reference: `enqueue` XADDs a b64-encoded ndarray
+(or image file) to the serving stream (`client.py:114`), `predict` is the
+sync round-trip (`client.py:199` via the HTTP frontend there; here it polls
+the result hash), `OutputQueue.query/dequeue` read results back
+(`client.py:203`). Results arrive as b64 ndarrays or the literal "NaN" for
+per-record failures (`ClusterServingInference.scala:71-79` degradation)."""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.broker import (Broker, connect_broker,
+                                              decode_ndarray, encode_ndarray)
+
+STREAM = "serving_stream"          # reference stream name
+RESULT_KEY = "result:serving_stream"
+
+
+class InputQueue:
+    def __init__(self, broker: Union[Broker, str, None] = None,
+                 stream: str = STREAM):
+        self.broker = broker if isinstance(broker, Broker) \
+            else connect_broker(broker)
+        self.stream = stream
+
+    def enqueue(self, uri: Optional[str] = None, **data) -> str:
+        """`enqueue("uuid", t=ndarray)` or path/bytes via `image=`."""
+        uri = uri or uuid.uuid4().hex
+        payload: Dict = {}
+        for name, value in data.items():
+            if isinstance(value, np.ndarray):
+                payload[name] = encode_ndarray(value)
+            elif name == "image":
+                payload[name] = self._encode_image(value)
+            else:
+                payload[name] = value
+        self.broker.xadd(self.stream, {"uri": uri, "data": payload})
+        return uri
+
+    @staticmethod
+    def _encode_image(value) -> Dict:
+        """Image path/bytes -> decoded float ndarray record (the reference
+        ships b64 JPEG and decodes OpenCV-side; decode client-side here so
+        the server stays shape-generic)."""
+        from analytics_zoo_tpu.data.image import load_image
+        arr = load_image(value)
+        return encode_ndarray(arr.astype(np.float32))
+
+    def predict(self, data: np.ndarray, timeout_s: float = 30.0) -> np.ndarray:
+        """Sync path (`client.py:199`): enqueue then poll the result."""
+        uri = self.enqueue(None, t=np.asarray(data))
+        out = OutputQueue(self.broker, self.stream)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            res = out.query(uri, delete=True)
+            if res is not None:
+                return res
+            time.sleep(0.005)
+        raise TimeoutError(f"No prediction for {uri} within {timeout_s}s")
+
+
+class OutputQueue:
+    def __init__(self, broker: Union[Broker, str, None] = None,
+                 stream: str = STREAM):
+        self.broker = broker if isinstance(broker, Broker) \
+            else connect_broker(broker)
+        self.result_key = f"result:{stream}"
+
+    def query(self, uri: str, delete: bool = False):
+        raw = self.broker.hget(self.result_key, uri)
+        if raw is None:
+            return None
+        if delete:
+            self.broker.hdel(self.result_key, uri)
+        return self._decode(raw)
+
+    def dequeue(self) -> Dict[str, np.ndarray]:
+        """Drain all results (`client.py:203` semantics)."""
+        allr = self.broker.hgetall(self.result_key)
+        out = {}
+        for uri, raw in allr.items():
+            out[uri] = self._decode(raw)
+            self.broker.hdel(self.result_key, uri)
+        return out
+
+    @staticmethod
+    def _decode(raw: str):
+        if raw == "NaN":   # per-record failure marker
+            return float("nan")
+        return decode_ndarray(json.loads(raw))

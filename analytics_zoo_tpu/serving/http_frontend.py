@@ -1,0 +1,103 @@
+"""HTTP frontend — the akka-http gateway analogue
+(`serving/http/FrontEndApp.scala:126-232`).
+
+Routes preserved: `POST /predict` (sync prediction: enqueue to the broker,
+await the result — `FrontEndApp.scala:163`), `GET /metrics` (timer snapshots
+as JSON, `:131,241`), plus `GET /` liveness ("welcome to analytics zoo web
+serving frontend"). Stdlib ThreadingHTTPServer: no extra dependency, one
+thread per in-flight request, the TPU work itself is serialized by the
+serving loop behind the broker."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.broker import Broker, connect_broker
+from analytics_zoo_tpu.serving.client import InputQueue
+from analytics_zoo_tpu.serving.server import ClusterServing
+from analytics_zoo_tpu.serving.timer import Timer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/":
+            self._send(200, {"message": "welcome to analytics zoo web "
+                                        "serving frontend"})
+        elif self.path == "/metrics":
+            serving: Optional[ClusterServing] = self.server.serving
+            timers = {"frontend": self.server.request_timer.snapshot()}
+            if serving is not None:
+                timers.update(serving.metrics())
+            self._send(200, timers)
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            self._send(404, {"error": "not found"})
+            return
+        with self.server.request_timer.timing():
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                # {"instances": [[...], ...]} tf-serving-style, or
+                # {"b64","dtype","shape"} raw tensor
+                if "instances" in req:
+                    arr = np.asarray(req["instances"], np.float32)
+                else:
+                    from analytics_zoo_tpu.serving.broker import \
+                        decode_ndarray
+                    arr = decode_ndarray(req)
+                result = self.server.input_queue.predict(
+                    arr, timeout_s=self.server.timeout_s)
+                if isinstance(result, float) and np.isnan(result):
+                    self._send(500, {"error": "inference failure (NaN)"})
+                else:
+                    self._send(200, {"predictions": np.asarray(result)
+                                     .tolist()})
+            except Exception as e:  # noqa: BLE001 — frontend must not die
+                self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+
+class FrontEnd:
+    """`FrontEndApp` equivalent: HTTP server in front of a broker stream."""
+
+    def __init__(self, broker: Union[Broker, str, None] = None,
+                 serving: Optional[ClusterServing] = None,
+                 host: str = "0.0.0.0", port: int = 10020,
+                 timeout_s: float = 30.0):
+        self.broker = broker if isinstance(broker, Broker) \
+            else connect_broker(broker)
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv.daemon_threads = True
+        self._srv.input_queue = InputQueue(self.broker)
+        self._srv.serving = serving
+        self._srv.request_timer = Timer("http_predict")
+        self._srv.timeout_s = timeout_s
+        self.host, self.port = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "FrontEnd":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()

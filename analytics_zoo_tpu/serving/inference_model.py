@@ -1,0 +1,133 @@
+"""InferenceModel — the multi-backend concurrent inference façade.
+
+Reference: `pipeline/inference/InferenceModel.scala:28`: a queue of
+`concurrentNum` model copies (`:62,520-624`), loaders for every engine, and
+thread-safe `doPredict`. TPU-native redesign:
+
+- No model copies: a jit-compiled function is immutable and thread-safe;
+  "concurrency" is a semaphore bounding in-flight predict calls (XLA
+  serializes device work; the bound keeps host-side queuing sane) — with
+  `auto_scaling` the permit count grows on contention like the reference's
+  queue-cloning (`:587`).
+- Dynamic shapes are the TPU hazard (recompiles), so predict pads the batch
+  to a power-of-two bucket and caches one executable per bucket — the
+  serving analogue of `hard_code_batch_size`.
+- Loaders: native Keras-style models / ZooModel zoo dirs / pure fn+params /
+  torch modules (via the torch bridge). The reference's TF/OpenVINO/Caffe
+  loaders map onto the native-model path (their runtimes don't exist on TPU;
+  weights must be converted, cf. `learn/torch_bridge.py`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.serving.timer import Timer
+
+
+def _next_bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class InferenceModel:
+    def __init__(self, concurrent_num: int = 1, auto_scaling: bool = False,
+                 max_batch: int = 512):
+        self.concurrent_num = concurrent_num
+        self.auto_scaling = auto_scaling
+        self._sema = threading.BoundedSemaphore(concurrent_num) \
+            if not auto_scaling else threading.Semaphore(concurrent_num)
+        self._fn: Optional[Callable] = None
+        self._params = None
+        self.max_batch = max_batch
+        self.buckets = [b for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+                        if b <= max_batch] or [max_batch]
+        self._jit: Optional[Callable] = None
+        self.timer = Timer("predict")
+
+    # -- loaders (`doLoad*`, InferenceModel.scala:76-318) ------------------
+    def load_keras(self, model, params=None) -> "InferenceModel":
+        """A native Keras-style model (Sequential/Model/ZooModel)."""
+        from analytics_zoo_tpu.models.common import ZooModel
+        if isinstance(model, ZooModel):
+            model = model.model
+        if params is not None:
+            model.params = params
+        if model.params is None:
+            raise ValueError("Model has no parameters; fit or load first")
+        return self.load_fn(lambda p, x: model.apply(p, x, training=False),
+                            model.params)
+
+    def load_zoo_model(self, cls, path: str) -> "InferenceModel":
+        """`doLoadBigDL` analogue: a saved ZooModel directory."""
+        return self.load_keras(cls.load_model(path))
+
+    def load_fn(self, fn: Callable, params) -> "InferenceModel":
+        """Pure `fn(params, x)` forward."""
+        self._fn = fn
+        self._params = params
+        # one jit wrapper; jax caches an executable per input shape (= per
+        # bucket), so no per-bucket bookkeeping is needed
+        self._jit = jax.jit(fn)
+        return self
+
+    def load_torch(self, torch_module) -> "InferenceModel":
+        """`doLoadPyTorch` analogue: convert the module natively (the
+        reference embeds CPython via JEP; on TPU the model becomes XLA)."""
+        from analytics_zoo_tpu.learn.torch_bridge import convert_torch_module
+        native = convert_torch_module(torch_module)
+        sample_shape = getattr(native, "input_shape", None)
+        if native.params is None and sample_shape is not None:
+            native.ensure_built(np.zeros((1,) + tuple(sample_shape[1:]),
+                                         np.float32))
+        return self.load_keras(native)
+
+    # -- predict (`doPredict`, InferenceModel.scala:520-624) ---------------
+    def predict(self, x) -> np.ndarray:
+        if self._fn is None:
+            raise RuntimeError("No model loaded")
+        x = jax.tree_util.tree_map(np.asarray, x)
+        leaves = jax.tree_util.tree_leaves(x)
+        n = leaves[0].shape[0] if leaves[0].ndim > 0 else 1
+
+        if n > self.max_batch:
+            # split oversize inputs into max_batch chunks
+            chunks = []
+            for s in range(0, n, self.max_batch):
+                part = jax.tree_util.tree_map(
+                    lambda a: a[s:s + self.max_batch], x)
+                chunks.append(self.predict(part))
+            return jax.tree_util.tree_map(
+                lambda *cs: np.concatenate(cs), *chunks)
+
+        acquired = self._sema.acquire(timeout=60)
+        if not acquired:
+            if not self.auto_scaling:
+                raise TimeoutError("predict queue exhausted "
+                                   "(concurrent_num permits busy)")
+            self._sema.release()  # grow like the reference's auto-scaling
+        try:
+            with self.timer.timing():
+                bucket = _next_bucket(n, self.buckets)
+                if n != bucket:
+                    pad = bucket - n
+                    x = jax.tree_util.tree_map(
+                        lambda a: np.concatenate(
+                            [a, np.repeat(a[-1:], pad, axis=0)]), x)
+                out = self._jit(self._params, x)
+                out = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[:n], out)
+                return out
+        finally:
+            if acquired:
+                self._sema.release()
+
+    def predict_batches(self, xs: List) -> List:
+        return [self.predict(x) for x in xs]

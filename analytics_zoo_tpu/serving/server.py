@@ -1,0 +1,118 @@
+"""ClusterServing — the serving loop.
+
+Reference: Flink job `RedisSource -> inference map -> RedisSink`
+(`ClusterServing.scala:55-68`), batching up to core count
+(`ClusterServingInference.scala:152` batchInput), singleton model per task
+manager (`FlinkInference.scala:41-52`), per-record failures degrade to "NaN"
+(`:71-79`). TPU redesign: one host thread drains the broker stream, groups
+records into a batch (up to `batch_size`, waiting at most `batch_timeout_ms`
+for stragglers), pads to the InferenceModel's shape bucket, runs the jit'd
+forward once, and writes per-record results back — dynamic batching under a
+latency SLO instead of Flink operator parallelism."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Optional, Union
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.broker import (Broker, connect_broker,
+                                              decode_ndarray, encode_ndarray,
+                                              new_consumer_name)
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.timer import Timer
+
+log = logging.getLogger("analytics_zoo_tpu.serving")
+
+GROUP = "serving_group"
+
+
+class ClusterServing:
+    def __init__(self, model: InferenceModel,
+                 broker: Union[Broker, str, None] = None,
+                 stream: str = "serving_stream",
+                 batch_size: int = 32, batch_timeout_ms: int = 5):
+        self.model = model
+        self.broker = broker if isinstance(broker, Broker) \
+            else connect_broker(broker)
+        self.stream = stream
+        self.result_key = f"result:{stream}"
+        self.batch_size = batch_size
+        self.batch_timeout_ms = batch_timeout_ms
+        self.consumer = new_consumer_name()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.batch_timer = Timer("batch")
+        self.records_served = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ClusterServing":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def run(self):
+        while not self._stop.is_set():
+            self.serve_once()
+
+    # -- one drain->batch->predict->sink cycle -----------------------------
+    def serve_once(self) -> int:
+        records = self.broker.read_group(
+            self.stream, GROUP, self.consumer, self.batch_size,
+            block_ms=self.batch_timeout_ms)
+        if not records:
+            return 0
+        with self.batch_timer.timing():
+            self._process(records)
+        self.broker.ack(self.stream, GROUP, [rid for rid, _ in records])
+        self.records_served += len(records)
+        return len(records)
+
+    def _process(self, records):
+        # decode; per-record decode failure -> NaN without killing the batch
+        decoded = []
+        for rid, rec in records:
+            try:
+                data = rec["data"]
+                # single-tensor fast path: field "t" or "image"
+                field = "t" if "t" in data else ("image" if "image" in data
+                                                 else next(iter(data)))
+                decoded.append((rec["uri"], decode_ndarray(data[field])))
+            except Exception as e:  # noqa: BLE001 — degrade per record
+                log.warning("decode failure for %s: %s", rec.get("uri"), e)
+                self.broker.hset(self.result_key, rec.get("uri", rid), "NaN")
+
+        if not decoded:
+            return
+        # group by shape so one forward serves each homogeneous sub-batch
+        by_shape = {}
+        for uri, arr in decoded:
+            by_shape.setdefault(arr.shape, []).append((uri, arr))
+        for shape, items in by_shape.items():
+            batch = np.stack([a for _, a in items])
+            try:
+                preds = self.model.predict(batch)
+                for (uri, _), pred in zip(items, preds):
+                    self.broker.hset(
+                        self.result_key, uri,
+                        json.dumps(encode_ndarray(np.asarray(pred))))
+            except Exception as e:  # noqa: BLE001 — stream must survive
+                log.error("inference failure for batch %s: %s", shape, e)
+                for uri, _ in items:
+                    self.broker.hset(self.result_key, uri, "NaN")
+
+    # -- metrics (`/metrics`, FrontEndApp.scala:241) -----------------------
+    def metrics(self) -> dict:
+        return {
+            "records_served": self.records_served,
+            "batch": self.batch_timer.snapshot(),
+            "predict": self.model.timer.snapshot(),
+        }

@@ -1,0 +1,75 @@
+"""Per-stage serving timers (`serving/engine/Timer.scala:33-100`): running
+min/max/avg and top-N slowest, printed per batch window; plus a metrics
+snapshot for the HTTP `/metrics` route (`http/FrontEndApp.scala:131,241`)."""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List
+
+
+class Timer:
+    def __init__(self, name: str, top_n: int = 10):
+        self.name = name
+        self.top_n = top_n
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with getattr(self, "_lock", threading.Lock()):
+            self.count = 0
+            self.total = 0.0
+            self.min = float("inf")
+            self.max = 0.0
+            self._top: List[float] = []
+
+    def record(self, seconds: float):
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            self.min = min(self.min, seconds)
+            self.max = max(self.max, seconds)
+            if len(self._top) < self.top_n:
+                heapq.heappush(self._top, seconds)
+            else:
+                heapq.heappushpop(self._top, seconds)
+
+    def timing(self):
+        """Context manager: `with timer.timing(): ...`"""
+        return _Span(self)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "name": self.name,
+                "count": self.count,
+                "avg_ms": round(self.avg * 1e3, 3),
+                "min_ms": round(self.min * 1e3, 3) if self.count else 0.0,
+                "max_ms": round(self.max * 1e3, 3),
+                "top": sorted((round(t * 1e3, 3) for t in self._top),
+                              reverse=True),
+            }
+
+    def __repr__(self):
+        s = self.snapshot()
+        return (f"Timer({self.name}: n={s['count']} avg={s['avg_ms']}ms "
+                f"min={s['min_ms']}ms max={s['max_ms']}ms)")
+
+
+class _Span:
+    def __init__(self, timer: Timer):
+        self.timer = timer
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.timer.record(time.perf_counter() - self.t0)
+        return False

@@ -1,0 +1,215 @@
+"""Serving subsystem tests: broker contract, InferenceModel bucketing,
+end-to-end queue->serving loop->result, HTTP frontend. Mirrors the
+reference's serving tests (`zoo/src/test/.../serving/`: protocol,
+pre/post-processing) on the single-host stand-in."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.serving import (ClusterServing, FrontEnd,
+                                       InferenceModel, InputQueue,
+                                       MemoryBroker, OutputQueue,
+                                       TCPBroker, TCPBrokerServer)
+from analytics_zoo_tpu.serving.broker import (decode_ndarray, encode_ndarray)
+
+
+def make_model(in_dim=4, out_dim=3):
+    m = Sequential([L.Dense(out_dim, input_shape=(in_dim,))])
+    m.ensure_built(np.zeros((1, in_dim), np.float32))
+    im = InferenceModel()
+    im.load_keras(m)
+    return m, im
+
+
+class TestBrokerContract:
+    def test_ndarray_codec_roundtrip(self):
+        a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        b = decode_ndarray(encode_ndarray(a))
+        np.testing.assert_array_equal(a, b)
+
+    def test_memory_stream_group_ack(self):
+        br = MemoryBroker()
+        r1 = br.xadd("s", {"v": 1})
+        br.xadd("s", {"v": 2})
+        got = br.read_group("s", "g", "c1", 10)
+        assert [rec["v"] for _, rec in got] == [1, 2]
+        # unacked: a second consumer doesn't see them (pending)
+        assert br.read_group("s", "g", "c2", 10, block_ms=1) == []
+        br.ack("s", "g", [r1])
+        # acked id is gone for good; the other remains pending
+        assert br.read_group("s", "g", "c3", 10, block_ms=1) == []
+
+    def test_memory_redelivery_after_timeout(self):
+        br = MemoryBroker(redeliver_after_s=0.05)
+        br.xadd("s", {"v": 1})
+        assert len(br.read_group("s", "g", "c1", 10)) == 1
+        time.sleep(0.08)
+        # consumer died without ack -> redelivered (at-least-once)
+        assert len(br.read_group("s", "g", "c2", 10)) == 1
+
+    def test_hash_ops(self):
+        br = MemoryBroker()
+        br.hset("k", "f", "v")
+        assert br.hget("k", "f") == "v"
+        assert br.hgetall("k") == {"f": "v"}
+        br.hdel("k", "f")
+        assert br.hget("k", "f") is None
+
+    def test_tcp_broker_roundtrip(self):
+        srv = TCPBrokerServer().start()
+        try:
+            cli = TCPBroker(srv.host, srv.port)
+            cli.xadd("s", {"v": 42})
+            got = cli.read_group("s", "g", "c", 5)
+            assert got[0][1]["v"] == 42
+            cli.ack("s", "g", [got[0][0]])
+            cli.hset("k", "f", "x")
+            assert cli.hget("k", "f") == "x"
+        finally:
+            srv.stop()
+
+
+class TestInferenceModel:
+    def test_bucketed_predict_shapes(self):
+        _, im = make_model()
+        for n in (1, 3, 7, 20):
+            out = im.predict(np.ones((n, 4), np.float32))
+            assert out.shape == (n, 3)
+
+    def test_oversize_batch_splits(self):
+        m = Sequential([L.Dense(3, input_shape=(4,))])
+        m.ensure_built(np.zeros((1, 4), np.float32))
+        im = InferenceModel(max_batch=8)
+        im.load_keras(m)
+        x = np.random.RandomState(0).randn(20, 4).astype(np.float32)
+        out = im.predict(x)
+        assert out.shape == (20, 3)
+        np.testing.assert_allclose(out, m.predict(x, batch_per_thread=32),
+                                   atol=1e-5)
+
+    def test_padding_does_not_change_results(self):
+        m, im = make_model()
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        got = im.predict(x)
+        want = m.predict(x, batch_per_thread=8)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_concurrent_predicts(self):
+        _, im = make_model()
+        im2 = InferenceModel(concurrent_num=4)
+        im2.load_fn(im._fn, im._params)
+        errs = []
+
+        def work():
+            try:
+                for _ in range(5):
+                    im2.predict(np.ones((2, 4), np.float32))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+        assert im2.timer.count == 40
+
+    def test_errors_without_model(self):
+        with pytest.raises(RuntimeError):
+            InferenceModel().predict(np.ones((1, 2)))
+
+
+class TestEndToEnd:
+    def test_queue_to_result(self):
+        m, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=8).start()
+        try:
+            q = InputQueue(br)
+            x = np.random.RandomState(1).randn(6, 4).astype(np.float32)
+            # async: enqueue rows individually, read back by uri
+            uris = [q.enqueue(None, t=x[i]) for i in range(3)]
+            out = OutputQueue(br)
+            deadline = time.time() + 10
+            results = {}
+            while len(results) < 3 and time.time() < deadline:
+                for u in uris:
+                    r = out.query(u)
+                    if r is not None:
+                        results[u] = r
+                time.sleep(0.01)
+            assert len(results) == 3
+            want = m.predict(x[:3], batch_per_thread=8)
+            for i, u in enumerate(uris):
+                np.testing.assert_allclose(results[u], want[i], atol=1e-5)
+            # sync path
+            got = q.predict(x[3])
+            np.testing.assert_allclose(got, want := m.predict(
+                x[3:4], batch_per_thread=8)[0], atol=1e-5)
+        finally:
+            serving.stop()
+
+    def test_bad_record_degrades_to_nan(self):
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=4).start()
+        try:
+            br.xadd("serving_stream",
+                    {"uri": "bad1", "data": {"t": {"b64": "!!!",
+                                                   "dtype": "float32",
+                                                   "shape": [2]}}})
+            deadline = time.time() + 10
+            while br.hget("result:serving_stream", "bad1") is None \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+            assert br.hget("result:serving_stream", "bad1") == "NaN"
+            # stream still alive afterwards
+            q = InputQueue(br)
+            out = q.predict(np.ones((4,), np.float32))
+            assert out.shape == (3,)
+        finally:
+            serving.stop()
+
+    def test_metrics_populated(self):
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br).start()
+        try:
+            InputQueue(br).predict(np.ones((4,), np.float32))
+            metrics = serving.metrics()
+            assert metrics["records_served"] >= 1
+            assert metrics["predict"]["count"] >= 1
+        finally:
+            serving.stop()
+
+
+class TestHTTPFrontend:
+    def test_predict_and_metrics_routes(self):
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br).start()
+        fe = FrontEnd(br, serving, host="127.0.0.1", port=0).start()
+        try:
+            url = f"http://127.0.0.1:{fe.port}"
+            req = urllib.request.Request(
+                url + "/predict",
+                data=json.dumps(
+                    {"instances": np.ones((2, 4)).tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            assert np.asarray(resp["predictions"]).shape == (2, 3)
+            metrics = json.loads(urllib.request.urlopen(
+                url + "/metrics", timeout=10).read())
+            assert metrics["frontend"]["count"] >= 1
+            root = json.loads(urllib.request.urlopen(
+                url + "/", timeout=10).read())
+            assert "welcome" in root["message"]
+        finally:
+            fe.stop()
+            serving.stop()
