@@ -1,0 +1,19 @@
+"""AutoML: hyperparameter search + time-series pipelines (SURVEY §2.10).
+
+The reference drives Ray Tune trials (`automl/search/ray_tune_search_engine.py:37`)
+over recipe-defined spaces (`automl/config/recipe.py`). This environment has
+no Ray, and a TPU host runs one trial at a time anyway — so the engine here
+executes trials in-process with the same surface: sample functions, recipes,
+ASHA-style successive halving. `backend="ray"` logs a warning and runs
+locally (Ray Tune dispatch is not wired in this build).
+"""
+
+from analytics_zoo_tpu.automl.search import (  # noqa: F401
+    SearchEngine, hp)
+from analytics_zoo_tpu.automl.recipe import (  # noqa: F401
+    Recipe, LSTMGridRandomRecipe, LSTMRandomRecipe, Seq2SeqRandomRecipe,
+    TCNGridRandomRecipe, MTNetGridRandomRecipe, BayesRecipe)
+from analytics_zoo_tpu.automl.feature import (  # noqa: F401
+    TimeSequenceFeatureTransformer)
+from analytics_zoo_tpu.automl.pipeline import (  # noqa: F401
+    TimeSequencePipeline, TimeSequencePredictor)

@@ -1,0 +1,133 @@
+"""Time-series feature engineering (`automl/feature/time_sequence.py:563`).
+
+`TimeSequenceFeatureTransformer`: datetime-derived features (hour, day of
+week, weekend, month...), standard scaling fitted on train only, and
+sliding-window unroll into (x[B, past_seq_len, F], y[B, horizon]) — the
+reference's fit_transform/transform/post_processing contract, including
+inverse-scaling predictions back to the original target unit."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+# feature name -> extractor over a pandas DatetimeIndex/Series
+_DT_FEATURES = {
+    "HOUR": lambda dt: dt.dt.hour,
+    "DAY": lambda dt: dt.dt.day,
+    "MONTH": lambda dt: dt.dt.month,
+    "DAYOFYEAR": lambda dt: dt.dt.dayofyear,
+    "WEEKDAY": lambda dt: dt.dt.weekday,
+    "WEEKOFYEAR": lambda dt: dt.dt.isocalendar().week.astype(np.int64),
+    "MINUTE": lambda dt: dt.dt.minute,
+    "IS_WEEKEND": lambda dt: (dt.dt.weekday >= 5).astype(np.int64),
+    "IS_AWAKE": lambda dt: ((dt.dt.hour >= 6) & (dt.dt.hour <= 23))
+    .astype(np.int64),
+    "IS_BUSY_HOURS": lambda dt: dt.dt.hour.isin([7, 8, 9, 17, 18, 19])
+    .astype(np.int64),
+}
+
+DEFAULT_FEATURES = ("HOUR", "IS_WEEKEND", "WEEKDAY", "MONTH")
+
+
+class TimeSequenceFeatureTransformer:
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 selected_features: Sequence[str] = DEFAULT_FEATURES,
+                 past_seq_len: int = 2, future_seq_len: int = 1,
+                 drop_missing: bool = True):
+        self.dt_col, self.target_col = dt_col, target_col
+        self.extra_features_col = list(extra_features_col or [])
+        self.selected_features = list(selected_features)
+        self.past_seq_len = past_seq_len
+        self.future_seq_len = future_seq_len
+        self.drop_missing = drop_missing
+        self._mean: Optional[np.ndarray] = None
+        self._std: Optional[np.ndarray] = None
+
+    # -- internals ---------------------------------------------------------
+    def _feature_frame(self, df: pd.DataFrame) -> np.ndarray:
+        if self.dt_col not in df.columns:
+            raise ValueError(f"Missing datetime column {self.dt_col!r}")
+        if self.target_col not in df.columns:
+            raise ValueError(f"Missing target column {self.target_col!r}")
+        df = df.copy()
+        if self.drop_missing:
+            df = df.dropna(subset=[self.target_col])
+        dt = pd.to_datetime(df[self.dt_col])
+        cols = [df[self.target_col].astype(np.float32)]
+        for name in self.selected_features:
+            if name not in _DT_FEATURES:
+                raise ValueError(f"Unknown datetime feature {name!r}; "
+                                 f"choose from {sorted(_DT_FEATURES)}")
+            cols.append(_DT_FEATURES[name](dt).astype(np.float32))
+        for c in self.extra_features_col:
+            cols.append(df[c].astype(np.float32))
+        return np.stack([np.asarray(c) for c in cols], axis=1)  # [T, F]
+
+    def _unroll(self, mat: np.ndarray, with_y: bool
+                ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        L, H = self.past_seq_len, self.future_seq_len
+        n = len(mat) - L - (H if with_y else 0) + 1
+        if n <= 0:
+            raise ValueError(
+                f"Series of length {len(mat)} too short for past_seq_len="
+                f"{L} + future_seq_len={H}")
+        x = np.stack([mat[i:i + L] for i in range(n)])
+        y = None
+        if with_y:
+            y = np.stack([mat[i + L:i + L + H, 0] for i in range(n)])
+        return x.astype(np.float32), \
+            (y.astype(np.float32) if y is not None else None)
+
+    # -- surface (`time_sequence.py` fit_transform/transform) --------------
+    def fit_transform(self, df: pd.DataFrame
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        mat = self._feature_frame(df)
+        self._mean = mat.mean(axis=0)
+        self._std = mat.std(axis=0) + 1e-8
+        mat = (mat - self._mean) / self._std
+        return self._unroll(mat, with_y=True)
+
+    def transform(self, df: pd.DataFrame, is_train: bool = False):
+        if self._mean is None:
+            raise RuntimeError("fit_transform first")
+        mat = (self._feature_frame(df) - self._mean) / self._std
+        x, y = self._unroll(mat, with_y=is_train)
+        return (x, y) if is_train else x
+
+    def post_processing(self, y_scaled: np.ndarray) -> np.ndarray:
+        """Inverse-scale predictions back to target units."""
+        if self._mean is None:
+            raise RuntimeError("fit_transform first")
+        return y_scaled * self._std[0] + self._mean[0]
+
+    # -- persistence -------------------------------------------------------
+    def state(self) -> Dict:
+        return {
+            "dt_col": self.dt_col, "target_col": self.target_col,
+            "extra_features_col": self.extra_features_col,
+            "selected_features": self.selected_features,
+            "past_seq_len": self.past_seq_len,
+            "future_seq_len": self.future_seq_len,
+            "mean": None if self._mean is None else self._mean.tolist(),
+            "std": None if self._std is None else self._std.tolist(),
+        }
+
+    @classmethod
+    def from_state(cls, state: Dict) -> "TimeSequenceFeatureTransformer":
+        t = cls(dt_col=state["dt_col"], target_col=state["target_col"],
+                extra_features_col=state["extra_features_col"],
+                selected_features=state["selected_features"],
+                past_seq_len=state["past_seq_len"],
+                future_seq_len=state["future_seq_len"])
+        if state["mean"] is not None:
+            t._mean = np.asarray(state["mean"], np.float32)
+            t._std = np.asarray(state["std"], np.float32)
+        return t
+
+    @property
+    def feature_dim(self) -> int:
+        return 1 + len(self.selected_features) + len(self.extra_features_col)

@@ -1,0 +1,338 @@
+"""Time-series model builders (`automl/model/`: VanillaLSTM.py, Seq2Seq.py
+341, MTNet_keras.py 614, tcn.py 151, tcmf/DeepGLO.py 904).
+
+Each builder takes a trial config dict and returns a compiled Keras-style
+model with a uniform `fit/predict` surface so the search engine and the
+zouwu forecasters drive them interchangeably. TCN's dilated causal convs are
+a custom layer over `lax.conv_general_dilated` (the torch reference uses
+Chomp1d+weight-norm; XLA fuses the pad+conv, so causality is just asymmetric
+padding). TCMF is DeepGLO-lite: global matrix factorization Y ~ F @ X trained
+by alternating jit'd gradient steps, X forecast forward by a per-factor
+linear AR model."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras import Input, Model, Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.keras.engine import Layer
+import optax
+
+
+# ---------------------------------------------------------------------------
+# VanillaLSTM (`automl/model/VanillaLSTM.py`)
+# ---------------------------------------------------------------------------
+def build_vanilla_lstm(config: Dict, input_shape, output_dim: int = 1):
+    """lstm_1 (seq) -> dropout -> lstm_2 -> dropout -> dense(out)."""
+    m = Sequential([
+        L.LSTM(int(config.get("lstm_1_units", 32)), input_shape=input_shape,
+               return_sequences=True),
+        L.Dropout(float(config.get("dropout_1", 0.2))),
+        L.LSTM(int(config.get("lstm_2_units", 32))),
+        L.Dropout(float(config.get("dropout_2", 0.2))),
+        L.Dense(output_dim),
+    ])
+    m.compile(optax.adam(float(config.get("lr", 1e-3))), "mse", ["mse"])
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Seq2Seq forecaster (`automl/model/Seq2Seq.py`): numeric encoder-decoder
+# ---------------------------------------------------------------------------
+class _RepeatLast(Layer):
+    """Take the encoder's final state and repeat it horizon times."""
+
+    def __init__(self, horizon: int, **kw):
+        super().__init__(**kw)
+        self.horizon = horizon
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.horizon, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.horizon, input_shape[-1])
+
+
+def build_seq2seq(config: Dict, input_shape, output_dim: int = 1,
+                  horizon: int = 1):
+    latent = int(config.get("latent_dim", 32))
+    m = Sequential([
+        L.LSTM(latent, input_shape=input_shape),       # encoder final state
+        L.Dropout(float(config.get("dropout", 0.2))),
+        _RepeatLast(horizon),
+        L.LSTM(latent, return_sequences=True),          # decoder
+        L.TimeDistributed(L.Dense(output_dim)),
+        L.Reshape((horizon * output_dim,)) if output_dim == 1 else
+        L.Reshape((horizon, output_dim)),
+    ])
+    m.compile(optax.adam(float(config.get("lr", 1e-3))), "mse", ["mse"])
+    return m
+
+
+# ---------------------------------------------------------------------------
+# TCN (`automl/model/tcn.py`): dilated causal conv residual blocks
+# ---------------------------------------------------------------------------
+class CausalConv1D(Layer):
+    """Causal dilated conv: left-pad (k-1)*d then VALID conv — the fused
+    equivalent of the torch reference's pad+Chomp1d."""
+
+    def __init__(self, filters: int, kernel_size: int, dilation: int = 1,
+                 activation: Optional[str] = "relu", **kw):
+        super().__init__(**kw)
+        self.filters, self.k, self.d = filters, kernel_size, dilation
+        self.activation = L.get_activation(activation) if activation else None
+
+    def build(self, rng, input_shape):
+        cin = input_shape[-1]
+        fan_in = self.k * cin
+        w = jax.random.normal(rng, (self.k, cin, self.filters)) \
+            / math.sqrt(fan_in)
+        return {"kernel": w.astype(jnp.float32),
+                "bias": jnp.zeros((self.filters,), jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        pad = (self.k - 1) * self.d
+        y = jax.lax.conv_general_dilated(
+            x, params["kernel"], window_strides=(1,),
+            padding=[(pad, 0)], rhs_dilation=(self.d,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        y = y + params["bias"]
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[:-1] + (self.filters,)
+
+
+class _TCNBlock(Layer):
+    """Residual block: 2x causal conv + dropout, 1x1 shortcut on channel
+    change (`tcn.py` TemporalBlock)."""
+
+    def __init__(self, filters: int, kernel_size: int, dilation: int,
+                 dropout: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.c1 = CausalConv1D(filters, kernel_size, dilation,
+                               name=self.name + "_c1")
+        self.c2 = CausalConv1D(filters, kernel_size, dilation,
+                               name=self.name + "_c2")
+        self.filters = filters
+        self.dropout = dropout
+
+    def build(self, rng, input_shape):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        p = {"c1": self.c1.build(k1, input_shape),
+             "c2": self.c2.build(
+                 k2, input_shape[:-1] + (self.filters,))}
+        if input_shape[-1] != self.filters:
+            p["shortcut"] = (jax.random.normal(
+                k3, (input_shape[-1], self.filters))
+                / math.sqrt(input_shape[-1])).astype(jnp.float32)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        y = self.c1.call(params["c1"], x)
+        if training and rng is not None and self.dropout > 0:
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - self.dropout
+            y = jnp.where(jax.random.bernoulli(sub, keep, y.shape),
+                          y / keep, 0.0)
+        y = self.c2.call(params["c2"], y)
+        if training and rng is not None and self.dropout > 0:
+            keep = 1.0 - self.dropout
+            y = jnp.where(jax.random.bernoulli(rng, keep, y.shape),
+                          y / keep, 0.0)
+        sc = x @ params["shortcut"] if "shortcut" in params else x
+        return jax.nn.relu(y + sc)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[:-1] + (self.filters,)
+
+
+def build_tcn(config: Dict, input_shape, output_dim: int = 1):
+    hidden = int(config.get("hidden_units", 32))
+    levels = int(config.get("levels", 3))
+    k = int(config.get("kernel_size", 3))
+    drop = float(config.get("dropout", 0.1))
+    layers = []
+    for i in range(levels):
+        kw = {"input_shape": input_shape} if i == 0 else {}
+        layers.append(_TCNBlock(hidden, k, dilation=2 ** i, dropout=drop,
+                                **kw))
+    layers += [L.Select(1, -1), L.Dense(output_dim)]
+    m = Sequential(layers)
+    m.compile(optax.adam(float(config.get("lr", 1e-3))), "mse", ["mse"])
+    return m
+
+
+# ---------------------------------------------------------------------------
+# MTNet (`automl/model/MTNet_keras.py`): memory of long_num windows encoded
+# by CNN, attention against the current window, + AR highway
+# ---------------------------------------------------------------------------
+class _MTNetCore(Layer):
+    def __init__(self, time_step: int, long_num: int, feature_dim: int,
+                 cnn_hid: int, dropout: float, **kw):
+        super().__init__(**kw)
+        self.T, self.n, self.F = time_step, long_num, feature_dim
+        self.cnn_hid = cnn_hid
+        self.dropout = dropout
+
+    def build(self, rng, input_shape):
+        ks = jax.random.split(rng, 5)
+        F, H = self.F, self.cnn_hid
+        # conv over time within a window: kernel [w, F, H]
+        w = min(3, self.T)
+        return {
+            "conv": (jax.random.normal(ks[0], (w, F, H))
+                     / math.sqrt(w * F)).astype(jnp.float32),
+            "conv_b": jnp.zeros((H,), jnp.float32),
+            "attn": (jax.random.normal(ks[1], (H, H))
+                     / math.sqrt(H)).astype(jnp.float32),
+            "gru_out": (jax.random.normal(ks[2], (2 * H, H))
+                        / math.sqrt(2 * H)).astype(jnp.float32),
+            "head": (jax.random.normal(ks[3], (H, 1))
+                     / math.sqrt(H)).astype(jnp.float32),
+            "ar": (jax.random.normal(ks[4], (self.T,))
+                   / math.sqrt(self.T)).astype(jnp.float32),
+        }
+
+    def _encode(self, params, wins):
+        """wins: [B, n, T, F] -> [B, n, H] via causal conv + max pool."""
+        B, n, T, F = wins.shape
+        x = wins.reshape(B * n, T, F)
+        y = jax.lax.conv_general_dilated(
+            x, params["conv"], (1,), "VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        y = jax.nn.relu(y + params["conv_b"])
+        return jnp.max(y, axis=1).reshape(B, n, -1)
+
+    def call(self, params, x, *, training=False, rng=None):
+        # x: [B, (n+1)*T, F] — long memory windows + current window
+        B = x.shape[0]
+        wins = x.reshape(B, self.n + 1, self.T, self.F)
+        mem, cur = wins[:, :-1], wins[:, -1:]
+        m_enc = self._encode(params, mem)            # [B, n, H]
+        c_enc = self._encode(params, cur)[:, 0]      # [B, H]
+        scores = jnp.einsum("bnh,hk,bk->bn", m_enc, params["attn"], c_enc)
+        alpha = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bn,bnh->bh", alpha, m_enc)
+        h = jax.nn.relu(jnp.concatenate([ctx, c_enc], axis=-1)
+                        @ params["gru_out"])
+        if training and rng is not None and self.dropout > 0:
+            keep = 1.0 - self.dropout
+            h = jnp.where(jax.random.bernoulli(rng, keep, h.shape),
+                          h / keep, 0.0)
+        nonlinear = (h @ params["head"])[:, 0]
+        ar = jnp.einsum("bt,t->b", x[:, -self.T:, 0], params["ar"])
+        return (nonlinear + ar)[:, None]
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], 1)
+
+
+def build_mtnet(config: Dict, feature_dim: int):
+    T = int(config.get("time_step", 4))
+    n = int(config.get("long_num", 4))
+    core = _MTNetCore(T, n, feature_dim,
+                      int(config.get("cnn_hid_size", 32)),
+                      float(config.get("dropout", 0.1)),
+                      input_shape=((n + 1) * T, feature_dim))
+    m = Sequential([core])
+    m.compile(optax.adam(float(config.get("lr", 1e-3))), "mse", ["mse"])
+    return m
+
+
+def mtnet_past_seq_len(config: Dict) -> int:
+    return (int(config.get("long_num", 4)) + 1) \
+        * int(config.get("time_step", 4))
+
+
+# ---------------------------------------------------------------------------
+# TCMF / DeepGLO-lite (`automl/model/tcmf/DeepGLO.py`)
+# ---------------------------------------------------------------------------
+class TCMF:
+    """Global factorization Y[n, t] ~ F[n, k] @ X[k, t]; forecast X with a
+    per-factor linear AR(p) model. Captures DeepGLO's global component (the
+    local per-series network is the reference's refinement stage)."""
+
+    def __init__(self, rank: int = 8, ar_lags: int = 8, steps: int = 300,
+                 lr: float = 0.05, seed: int = 0):
+        self.rank, self.ar_lags = rank, ar_lags
+        self.steps, self.lr = steps, lr
+        self.seed = seed
+        self.F = self.X = self.ar = None
+
+    def fit(self, y: np.ndarray) -> "TCMF":
+        y = jnp.asarray(y, jnp.float32)
+        n, t = y.shape
+        k = self.rank
+        key = jax.random.PRNGKey(self.seed)
+        kf, kx = jax.random.split(key)
+        params = {"F": jax.random.normal(kf, (n, k)) * 0.1,
+                  "X": jax.random.normal(kx, (k, t)) * 0.1}
+        opt = optax.adam(self.lr)
+        opt_state = opt.init(params)
+
+        def loss(p):
+            return jnp.mean((p["F"] @ p["X"] - y) ** 2) \
+                + 1e-4 * (jnp.mean(p["F"] ** 2) + jnp.mean(p["X"] ** 2))
+
+        @jax.jit
+        def run(params, opt_state):
+            def step(carry, _):
+                params, opt_state = carry
+                l, g = jax.value_and_grad(loss)(params)
+                updates, opt_state = opt.update(g, opt_state)
+                return (optax.apply_updates(params, updates), opt_state), l
+            (params, opt_state), ls = jax.lax.scan(
+                step, (params, opt_state), None, length=self.steps)
+            return params, opt_state, ls
+
+        params, opt_state, _ = run(params, opt_state)
+        self.F = np.asarray(params["F"])
+        self.X = np.asarray(params["X"])
+        self._fit_ar()
+        return self
+
+    def _fit_ar(self):
+        """Least-squares AR(p) per factor row of X."""
+        p = min(self.ar_lags, self.X.shape[1] - 1)
+        self.ar = []
+        for row in self.X:
+            A = np.stack([row[i:i + p] for i in range(len(row) - p)])
+            b = row[p:]
+            coef, *_ = np.linalg.lstsq(A, b, rcond=None)
+            self.ar.append(coef)
+        self.ar = np.stack(self.ar)           # [k, p]
+        self._p = p
+
+    def predict(self, horizon: int) -> np.ndarray:
+        if self.F is None:
+            raise RuntimeError("fit first")
+        X = self.X.copy()
+        for _ in range(horizon):
+            nxt = np.einsum("kp,kp->k", self.ar, X[:, -self._p:])
+            X = np.concatenate([X, nxt[:, None]], axis=1)
+        return self.F @ X[:, -horizon:]
+
+
+# ---------------------------------------------------------------------------
+# registry used by the search pipeline
+# ---------------------------------------------------------------------------
+def build_model(config: Dict, input_shape, output_dim: int = 1):
+    name = config.get("model", "VanillaLSTM")
+    if name == "VanillaLSTM":
+        return build_vanilla_lstm(config, input_shape, output_dim)
+    if name == "Seq2Seq":
+        # horizon steps of a single target -> [B, horizon] predictions
+        return build_seq2seq(config, input_shape, output_dim=1,
+                             horizon=output_dim)
+    if name == "TCN":
+        return build_tcn(config, input_shape, output_dim)
+    if name == "MTNet":
+        return build_mtnet(config, feature_dim=input_shape[-1])
+    raise ValueError(f"Unknown model {name!r}")

@@ -1,0 +1,163 @@
+"""TimeSequencePipeline + TimeSequencePredictor
+(`automl/pipeline/time_sequence.py:233`, `automl/regression/
+time_sequence_predictor.py:99`).
+
+Predictor.fit searches a recipe's space with the local SearchEngine (each
+trial = transformer + model trained for the rung's epoch budget, scored on
+held-out data), then refits the best config into a `TimeSequencePipeline`
+that carries transformer state + model weights through save/load."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.automl.feature import TimeSequenceFeatureTransformer
+from analytics_zoo_tpu.automl.models import (build_model, mtnet_past_seq_len)
+from analytics_zoo_tpu.automl.recipe import LSTMGridRandomRecipe, Recipe
+from analytics_zoo_tpu.automl.search import SearchEngine
+
+
+def _past_seq_len(config: Dict) -> int:
+    if config.get("model") == "MTNet":
+        return mtnet_past_seq_len(config)
+    return int(config.get("past_seq_len", 2))
+
+
+def _metric_value(name: str, y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    y_true = np.asarray(y_true).reshape(len(y_true), -1)
+    y_pred = np.asarray(y_pred).reshape(len(y_pred), -1)
+    err = y_true - y_pred
+    if name == "mse":
+        return float(np.mean(err ** 2))
+    if name == "rmse":
+        return float(np.sqrt(np.mean(err ** 2)))
+    if name == "mae":
+        return float(np.mean(np.abs(err)))
+    if name == "smape":
+        denom = (np.abs(y_true) + np.abs(y_pred)) / 2 + 1e-8
+        return float(np.mean(np.abs(err) / denom) * 100)
+    if name == "r2":
+        ss_res = np.sum(err ** 2)
+        ss_tot = np.sum((y_true - y_true.mean()) ** 2) + 1e-12
+        return float(1 - ss_res / ss_tot)
+    raise ValueError(f"Unknown metric {name!r}")
+
+
+class TimeSequencePipeline:
+    def __init__(self, transformer: TimeSequenceFeatureTransformer,
+                 model, config: Dict):
+        self.transformer = transformer
+        self.model = model
+        self.config = dict(config)
+
+    # -- inference/eval (`time_sequence.py` predict/evaluate) -------------
+    def predict(self, df: pd.DataFrame) -> np.ndarray:
+        x = self.transformer.transform(df, is_train=False)
+        y_scaled = self.model.predict(x, batch_per_thread=64)
+        return self.transformer.post_processing(np.asarray(y_scaled))
+
+    def evaluate(self, df: pd.DataFrame,
+                 metrics: Sequence[str] = ("mse",)) -> Dict[str, float]:
+        x, y = self.transformer.transform(df, is_train=True)
+        y_pred = np.asarray(self.model.predict(x, batch_per_thread=64))
+        y_true = self.transformer.post_processing(y)
+        y_pred = self.transformer.post_processing(y_pred)
+        return {m: _metric_value(m, y_true, y_pred) for m in metrics}
+
+    def fit(self, df: pd.DataFrame, epochs: int = 1, batch_size: int = 32):
+        """Incremental fit on new data (transformer stays frozen)."""
+        x, y = self.transformer.transform(df, is_train=True)
+        return self.model.fit(x, y, batch_size=min(batch_size, len(x)),
+                              nb_epoch=epochs)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "pipeline.json"), "w") as fh:
+            json.dump({"config": self.config,
+                       "transformer": self.transformer.state()}, fh)
+        self.model.save_weights(os.path.join(path, "weights"))
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "TimeSequencePipeline":
+        with open(os.path.join(path, "pipeline.json")) as fh:
+            blob = json.load(fh)
+        transformer = TimeSequenceFeatureTransformer.from_state(
+            blob["transformer"])
+        config = blob["config"]
+        input_shape = (_past_seq_len(config), transformer.feature_dim)
+        model = build_model(config, input_shape,
+                            output_dim=transformer.future_seq_len)
+        model.ensure_built(np.zeros((1,) + input_shape, np.float32))
+        model.load_weights(os.path.join(path, "weights"))
+        return cls(transformer, model, config)
+
+
+class TimeSequencePredictor:
+    """`TimeSequencePredictor.fit` -> best pipeline."""
+
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 future_seq_len: int = 1,
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 drop_missing: bool = True, seed: int = 0):
+        self.dt_col, self.target_col = dt_col, target_col
+        self.future_seq_len = future_seq_len
+        self.extra_features_col = extra_features_col
+        self.drop_missing = drop_missing
+        self.seed = seed
+        self.search_engine: Optional[SearchEngine] = None
+
+    def _make_transformer(self, config: Dict
+                          ) -> TimeSequenceFeatureTransformer:
+        return TimeSequenceFeatureTransformer(
+            dt_col=self.dt_col, target_col=self.target_col,
+            extra_features_col=self.extra_features_col,
+            past_seq_len=_past_seq_len(config),
+            future_seq_len=self.future_seq_len,
+            drop_missing=self.drop_missing)
+
+    def _train_once(self, config: Dict, train_df, val_df, epochs: int):
+        transformer = self._make_transformer(config)
+        x, y = transformer.fit_transform(train_df)
+        model = build_model(config, (x.shape[1], x.shape[2]),
+                            output_dim=self.future_seq_len)
+        batch = min(int(config.get("batch_size", 32)), len(x))
+        model.fit(x, y, batch_size=batch, nb_epoch=epochs)
+        vx, vy = transformer.transform(val_df, is_train=True)
+        y_pred = np.asarray(model.predict(vx, batch_per_thread=64))
+        return transformer, model, vy, y_pred
+
+    def fit(self, input_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            recipe: Optional[Recipe] = None, metric: str = "mse",
+            ) -> TimeSequencePipeline:
+        recipe = recipe or LSTMGridRandomRecipe(num_rand_samples=1)
+        if validation_df is None:
+            split = int(len(input_df) * 0.8)
+            input_df, validation_df = input_df.iloc[:split], \
+                input_df.iloc[split:]
+
+        def train_fn(config, data, budget):
+            train_df, val_df = data
+            _, _, vy, y_pred = self._train_once(config, train_df, val_df,
+                                                epochs=budget)
+            return {metric: _metric_value(metric, vy, y_pred)}
+
+        mode = "max" if metric == "r2" else "min"
+        engine = SearchEngine(metric=metric, mode=mode, seed=self.seed,
+                              scheduler="asha", grace_budget=1,
+                              max_budget=recipe.training_iteration)
+        engine.compile((input_df, validation_df), train_fn, recipe=recipe)
+        engine.run()
+        self.search_engine = engine
+        best = engine.get_best_config()
+        transformer, model, _, _ = self._train_once(
+            best, input_df, validation_df,
+            epochs=recipe.training_iteration)
+        return TimeSequencePipeline(transformer, model, best)

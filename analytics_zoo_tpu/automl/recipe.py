@@ -1,0 +1,144 @@
+"""Search-space recipes (`automl/config/recipe.py:643`'s presets).
+
+Each recipe returns a search space over BOTH feature params (past_seq_len,
+selected datetime features) and model params (units, dropout, lr, batch) —
+the reference's coupled feature+model search. Names/defaults follow the
+reference recipes; samplers use the local `hp` DSL.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from analytics_zoo_tpu.automl.search import hp
+
+
+class Recipe:
+    num_samples = 1
+    training_iteration = 10   # max epochs budget for the scheduler
+
+    def search_space(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class LSTMGridRandomRecipe(Recipe):
+    """`recipe.py` LSTMGridRandomRecipe: grid over units, random over
+    lr/dropout/past_seq_len."""
+
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 5,
+                 look_back: int = 2, batch_size: int = 32):
+        self.num_samples = num_rand_samples
+        self.training_iteration = epochs
+        self.look_back = look_back
+        self.batch_size = batch_size
+
+    def search_space(self):
+        return {
+            "model": "VanillaLSTM",
+            "lstm_1_units": hp.grid_search([16, 32]),
+            "lstm_2_units": hp.grid_search([16, 32]),
+            "dropout_1": hp.uniform(0.2, 0.5),
+            "dropout_2": hp.uniform(0.2, 0.5),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": self.batch_size,
+            "past_seq_len": self.look_back,
+            "epochs": self.training_iteration,
+        }
+
+
+class LSTMRandomRecipe(LSTMGridRandomRecipe):
+    """All-random variant."""
+
+    def search_space(self):
+        space = super().search_space()
+        space["lstm_1_units"] = hp.choice([8, 16, 32, 64])
+        space["lstm_2_units"] = hp.choice([8, 16, 32, 64])
+        return space
+
+
+class Seq2SeqRandomRecipe(Recipe):
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 5,
+                 look_back: int = 4):
+        self.num_samples = num_rand_samples
+        self.training_iteration = epochs
+        self.look_back = look_back
+
+    def search_space(self):
+        return {
+            "model": "Seq2Seq",
+            "latent_dim": hp.choice([16, 32, 64]),
+            "dropout": hp.uniform(0.2, 0.5),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": hp.choice([32, 64]),
+            "past_seq_len": self.look_back,
+            "epochs": self.training_iteration,
+        }
+
+
+class TCNGridRandomRecipe(Recipe):
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 5,
+                 look_back: int = 8):
+        self.num_samples = num_rand_samples
+        self.training_iteration = epochs
+        self.look_back = look_back
+
+    def search_space(self):
+        return {
+            "model": "TCN",
+            "hidden_units": hp.grid_search([16, 32]),
+            "levels": hp.choice([2, 3]),
+            "kernel_size": hp.choice([2, 3]),
+            "dropout": hp.uniform(0.0, 0.3),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": 32,
+            "past_seq_len": self.look_back,
+            "epochs": self.training_iteration,
+        }
+
+
+class MTNetGridRandomRecipe(Recipe):
+    """`recipe.py` MTNetGridRandomRecipe (long_num x time_step windows)."""
+
+    def __init__(self, num_rand_samples: int = 1, epochs: int = 5,
+                 time_step=(3, 4), long_num=(3, 4)):
+        self.num_samples = num_rand_samples
+        self.training_iteration = epochs
+        self.time_step = list(time_step)
+        self.long_num = list(long_num)
+
+    def search_space(self):
+        return {
+            "model": "MTNet",
+            "time_step": hp.grid_search(self.time_step),
+            "long_num": hp.grid_search(self.long_num),
+            "cnn_hid_size": hp.choice([16, 32]),
+            "dropout": hp.uniform(0.1, 0.3),
+            "lr": hp.loguniform(1e-3, 1e-2),
+            "batch_size": 32,
+            "epochs": self.training_iteration,
+        }
+
+
+class BayesRecipe(Recipe):
+    """The reference's BayesRecipe drives skopt BO; without skopt this is a
+    dense random recipe over the same continuous space (`recipe.py`
+    BayesRecipe ranges)."""
+
+    def __init__(self, num_samples: int = 8, epochs: int = 5,
+                 look_back: int = 2):
+        self.num_samples = num_samples
+        self.training_iteration = epochs
+        self.look_back = look_back
+
+    def search_space(self):
+        return {
+            "model": "VanillaLSTM",
+            "lstm_1_units": hp.randint(8, 65),
+            "lstm_2_units": hp.randint(8, 65),
+            "dropout_1": hp.uniform(0.2, 0.5),
+            "dropout_2": hp.uniform(0.2, 0.5),
+            "lr": hp.loguniform(1e-4, 1e-1),
+            "batch_size": hp.choice([32, 64]),
+            "past_seq_len": self.look_back,
+            "epochs": self.training_iteration,
+        }

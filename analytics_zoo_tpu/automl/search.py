@@ -1,0 +1,227 @@
+"""Search engine: sampling DSL + trial runner + successive halving.
+
+Reference: `RayTuneSearchEngine` (`automl/search/ray_tune_search_engine.py:37`,
+`compile` `:61`, `run` `:171`) with SearchAlg (skopt BO) and schedulers
+(ASHA). Here: the same `compile(data, model_builder, recipe)` / `run()` /
+`get_best_trials` surface, executed in-process. Trials are pure functions
+`train_fn(config, data, budget) -> {"metric": float, ...}` so the engine is
+agnostic to what a trial trains (a jit'd TPU model, an sklearn fit, ...).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import logging
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+log = logging.getLogger("analytics_zoo_tpu.automl")
+
+
+# ---------------------------------------------------------------------------
+# Sample functions (the tune.* DSL used in recipes)
+# ---------------------------------------------------------------------------
+class _Sampler:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class _Choice(_Sampler):
+    def __init__(self, options: Sequence):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+class _Grid:
+    """Expanded exhaustively (tune.grid_search)."""
+
+    def __init__(self, options: Sequence):
+        self.options = list(options)
+
+
+class _Uniform(_Sampler):
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def sample(self, rng):
+        return rng.uniform(self.lo, self.hi)
+
+
+class _QUniform(_Sampler):
+    def __init__(self, lo, hi, q):
+        self.lo, self.hi, self.q = lo, hi, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.lo, self.hi)
+        return type(self.q)(round(v / self.q) * self.q)
+
+
+class _LogUniform(_Sampler):
+    def __init__(self, lo, hi):
+        self.lo, self.hi = math.log(lo), math.log(hi)
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class _RandInt(_Sampler):
+    def __init__(self, lo, hi):
+        self.lo, self.hi = lo, hi
+
+    def sample(self, rng):
+        return rng.randint(self.lo, self.hi - 1)  # tune.randint excl. upper
+
+
+class hp:
+    """Sample-function namespace (tune.*-compatible names)."""
+    choice = _Choice
+    grid_search = _Grid
+    uniform = _Uniform
+    quniform = _QUniform
+    loguniform = _LogUniform
+    randint = _RandInt
+
+
+def _expand(space: Dict[str, Any], num_samples: int,
+            seed: int = 0) -> List[Dict[str, Any]]:
+    """Grid entries expand cartesian; samplers draw `num_samples` times per
+    grid point (the GridRandomRecipe semantics)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in space.items() if isinstance(v, _Grid)]
+    grid_values = [space[k].options for k in grid_keys]
+    configs = []
+    for combo in itertools.product(*grid_values) if grid_keys else [()]:
+        for _ in range(num_samples):
+            cfg = {}
+            for k, v in space.items():
+                if isinstance(v, _Grid):
+                    continue
+                cfg[k] = v.sample(rng) if isinstance(v, _Sampler) else v
+            cfg.update(dict(zip(grid_keys, combo)))
+            configs.append(cfg)
+    # dedupe identical configs (all-grid spaces with num_samples>1)
+    seen, out = set(), []
+    for c in configs:
+        key = tuple(sorted((k, repr(v)) for k, v in c.items()))
+        if key not in seen:
+            seen.add(key)
+            out.append(c)
+    return out
+
+
+@dataclass
+class Trial:
+    config: Dict[str, Any]
+    metric: Optional[float] = None
+    results: Dict[str, Any] = field(default_factory=dict)
+    budget: int = 0
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and self.metric is not None
+
+
+class SearchEngine:
+    """`RayTuneSearchEngine`-shaped trial driver.
+
+    train_fn(config, data, budget) -> dict with `metric` key (lower is
+    better when mode="min"). `scheduler="asha"` runs successive halving:
+    all configs get `grace_budget`, the top 1/eta advance with eta x budget,
+    until `max_budget`.
+    """
+
+    def __init__(self, metric: str = "mse", mode: str = "min",
+                 num_samples: int = 1, seed: int = 0,
+                 scheduler: Optional[str] = None, eta: int = 3,
+                 grace_budget: int = 1, max_budget: int = 9,
+                 backend: str = "local"):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be min|max")
+        if backend == "ray":
+            # Ray Tune dispatch is not wired in this build; be explicit
+            # rather than silently running local (trials execute serially
+            # in-process either way on a single TPU host).
+            log.warning("backend='ray' is not wired in this build; trials "
+                        "run in-process on this host")
+            backend = "local"
+        self.metric, self.mode = metric, mode
+        self.num_samples, self.seed = num_samples, seed
+        self.scheduler, self.eta = scheduler, eta
+        self.grace_budget, self.max_budget = grace_budget, max_budget
+        self.backend = backend
+        self.trials: List[Trial] = []
+        self._train_fn: Optional[Callable] = None
+        self._data = None
+        self._configs: List[Dict] = []
+
+    # -- compile/run surface (`ray_tune_search_engine.py:61,171`) ----------
+    def compile(self, data, train_fn: Callable, recipe=None,
+                search_space: Optional[Dict[str, Any]] = None
+                ) -> "SearchEngine":
+        if recipe is not None:
+            search_space = dict(recipe.search_space())
+            self.num_samples = getattr(recipe, "num_samples",
+                                       self.num_samples)
+        if not search_space:
+            raise ValueError("Provide a recipe or search_space")
+        self._train_fn = train_fn
+        self._data = data
+        self._configs = _expand(search_space, self.num_samples, self.seed)
+        return self
+
+    def run(self) -> List[Trial]:
+        if self._train_fn is None:
+            raise RuntimeError("compile() first")
+        if self.scheduler == "asha":
+            self.trials = self._run_asha()
+        else:
+            self.trials = [self._run_one(c, self.max_budget)
+                           for c in self._configs]
+        return self.trials
+
+    def _run_one(self, config: Dict, budget: int) -> Trial:
+        t = Trial(config=copy.deepcopy(config), budget=budget)
+        try:
+            results = self._train_fn(config, self._data, budget)
+            t.results = results
+            t.metric = float(results[self.metric])
+        except Exception as e:  # noqa: BLE001 — a bad config must not kill
+            log.warning("trial failed for %s: %s", config, e)
+            t.error = f"{type(e).__name__}: {e}"
+        return t
+
+    def _run_asha(self) -> List[Trial]:
+        alive = list(self._configs)
+        budget = self.grace_budget
+        done: List[Trial] = []
+        while alive:
+            rung = [self._run_one(c, budget) for c in alive]
+            ok = sorted((t for t in rung if t.ok), key=self._key)
+            done.extend(t for t in rung if not t.ok)
+            if budget >= self.max_budget or len(ok) <= 1:
+                done.extend(ok)
+                break
+            keep = max(1, len(ok) // self.eta)
+            done.extend(ok[keep:])
+            alive = [t.config for t in ok[:keep]]
+            budget = min(budget * self.eta, self.max_budget)
+        return done
+
+    def _key(self, t: Trial):
+        return t.metric if self.mode == "min" else -t.metric
+
+    # -- results -----------------------------------------------------------
+    def get_best_trials(self, k: int = 1) -> List[Trial]:
+        ok = sorted((t for t in self.trials if t.ok), key=self._key)
+        if not ok:
+            raise RuntimeError("No successful trials")
+        return ok[:k]
+
+    def get_best_config(self) -> Dict[str, Any]:
+        return self.get_best_trials(1)[0].config

@@ -1,0 +1,13 @@
+"""NNFrames — DataFrame ML pipeline integration (SURVEY §2.8).
+
+Reference: `NNEstimator`/`NNModel`/`NNClassifier(Model)`
+(`nnframes/NNEstimator.scala:197,641`, py `nn_classifier.py:140,573`): Spark
+ML Estimator/Transformer pairs that train a model on a DataFrame and add a
+`prediction` column. Spark DataFrames don't exist here; the same pipeline
+surface runs on pandas DataFrames (the repo's tabular interchange format,
+like orca's `to_dataset` path), with feature assembly from scalar columns or
+array-valued columns.
+"""
+
+from analytics_zoo_tpu.nnframes.nn_estimator import (  # noqa: F401
+    NNClassifier, NNClassifierModel, NNEstimator, NNImageReader, NNModel)

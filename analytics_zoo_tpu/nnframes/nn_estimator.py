@@ -1,0 +1,205 @@
+"""NNEstimator/NNModel/NNClassifier over pandas DataFrames.
+
+Behavioral contract from `nnframes/NNEstimator.scala:197` + python mirror
+(`nn_classifier.py`): builder-style setters (setBatchSize/setMaxEpoch/
+setLearningRate/setFeaturesCol/setLabelCol/setCachingSample →
+snake_case), `fit(df) -> NNModel`, `NNModel.transform(df)` appends a
+`prediction` column, `NNClassifier` trains on integer labels with
+(sparse) cross-entropy and its model predicts the argmax class
+(1-based by default, like BigDL's ClassNLL convention)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu.keras.engine import KerasNet
+
+
+def _assemble(df: pd.DataFrame, cols: Sequence[str]) -> np.ndarray:
+    """Feature assembly: one array-valued column passes through (stacked);
+    several scalar columns concatenate — the NNEstimator featureSize
+    flattening (`NNEstimator.scala` supports both)."""
+    if len(cols) == 1:
+        first = df[cols[0]].iloc[0]
+        if isinstance(first, (list, tuple, np.ndarray)):
+            return np.stack([np.asarray(v, np.float32)
+                             for v in df[cols[0]]])
+        return df[cols[0]].to_numpy(np.float32)[:, None]
+    return np.stack([df[c].to_numpy(np.float32) for c in cols], axis=1)
+
+
+class NNEstimator:
+    def __init__(self, model: KerasNet, criterion: Union[str, Any] = "mse",
+                 optimizer: Union[str, Any] = "adam"):
+        self.model = model
+        self.criterion = criterion
+        self.optimizer = optimizer
+        self.batch_size = 32
+        self.max_epoch = 1
+        self.features_col: List[str] = ["features"]
+        self.label_col = "label"
+        self.caching_sample = True
+        self._lr: Optional[float] = None
+        self._validation = None
+
+    # -- builder setters (`NNEstimator.scala` setters) ---------------------
+    def set_batch_size(self, v: int) -> "NNEstimator":
+        self.batch_size = v
+        return self
+
+    def set_max_epoch(self, v: int) -> "NNEstimator":
+        self.max_epoch = v
+        return self
+
+    def set_learning_rate(self, v: float) -> "NNEstimator":
+        self._lr = v
+        return self
+
+    def set_features_col(self, v: Union[str, Sequence[str]]) -> "NNEstimator":
+        self.features_col = [v] if isinstance(v, str) else list(v)
+        return self
+
+    def set_label_col(self, v: str) -> "NNEstimator":
+        self.label_col = v
+        return self
+
+    def set_caching_sample(self, v: bool) -> "NNEstimator":
+        self.caching_sample = v
+        return self
+
+    def set_validation(self, df: pd.DataFrame,
+                       trigger=None) -> "NNEstimator":
+        self._validation = df
+        return self
+
+    # -- fit ---------------------------------------------------------------
+    def _label_array(self, df: pd.DataFrame) -> np.ndarray:
+        y = np.asarray(list(df[self.label_col]), np.float32)
+        # regression targets get a trailing feature dim so elementwise
+        # losses align with [B, 1] model outputs (no silent broadcast)
+        return y[:, None] if y.ndim == 1 else y
+
+    def _compile(self):
+        if self._lr is not None:
+            import optax
+            opt = optax.adam(self._lr) if isinstance(self.optimizer, str) \
+                else self.optimizer
+        else:
+            opt = self.optimizer
+        self.model.compile(opt, self.criterion)
+
+    def fit(self, df: pd.DataFrame) -> "NNModel":
+        x = _assemble(df, self.features_col)
+        y = self._label_array(df)
+        self._compile()
+        val = None
+        if self._validation is not None:
+            val = (_assemble(self._validation, self.features_col),
+                   self._label_array(self._validation))
+        self.model.fit(x, y, batch_size=min(self.batch_size, len(x)),
+                       nb_epoch=self.max_epoch, validation_data=val)
+        return self._make_model()
+
+    def _make_model(self) -> "NNModel":
+        return NNModel(self.model, self.features_col)
+
+
+class NNModel:
+    """Transformer: adds a `prediction` column (`NNEstimator.scala:641`)."""
+
+    def __init__(self, model: KerasNet,
+                 features_col: Union[str, Sequence[str]] = "features"):
+        self.model = model
+        self.features_col = [features_col] if isinstance(features_col, str) \
+            else list(features_col)
+        self.batch_size = 32
+
+    def set_batch_size(self, v: int) -> "NNModel":
+        self.batch_size = v
+        return self
+
+    def set_features_col(self, v: Union[str, Sequence[str]]) -> "NNModel":
+        self.features_col = [v] if isinstance(v, str) else list(v)
+        return self
+
+    def _predict(self, df: pd.DataFrame) -> np.ndarray:
+        x = _assemble(df, self.features_col)
+        return np.asarray(self.model.predict(
+            x, batch_per_thread=self.batch_size))
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        preds = self._predict(df)
+        out = df.copy()
+        out["prediction"] = [p if np.ndim(p) else float(p) for p in preds]
+        return out
+
+
+class NNClassifier(NNEstimator):
+    """Integer-label classification (`nn_classifier.py:140`). Labels are
+    1-based by default (the BigDL ClassNLL convention the reference keeps);
+    pass `zero_based_label=True` for 0-based data. No silent inference —
+    a 0-based dataset that happens to lack class 0 would otherwise be
+    shifted wrongly without any error."""
+
+    def __init__(self, model: KerasNet, criterion: Union[str, Any] =
+                 "sparse_categorical_crossentropy",
+                 optimizer: Union[str, Any] = "adam",
+                 zero_based_label: bool = False):
+        super().__init__(model, criterion, optimizer)
+        self.zero_based_label = zero_based_label
+
+    def _label_array(self, df: pd.DataFrame) -> np.ndarray:
+        y = df[self.label_col].to_numpy().astype(np.int32)
+        if not self.zero_based_label:
+            y = y - 1
+        if y.min() < 0:
+            raise ValueError(
+                "Negative class index after label-base shift; pass "
+                "zero_based_label=True for 0-based labels")
+        return y
+
+    def _make_model(self) -> "NNClassifierModel":
+        return NNClassifierModel(self.model, self.features_col,
+                                 zero_based_label=self.zero_based_label)
+
+
+class NNClassifierModel(NNModel):
+    """Argmax prediction column (`nn_classifier.py:573`)."""
+
+    def __init__(self, model: KerasNet,
+                 features_col: Union[str, Sequence[str]] = "features",
+                 zero_based_label: bool = True):
+        super().__init__(model, features_col)
+        self.zero_based_label = zero_based_label
+
+    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+        probs = self._predict(df)
+        cls = np.argmax(probs, axis=-1)
+        if not self.zero_based_label:
+            cls = cls + 1
+        out = df.copy()
+        out["prediction"] = cls.astype(np.int64)
+        return out
+
+
+class NNImageReader:
+    """`NNImageReader.readImages`: directory -> DataFrame with image arrays
+    ('image' column) + 'path' (+ 'label' when the dir layout has classes)."""
+
+    @staticmethod
+    def read_images(path: str, with_label: bool = False,
+                    resize: Optional[int] = None,
+                    one_based_label: bool = True) -> pd.DataFrame:
+        from analytics_zoo_tpu.data.image import ImageResize, ImageSet
+        iset = ImageSet.read(path, with_label=with_label,
+                             one_based_label=one_based_label)
+        if resize:
+            iset = iset.transform(ImageResize(resize, resize))
+        data = {"image": [im.astype(np.float32) for im in iset.images],
+                "path": iset.paths}
+        if iset.labels is not None:
+            data["label"] = iset.labels
+        return pd.DataFrame(data)

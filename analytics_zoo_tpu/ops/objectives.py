@@ -30,6 +30,18 @@ def _f32(x) -> Array:
     return jnp.asarray(x, jnp.float32)
 
 
+def _align(y_true, y_pred):
+    """Align a rank-off-by-one target with a trailing size-1 prediction dim
+    (or vice versa). Without this, `[B] - [B, 1]` silently broadcasts to
+    `[B, B]` and the loss optimizes toward the global mean."""
+    y_true, y_pred = _f32(y_true), _f32(y_pred)
+    if y_true.ndim == y_pred.ndim - 1 and y_pred.shape[-1] == 1:
+        y_true = y_true[..., None]
+    elif y_pred.ndim == y_true.ndim - 1 and y_true.shape[-1] == 1:
+        y_pred = y_pred[..., None]
+    return y_true, y_pred
+
+
 class Objective:
     """Base class: a callable loss(y_true, y_pred) -> scalar."""
 
@@ -42,26 +54,26 @@ class Objective:
 
 class MeanSquaredError(Objective):
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         return jnp.mean(jnp.square(y_pred - y_true))
 
 
 class MeanAbsoluteError(Objective):
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         return jnp.mean(jnp.abs(y_pred - y_true))
 
 
 class MeanAbsolutePercentageError(Objective):
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         diff = jnp.abs(y_pred - y_true) / jnp.clip(jnp.abs(y_true), EPS, None)
         return 100.0 * jnp.mean(diff)
 
 
 class MeanSquaredLogarithmicError(Objective):
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         a = jnp.log1p(jnp.clip(y_pred, EPS, None))
         b = jnp.log1p(jnp.clip(y_true, EPS, None))
         return jnp.mean(jnp.square(a - b))
@@ -72,7 +84,7 @@ class BinaryCrossEntropy(Objective):
         self.from_logits = from_logits
 
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         if self.from_logits:
             # stable: max(x,0) - x*y + log1p(exp(-|x|))
             x = y_pred
@@ -90,7 +102,7 @@ class CategoricalCrossEntropy(Objective):
         self.from_logits = from_logits
 
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         if self.from_logits:
             logp = jax.nn.log_softmax(y_pred, axis=-1)
         else:
@@ -120,13 +132,13 @@ class SparseCategoricalCrossEntropy(Objective):
 
 class Hinge(Objective):
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
 
 
 class SquaredHinge(Objective):
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
 
 
@@ -154,7 +166,7 @@ class KullbackLeiblerDivergence(Objective):
 
 class Poisson(Objective):
     def __call__(self, y_true, y_pred):
-        y_true, y_pred = _f32(y_true), _f32(y_pred)
+        y_true, y_pred = _align(y_true, y_pred)
         return jnp.mean(y_pred - y_true * jnp.log(y_pred + EPS))
 
 

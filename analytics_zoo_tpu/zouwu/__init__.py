@@ -1,0 +1,13 @@
+"""Zouwu — user-facing time-series API (SURVEY §2.10, `pyzoo/zoo/zouwu/`).
+
+`AutoTSTrainer`/`TSPipeline` (`zouwu/autots/forecast.py:22,86`) over the
+AutoML search, plus standalone forecasters (`zouwu/model/forecast/*.py`) and
+anomaly detectors (`zouwu/model/anomaly.py`).
+"""
+
+from analytics_zoo_tpu.zouwu.autots import AutoTSTrainer, TSPipeline  # noqa: F401
+from analytics_zoo_tpu.zouwu.forecast import (  # noqa: F401
+    LSTMForecaster, MTNetForecaster, Seq2SeqForecaster, TCNForecaster,
+    TCMFForecaster)
+from analytics_zoo_tpu.zouwu.anomaly import (  # noqa: F401
+    AEDetector, ThresholdDetector)

@@ -1,0 +1,71 @@
+"""Anomaly detectors (`zouwu/model/anomaly.py`): threshold on forecast error
+(re-exported from the model zoo) and an autoencoder detector over windows."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.models.anomalydetection import ThresholdDetector  # noqa: F401
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+
+
+class AEDetector:
+    """Dense autoencoder on sliding windows; anomaly when reconstruction
+    error exceeds the (1 - ratio) quantile (`anomaly.py` AEDetector)."""
+
+    def __init__(self, roll_len: int = 24, compress_rate: float = 0.25,
+                 ratio: float = 0.01, epochs: int = 20, lr: float = 1e-3,
+                 batch_size: int = 32, seed: int = 0):
+        self.roll_len = roll_len
+        self.compress_rate = compress_rate
+        self.ratio = ratio
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.lr = lr
+        self.model: Optional[Sequential] = None
+        self.threshold: Optional[float] = None
+        self._mean = self._std = None
+
+    def _roll(self, y: np.ndarray) -> np.ndarray:
+        y = np.asarray(y, np.float32).reshape(-1)
+        if len(y) < self.roll_len:
+            raise ValueError(f"series shorter than roll_len={self.roll_len}")
+        return np.stack([y[i:i + self.roll_len]
+                         for i in range(len(y) - self.roll_len + 1)])
+
+    def fit(self, y: np.ndarray) -> "AEDetector":
+        import optax
+        win = self._roll(y)
+        self._mean, self._std = win.mean(), win.std() + 1e-8
+        win = (win - self._mean) / self._std
+        hidden = max(2, int(self.roll_len * self.compress_rate))
+        self.model = Sequential([
+            L.Dense(hidden, activation="relu",
+                    input_shape=(self.roll_len,)),
+            L.Dense(self.roll_len),
+        ])
+        self.model.compile(optax.adam(self.lr), "mse")
+        self.model.fit(win, win, batch_size=min(self.batch_size, len(win)),
+                       nb_epoch=self.epochs)
+        err = self._errors(win)
+        self.threshold = float(np.quantile(err, 1.0 - self.ratio))
+        return self
+
+    def _errors(self, win_scaled: np.ndarray) -> np.ndarray:
+        recon = np.asarray(self.model.predict(win_scaled,
+                                              batch_per_thread=64))
+        return np.mean((recon - win_scaled) ** 2, axis=1)
+
+    def score(self, y: np.ndarray) -> np.ndarray:
+        """Per-window anomaly flags (1 = anomalous window)."""
+        if self.model is None:
+            raise RuntimeError("fit first")
+        win = (self._roll(y) - self._mean) / self._std
+        return (self._errors(win) > self.threshold).astype(np.int32)
+
+    def anomaly_indexes(self, y: np.ndarray) -> np.ndarray:
+        """Indices (window starts) flagged anomalous."""
+        return np.where(self.score(y) == 1)[0]

@@ -1,0 +1,67 @@
+"""AutoTS — `AutoTSTrainer`/`TSPipeline` (`zouwu/autots/forecast.py:22,86`).
+
+Thin user-facing wrapper over the AutoML TimeSequencePredictor: the trainer
+searches feature+model config, the pipeline carries the fitted artifacts
+with fit/predict/evaluate/save/load."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import pandas as pd
+
+from analytics_zoo_tpu.automl.pipeline import (TimeSequencePipeline,
+                                               TimeSequencePredictor)
+from analytics_zoo_tpu.automl.recipe import LSTMGridRandomRecipe, Recipe
+
+
+class TSPipeline:
+    """`TSPipeline` (`zouwu/autots/forecast.py:86`)."""
+
+    def __init__(self, inner: TimeSequencePipeline):
+        self._inner = inner
+
+    def predict(self, input_df: pd.DataFrame):
+        return self._inner.predict(input_df)
+
+    def evaluate(self, input_df: pd.DataFrame,
+                 metrics: Sequence[str] = ("mse",)) -> Dict[str, float]:
+        return self._inner.evaluate(input_df, metrics)
+
+    def fit(self, input_df: pd.DataFrame, epoch_num: int = 1,
+            batch_size: int = 32):
+        """Incremental fit (`forecast.py:101`)."""
+        return self._inner.fit(input_df, epochs=epoch_num,
+                               batch_size=batch_size)
+
+    def save(self, pipeline_file: str) -> str:
+        return self._inner.save(pipeline_file)
+
+    @classmethod
+    def load(cls, pipeline_file: str) -> "TSPipeline":
+        return cls(TimeSequencePipeline.load(pipeline_file))
+
+    @property
+    def config(self) -> Dict:
+        return self._inner.config
+
+
+class AutoTSTrainer:
+    """`AutoTSTrainer` (`zouwu/autots/forecast.py:22`)."""
+
+    def __init__(self, dt_col: str = "datetime", target_col: str = "value",
+                 horizon: int = 1,
+                 extra_features_col: Optional[Sequence[str]] = None,
+                 seed: int = 0):
+        self._predictor = TimeSequencePredictor(
+            dt_col=dt_col, target_col=target_col, future_seq_len=horizon,
+            extra_features_col=extra_features_col, seed=seed)
+
+    def fit(self, train_df: pd.DataFrame,
+            validation_df: Optional[pd.DataFrame] = None,
+            recipe: Optional[Recipe] = None,
+            metric: str = "mse") -> TSPipeline:
+        recipe = recipe or LSTMGridRandomRecipe(num_rand_samples=1)
+        pipeline = self._predictor.fit(train_df, validation_df,
+                                       recipe=recipe, metric=metric)
+        return TSPipeline(pipeline)

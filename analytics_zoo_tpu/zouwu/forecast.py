@@ -1,0 +1,129 @@
+"""Standalone forecasters (`zouwu/model/forecast/*.py`).
+
+Uniform surface: `fit(x, y, epochs, batch_size)` on unrolled windows
+(x: [B, past_len, F], y: [B, horizon]), `predict(x)`, `evaluate(x, y)` —
+matching `LSTMForecaster` (`lstm_forecaster.py:21`), `MTNetForecaster`,
+`TCNForecaster`, and the factorization-based `TCMFForecaster` (distributed
+via Orca in the reference; single-host jit here)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.automl.models import (TCMF, build_mtnet, build_tcn,
+                                             build_seq2seq,
+                                             build_vanilla_lstm,
+                                             mtnet_past_seq_len)
+from analytics_zoo_tpu.automl.pipeline import _metric_value
+
+
+class _KerasForecaster:
+    def __init__(self):
+        self.model = None
+
+    def fit(self, x: np.ndarray, y: np.ndarray, epochs: int = 10,
+            batch_size: int = 32, validation_data=None):
+        batch_size = min(batch_size, len(x))
+        return self.model.fit(np.asarray(x, np.float32),
+                              np.asarray(y, np.float32),
+                              batch_size=batch_size, nb_epoch=epochs,
+                              validation_data=validation_data)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.predict(np.asarray(x, np.float32),
+                                             batch_per_thread=64))
+
+    def evaluate(self, x: np.ndarray, y: np.ndarray,
+                 metrics: Sequence[str] = ("mse",)) -> Dict[str, float]:
+        pred = self.predict(x)
+        return {m: _metric_value(m, y, pred) for m in metrics}
+
+    def save(self, path: str):
+        self.model.save_weights(path)
+
+    def restore(self, path: str):
+        self.model.load_weights(path)
+
+
+class LSTMForecaster(_KerasForecaster):
+    """`lstm_forecaster.py:21`: 2-layer LSTM regressor."""
+
+    def __init__(self, target_dim: int = 1, feature_dim: int = 1,
+                 lstm_1_units: int = 16, lstm_2_units: int = 8,
+                 dropout_1: float = 0.2, dropout_2: float = 0.2,
+                 lr: float = 1e-3, past_seq_len: int = 2):
+        super().__init__()
+        self.model = build_vanilla_lstm(
+            {"lstm_1_units": lstm_1_units, "lstm_2_units": lstm_2_units,
+             "dropout_1": dropout_1, "dropout_2": dropout_2, "lr": lr},
+            input_shape=(past_seq_len, feature_dim), output_dim=target_dim)
+
+
+class Seq2SeqForecaster(_KerasForecaster):
+    def __init__(self, target_dim: int = 1, feature_dim: int = 1,
+                 latent_dim: int = 32, dropout: float = 0.2,
+                 lr: float = 1e-3, past_seq_len: int = 4,
+                 future_seq_len: int = 1):
+        super().__init__()
+        self.model = build_seq2seq(
+            {"latent_dim": latent_dim, "dropout": dropout, "lr": lr},
+            input_shape=(past_seq_len, feature_dim),
+            output_dim=target_dim, horizon=future_seq_len)
+
+
+class TCNForecaster(_KerasForecaster):
+    def __init__(self, target_dim: int = 1, feature_dim: int = 1,
+                 hidden_units: int = 32, levels: int = 3,
+                 kernel_size: int = 3, dropout: float = 0.1,
+                 lr: float = 1e-3, past_seq_len: int = 8):
+        super().__init__()
+        self.model = build_tcn(
+            {"hidden_units": hidden_units, "levels": levels,
+             "kernel_size": kernel_size, "dropout": dropout, "lr": lr},
+            input_shape=(past_seq_len, feature_dim), output_dim=target_dim)
+
+
+class MTNetForecaster(_KerasForecaster):
+    """`mtnet_forecaster.py`: memory-network forecaster. Input windows must
+    be (long_series_num + 1) * series_length long."""
+
+    def __init__(self, target_dim: int = 1, feature_dim: int = 1,
+                 long_series_num: int = 4, series_length: int = 4,
+                 cnn_hid_size: int = 32, dropout: float = 0.1,
+                 lr: float = 1e-3):
+        super().__init__()
+        self.config = {"time_step": series_length,
+                       "long_num": long_series_num,
+                       "cnn_hid_size": cnn_hid_size, "dropout": dropout,
+                       "lr": lr}
+        self.past_seq_len = mtnet_past_seq_len(self.config)
+        self.model = build_mtnet(self.config, feature_dim=feature_dim)
+
+
+class TCMFForecaster:
+    """`tcmf_forecaster.py`: global matrix factorization over a panel of
+    series. fit on {"id": [n], "y": [n, T]}, predict(horizon)."""
+
+    def __init__(self, rank: int = 8, ar_lags: int = 8, steps: int = 300,
+                 lr: float = 0.05, seed: int = 0):
+        self._tcmf = TCMF(rank=rank, ar_lags=ar_lags, steps=steps, lr=lr,
+                          seed=seed)
+        self._ids: Optional[np.ndarray] = None
+
+    def fit(self, x: Dict):
+        y = np.asarray(x["y"], np.float32)
+        self._ids = np.asarray(x.get("id", np.arange(len(y))))
+        self._tcmf.fit(y)
+        return self
+
+    def predict(self, horizon: int = 24) -> Dict:
+        preds = self._tcmf.predict(horizon)
+        return {"id": self._ids, "prediction": preds}
+
+    def evaluate(self, target_value: Dict,
+                 metric: Sequence[str] = ("mse",)) -> Dict[str, float]:
+        y_true = np.asarray(target_value["y"], np.float32)
+        preds = self._tcmf.predict(y_true.shape[1])
+        return {m: _metric_value(m, y_true, preds) for m in metric}
