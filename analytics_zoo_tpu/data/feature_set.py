@@ -86,8 +86,86 @@ class FeatureSet:
                 out.append(rows)
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
+    def _native_loader(self, batch_size: int, drop_remainder: bool,
+                       ordered: bool):
+        """C++ threaded loader for this batch geometry. The dataset is
+        packed ONCE per FeatureSet (streamed in chunks — never a full-RAM
+        copy); per-geometry loaders share that file via mmap. `ordered`
+        uses a single worker so batches arrive in index order (threaded
+        delivery is completion-ordered)."""
+        from analytics_zoo_tpu.data import native_loader as nl
+        if not nl.available():
+            return None
+        if getattr(self, "_packed", None) is None:
+            # stream the (possibly memmapped) leaves: head then tail chunks
+            class _Concat:
+                def __init__(self, head, tail):
+                    self.head, self.tail = head, tail
+                    self.shape = (len(head) + len(tail),) + head.shape[1:]
+                    self.dtype = head.dtype
+
+                def __len__(self):
+                    return self.shape[0]
+
+                def __getitem__(self, sl):
+                    lo, hi = sl.start or 0, sl.stop
+                    h = len(self.head)
+                    if hi <= h:
+                        return self.head[lo:hi]
+                    if lo >= h:
+                        return self.tail[lo - h:hi - h]
+                    return np.concatenate(
+                        [self.head[lo:], self.tail[:hi - h]])
+
+            leaves = [head if tail is None else _Concat(head, tail)
+                      for head, tail in self._leaves]
+            self._packed = nl.NativeBatchLoader.pack_file(
+                leaves, cache_dir=getattr(self, "_cache_dir", None))
+        path, n, specs = self._packed
+        key = (batch_size, drop_remainder, ordered)
+        cache = getattr(self, "_native_cache", None)
+        if cache is None:
+            cache = self._native_cache = {}
+        if key not in cache:
+            cache[key] = nl.NativeBatchLoader(
+                path, n, specs, batch_size,
+                n_threads=1 if ordered else 2,
+                drop_remainder=drop_remainder)
+        return cache[key]
+
+    def close(self):
+        """Release native loaders and the packed record file."""
+        for loader in getattr(self, "_native_cache", {}).values():
+            loader.close()
+        self._native_cache = {}
+        packed = getattr(self, "_packed", None)
+        if packed is not None and os.path.exists(packed[0]):
+            os.unlink(packed[0])
+        self._packed = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
     def iter_batches(self, batch_size: int, shuffle: bool = True,
-                     seed: int = 0, drop_remainder: bool = True):
+                     seed: int = 0, drop_remainder: bool = True,
+                     native: Optional[bool] = None):
+        """`native=None` auto-selects: spilled tiers go through the C++
+        threaded loader (batch assembly off the GIL, overlapping the TPU
+        step); DRAM stays on the numpy fast path. shuffle=False keeps the
+        sequential-order contract (single-worker native delivery)."""
+        import jax
+        if native is None:
+            native = self._split < self._n
+        if native:
+            loader = self._native_loader(batch_size, drop_remainder,
+                                         ordered=not shuffle)
+            if loader is not None:
+                for leaves in loader.iter_epoch(seed=seed, shuffle=shuffle):
+                    yield jax.tree_util.tree_unflatten(self._treedef, leaves)
+                return
         idx = np.arange(self._n)
         if shuffle:
             np.random.RandomState(seed).shuffle(idx)

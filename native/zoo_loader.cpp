@@ -1,0 +1,248 @@
+// Native data loader: threaded shuffled batch assembly from an mmap'd
+// record file.
+//
+// The TPU-native equivalent of the reference's native data-cache layer
+// (PMEM NativeArray via JNI memkind, zoo/src/main/java/.../pmem/
+// PersistentMemoryAllocator.java:37; pmem/FeatureSet.scala:151): samples
+// live out-of-heap in a file-backed mapping (which IS how memkind fsdax PMEM
+// works), and batch gather/shuffle runs on C++ worker threads off the Python
+// GIL, overlapping host-side batch assembly with TPU step execution.
+//
+// Layout: one flat file of n_records fixed-size records (a record packs all
+// pytree leaves' row bytes back to back; Python splits by offset).
+//
+// C ABI (ctypes):
+//   void*   zoo_loader_create(path, n_records, record_bytes, batch_size,
+//                             n_threads, queue_capacity, drop_remainder)
+//   void    zoo_loader_start_epoch(l, seed, shuffle)  // also abandons any
+//                                                     // half-read epoch
+//   int64_t zoo_loader_next(l, out)   // rows copied; 0 = epoch end; -1 err
+//   void    zoo_loader_destroy(l)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Batch {
+    std::vector<uint8_t> data;
+    int64_t rows = 0;
+    uint64_t gen = 0;
+};
+
+struct Loader {
+    // immutable config
+    int fd = -1;
+    const uint8_t* base = nullptr;
+    size_t map_len = 0;
+    int64_t n_records = 0;
+    int64_t record_bytes = 0;
+    int64_t batch_size = 0;
+    int n_threads = 1;
+    int queue_capacity = 4;
+    bool drop_remainder = true;
+
+    // epoch state (index values are always valid record ids, so a worker
+    // racing an epoch restart reads a mix of old/new permutation — its
+    // batch carries a stale gen and is discarded, never unsafe)
+    std::vector<int64_t> index;
+    std::atomic<int64_t> next_batch{0};
+    int64_t n_batches = 0;
+    uint64_t gen = 0;
+
+    std::mutex mu;
+    std::condition_variable cv_ready, cv_free;
+    std::deque<Batch*> ready;
+    std::deque<Batch*> free_bufs;
+    std::vector<Batch*> all_bufs;
+    int64_t delivered = 0;     // batches handed to the consumer this epoch
+    bool shutting_down = false;
+
+    std::vector<std::thread> workers;
+
+    ~Loader() {
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            shutting_down = true;
+        }
+        cv_ready.notify_all();
+        cv_free.notify_all();
+        for (auto& t : workers) {
+            if (t.joinable()) t.join();
+        }
+        for (auto* b : all_bufs) delete b;
+        if (base) munmap(const_cast<uint8_t*>(base), map_len);
+        if (fd >= 0) close(fd);
+    }
+};
+
+void worker_loop(Loader* L) {
+    for (;;) {
+        Batch* buf = nullptr;
+        uint64_t my_gen;
+        int64_t b;
+        {
+            std::unique_lock<std::mutex> lk(L->mu);
+            L->cv_free.wait(lk, [&] {
+                return L->shutting_down ||
+                       (L->next_batch.load(std::memory_order_relaxed) <
+                            L->n_batches &&
+                        !L->free_bufs.empty());
+            });
+            if (L->shutting_down) return;
+            buf = L->free_bufs.front();
+            L->free_bufs.pop_front();
+            // claim the batch index under the SAME lock that start_epoch
+            // holds while resetting (gen, next_batch) — so an index can
+            // never be claimed for one epoch with another epoch's gen
+            // (which would silently drop a batch and hang the consumer)
+            my_gen = L->gen;
+            b = L->next_batch.fetch_add(1, std::memory_order_relaxed);
+        }
+        if (b >= L->n_batches) {            // raced past the end: recycle
+            std::lock_guard<std::mutex> lk(L->mu);
+            L->free_bufs.push_back(buf);
+            L->cv_free.notify_one();
+            continue;
+        }
+        const int64_t start = b * L->batch_size;
+        const int64_t rows =
+            std::min(L->batch_size, L->n_records - start);
+        for (int64_t r = 0; r < rows; ++r) {
+            const int64_t rec = L->index[start + r];
+            std::memcpy(buf->data.data() + r * L->record_bytes,
+                        L->base + rec * L->record_bytes,
+                        L->record_bytes);
+        }
+        buf->rows = rows;
+        buf->gen = my_gen;
+        {
+            std::lock_guard<std::mutex> lk(L->mu);
+            if (buf->gen != L->gen) {       // epoch restarted mid-copy
+                L->free_bufs.push_back(buf);
+                L->cv_free.notify_one();
+                continue;
+            }
+            L->ready.push_back(buf);
+        }
+        L->cv_ready.notify_one();
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* zoo_loader_create(const char* path, int64_t n_records,
+                        int64_t record_bytes, int64_t batch_size,
+                        int n_threads, int queue_capacity,
+                        int drop_remainder) {
+    if (n_records <= 0 || record_bytes <= 0 || batch_size <= 0) return nullptr;
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0 ||
+        st.st_size < n_records * record_bytes) {
+        close(fd);
+        return nullptr;
+    }
+    size_t len = static_cast<size_t>(st.st_size);
+    void* base = mmap(nullptr, len, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (base == MAP_FAILED) {
+        close(fd);
+        return nullptr;
+    }
+    auto* L = new Loader();
+    L->fd = fd;
+    L->base = static_cast<const uint8_t*>(base);
+    L->map_len = len;
+    L->n_records = n_records;
+    L->record_bytes = record_bytes;
+    L->batch_size = batch_size;
+    L->n_threads = n_threads < 1 ? 1 : n_threads;
+    L->queue_capacity = queue_capacity < 2 ? 2 : queue_capacity;
+    L->drop_remainder = drop_remainder != 0;
+    L->index.resize(n_records);
+    for (int64_t i = 0; i < n_records; ++i) L->index[i] = i;
+    for (int i = 0; i < L->queue_capacity; ++i) {
+        auto* b = new Batch();
+        b->data.resize(static_cast<size_t>(batch_size * record_bytes));
+        L->all_bufs.push_back(b);
+        L->free_bufs.push_back(b);
+    }
+    for (int i = 0; i < L->n_threads; ++i) {
+        L->workers.emplace_back(worker_loop, L);
+    }
+    return L;
+}
+
+void zoo_loader_start_epoch(void* lp, uint64_t seed, int shuffle) {
+    if (!lp) return;
+    auto* L = static_cast<Loader*>(lp);
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->gen++;
+    // abandon any undelivered batches from a half-read epoch
+    while (!L->ready.empty()) {
+        L->free_bufs.push_back(L->ready.front());
+        L->ready.pop_front();
+    }
+    for (int64_t i = 0; i < L->n_records; ++i) L->index[i] = i;
+    if (shuffle) {
+        std::mt19937_64 rng(seed);
+        for (int64_t i = L->n_records - 1; i > 0; --i) {
+            std::uniform_int_distribution<int64_t> d(0, i);
+            std::swap(L->index[i], L->index[d(rng)]);
+        }
+    }
+    L->n_batches = L->drop_remainder
+        ? L->n_records / L->batch_size
+        : (L->n_records + L->batch_size - 1) / L->batch_size;
+    L->next_batch.store(0);
+    L->delivered = 0;
+    L->cv_free.notify_all();
+}
+
+int64_t zoo_loader_next(void* lp, uint8_t* out) {
+    if (!lp || !out) return -1;
+    auto* L = static_cast<Loader*>(lp);
+    Batch* buf = nullptr;
+    {
+        std::unique_lock<std::mutex> lk(L->mu);
+        if (L->delivered >= L->n_batches) return 0;   // epoch end
+        L->cv_ready.wait(lk, [&] {
+            return L->shutting_down || !L->ready.empty();
+        });
+        if (L->shutting_down) return -1;
+        buf = L->ready.front();
+        L->ready.pop_front();
+        L->delivered++;
+    }
+    const int64_t rows = buf->rows;
+    std::memcpy(out, buf->data.data(),
+                static_cast<size_t>(rows * L->record_bytes));
+    {
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->free_bufs.push_back(buf);
+    }
+    L->cv_free.notify_one();
+    return rows;
+}
+
+void zoo_loader_destroy(void* lp) {
+    if (!lp) return;
+    delete static_cast<Loader*>(lp);
+}
+
+}  // extern "C"

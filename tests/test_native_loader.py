@@ -1,0 +1,130 @@
+"""Native C++ batch loader tests: build, record integrity, epoch semantics,
+FeatureSet integration, python fallback. The native component mirrors the
+reference's JNI data-cache layer (SURVEY §2.3 PMEM allocator)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data import native_loader as nl
+from analytics_zoo_tpu.data.feature_set import FeatureSet
+
+pytestmark = pytest.mark.skipif(not nl.available(),
+                                reason="no native toolchain")
+
+
+@pytest.fixture(scope="module")
+def loader():
+    rng = np.random.RandomState(0)
+    x = rng.randn(500, 6).astype(np.float32)
+    y = np.arange(500).astype(np.int64)
+    ld = nl.NativeBatchLoader.from_arrays([x, y], batch_size=64)
+    yield ld, x, y
+    ld.close()
+
+
+class TestNativeLoader:
+    def test_shapes_and_row_integrity(self, loader):
+        ld, x, y = loader
+        for xb, yb in ld.iter_epoch(seed=3):
+            assert xb.shape == (64, 6) and yb.shape == (64,)
+            assert xb.dtype == np.float32 and yb.dtype == np.int64
+            # each delivered row matches its source record exactly
+            np.testing.assert_array_equal(xb, x[yb])
+
+    def test_epoch_covers_unique_records(self, loader):
+        ld, _, _ = loader
+        got = np.concatenate([b[1] for b in ld.iter_epoch(seed=1)])
+        assert len(got) == 7 * 64
+        assert len(set(got.tolist())) == len(got)
+
+    def test_different_seeds_shuffle_differently(self, loader):
+        ld, _, _ = loader
+        e1 = np.concatenate([b[1] for b in ld.iter_epoch(seed=1)])
+        e2 = np.concatenate([b[1] for b in ld.iter_epoch(seed=2)])
+        assert not np.array_equal(e1, e2)
+
+    def test_abandoned_epoch_restart(self, loader):
+        ld, _, _ = loader
+        it = ld.iter_epoch(seed=5)
+        next(it)  # read one batch then abandon
+        it.close()
+        got = np.concatenate([b[1] for b in ld.iter_epoch(seed=6)])
+        assert len(set(got.tolist())) == len(got) == 7 * 64
+
+    def test_keep_remainder(self):
+        ids = np.arange(100).astype(np.int32)
+        ld = nl.NativeBatchLoader.from_arrays([ids], batch_size=32,
+                                              drop_remainder=False)
+        sizes = [len(b[0]) for b in ld.iter_epoch(shuffle=False)]
+        assert sorted(sizes) == [4, 32, 32, 32]
+        ld.close()
+
+    def test_multidim_leaves(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(64, 4, 3).astype(np.float32)
+        ld = nl.NativeBatchLoader.from_arrays([x], batch_size=16)
+        for (xb,) in ld.iter_epoch(shuffle=False):
+            assert xb.shape == (16, 4, 3)
+        ld.close()
+
+
+class TestFeatureSetIntegration:
+    def test_disk_tier_native_matches_python(self):
+        rng = np.random.RandomState(0)
+        data = {"x": rng.randn(300, 5).astype(np.float32),
+                "y": np.arange(300).astype(np.int64)}
+        fs = FeatureSet(data, memory_type="DISK")
+        nat = list(fs.iter_batches(50, shuffle=True, seed=7, native=True))
+        py = list(fs.iter_batches(50, shuffle=True, seed=7, native=False))
+        assert len(nat) == len(py) == 6
+        # same record SET per epoch (order differs: threaded delivery +
+        # different shuffler), every native row intact
+        nat_ids = np.concatenate([b["y"] for b in nat])
+        assert len(set(nat_ids.tolist())) == 300
+        for b in nat:
+            np.testing.assert_array_equal(b["x"], data["x"][b["y"]])
+
+    def test_no_shuffle_preserves_row_order(self):
+        data = {"x": np.arange(100, dtype=np.float32)}
+        fs = FeatureSet(data, memory_type="DISK")
+        got = np.concatenate(
+            [b["x"] for b in fs.iter_batches(10, shuffle=False)])
+        np.testing.assert_array_equal(got, np.arange(100, dtype=np.float32))
+        fs.close()
+
+    def test_peek_then_reiterate_no_deadlock(self):
+        data = {"x": np.arange(64, dtype=np.float32)}
+        fs = FeatureSet(data, memory_type="DISK")
+        it = fs.iter_batches(8, seed=1)
+        next(it)                     # peek and abandon
+        full = list(fs.iter_batches(8, seed=2))
+        assert len(full) == 8
+        fs.close()
+
+    def test_geometries_share_one_packed_file(self):
+        data = {"x": np.arange(64, dtype=np.float32)}
+        fs = FeatureSet(data, memory_type="DISK")
+        list(fs.iter_batches(8))
+        list(fs.iter_batches(16))
+        list(fs.iter_batches(16, drop_remainder=False))
+        assert len(fs._native_cache) == 3
+        paths = {ld.path for ld in fs._native_cache.values()}
+        assert len(paths) == 1       # shared packed file
+        fs.close()
+
+    def test_dram_tier_defaults_to_python(self):
+        fs = FeatureSet({"x": np.arange(10, dtype=np.float32)})
+        assert getattr(fs, "_native_cache", None) is None
+        list(fs.iter_batches(5))
+        assert getattr(fs, "_native_cache", None) is None
+
+
+class TestFallback:
+    def test_python_path_when_disabled(self, monkeypatch):
+        monkeypatch.setattr(nl, "_build_failed", True)
+        monkeypatch.setattr(nl, "_lib", None)
+        assert not nl.available()
+        fs = FeatureSet({"x": np.arange(40, dtype=np.float32)},
+                        memory_type="DISK")
+        batches = list(fs.iter_batches(8, shuffle=False))
+        assert len(batches) == 5
