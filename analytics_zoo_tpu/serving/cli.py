@@ -1,0 +1,91 @@
+"""Cluster Serving CLI — `cluster-serving-start/stop/cli` analogue
+(`scripts/cluster-serving/`).
+
+    python -m analytics_zoo_tpu.serving.cli start --config config.yaml
+    python -m analytics_zoo_tpu.serving.cli broker --port 6380
+    python -m analytics_zoo_tpu.serving.cli metrics --url tcp://host:port
+
+`start` runs the serving loop (and HTTP frontend when http_port is set) in
+the foreground; `broker` runs a standalone TCP broker so clients on other
+hosts/processes can enqueue (the image has no Redis server)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import time
+
+
+def cmd_start(args) -> int:
+    from analytics_zoo_tpu.serving.config import ServingConfig
+    from analytics_zoo_tpu.serving.http_frontend import FrontEnd
+    from analytics_zoo_tpu.serving.server import ClusterServing
+    cfg = ServingConfig.load(args.config)
+    model = cfg.build_model()
+    serving = ClusterServing(model, cfg.broker_url, stream=cfg.stream,
+                             batch_size=cfg.batch_size,
+                             batch_timeout_ms=cfg.batch_timeout_ms).start()
+    frontend = None
+    if cfg.http_port is not None:
+        frontend = FrontEnd(serving.broker, serving,
+                            port=cfg.http_port).start()
+        print(f"http frontend on :{frontend.port}", flush=True)
+    print("cluster serving started", flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        if frontend:
+            frontend.stop()
+        serving.stop()
+        print(json.dumps(serving.metrics()), flush=True)
+    return 0
+
+
+def cmd_broker(args) -> int:
+    from analytics_zoo_tpu.serving.broker import TCPBrokerServer
+    srv = TCPBrokerServer(host=args.host, port=args.port).start()
+    print(f"broker listening on {srv.host}:{srv.port}", flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.5)
+    srv.stop()
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    import urllib.request
+    print(urllib.request.urlopen(args.url + "/metrics",
+                                 timeout=10).read().decode())
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser(prog="analytics-zoo-serving")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("start", help="run the serving loop")
+    ps.add_argument("--config", required=True)
+    ps.set_defaults(fn=cmd_start)
+    pb = sub.add_parser("broker", help="run a standalone TCP broker")
+    pb.add_argument("--host", default="0.0.0.0")
+    pb.add_argument("--port", type=int, default=6379)
+    pb.set_defaults(fn=cmd_broker)
+    pm = sub.add_parser("metrics", help="fetch frontend metrics")
+    pm.add_argument("--url", required=True)
+    pm.set_defaults(fn=cmd_metrics)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Serving configuration — the `ClusterServingHelper` analogue.
+
+Reference: `serving/utils/ClusterServingHelper.scala:481` parses
+`scripts/cluster-serving/config.yaml` (`:3-34`: model path, core_number,
+redis host/port, secure flags) and builds the InferenceModel. Same YAML
+surface here, with broker URL generalized beyond redis and the model loaded
+from this framework's formats."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+def _load_yaml(path: str) -> Dict[str, Any]:
+    try:
+        import yaml
+        with open(path) as fh:
+            return yaml.safe_load(fh) or {}
+    except ImportError:
+        # minimal fallback parser: two-level `key:` / `  key: value` yaml,
+        # which is all config.yaml uses
+        out: Dict[str, Any] = {}
+        section: Optional[str] = None
+        with open(path) as fh:
+            for raw in fh:
+                line = raw.rstrip()
+                if not line or line.lstrip().startswith("#"):
+                    continue
+                indent = len(line) - len(line.lstrip())
+                key, _, value = line.strip().partition(":")
+                value = value.strip()
+                if indent == 0:
+                    if value:
+                        out[key] = _coerce(value)
+                        section = None
+                    else:
+                        out[key] = {}
+                        section = key
+                elif section is not None:
+                    out[section][key] = _coerce(value)
+        return out
+
+
+def _coerce(v: str):
+    if v in ("", "~", "null"):
+        return None
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v.strip("'\"")
+
+
+@dataclass
+class ServingConfig:
+    """config.yaml schema (reference `scripts/cluster-serving/config.yaml`)."""
+
+    model_path: Optional[str] = None
+    model_class: Optional[str] = None       # zoo-model class name
+    broker_url: str = "memory"              # memory | tcp://h:p | redis://h:p
+    stream: str = "serving_stream"
+    batch_size: int = 32                    # core_number analogue
+    batch_timeout_ms: int = 5
+    concurrent_num: int = 1
+    http_port: Optional[int] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "ServingConfig":
+        raw = _load_yaml(path)
+        model = raw.get("model", {}) or {}
+        params = raw.get("params", {}) or {}
+        redis = raw.get("redis", {}) or {}
+        cfg = cls()
+        cfg.model_path = model.get("path")
+        cfg.model_class = model.get("class")
+        if redis.get("host"):
+            cfg.broker_url = f"redis://{redis['host']}:{redis.get('port', 6379)}"
+        if raw.get("broker"):
+            cfg.broker_url = raw["broker"]
+        cfg.batch_size = int(params.get("core_number",
+                                        params.get("batch_size", 32)))
+        cfg.batch_timeout_ms = int(params.get("batch_timeout_ms", 5))
+        cfg.concurrent_num = int(params.get("concurrent_num", 1))
+        if raw.get("http_port") is not None:
+            cfg.http_port = int(raw["http_port"])
+        cfg.extra = raw
+        return cfg
+
+    def build_model(self):
+        """Model resolution (`ClusterServingHelper` model-type dispatch):
+        a ZooModel dir (config.json names the class) or bare weights +
+        model_class."""
+        import json
+        from analytics_zoo_tpu.serving.inference_model import InferenceModel
+        from analytics_zoo_tpu import models as zoo_models
+        if not self.model_path:
+            raise ValueError("config has no model.path")
+        im = InferenceModel(concurrent_num=self.concurrent_num)
+        cfg_json = os.path.join(self.model_path, "config.json")
+        if os.path.exists(cfg_json):
+            with open(cfg_json) as fh:
+                cls_name = json.load(fh)["class"]
+            cls = _find_model_class(cls_name)
+            return im.load_zoo_model(cls, self.model_path)
+        raise ValueError(
+            f"{self.model_path} is not a saved ZooModel directory")
+
+
+def _find_model_class(name: str):
+    from analytics_zoo_tpu.models import (anomalydetection, bert, image,
+                                          recommendation, seq2seq,
+                                          textclassification, textmatching)
+    for mod in (recommendation, anomalydetection, textclassification,
+                textmatching, seq2seq, image, bert):
+        if hasattr(mod, name):
+            return getattr(mod, name)
+    raise ValueError(f"Unknown model class {name!r}")

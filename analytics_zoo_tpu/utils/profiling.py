@@ -1,0 +1,100 @@
+"""Profiling & timing utilities (SURVEY §2.12/§5 tracing).
+
+The reference has no general tracer — only `Supportive.timing` span logs
+(`serving/utils/Supportive.scala`, `InferenceSupportive.timing`) and serving
+`Timer` windows. The TPU build supplies both and adds what the reference
+lacks: real device profiling via the jax profiler (xprof traces viewable in
+TensorBoard/Perfetto) and step-level throughput/MFU accounting."""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+log = logging.getLogger("analytics_zoo_tpu.profiling")
+
+
+@contextlib.contextmanager
+def timing(name: str, logger: Optional[logging.Logger] = None
+           ) -> Iterator[None]:
+    """`Supportive.timing` span: logs `name time [s]` at INFO."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        (logger or log).info("%s time %.4fs", name,
+                             time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """jax profiler trace (xprof): open in TensorBoard's profile plugin or
+    Perfetto. Wrap a few training steps, not a whole run."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (`jax.profiler.TraceAnnotation`)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Per-step wall-clock + throughput accounting; the `Throughput` scalar
+    the reference writes to its train summary (`Topology.scala:224`)."""
+
+    def __init__(self, flops_per_step: Optional[float] = None,
+                 peak_flops: Optional[float] = None):
+        self.flops_per_step = flops_per_step
+        self.peak_flops = peak_flops
+        self.steps = 0
+        self.total_s = 0.0
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.total_s += time.perf_counter() - self._t0
+        self.steps += 1
+        return False
+
+    @property
+    def step_ms(self) -> float:
+        return self.total_s / max(self.steps, 1) * 1e3
+
+    def samples_per_sec(self, batch_size: int) -> float:
+        return batch_size * self.steps / max(self.total_s, 1e-9)
+
+    @property
+    def mfu(self) -> Optional[float]:
+        if not (self.flops_per_step and self.peak_flops and self.total_s):
+            return None
+        return (self.flops_per_step * self.steps / self.total_s
+                / self.peak_flops)
+
+    def summary(self, batch_size: Optional[int] = None) -> Dict[str, float]:
+        out = {"steps": self.steps, "step_ms": round(self.step_ms, 3)}
+        if batch_size:
+            out["samples_per_sec"] = round(self.samples_per_sec(batch_size),
+                                           1)
+        if self.mfu is not None:
+            out["mfu"] = round(self.mfu, 4)
+        return out
+
+
+def transformer_train_flops(n_params_matmul: int, tokens: int,
+                            n_layers: int, seq_len: int,
+                            hidden: int, batch: int) -> float:
+    """Standard fwd+bwd FLOPs estimate: 6 per matmul-param per token plus
+    attention score/context terms (the bench.py accounting, shared)."""
+    return (6.0 * n_params_matmul * tokens
+            + 12.0 * n_layers * seq_len ** 2 * hidden * batch)

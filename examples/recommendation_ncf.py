@@ -1,0 +1,38 @@
+"""NeuralCF on synthetic MovieLens-style data (the reference's
+recommendation-ncf app, `apps/recommendation-ncf/`, baseline config 1).
+
+    python examples/recommendation_ncf.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+
+def synthetic_ratings(n=4096, users=200, items=100, seed=0):
+    rng = np.random.RandomState(seed)
+    u = rng.randint(1, users + 1, n)
+    i = rng.randint(1, items + 1, n)
+    # implicit preference structure so there is signal to learn
+    label = ((u * 7 + i * 3) % 5 + 1).astype(np.int32)
+    return np.stack([u, i], axis=1).astype(np.int32), label
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    x, y = synthetic_ratings()
+    ncf = NeuralCF(user_count=200, item_count=100, class_num=5,
+                   hidden_layers=(20, 10), include_mf=True)
+    ncf.compile("adam", "sparse_categorical_crossentropy", ["accuracy"])
+    history = ncf.fit(x, y - 1, batch_size=256, nb_epoch=4)
+    print("final loss:", history["loss"][-1])
+    metrics = ncf.evaluate(x, y - 1, batch_per_thread=256)
+    print("metrics:", metrics)
+    recs = ncf.recommend_for_user(np.unique(x[:, 0])[:3], max_items=4)
+    for user, items in list(recs.items())[:3]:
+        print(f"user {user}: {items}")
+
+
+if __name__ == "__main__":
+    main()

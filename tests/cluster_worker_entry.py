@@ -1,0 +1,25 @@
+"""Worker entry used by test_cluster.py: each process contributes its rank
+to a global psum over the full multi-process mesh and writes the result to a
+rank-stamped file (so the test can assert every process agreed)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def main(out_dir):
+    rank = jax.process_index()
+    n_local = jax.local_device_count()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    local = np.full((n_local,), float(rank + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local,
+        (jax.device_count(),))
+    total = jax.jit(jnp.sum,
+                    out_shardings=NamedSharding(mesh, P()))(arr)
+    with open(os.path.join(out_dir, f"rank{rank}.txt"), "w") as fh:
+        fh.write(str(float(total)))
+    return 0

@@ -1,0 +1,153 @@
+"""Cluster bootstrap launcher, serving config/CLI, profiling utils."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving.config import ServingConfig
+from analytics_zoo_tpu.utils.profiling import (StepTimer, timing,
+                                               transformer_train_flops)
+
+
+class TestClusterLauncher:
+    def test_two_process_rendezvous_and_collective(self, tmp_path):
+        from analytics_zoo_tpu.common.cluster import launch_local_cluster
+        env = {"PYTHONPATH": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + ":" + os.path.dirname(
+            os.path.abspath(__file__))}
+        mon = launch_local_cluster(
+            "cluster_worker_entry:main", num_processes=2,
+            devices_per_process=2, worker_args=[str(tmp_path)], env=env)
+        codes = mon.wait(timeout=180)
+        assert codes == [0, 0]
+        # 2 devices x rank1 + 2 devices x rank2 = 6; all ranks agree
+        vals = []
+        for r in range(2):
+            with open(tmp_path / f"rank{r}.txt") as fh:
+                vals.append(float(fh.read()))
+        assert vals == [6.0, 6.0]
+
+    def test_failing_worker_terminates_cluster(self, tmp_path):
+        from analytics_zoo_tpu.common.cluster import launch_local_cluster
+        env = {"PYTHONPATH": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + ":" + os.path.dirname(
+            os.path.abspath(__file__))}
+        # nonexistent entry fn -> workers exit nonzero -> RuntimeError
+        mon = launch_local_cluster("cluster_worker_entry:nope",
+                                   num_processes=2, worker_args=[],
+                                   env=env)
+        with pytest.raises(RuntimeError, match="exited with"):
+            mon.wait(timeout=180)
+
+
+class TestServingConfig:
+    def test_yaml_parse(self, tmp_path):
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text(
+            "model:\n"
+            "  path: /models/ncf\n"
+            "params:\n"
+            "  core_number: 16\n"
+            "  concurrent_num: 2\n"
+            "redis:\n"
+            "  host: cacher\n"
+            "  port: 6380\n")
+        cfg = ServingConfig.load(str(cfg_file))
+        assert cfg.model_path == "/models/ncf"
+        assert cfg.batch_size == 16
+        assert cfg.concurrent_num == 2
+        assert cfg.broker_url == "redis://cacher:6380"
+
+    def test_broker_override_and_defaults(self, tmp_path):
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text("model:\n  path: /m\nbroker: tcp://h:7000\n")
+        cfg = ServingConfig.load(str(cfg_file))
+        assert cfg.broker_url == "tcp://h:7000"
+        assert cfg.batch_size == 32
+
+    def test_build_model_from_zoo_dir(self, tmp_path):
+        from analytics_zoo_tpu.models.textclassification import TextClassifier
+        m = TextClassifier(class_num=2, vocab_size=30, embedding_dim=8,
+                           sequence_length=6)
+        m.model.ensure_built(np.zeros((1, 6), np.int32))
+        m.save_model(str(tmp_path / "tc"))
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text(f"model:\n  path: {tmp_path / 'tc'}\n")
+        im = ServingConfig.load(str(cfg_file)).build_model()
+        out = im.predict(np.zeros((3, 6), np.int32))
+        assert np.asarray(out).shape == (3, 2)
+
+
+class TestServingCLIEndToEnd:
+    def test_broker_and_start_roundtrip(self, tmp_path):
+        """Full deployment shape: broker proc + serving proc + client."""
+        from analytics_zoo_tpu.models.textclassification import TextClassifier
+        from analytics_zoo_tpu.serving.client import InputQueue
+        m = TextClassifier(class_num=2, vocab_size=30, embedding_dim=8,
+                           sequence_length=6)
+        m.model.ensure_built(np.zeros((1, 6), np.int32))
+        m.save_model(str(tmp_path / "tc"))
+
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        broker = subprocess.Popen(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.cli",
+             "broker", "--host", "127.0.0.1", "--port", str(port)], env=env)
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text(
+            f"model:\n  path: {tmp_path / 'tc'}\n"
+            f"broker: tcp://127.0.0.1:{port}\n")
+        serving = subprocess.Popen(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.cli",
+             "start", "--config", str(cfg_file)], env=env)
+        try:
+            q = InputQueue(f"tcp://127.0.0.1:{port}")
+            deadline = time.time() + 120
+            out = None
+            while time.time() < deadline:
+                try:
+                    out = q.predict(np.zeros((6,), np.float32),
+                                    timeout_s=10)
+                    break
+                except (ConnectionRefusedError, TimeoutError, OSError):
+                    time.sleep(0.5)
+            assert out is not None and np.asarray(out).shape == (2,)
+        finally:
+            serving.terminate()
+            broker.terminate()
+            serving.wait(timeout=10)
+            broker.wait(timeout=10)
+
+
+class TestProfiling:
+    def test_timing_logs(self, caplog):
+        import logging
+        with caplog.at_level(logging.INFO,
+                             logger="analytics_zoo_tpu.profiling"):
+            with timing("stage"):
+                pass
+        assert any("stage time" in r.message for r in caplog.records)
+
+    def test_step_timer_mfu(self):
+        st = StepTimer(flops_per_step=1e9, peak_flops=1e12)
+        for _ in range(3):
+            with st:
+                time.sleep(0.001)
+        s = st.summary(batch_size=8)
+        assert s["steps"] == 3 and s["samples_per_sec"] > 0
+        assert 0 < s["mfu"] < 1
+
+    def test_flops_accounting_matches_bench(self):
+        f = transformer_train_flops(n_params_matmul=86e6, tokens=4096,
+                                    n_layers=12, seq_len=128, hidden=768,
+                                    batch=32)
+        assert f > 2e12
