@@ -114,6 +114,13 @@ def init_zoo_context(config: Optional[ZooConfig] = None,
     """
     config = ZooConfig.from_env(config)  # copies; caller's object untouched
     _configure_logging(config.log_level)
+    # Fast TPU random bits for dropout et al.; see ZooConfig.prng_impl. Any
+    # non-default setting — JAX_DEFAULT_PRNG_IMPL env var or a prior
+    # jax.config.update by the user — wins. (A user who wants jax's own
+    # default, threefry, pins it via ZooConfig.prng_impl.)
+    if ("JAX_DEFAULT_PRNG_IMPL" not in os.environ
+            and jax.config.jax_default_prng_impl == "threefry2x32"):
+        jax.config.update("jax_default_prng_impl", config.prng_impl)
     # Wire config fields into the global context flags (setters validate).
     ZooContext.log_output = config.log_output
     ZooContext.pandas_read_backend = config.pandas_read_backend

@@ -3,7 +3,7 @@
 
     python -m analytics_zoo_tpu.serving.cli start --config config.yaml
     python -m analytics_zoo_tpu.serving.cli broker --port 6380
-    python -m analytics_zoo_tpu.serving.cli metrics --url tcp://host:port
+    python -m analytics_zoo_tpu.serving.cli metrics --url http://host:http_port
 
 `start` runs the serving loop (and HTTP frontend when http_port is set) in
 the foreground; `broker` runs a standalone TCP broker so clients on other
@@ -64,7 +64,12 @@ def cmd_broker(args) -> int:
 
 def cmd_metrics(args) -> int:
     import urllib.request
-    print(urllib.request.urlopen(args.url + "/metrics",
+    url = args.url
+    if not url.startswith(("http://", "https://")):
+        raise SystemExit(
+            f"metrics is served by the HTTP frontend; expected an http(s) "
+            f"URL (host:http_port), got {url!r}")
+    print(urllib.request.urlopen(url.rstrip("/") + "/metrics",
                                  timeout=10).read().decode())
     return 0
 

@@ -97,11 +97,10 @@ class ServingConfig:
 
     def build_model(self):
         """Model resolution (`ClusterServingHelper` model-type dispatch):
-        a ZooModel dir (config.json names the class) or bare weights +
-        model_class."""
+        a ZooModel dir (config.json names the class), or bare weights plus
+        `model: {class: ..., config: {...constructor kwargs...}}`."""
         import json
         from analytics_zoo_tpu.serving.inference_model import InferenceModel
-        from analytics_zoo_tpu import models as zoo_models
         if not self.model_path:
             raise ValueError("config has no model.path")
         im = InferenceModel(concurrent_num=self.concurrent_num)
@@ -111,8 +110,15 @@ class ServingConfig:
                 cls_name = json.load(fh)["class"]
             cls = _find_model_class(cls_name)
             return im.load_zoo_model(cls, self.model_path)
+        if self.model_class:
+            cls = _find_model_class(self.model_class)
+            kwargs = (self.extra.get("model", {}) or {}).get("config") or {}
+            inst = cls(**kwargs)
+            inst.model.load_weights(os.path.join(self.model_path, "weights"))
+            return im.load_keras(inst)
         raise ValueError(
-            f"{self.model_path} is not a saved ZooModel directory")
+            f"{self.model_path} is not a saved ZooModel directory "
+            "(no config.json) and no model.class was given")
 
 
 def _find_model_class(name: str):

@@ -17,6 +17,13 @@ import sys
 import time
 
 import jax
+
+# RngBitGenerator-backed keys: dropout bit generation under the default
+# threefry costs ~25% of the BERT train step on v5e (34.7% -> 44.1% MFU).
+# Matches the framework default (ZooConfig.prng_impl).
+if "JAX_DEFAULT_PRNG_IMPL" not in os.environ:
+    jax.config.update("jax_default_prng_impl", "rbg")
+
 import jax.numpy as jnp
 import numpy as np
 import optax

@@ -7,7 +7,7 @@ recommendation-ncf app, `apps/recommendation-ncf/`, baseline config 1).
 import numpy as np
 
 from analytics_zoo_tpu import init_orca_context
-from analytics_zoo_tpu.models.recommendation import NeuralCF
+from analytics_zoo_tpu.models.recommendation import NeuralCF, UserItemFeature
 
 
 def synthetic_ratings(n=4096, users=200, items=100, seed=0):
@@ -29,7 +29,10 @@ def main():
     print("final loss:", history["loss"][-1])
     metrics = ncf.evaluate(x, y - 1, batch_per_thread=256)
     print("metrics:", metrics)
-    recs = ncf.recommend_for_user(np.unique(x[:, 0])[:3], max_items=4)
+    candidates = [UserItemFeature(int(u), int(i))
+                  for u in np.unique(x[:, 0])[:3]
+                  for i in range(1, 101)]
+    recs = ncf.recommend_for_user(candidates, max_items=4)
     for user, items in list(recs.items())[:3]:
         print(f"user {user}: {items}")
 
