@@ -127,7 +127,9 @@ class ZooConfig:
             cur = getattr(cfg, k)
             if dataclasses.is_dataclass(cur) and isinstance(v, dict):
                 sub_fields = {f.name for f in dataclasses.fields(cur)}
+                legacy = getattr(type(cur), "LEGACY_FIELDS", {})
                 for sk, sv in v.items():
+                    sk = legacy.get(sk, sk)
                     if sk not in sub_fields:
                         raise ValueError(f"Unknown config key: {k}.{sk}")
                     setattr(cur, sk, sv)
@@ -146,6 +148,12 @@ class ZooConfig:
             cur = getattr(cfg, f.name)
             if dataclasses.is_dataclass(cur):
                 sub_hints = typing.get_type_hints(type(cur))
+                legacy = getattr(type(cur), "LEGACY_FIELDS", {})
+                for old, new in legacy.items():
+                    key = f"{cls.ENV_PREFIX}{f.name}_{old}".upper()
+                    if key in os.environ:
+                        setattr(cur, new,
+                                _coerce(os.environ[key], sub_hints[new], key))
                 for sf in dataclasses.fields(cur):
                     key = f"{cls.ENV_PREFIX}{f.name}_{sf.name}".upper()
                     if key in os.environ:

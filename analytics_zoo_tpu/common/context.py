@@ -114,13 +114,6 @@ def init_zoo_context(config: Optional[ZooConfig] = None,
     """
     config = ZooConfig.from_env(config)  # copies; caller's object untouched
     _configure_logging(config.log_level)
-    # Fast TPU random bits for dropout et al.; see ZooConfig.prng_impl. Any
-    # non-default setting — JAX_DEFAULT_PRNG_IMPL env var or a prior
-    # jax.config.update by the user — wins. (A user who wants jax's own
-    # default, threefry, pins it via ZooConfig.prng_impl.)
-    if ("JAX_DEFAULT_PRNG_IMPL" not in os.environ
-            and jax.config.jax_default_prng_impl == "threefry2x32"):
-        jax.config.update("jax_default_prng_impl", config.prng_impl)
     # Wire config fields into the global context flags (setters validate).
     ZooContext.log_output = config.log_output
     ZooContext.pandas_read_backend = config.pandas_read_backend
@@ -146,6 +139,19 @@ def init_zoo_context(config: Optional[ZooConfig] = None,
             _GLOBAL["distributed_initialized"] = True
     elif cluster_mode != "local":
         raise ValueError(f"Unknown cluster_mode: {cluster_mode}")
+
+    # Fast TPU random bits for dropout et al. (rbg keys lower to the
+    # hardware RngBitGenerator; threefry costs ~25% of a BERT train step on
+    # v5e). TPU-only: on CPU/GPU threefry stays, keeping init draws stable.
+    # The JAX_DEFAULT_PRNG_IMPL env var or a prior jax.config.update to a
+    # non-threefry impl wins; to force threefry ON TPU set the env var or
+    # ZooConfig.prng_impl="threefry2x32" (an explicit jax.config.update to
+    # threefry is indistinguishable from the untouched default). Runs after
+    # distributed init because default_backend() touches the XLA backend.
+    if ("JAX_DEFAULT_PRNG_IMPL" not in os.environ
+            and jax.config.jax_default_prng_impl == "threefry2x32"
+            and jax.default_backend() == "tpu"):
+        jax.config.update("jax_default_prng_impl", config.prng_impl)
 
     if mesh_axes:
         valid = set(MeshConfig.__dataclass_fields__)

@@ -1,0 +1,242 @@
+"""GAN training — the TFPark GANEstimator equivalent.
+
+Mirrors `pyzoo/zoo/tfpark/gan/gan_estimator.py:28` (GANEstimator: generator/
+discriminator fns + per-network losses and optimizers) and the alternating
+update schedule of `GanOptimMethod` (`zoo/.../tfpark/GanOptimMethod.scala` /
+`gan/common.py:19`): with `d_steps` and `g_steps`, iteration `i` updates the
+discriminator when `i % (d_steps + g_steps) < d_steps`, else the generator.
+
+TPU-native design: instead of one TF graph with masked joint gradients (the
+reference packs G+D variables into one flat tensor and zeroes the inactive
+half each step), each network keeps its own params/optimizer state and there
+are TWO jit-compiled step programs — `d_step` (grads w.r.t. discriminator
+only, generator under `stop_gradient`) and `g_step` (grads flow through the
+frozen discriminator into the generator). Batches are sharded over the mesh's
+data axis; GSPMD inserts the gradient all-reduce.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.common.context import get_context
+from analytics_zoo_tpu.keras.engine import KerasNet
+from analytics_zoo_tpu.learn import trainer
+from analytics_zoo_tpu.learn.checkpoint import (CheckpointManager,
+                                                latest_checkpoint,
+                                                load_checkpoint)
+
+log = logging.getLogger("analytics_zoo_tpu.gan")
+
+
+# ---------------------------------------------------------------------------
+# Standard GAN losses (tf.contrib.gan loss-fn surface used by the reference's
+# examples: fn(real_logits/fake_logits) -> scalar)
+# ---------------------------------------------------------------------------
+def minimax_generator_loss(fake_logits: jax.Array) -> jax.Array:
+    """Non-saturating generator loss: -log D(G(z))."""
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(
+        fake_logits, jnp.ones_like(fake_logits)))
+
+
+def minimax_discriminator_loss(real_logits: jax.Array,
+                               fake_logits: jax.Array) -> jax.Array:
+    real = optax.sigmoid_binary_cross_entropy(
+        real_logits, jnp.ones_like(real_logits))
+    fake = optax.sigmoid_binary_cross_entropy(
+        fake_logits, jnp.zeros_like(fake_logits))
+    return jnp.mean(real) + jnp.mean(fake)
+
+
+def wasserstein_generator_loss(fake_logits: jax.Array) -> jax.Array:
+    return -jnp.mean(fake_logits)
+
+
+def wasserstein_discriminator_loss(real_logits: jax.Array,
+                                   fake_logits: jax.Array) -> jax.Array:
+    return jnp.mean(fake_logits) - jnp.mean(real_logits)
+
+
+def least_squares_generator_loss(fake_logits: jax.Array) -> jax.Array:
+    return jnp.mean((fake_logits - 1.0) ** 2)
+
+
+def least_squares_discriminator_loss(real_logits: jax.Array,
+                                     fake_logits: jax.Array) -> jax.Array:
+    return jnp.mean((real_logits - 1.0) ** 2) + jnp.mean(fake_logits ** 2)
+
+
+class GANEstimator:
+    """Alternating G/D trainer over a device mesh.
+
+    generator / discriminator: `KerasNet` models (Sequential/Model) or any
+    object with `build(rng, input_shape)` + `apply(params, x, training, rng)`.
+    Loss fns follow the reference's tfgan-style contract:
+    `generator_loss_fn(fake_logits)`, `discriminator_loss_fn(real_logits,
+    fake_logits)`.
+    """
+
+    def __init__(self, generator: KerasNet, discriminator: KerasNet,
+                 generator_loss_fn: Callable = minimax_generator_loss,
+                 discriminator_loss_fn: Callable = minimax_discriminator_loss,
+                 generator_optimizer=None, discriminator_optimizer=None,
+                 generator_steps: int = 1, discriminator_steps: int = 1,
+                 model_dir: Optional[str] = None):
+        self.generator = generator
+        self.discriminator = discriminator
+        self.g_loss_fn = generator_loss_fn
+        self.d_loss_fn = discriminator_loss_fn
+        self.g_opt = generator_optimizer or optax.adam(1e-4, b1=0.5)
+        self.d_opt = discriminator_optimizer or optax.adam(1e-4, b1=0.5)
+        self.g_steps = int(generator_steps)
+        self.d_steps = int(discriminator_steps)
+        if self.g_steps < 1 or self.d_steps < 1:
+            raise ValueError("generator_steps/discriminator_steps must be >=1")
+        self.model_dir = model_dir
+        self._ckpt_mgr: Optional[CheckpointManager] = None
+        self.g_params = None
+        self.d_params = None
+        self._counter = 0
+
+    # -- setup -------------------------------------------------------------
+    def _ensure_built(self, noise_sample, real_sample, rng: jax.Array):
+        if self.g_params is None:
+            kg, kd = jax.random.split(rng)
+            self.generator.ensure_built(noise_sample, kg)
+            self.g_params = self.generator.params
+            self.discriminator.ensure_built(real_sample, kd)
+            self.d_params = self.discriminator.params
+
+    def _build_steps(self):
+        gen, disc = self.generator, self.discriminator
+        g_loss_fn, d_loss_fn = self.g_loss_fn, self.d_loss_fn
+        g_opt, d_opt = self.g_opt, self.d_opt
+
+        def d_step(g_params, d_params, d_opt_state, noise, real, rng):
+            fake = jax.lax.stop_gradient(
+                gen.apply(g_params, noise, training=True, rng=rng))
+
+            def loss(dp):
+                return d_loss_fn(disc.apply(dp, real, training=True, rng=rng),
+                                 disc.apply(dp, fake, training=True, rng=rng))
+
+            l, grads = jax.value_and_grad(loss)(d_params)
+            updates, d_opt_state = d_opt.update(grads, d_opt_state, d_params)
+            return optax.apply_updates(d_params, updates), d_opt_state, l
+
+        def g_step(g_params, g_opt_state, d_params, noise, rng):
+            def loss(gp):
+                fake = gen.apply(gp, noise, training=True, rng=rng)
+                return g_loss_fn(disc.apply(d_params, fake, training=True,
+                                            rng=rng))
+
+            l, grads = jax.value_and_grad(loss)(g_params)
+            updates, g_opt_state = g_opt.update(grads, g_opt_state, g_params)
+            return optax.apply_updates(g_params, updates), g_opt_state, l
+
+        return (jax.jit(d_step, donate_argnums=(1, 2)),
+                jax.jit(g_step, donate_argnums=(0, 1)))
+
+    # -- training ----------------------------------------------------------
+    def train(self, real_data, noise_fn: Callable[[int, int], np.ndarray],
+              batch_size: int = 32, end_iteration: int = 1000,
+              seed: int = 0, checkpoint_every: int = 0
+              ) -> Dict[str, List[float]]:
+        """Run the alternating schedule for `end_iteration` total updates.
+
+        real_data: ndarray (or pytree) of real samples; noise_fn(batch,
+        seed) -> noise batch. `checkpoint_every` > 0 snapshots both nets to
+        `model_dir` every that many iterations.
+        """
+        ctx = get_context()
+        mesh = ctx.mesh
+        dp = mesh.data_parallel_size if mesh else 1
+        trainer.check_global_batch(batch_size, dp)
+
+        rng = jax.random.PRNGKey(seed)
+        rng, init_rng = jax.random.split(rng)
+        noise0 = noise_fn(batch_size, seed)
+        real_iter = trainer.iter_batches(real_data, None, batch_size,
+                                         shuffle=True, seed=seed)
+        real0 = next(iter(trainer.iter_batches(real_data, None, batch_size)))[0]
+        self._ensure_built(noise0, real0, init_rng)
+
+        d_step, g_step = self._build_steps()
+        g_params = trainer._put_replicated(self.g_params, mesh)
+        d_params = trainer._put_replicated(self.d_params, mesh)
+        g_opt_state = trainer._put_replicated(self.g_opt.init(g_params), mesh)
+        d_opt_state = trainer._put_replicated(self.d_opt.init(d_params), mesh)
+
+        history: Dict[str, List[float]] = {"d_loss": [], "g_loss": []}
+        period = self.d_steps + self.g_steps
+        it = 0
+        while it < end_iteration:
+            try:
+                real_b = next(real_iter)[0]
+            except StopIteration:
+                real_iter = trainer.iter_batches(real_data, None, batch_size,
+                                                 shuffle=True, seed=seed + it)
+                real_b = next(real_iter)[0]
+            noise_b = noise_fn(batch_size, seed + 1 + it)
+            real_b = trainer._put_batch(real_b, mesh)
+            noise_b = trainer._put_batch(noise_b, mesh)
+            rng, step_rng = jax.random.split(rng)
+
+            if self._counter % period < self.d_steps:
+                d_params, d_opt_state, l = d_step(
+                    g_params, d_params, d_opt_state, noise_b, real_b, step_rng)
+                history["d_loss"].append(float(l))
+            else:
+                g_params, g_opt_state, l = g_step(
+                    g_params, g_opt_state, d_params, noise_b, step_rng)
+                history["g_loss"].append(float(l))
+            self._counter += 1
+            it += 1
+            if (checkpoint_every and self.model_dir
+                    and it % checkpoint_every == 0):
+                self._snapshot(g_params, d_params, it)
+
+        self.g_params = jax.device_get(g_params)
+        self.d_params = jax.device_get(d_params)
+        self.generator.params = self.g_params
+        self.discriminator.params = self.d_params
+        if self.model_dir:
+            self._snapshot(g_params, d_params, end_iteration)
+        return history
+
+    def _snapshot(self, g_params, d_params, iteration: int):
+        if self._ckpt_mgr is None:
+            self._ckpt_mgr = CheckpointManager(self.model_dir,
+                                               optim_name="gan")
+        self._ckpt_mgr.save(iteration,
+                            {"generator": jax.device_get(g_params),
+                             "discriminator": jax.device_get(d_params)},
+                            extra={"iteration": iteration})
+
+    def restore(self, path: Optional[str] = None,
+                version: Optional[int] = None) -> "GANEstimator":
+        path = path or self.model_dir
+        if path is None or latest_checkpoint(path) is None:
+            raise FileNotFoundError(f"No GAN checkpoint under {path!r}")
+        params, _, _ = load_checkpoint(path, version)
+        # remap saved auto-generated layer names onto this instance's names
+        self.g_params = self.generator._remap_loaded(params["generator"])
+        self.d_params = self.discriminator._remap_loaded(params["discriminator"])
+        self.generator.params = self.g_params
+        self.discriminator.params = self.d_params
+        return self
+
+    # -- inference ---------------------------------------------------------
+    def generate(self, noise: np.ndarray) -> np.ndarray:
+        """Run the trained generator on a batch of noise."""
+        if self.g_params is None:
+            raise RuntimeError("GANEstimator.generate before train/restore")
+        out = self.generator.apply(self.g_params, jnp.asarray(noise),
+                                   training=False)
+        return np.asarray(out)

@@ -6,12 +6,38 @@ queue records into shape-bucketed jit'd forwards on the TPU. The client
 protocol surface (`InputQueue`/`OutputQueue`, `pyzoo/zoo/serving/client.py`)
 is preserved; the transport is a pluggable broker (in-memory, TCP, or Redis
 when available) instead of a hard Redis dependency.
+
+Submodule attributes resolve lazily (PEP 562): `ZooConfig()` holds a
+`ServingConfig` and must not drag the broker/server/HTTP stack into every
+training-only import.
 """
 
-from analytics_zoo_tpu.serving.inference_model import InferenceModel  # noqa: F401
-from analytics_zoo_tpu.serving.broker import (  # noqa: F401
-    MemoryBroker, TCPBroker, TCPBrokerServer, connect_broker)
-from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue  # noqa: F401
-from analytics_zoo_tpu.serving.server import ClusterServing  # noqa: F401
-from analytics_zoo_tpu.serving.timer import Timer  # noqa: F401
-from analytics_zoo_tpu.serving.http_frontend import FrontEnd  # noqa: F401
+_EXPORTS = {
+    "InferenceModel": "analytics_zoo_tpu.serving.inference_model",
+    "MemoryBroker": "analytics_zoo_tpu.serving.broker",
+    "TCPBroker": "analytics_zoo_tpu.serving.broker",
+    "TCPBrokerServer": "analytics_zoo_tpu.serving.broker",
+    "connect_broker": "analytics_zoo_tpu.serving.broker",
+    "InputQueue": "analytics_zoo_tpu.serving.client",
+    "OutputQueue": "analytics_zoo_tpu.serving.client",
+    "ClusterServing": "analytics_zoo_tpu.serving.server",
+    "Timer": "analytics_zoo_tpu.serving.timer",
+    "FrontEnd": "analytics_zoo_tpu.serving.http_frontend",
+    "ServingConfig": "analytics_zoo_tpu.serving.config",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+        mod = importlib.import_module(_EXPORTS[name])
+        value = getattr(mod, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -19,28 +19,34 @@ def _load_yaml(path: str) -> Dict[str, Any]:
         with open(path) as fh:
             return yaml.safe_load(fh) or {}
     except ImportError:
-        # minimal fallback parser: two-level `key:` / `  key: value` yaml,
-        # which is all config.yaml uses
-        out: Dict[str, Any] = {}
-        section: Optional[str] = None
         with open(path) as fh:
-            for raw in fh:
-                line = raw.rstrip()
-                if not line or line.lstrip().startswith("#"):
-                    continue
-                indent = len(line) - len(line.lstrip())
-                key, _, value = line.strip().partition(":")
-                value = value.strip()
-                if indent == 0:
-                    if value:
-                        out[key] = _coerce(value)
-                        section = None
-                    else:
-                        out[key] = {}
-                        section = key
-                elif section is not None:
-                    out[section][key] = _coerce(value)
-        return out
+            return _parse_simple_yaml(fh.read())
+
+
+def _parse_simple_yaml(text: str) -> Dict[str, Any]:
+    """No-PyYAML fallback: nested `key:` maps / `key: value` scalars at any
+    indentation depth (config.yaml uses up to three levels:
+    model: {class, config: {kwargs...}})."""
+    out: Dict[str, Any] = {}
+    # stack of (indent, dict) from root to the innermost open map
+    stack = [(-1, out)]
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line or line.lstrip().startswith("#"):
+            continue
+        indent = len(line) - len(line.lstrip())
+        key, _, value = line.strip().partition(":")
+        value = value.strip()
+        while len(stack) > 1 and indent <= stack[-1][0]:
+            stack.pop()
+        parent = stack[-1][1]
+        if value:
+            parent[key] = _coerce(value)
+        else:
+            child: Dict[str, Any] = {}
+            parent[key] = child
+            stack.append((indent, child))
+    return out
 
 
 def _coerce(v: str):
@@ -72,6 +78,12 @@ class ServingConfig:
     concurrent_num: int = 1
     http_port: Optional[int] = None
     extra: Dict[str, Any] = field(default_factory=dict)
+
+    # pre-consolidation field names (ZooConfig JSON / ZOO_SERVING_* env vars)
+    LEGACY_FIELDS = {"core_number": "batch_size",
+                     "redis_url": "broker_url",
+                     "queue": "stream",
+                     "max_latency_ms": "batch_timeout_ms"}
 
     @classmethod
     def load(cls, path: str) -> "ServingConfig":

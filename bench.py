@@ -20,8 +20,10 @@ import jax
 
 # RngBitGenerator-backed keys: dropout bit generation under the default
 # threefry costs ~25% of the BERT train step on v5e (34.7% -> 44.1% MFU).
-# Matches the framework default (ZooConfig.prng_impl).
-if "JAX_DEFAULT_PRNG_IMPL" not in os.environ:
+# Matches the framework default (init_zoo_context flips to ZooConfig.prng_impl
+# on TPU only; CPU smoke runs keep threefry like the framework does).
+if ("JAX_DEFAULT_PRNG_IMPL" not in os.environ
+        and jax.default_backend() == "tpu"):
     jax.config.update("jax_default_prng_impl", "rbg")
 
 import jax.numpy as jnp

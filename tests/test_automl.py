@@ -122,9 +122,11 @@ class TestModels:
     def test_vanilla_lstm_learns(self):
         from analytics_zoo_tpu.automl.models import build_vanilla_lstm
         x, y = self._xy()
-        m = build_vanilla_lstm({"lstm_1_units": 8, "lstm_2_units": 8},
+        m = build_vanilla_lstm({"lstm_1_units": 8, "lstm_2_units": 8,
+                                "dropout_1": 0.0, "dropout_2": 0.0,
+                                "lr": 3e-3},
                                (6, 3))
-        h = m.fit(x, y, batch_size=32, nb_epoch=8)
+        h = m.fit(x, y, batch_size=32, nb_epoch=25)
         assert h["loss"][-1] < h["loss"][0]
         assert np.asarray(m.predict(x, batch_per_thread=64)).shape == (64, 1)
 

@@ -70,6 +70,25 @@ class TestServingConfig:
         assert cfg.broker_url == "tcp://h:7000"
         assert cfg.batch_size == 32
 
+    def test_fallback_parser_three_level_nesting(self):
+        from analytics_zoo_tpu.serving.config import _parse_simple_yaml
+        parsed = _parse_simple_yaml(
+            "model:\n"
+            "  class: NeuralCF\n"
+            "  config:\n"
+            "    user_count: 200\n"
+            "    item_count: 100\n"
+            "  path: /m\n"
+            "params:\n"
+            "  core_number: 4\n"
+            "top: 1\n")
+        assert parsed == {
+            "model": {"class": "NeuralCF",
+                      "config": {"user_count": 200, "item_count": 100},
+                      "path": "/m"},
+            "params": {"core_number": 4},
+            "top": 1}
+
     def test_build_model_from_zoo_dir(self, tmp_path):
         from analytics_zoo_tpu.models.textclassification import TextClassifier
         m = TextClassifier(class_num=2, vocab_size=30, embedding_dim=8,

@@ -40,6 +40,37 @@ class TestConfig:
         assert cfg.seed == 42
         assert cfg.log_level == "DEBUG"
 
+    def test_legacy_serving_fields(self, monkeypatch):
+        # pre-consolidation names keep working: env vars ...
+        monkeypatch.setenv("ZOO_SERVING_CORE_NUMBER", "16")
+        monkeypatch.setenv("ZOO_SERVING_REDIS_URL", "redis://h:1")
+        monkeypatch.setenv("ZOO_SERVING_QUEUE", "q1")
+        monkeypatch.setenv("ZOO_SERVING_MAX_LATENCY_MS", "9")
+        cfg = ZooConfig.from_env()
+        assert cfg.serving.batch_size == 16
+        assert cfg.serving.broker_url == "redis://h:1"
+        assert cfg.serving.stream == "q1"
+        assert cfg.serving.batch_timeout_ms == 9
+        # ... and saved-JSON keys from the previous schema
+        cfg2 = ZooConfig.from_dict(
+            {"serving": {"core_number": 8, "queue": "q2"}})
+        assert cfg2.serving.batch_size == 8
+        assert cfg2.serving.stream == "q2"
+
+    def test_training_import_does_not_load_serving_stack(self):
+        import subprocess
+        import sys
+        code = (
+            "import sys\n"
+            "from analytics_zoo_tpu.common.config import ZooConfig\n"
+            "ZooConfig()\n"
+            "loaded = [m for m in sys.modules if 'serving' in m]\n"
+            "assert 'analytics_zoo_tpu.serving.broker' not in loaded, loaded\n"
+            "assert 'analytics_zoo_tpu.serving.server' not in loaded, loaded\n"
+        )
+        subprocess.run([sys.executable, "-c", code], check=True,
+                       cwd=os.path.dirname(os.path.dirname(__file__)))
+
 
 class TestMesh:
     def test_all_data_parallel(self, devices8):
