@@ -890,3 +890,9 @@ class TimeDistributed(Layer):
         inner_shape = (input_shape[0],) + tuple(input_shape[2:])
         inner_out = self.layer.compute_output_shape(inner_shape)
         return (input_shape[0], input_shape[1]) + tuple(inner_out[1:])
+
+
+# Extended Keras1-parity set (advanced activations, noise, conv variants,
+# ConvLSTM, LRN, torch-style elementwise, ...) lives in layers_ext but is
+# part of this namespace — the reference exposes one flat layer namespace.
+from analytics_zoo_tpu.keras.layers_ext import *  # noqa: E402,F401,F403

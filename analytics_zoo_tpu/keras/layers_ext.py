@@ -1,0 +1,1030 @@
+"""Extended Keras1-parity layer set.
+
+Completes the reference's layer inventory
+(`zoo/.../pipeline/api/keras/layers/*.scala`, python mirror
+`pyzoo/zoo/pipeline/api/keras/layers/`): advanced activations
+(`Advanced_Activations.scala`-family: LeakyReLU/ELU/PReLU/SReLU/
+ThresholdedReLU), noise & structured dropout (`GaussianNoise.scala`,
+`GaussianDropout.scala`, `SpatialDropout*.scala`, `Masking.scala`), dense
+variants (`Highway.scala`, `MaxoutDense.scala`), the remaining convolution
+family (`SeparableConvolution2D.scala`, `Deconvolution2D.scala`,
+`AtrousConvolution1D/2D.scala`, `LocallyConnected1D/2D.scala`,
+`Cropping1D/2D/3D.scala`, `ZeroPadding1D/3D.scala`, `UpSampling1D/3D.scala`,
+`MaxPooling3D/AveragePooling3D.scala`, global 3D pools), `ConvLSTM2D.scala`/
+`ConvLSTM3D.scala`, `LRN2D.scala`/`WithinChannelLRN2D.scala`,
+`ResizeBilinear.scala`, `GaussianSampler.scala` (VAE app), and the
+torch-style elementwise layers of `pyzoo/.../keras/layers/torch.py` (Scale,
+CAdd, CMul, AddConstant, MulConstant, Abs, Clamp/HardTanh, Exp, Log, Power,
+Square, Sqrt, Negative, Identity, HardShrink, SoftShrink, Threshold).
+
+All layers follow the same stateless contract as
+`analytics_zoo_tpu.keras.layers`: `build` → param pytree, `call` →
+jax-traceable fn; channels_last is native with `dim_ordering="th"` accepted
+and transposed on the fly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras.engine import Layer
+from analytics_zoo_tpu.keras.layers import (
+    _ConvND, _GlobalPool, _PoolND, _Recurrent, _from_channels_last,
+    _to_channels_last, get_activation, get_init)
+
+__all__ = [
+    "LeakyReLU", "ELU", "PReLU", "SReLU", "ThresholdedReLU",
+    "GaussianNoise", "GaussianDropout", "SpatialDropout1D", "SpatialDropout2D",
+    "SpatialDropout3D", "Masking",
+    "Highway", "MaxoutDense",
+    "SeparableConvolution2D", "SeparableConv2D", "Deconvolution2D",
+    "Conv2DTranspose", "AtrousConvolution1D", "AtrousConvolution2D",
+    "LocallyConnected1D", "LocallyConnected2D",
+    "Cropping1D", "Cropping2D", "Cropping3D",
+    "ZeroPadding1D", "ZeroPadding3D", "UpSampling1D", "UpSampling3D",
+    "MaxPooling3D", "AveragePooling3D", "GlobalMaxPooling3D",
+    "GlobalAveragePooling3D",
+    "ConvLSTM2D", "ConvLSTM3D",
+    "LRN2D", "WithinChannelLRN2D", "ResizeBilinear", "GaussianSampler",
+    "Scale", "CAdd", "CMul", "AddConstant", "MulConstant", "Abs", "Clamp",
+    "HardTanh", "Exp", "Log", "Power", "Square", "Sqrt", "Negative",
+    "Identity", "HardShrink", "SoftShrink", "Threshold",
+]
+
+
+# ---------------------------------------------------------------------------
+# Advanced activations
+# ---------------------------------------------------------------------------
+class LeakyReLU(Layer):
+    """`keras/layers/advanced_activations` LeakyReLU(alpha)."""
+
+    def __init__(self, alpha: float = 0.3, **kw):
+        super().__init__(**kw)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.nn.leaky_relu(x, self.alpha)
+
+
+class ELU(Layer):
+    def __init__(self, alpha: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.nn.elu(x, self.alpha)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, theta: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.theta = float(theta)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * (x > self.theta).astype(x.dtype)
+
+
+class PReLU(Layer):
+    """Learnable per-element leaky slope (Keras1 default: alphas have the
+    full non-batch input shape)."""
+
+    def build(self, rng, input_shape):
+        return {"alpha": jnp.zeros(tuple(input_shape[1:]), jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        a = params["alpha"]
+        return jnp.maximum(x, 0.0) + a * jnp.minimum(x, 0.0)
+
+
+class SReLU(Layer):
+    """S-shaped ReLU (`SReLU.scala`): two learnable thresholds + slopes."""
+
+    def build(self, rng, input_shape):
+        shape = tuple(input_shape[1:])
+        return {"t_left": jnp.zeros(shape, jnp.float32),
+                "a_left": jnp.zeros(shape, jnp.float32),
+                "t_right": jnp.ones(shape, jnp.float32),
+                "a_right": jnp.ones(shape, jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y_left = tl + al * (x - tl)
+        y_right = tr + ar * (x - tr)
+        return jnp.where(x < tl, y_left, jnp.where(x > tr, y_right, x))
+
+
+# ---------------------------------------------------------------------------
+# Noise / structured dropout / masking
+# ---------------------------------------------------------------------------
+class GaussianNoise(Layer):
+    def __init__(self, sigma: float, **kw):
+        super().__init__(**kw)
+        self.sigma = float(sigma)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or self.sigma <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(f"{self.name}: needs an rng in training")
+        return x + self.sigma * jax.random.normal(rng, jnp.shape(x), x.dtype)
+
+
+class GaussianDropout(Layer):
+    """Multiplicative 1-mean gaussian noise with std sqrt(p/(1-p))."""
+
+    def __init__(self, p: float, **kw):
+        super().__init__(**kw)
+        self.rate = float(p)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or self.rate <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(f"{self.name}: needs an rng in training")
+        std = float(np.sqrt(self.rate / (1.0 - self.rate)))
+        return x * (1.0 + std * jax.random.normal(rng, jnp.shape(x), x.dtype))
+
+
+class _SpatialDropout(Layer):
+    """Drops whole feature maps; mask broadcasts over spatial axes."""
+    spatial_rank = 2
+
+    def __init__(self, p: float = 0.5, dim_ordering: str = "tf", **kw):
+        super().__init__(**kw)
+        self.rate = float(p)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not training or self.rate <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(f"{self.name}: needs an rng in training")
+        keep = 1.0 - self.rate
+        shape = list(jnp.shape(x))
+        if self.dim_ordering == "tf":
+            for ax in range(1, 1 + self.spatial_rank):
+                shape[ax] = 1
+        else:
+            for ax in range(2, 2 + self.spatial_rank):
+                shape[ax] = 1
+        mask = jax.random.bernoulli(rng, keep, tuple(shape))
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class SpatialDropout1D(_SpatialDropout):
+    spatial_rank = 1
+
+
+class SpatialDropout2D(_SpatialDropout):
+    spatial_rank = 2
+
+
+class SpatialDropout3D(_SpatialDropout):
+    spatial_rank = 3
+
+
+class Masking(Layer):
+    """`Masking.scala`: zero timesteps whose features all equal
+    mask_value."""
+
+    def __init__(self, mask_value: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.mask_value = float(mask_value)
+
+    def call(self, params, x, *, training=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return x * keep.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dense variants
+# ---------------------------------------------------------------------------
+class Highway(Layer):
+    """`Highway.scala`: y = t·h(x) + (1−t)·x; requires out_dim == in_dim."""
+
+    def __init__(self, activation="tanh", use_bias: bool = True,
+                 init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.activation = get_activation(activation)
+        self.use_bias = use_bias
+        self.init = get_init(init)
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        p = {"kernel": self.init(k1, (d, d), jnp.float32),
+             "transform_kernel": self.init(k2, (d, d), jnp.float32)}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((d,), jnp.float32)
+            # negative transform bias ≈ carry-by-default (highway paper)
+            p["transform_bias"] = jnp.full((d,), -2.0, jnp.float32)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        h = x @ params["kernel"]
+        t = x @ params["transform_kernel"]
+        if self.use_bias:
+            h = h + params["bias"]
+            t = t + params["transform_bias"]
+        h = self.activation(h)
+        t = jax.nn.sigmoid(t)
+        return t * h + (1.0 - t) * x
+
+
+class MaxoutDense(Layer):
+    """`MaxoutDense.scala`: max over nb_feature affine maps."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 use_bias: bool = True, init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+        self.use_bias = use_bias
+        self.init = get_init(init)
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        p = {"kernel": self.init(
+            rng, (self.nb_feature, d, self.output_dim), jnp.float32)}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.nb_feature, self.output_dim),
+                                  jnp.float32)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        y = jnp.einsum("bd,fdo->bfo", x, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        return jnp.max(y, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.output_dim)
+
+
+# ---------------------------------------------------------------------------
+# Convolution family
+# ---------------------------------------------------------------------------
+class SeparableConvolution2D(Layer):
+    """`SeparableConvolution2D.scala`: depthwise (feature_group_count) then
+    1×1 pointwise — both MXU-tileable convs."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), border_mode="valid",
+                 depth_multiplier: int = 1, dim_ordering="tf",
+                 use_bias: bool = True, init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.activation = get_activation(activation)
+        self.strides = tuple(subsample)
+        self.padding = border_mode.upper()
+        self.depth_multiplier = depth_multiplier
+        self.dim_ordering = dim_ordering
+        self.use_bias = use_bias
+        self.init = get_init(init)
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[1] if self.dim_ordering == "th" \
+            else input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        p = {
+            "depthwise": self.init(
+                k1, self.kernel_size + (1, in_ch * self.depth_multiplier),
+                jnp.float32),
+            "pointwise": self.init(
+                k2, (1, 1, in_ch * self.depth_multiplier, self.nb_filter),
+                jnp.float32),
+        }
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, 2)
+        in_ch = x.shape[-1]
+        y = jax.lax.conv_general_dilated(
+            x, params["depthwise"], window_strides=self.strides,
+            padding=self.padding, feature_group_count=in_ch,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jax.lax.conv_general_dilated(
+            y, params["pointwise"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"]
+        y = self.activation(y)
+        return _from_channels_last(y, self.dim_ordering, 2)
+
+    def _out(self, size, k, s):
+        if size is None:
+            return None
+        return -(-size // s) if self.padding == "SAME" \
+            else (size - k) // s + 1
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            h, w = input_shape[2:4]
+            return (input_shape[0], self.nb_filter,
+                    self._out(h, self.kernel_size[0], self.strides[0]),
+                    self._out(w, self.kernel_size[1], self.strides[1]))
+        h, w = input_shape[1:3]
+        return (input_shape[0],
+                self._out(h, self.kernel_size[0], self.strides[0]),
+                self._out(w, self.kernel_size[1], self.strides[1]),
+                self.nb_filter)
+
+
+SeparableConv2D = SeparableConvolution2D
+
+
+class Deconvolution2D(Layer):
+    """`Deconvolution2D.scala` (transposed conv / Conv2DTranspose)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), border_mode="valid",
+                 dim_ordering="tf", use_bias: bool = True,
+                 init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.activation = get_activation(activation)
+        self.strides = tuple(subsample)
+        self.padding = border_mode.upper()
+        self.dim_ordering = dim_ordering
+        self.use_bias = use_bias
+        self.init = get_init(init)
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[1] if self.dim_ordering == "th" \
+            else input_shape[-1]
+        p = {"kernel": self.init(
+            rng, self.kernel_size + (in_ch, self.nb_filter), jnp.float32)}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.nb_filter,), jnp.float32)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, 2)
+        # Scatter (gradient-of-conv) semantics — matches Keras/BigDL. jax's
+        # conv_transpose correlates, so flip the spatial dims.
+        y = jax.lax.conv_transpose(
+            x, jnp.flip(params["kernel"], (0, 1)), strides=self.strides,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"]
+        y = self.activation(y)
+        return _from_channels_last(y, self.dim_ordering, 2)
+
+    def _out(self, size, k, s):
+        if size is None:
+            return None
+        return size * s if self.padding == "SAME" else (size - 1) * s + k
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            h, w = input_shape[2:4]
+            return (input_shape[0], self.nb_filter,
+                    self._out(h, self.kernel_size[0], self.strides[0]),
+                    self._out(w, self.kernel_size[1], self.strides[1]))
+        h, w = input_shape[1:3]
+        return (input_shape[0],
+                self._out(h, self.kernel_size[0], self.strides[0]),
+                self._out(w, self.kernel_size[1], self.strides[1]),
+                self.nb_filter)
+
+
+Conv2DTranspose = Deconvolution2D
+
+
+class AtrousConvolution2D(_ConvND):
+    """`AtrousConvolution2D.scala`: dilated conv."""
+
+    def __init__(self, nb_filter, nb_row, nb_col, atrous_rate=(1, 1), **kw):
+        super().__init__(nb_filter, (nb_row, nb_col), **kw)
+        self.atrous_rate = tuple(atrous_rate)
+
+    def call(self, params, x, *, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, self.spatial_rank)
+        y = jax.lax.conv_general_dilated(
+            x, params["kernel"], window_strides=self.strides,
+            padding=self.padding, rhs_dilation=self.atrous_rate,
+            dimension_numbers=self.dn)
+        if self.use_bias:
+            y = y + params["bias"]
+        y = self.activation(y)
+        return _from_channels_last(y, self.dim_ordering, self.spatial_rank)
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            spatial = input_shape[2:]
+        else:
+            spatial = input_shape[1:-1]
+        out = []
+        for d, k, s, r in zip(spatial, self.kernel_size, self.strides,
+                              self.atrous_rate):
+            if d is None:
+                out.append(None)
+            elif self.padding == "SAME":
+                out.append(-(-d // s))
+            else:
+                eff = (k - 1) * r + 1
+                out.append((d - eff) // s + 1)
+        if self.dim_ordering == "th":
+            return (input_shape[0], self.nb_filter) + tuple(out)
+        return (input_shape[0],) + tuple(out) + (self.nb_filter,)
+
+
+class AtrousConvolution1D(AtrousConvolution2D):
+    spatial_rank = 1
+    dn = ("NWC", "WIO", "NWC")
+
+    def __init__(self, nb_filter, filter_length, atrous_rate: int = 1, **kw):
+        _ConvND.__init__(self, nb_filter, (filter_length,), **kw)
+        self.atrous_rate = (atrous_rate,)
+
+
+class LocallyConnected1D(Layer):
+    """`LocallyConnected1D.scala`: unshared conv — per-position kernels.
+    Implemented as patch extraction + batched einsum (one big contraction,
+    not a python loop over positions)."""
+
+    spatial_rank = 1
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 subsample_length: int = 1, use_bias: bool = True,
+                 init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.kernel_size = (filter_length,)
+        self.strides = (subsample_length,)
+        self.activation = get_activation(activation)
+        self.use_bias = use_bias
+        self.init = get_init(init)
+
+    def _out_len(self, size):
+        return (size - self.kernel_size[0]) // self.strides[0] + 1
+
+    def build(self, rng, input_shape):
+        in_ch = input_shape[-1]
+        out_len = self._out_len(input_shape[1])
+        p = {"kernel": self.init(
+            rng, (out_len, self.kernel_size[0] * in_ch, self.nb_filter),
+            jnp.float32)}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((out_len, self.nb_filter), jnp.float32)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        # [B, L, C] → patches [B, out_len, k*C]
+        k = self.kernel_size[0]
+        s = self.strides[0]
+        out_len = self._out_len(x.shape[1])
+        idx = jnp.arange(out_len)[:, None] * s + jnp.arange(k)[None, :]
+        patches = x[:, idx, :]                      # [B, out_len, k, C]
+        patches = patches.reshape(x.shape[0], out_len, -1)
+        y = jnp.einsum("bok,okf->bof", patches, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        return self.activation(y)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self._out_len(input_shape[1]),
+                self.nb_filter)
+
+
+class LocallyConnected2D(Layer):
+    """`LocallyConnected2D.scala`."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), use_bias: bool = True,
+                 dim_ordering="tf", init="glorot_uniform", **kw):
+        super().__init__(**kw)
+        self.nb_filter = nb_filter
+        self.kernel_size = (nb_row, nb_col)
+        self.strides = tuple(subsample)
+        self.activation = get_activation(activation)
+        self.use_bias = use_bias
+        self.dim_ordering = dim_ordering
+        self.init = get_init(init)
+
+    def _out(self, size, k, s):
+        return (size - k) // s + 1
+
+    def build(self, rng, input_shape):
+        if self.dim_ordering == "th":
+            in_ch, h, w = input_shape[1], input_shape[2], input_shape[3]
+        else:
+            h, w, in_ch = input_shape[1], input_shape[2], input_shape[3]
+        oh = self._out(h, self.kernel_size[0], self.strides[0])
+        ow = self._out(w, self.kernel_size[1], self.strides[1])
+        kdim = self.kernel_size[0] * self.kernel_size[1] * in_ch
+        p = {"kernel": self.init(
+            rng, (oh * ow, kdim, self.nb_filter), jnp.float32)}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((oh, ow, self.nb_filter), jnp.float32)
+        return p
+
+    def call(self, params, x, *, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, 2)
+        b, h, w, c = x.shape
+        kh, kw = self.kernel_size
+        sh, sw = self.strides
+        oh = self._out(h, kh, sh)
+        ow = self._out(w, kw, sw)
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (kh, kw), (sh, sw), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # patches: [B, oh, ow, C*kh*kw] with channel-major ordering →
+        # reorder to kh*kw*C to match kernel layout
+        patches = patches.reshape(b, oh, ow, c, kh, kw)
+        patches = jnp.transpose(patches, (0, 1, 2, 4, 5, 3))
+        patches = patches.reshape(b, oh * ow, kh * kw * c)
+        y = jnp.einsum("bok,okf->bof", patches, params["kernel"])
+        y = y.reshape(b, oh, ow, self.nb_filter)
+        if self.use_bias:
+            y = y + params["bias"]
+        y = self.activation(y)
+        return _from_channels_last(y, self.dim_ordering, 2)
+
+    def compute_output_shape(self, input_shape):
+        if self.dim_ordering == "th":
+            h, w = input_shape[2], input_shape[3]
+            return (input_shape[0], self.nb_filter,
+                    self._out(h, self.kernel_size[0], self.strides[0]),
+                    self._out(w, self.kernel_size[1], self.strides[1]))
+        h, w = input_shape[1], input_shape[2]
+        return (input_shape[0],
+                self._out(h, self.kernel_size[0], self.strides[0]),
+                self._out(w, self.kernel_size[1], self.strides[1]),
+                self.nb_filter)
+
+
+# ---------------------------------------------------------------------------
+# Cropping / padding / upsampling
+# ---------------------------------------------------------------------------
+class Cropping1D(Layer):
+    def __init__(self, cropping=(1, 1), **kw):
+        super().__init__(**kw)
+        self.cropping = tuple(cropping)
+
+    def call(self, params, x, *, training=False, rng=None):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b, :]
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        s[1] -= sum(self.cropping)
+        return tuple(s)
+
+
+class _CroppingND(Layer):
+    spatial_rank = 2
+
+    def __init__(self, cropping=None, dim_ordering="tf", **kw):
+        super().__init__(**kw)
+        self.cropping = tuple(tuple(c) for c in (
+            cropping or ((1, 1),) * self.spatial_rank))
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, self.spatial_rank)
+        idx = [slice(None)]
+        for ax, (a, b) in enumerate(self.cropping):
+            idx.append(slice(a, x.shape[1 + ax] - b))
+        idx.append(slice(None))
+        y = x[tuple(idx)]
+        return _from_channels_last(y, self.dim_ordering, self.spatial_rank)
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        off = 2 if self.dim_ordering == "th" else 1
+        for ax, (a, b) in enumerate(self.cropping):
+            s[off + ax] -= a + b
+        return tuple(s)
+
+
+class Cropping2D(_CroppingND):
+    spatial_rank = 2
+
+
+class Cropping3D(_CroppingND):
+    spatial_rank = 3
+
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), **kw):
+        super().__init__(cropping, **kw)
+
+
+class ZeroPadding1D(Layer):
+    def __init__(self, padding=1, **kw):
+        super().__init__(**kw)
+        self.padding = (padding, padding) if isinstance(padding, int) \
+            else tuple(padding)
+
+    def call(self, params, x, *, training=False, rng=None):
+        a, b = self.padding
+        return jnp.pad(x, ((0, 0), (a, b), (0, 0)))
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        s[1] += sum(self.padding)
+        return tuple(s)
+
+
+class ZeroPadding3D(Layer):
+    def __init__(self, padding=(1, 1, 1), dim_ordering="tf", **kw):
+        super().__init__(**kw)
+        self.padding = tuple(padding)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        p1, p2, p3 = self.padding
+        if self.dim_ordering == "tf":
+            return jnp.pad(x, ((0, 0), (p1, p1), (p2, p2), (p3, p3), (0, 0)))
+        return jnp.pad(x, ((0, 0), (0, 0), (p1, p1), (p2, p2), (p3, p3)))
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        off = 2 if self.dim_ordering == "th" else 1
+        for i, p in enumerate(self.padding):
+            s[off + i] += 2 * p
+        return tuple(s)
+
+
+class UpSampling1D(Layer):
+    def __init__(self, length: int = 2, **kw):
+        super().__init__(**kw)
+        self.length = length
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.repeat(x, self.length, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        s[1] *= self.length
+        return tuple(s)
+
+
+class UpSampling3D(Layer):
+    def __init__(self, size=(2, 2, 2), dim_ordering="tf", **kw):
+        super().__init__(**kw)
+        self.size = tuple(size)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        off = 2 if self.dim_ordering == "th" else 1
+        y = x
+        for i, s in enumerate(self.size):
+            y = jnp.repeat(y, s, axis=off + i)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        off = 2 if self.dim_ordering == "th" else 1
+        for i, f in enumerate(self.size):
+            s[off + i] *= f
+        return tuple(s)
+
+
+class MaxPooling3D(_PoolND):
+    spatial_rank = 3
+
+
+class AveragePooling3D(_PoolND):
+    spatial_rank = 3
+    reducer = "avg"
+
+
+class GlobalMaxPooling3D(_GlobalPool):
+    spatial_axes = (1, 2, 3)
+
+
+class GlobalAveragePooling3D(_GlobalPool):
+    spatial_axes = (1, 2, 3)
+    reducer = "avg"
+
+
+# ---------------------------------------------------------------------------
+# ConvLSTM
+# ---------------------------------------------------------------------------
+class ConvLSTM2D(_Recurrent):
+    """`ConvLSTM2D.scala`: LSTM whose gates are N-D convs. Input
+    [B, T, *spatial, C] (channels_last). Gates computed in one fused conv
+    (4·filters output channels) per step under `lax.scan`. border_mode is
+    forced "same" so the state keeps its spatial shape (reference
+    behavior). `ConvLSTM3D.scala` is the spatial_rank=3 subclass."""
+
+    n_gates = 4
+    spatial_rank = 2
+    dn = ("NHWC", "HWIO", "NHWC")
+
+    def __init__(self, nb_filter: int, nb_kernel: int, activation="tanh",
+                 inner_activation="hard_sigmoid", return_sequences=False,
+                 go_backwards=False, border_mode="same", subsample=None,
+                 init="glorot_uniform", inner_init="orthogonal", **kw):
+        super().__init__(nb_filter, activation=activation,
+                         inner_activation=inner_activation,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards, init=init,
+                         inner_init=inner_init, **kw)
+        if border_mode != "same":
+            raise ValueError(
+                f"{type(self).__name__} supports border_mode='same' only")
+        self.kernel_size = (nb_kernel,) * self.spatial_rank \
+            if isinstance(nb_kernel, int) else tuple(nb_kernel)
+        self.strides = tuple(subsample or (1,) * self.spatial_rank)
+        self._state_spatial: Optional[Tuple[int, ...]] = None
+
+    def _out_spatial(self, spatial):
+        return tuple(-(-d // s) for d, s in zip(spatial, self.strides))
+
+    def build(self, rng, input_shape):
+        # input_shape: [B, T, *spatial, C]
+        spatial = input_shape[2:2 + self.spatial_rank]
+        in_ch = input_shape[-1]
+        self._state_spatial = self._out_spatial(spatial)
+        k1, k2 = jax.random.split(rng)
+        return {
+            "kernel": self.init(
+                k1, self.kernel_size + (in_ch, 4 * self.output_dim),
+                jnp.float32),
+            "recurrent": self.inner_init(
+                k2, self.kernel_size + (self.output_dim,
+                                        4 * self.output_dim), jnp.float32),
+            "bias": jnp.zeros((4 * self.output_dim,), jnp.float32),
+        }
+
+    def initial_state(self, batch):
+        z = jnp.zeros((batch,) + self._state_spatial + (self.output_dim,),
+                      jnp.float32)
+        return (z, z)
+
+    def step(self, params, carry, x_t):
+        h, c = carry
+        zx = jax.lax.conv_general_dilated(
+            x_t, params["kernel"], window_strides=self.strides,
+            padding="SAME", dimension_numbers=self.dn)
+        zh = jax.lax.conv_general_dilated(
+            h, params["recurrent"],
+            window_strides=(1,) * self.spatial_rank, padding="SAME",
+            dimension_numbers=self.dn)
+        z = zx + zh + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = self.inner_activation(i)
+        f = self.inner_activation(f)
+        o = self.inner_activation(o)
+        g = self.activation(g)
+        c_new = f * c + i * g
+        h_new = o * self.activation(c_new)
+        return (h_new, c_new), h_new
+
+    def call(self, params, x, *, training=False, rng=None):
+        if self._state_spatial is None:
+            self._state_spatial = self._out_spatial(
+                x.shape[2:2 + self.spatial_rank])
+        return super().call(params, x, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        b, t = input_shape[:2]
+        out = self._out_spatial(input_shape[2:2 + self.spatial_rank])
+        if self.return_sequences:
+            return (b, t) + out + (self.output_dim,)
+        return (b,) + out + (self.output_dim,)
+
+
+class ConvLSTM3D(ConvLSTM2D):
+    """`ConvLSTM3D.scala`: volumetric ConvLSTM, input [B, T, D, H, W, C]."""
+
+    spatial_rank = 3
+    dn = ("NDHWC", "DHWIO", "NDHWC")
+
+
+# ---------------------------------------------------------------------------
+# Normalization / resize / sampling
+# ---------------------------------------------------------------------------
+class LRN2D(Layer):
+    """`LRN2D.scala`: cross-channel local response normalization
+    (AlexNet/GoogLeNet): x / (k + alpha/n · Σ x²)^beta over a channel
+    window."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0, beta: float = 0.75,
+                 n: int = 5, dim_ordering: str = "tf", **kw):
+        super().__init__(**kw)
+        self.alpha, self.k, self.beta, self.n = alpha, k, beta, n
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, *, training=False, rng=None):
+        x = _to_channels_last(x, self.dim_ordering, 2)
+        half = self.n // 2
+        sq = jnp.square(x)
+        window = (1, 1, 1, self.n)
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, window, (1, 1, 1, 1),
+            [(0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)])
+        y = x / jnp.power(self.k + (self.alpha / self.n) * summed, self.beta)
+        return _from_channels_last(y, self.dim_ordering, 2)
+
+
+class WithinChannelLRN2D(Layer):
+    """`WithinChannelLRN2D.scala`: LRN over a spatial window within each
+    channel."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, **kw):
+        super().__init__(**kw)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def call(self, params, x, *, training=False, rng=None):
+        n = self.size
+        half = n // 2
+        pad = [(0, 0), (half, n - 1 - half), (half, n - 1 - half), (0, 0)]
+        sq = jnp.square(x)
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, (1, n, n, 1), (1, 1, 1, 1), pad)
+        mean_sq = summed / float(n * n)
+        return x / jnp.power(1.0 + self.alpha * mean_sq, self.beta)
+
+
+class ResizeBilinear(Layer):
+    """`ResizeBilinear.scala`: bilinear spatial resize (NHWC)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, **kw):
+        super().__init__(**kw)
+        self.out_hw = (output_height, output_width)
+        self.align_corners = align_corners
+
+    def call(self, params, x, *, training=False, rng=None):
+        b, _, _, c = x.shape
+        return jax.image.resize(x, (b,) + self.out_hw + (c,), "bilinear")
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self.out_hw + (input_shape[-1],)
+
+
+class GaussianSampler(Layer):
+    """`GaussianSampler.scala` (VAE reparameterization): input
+    [mean, log_var] → mean + exp(log_var/2)·ε."""
+
+    def call(self, params, xs, *, training=False, rng=None):
+        mean, log_var = xs
+        if rng is None:
+            return mean
+        eps = jax.random.normal(rng, jnp.shape(mean), mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps
+
+    def compute_output_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+# ---------------------------------------------------------------------------
+# Torch-style elementwise layers (`pyzoo/.../keras/layers/torch.py`)
+# ---------------------------------------------------------------------------
+class Scale(Layer):
+    """Learnable per-channel affine y = a·x + b (`Scale` in torch.py)."""
+
+    def build(self, rng, input_shape):
+        d = input_shape[-1]
+        return {"alpha": jnp.ones((d,), jnp.float32),
+                "beta": jnp.zeros((d,), jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["alpha"] + params["beta"]
+
+
+class CAdd(Layer):
+    """Learnable bias of arbitrary broadcastable shape."""
+
+    def __init__(self, size: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.size = tuple(size)
+
+    def build(self, rng, input_shape):
+        return {"bias": jnp.zeros(self.size, jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x + params["bias"]
+
+
+class CMul(Layer):
+    def __init__(self, size: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.size = tuple(size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size, jnp.float32)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["weight"]
+
+
+class _Elementwise(Layer):
+    fn = staticmethod(lambda x: x)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return type(self).fn(x)
+
+
+class AddConstant(Layer):
+    def __init__(self, constant_scalar: float, **kw):
+        super().__init__(**kw)
+        self.c = constant_scalar
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x + self.c
+
+
+class MulConstant(Layer):
+    def __init__(self, constant_scalar: float, **kw):
+        super().__init__(**kw)
+        self.c = constant_scalar
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * self.c
+
+
+class Abs(_Elementwise):
+    fn = staticmethod(jnp.abs)
+
+
+class Exp(_Elementwise):
+    fn = staticmethod(jnp.exp)
+
+
+class Log(_Elementwise):
+    fn = staticmethod(jnp.log)
+
+
+class Square(_Elementwise):
+    fn = staticmethod(jnp.square)
+
+
+class Sqrt(_Elementwise):
+    fn = staticmethod(jnp.sqrt)
+
+
+class Negative(_Elementwise):
+    fn = staticmethod(jnp.negative)
+
+
+class Identity(_Elementwise):
+    pass
+
+
+class Power(Layer):
+    """y = (scale·x + shift)^power."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 **kw):
+        super().__init__(**kw)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.power(self.scale * x + self.shift, self.power)
+
+
+class Clamp(Layer):
+    def __init__(self, min: float, max: float, **kw):
+        super().__init__(**kw)
+        self.min_v, self.max_v = float(min), float(max)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.clip(x, self.min_v, self.max_v)
+
+
+class HardTanh(Clamp):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0, **kw):
+        super().__init__(min_value, max_value, **kw)
+
+
+class HardShrink(Layer):
+    def __init__(self, value: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.value = value
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0)
+
+
+class SoftShrink(Layer):
+    def __init__(self, value: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.value = value
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.value, 0.0)
+
+
+class Threshold(Layer):
+    """y = x if x > th else v."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, **kw):
+        super().__init__(**kw)
+        self.th, self.v = th, v
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x > self.th, x, self.v)

@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.keras2 import layers  # noqa: F401

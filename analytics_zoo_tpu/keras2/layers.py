@@ -1,0 +1,223 @@
+"""Keras2-flavoured layer API (`zoo/.../pipeline/api/keras2/layers/`).
+
+The reference carries a second, keras-2.x-style parameter surface for a
+subset of layers (Dense/Conv/pooling/merge) alongside the Keras1 set. Here
+they are thin adapters over the same jax implementations in
+`analytics_zoo_tpu.keras.layers` — argument names translated
+(units/filters/kernel_size/strides/padding/kernel_initializer/data_format),
+merge modes exposed as classes (Add/Multiply/.../Concatenate/Dot).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from analytics_zoo_tpu.keras import layers as k1
+from analytics_zoo_tpu.keras.engine import Layer
+
+
+def _pair(v) -> tuple:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _data_format_to_ordering(data_format: Optional[str]) -> str:
+    if data_format in (None, "channels_last"):
+        return "tf"
+    if data_format == "channels_first":
+        return "th"
+    raise ValueError(f"Unsupported data_format: {data_format}")
+
+
+class Dense(k1.Dense):
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 kernel_initializer="glorot_uniform", **kw):
+        super().__init__(units, activation=activation, use_bias=use_bias,
+                         init=kernel_initializer, **kw)
+
+
+class Conv1D(k1.Convolution1D):
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 padding: str = "valid", activation=None,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform", **kw):
+        super().__init__(filters, kernel_size, subsample=(strides,),
+                         border_mode=padding, activation=activation,
+                         use_bias=use_bias, init=kernel_initializer, **kw)
+
+
+class Conv2D(k1.Convolution2D):
+    def __init__(self, filters: int, kernel_size, strides=(1, 1),
+                 padding: str = "valid", data_format: Optional[str] = None,
+                 activation=None, use_bias: bool = True,
+                 kernel_initializer="glorot_uniform", **kw):
+        kh, kw_ = _pair(kernel_size)
+        super().__init__(filters, kh, kw_, subsample=_pair(strides),
+                         border_mode=padding,
+                         dim_ordering=_data_format_to_ordering(data_format),
+                         activation=activation, use_bias=use_bias,
+                         init=kernel_initializer, **kw)
+
+
+class MaxPooling1D(k1.MaxPooling1D):
+    def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
+                 padding: str = "valid", **kw):
+        super().__init__(pool_length=pool_size, stride=strides,
+                         border_mode=padding, **kw)
+
+
+class AveragePooling1D(k1.AveragePooling1D):
+    def __init__(self, pool_size: int = 2, strides: Optional[int] = None,
+                 padding: str = "valid", **kw):
+        super().__init__(pool_length=pool_size, stride=strides,
+                         border_mode=padding, **kw)
+
+
+class MaxPooling2D(k1.MaxPooling2D):
+    def __init__(self, pool_size=(2, 2), strides=None,
+                 padding: str = "valid", data_format: Optional[str] = None,
+                 **kw):
+        super().__init__(pool_size=_pair(pool_size),
+                         strides=_pair(strides) if strides else None,
+                         border_mode=padding,
+                         dim_ordering=_data_format_to_ordering(data_format),
+                         **kw)
+
+
+class AveragePooling2D(k1.AveragePooling2D):
+    def __init__(self, pool_size=(2, 2), strides=None,
+                 padding: str = "valid", data_format: Optional[str] = None,
+                 **kw):
+        super().__init__(pool_size=_pair(pool_size),
+                         strides=_pair(strides) if strides else None,
+                         border_mode=padding,
+                         dim_ordering=_data_format_to_ordering(data_format),
+                         **kw)
+
+
+class GlobalMaxPooling2D(k1.GlobalMaxPooling2D):
+    def __init__(self, data_format: Optional[str] = None, **kw):
+        super().__init__(dim_ordering=_data_format_to_ordering(data_format),
+                         **kw)
+
+
+class GlobalAveragePooling2D(k1.GlobalAveragePooling2D):
+    def __init__(self, data_format: Optional[str] = None, **kw):
+        super().__init__(dim_ordering=_data_format_to_ordering(data_format),
+                         **kw)
+
+
+# -- merge classes (`keras2/layers/merge.py` flavour) -----------------------
+class _MergeBase(k1.Merge):
+    mode = "sum"
+
+    def __init__(self, **kw):
+        super().__init__(mode=type(self).mode, **kw)
+
+
+class Add(_MergeBase):
+    mode = "sum"
+
+
+class Multiply(_MergeBase):
+    mode = "mul"
+
+
+class Average(_MergeBase):
+    mode = "ave"
+
+
+class Maximum(_MergeBase):
+    mode = "max"
+
+
+class Subtract(Layer):
+    def call(self, params, xs, *, training=False, rng=None):
+        a, b = xs
+        return a - b
+
+    def compute_output_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class Minimum(Layer):
+    def call(self, params, xs, *, training=False, rng=None):
+        out = xs[0]
+        for x in xs[1:]:
+            import jax.numpy as jnp
+            out = jnp.minimum(out, x)
+        return out
+
+    def compute_output_shape(self, input_shapes):
+        return input_shapes[0]
+
+
+class Concatenate(k1.Merge):
+    def __init__(self, axis: int = -1, **kw):
+        super().__init__(mode="concat", concat_axis=axis, **kw)
+
+
+class Dot(Layer):
+    """keras2 Dot: per-sample tensordot over the given axes (batch dim
+    excluded); `normalize=True` L2-normalizes along the contraction axis
+    first (cosine proximity)."""
+
+    def __init__(self, axes=-1, normalize: bool = False, **kw):
+        super().__init__(**kw)
+        self.axes = tuple(axes) if isinstance(axes, (list, tuple)) \
+            else (axes, axes)
+        self.normalize = normalize
+
+    def _sample_axes(self, shapes):
+        # translate full-tensor axes to per-sample (batch-stripped) axes
+        out = []
+        for ax, shape in zip(self.axes, shapes):
+            nd = len(shape)
+            a = ax if ax >= 0 else nd + ax
+            if a == 0:
+                raise ValueError("Dot axes cannot include the batch dim")
+            out.append(a - 1)
+        return tuple(out)
+
+    def call(self, params, xs, *, training=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+        a, b = xs
+        ax_a, ax_b = self._sample_axes([a.shape, b.shape])
+        if self.normalize:
+            a = a / jnp.clip(jnp.linalg.norm(a, axis=ax_a + 1, keepdims=True),
+                             1e-7, None)
+            b = b / jnp.clip(jnp.linalg.norm(b, axis=ax_b + 1, keepdims=True),
+                             1e-7, None)
+        y = jax.vmap(
+            lambda u, v: jnp.tensordot(u, v, axes=((ax_a,), (ax_b,))))(a, b)
+        if y.ndim == 1:
+            y = y[:, None]
+        return y
+
+    def compute_output_shape(self, input_shapes):
+        sa, sb = input_shapes
+        ax_a, ax_b = self._sample_axes([sa, sb])
+        rest_a = [d for i, d in enumerate(sa[1:]) if i != ax_a]
+        rest_b = [d for i, d in enumerate(sb[1:]) if i != ax_b]
+        out = tuple([sa[0]] + rest_a + rest_b)
+        return out if len(out) > 1 else (sa[0], 1)
+
+
+def add(inputs, name=None):
+    return Add(name=name)(inputs)
+
+
+def multiply(inputs, name=None):
+    return Multiply(name=name)(inputs)
+
+
+def average(inputs, name=None):
+    return Average(name=name)(inputs)
+
+
+def maximum(inputs, name=None):
+    return Maximum(name=name)(inputs)
+
+
+def concatenate(inputs, axis=-1, name=None):
+    return Concatenate(axis=axis, name=name)(inputs)

@@ -119,22 +119,26 @@ class GANEstimator:
         g_opt, d_opt = self.g_opt, self.d_opt
 
         def d_step(g_params, d_params, d_opt_state, noise, real, rng):
+            k_gen, k_real, k_fake = jax.random.split(rng, 3)
             fake = jax.lax.stop_gradient(
-                gen.apply(g_params, noise, training=True, rng=rng))
+                gen.apply(g_params, noise, training=True, rng=k_gen))
 
             def loss(dp):
-                return d_loss_fn(disc.apply(dp, real, training=True, rng=rng),
-                                 disc.apply(dp, fake, training=True, rng=rng))
+                return d_loss_fn(
+                    disc.apply(dp, real, training=True, rng=k_real),
+                    disc.apply(dp, fake, training=True, rng=k_fake))
 
             l, grads = jax.value_and_grad(loss)(d_params)
             updates, d_opt_state = d_opt.update(grads, d_opt_state, d_params)
             return optax.apply_updates(d_params, updates), d_opt_state, l
 
         def g_step(g_params, g_opt_state, d_params, noise, rng):
+            k_gen, k_disc = jax.random.split(rng)
+
             def loss(gp):
-                fake = gen.apply(gp, noise, training=True, rng=rng)
+                fake = gen.apply(gp, noise, training=True, rng=k_gen)
                 return g_loss_fn(disc.apply(d_params, fake, training=True,
-                                            rng=rng))
+                                            rng=k_disc))
 
             l, grads = jax.value_and_grad(loss)(g_params)
             updates, g_opt_state = g_opt.update(grads, g_opt_state, g_params)
@@ -176,6 +180,7 @@ class GANEstimator:
         history: Dict[str, List[float]] = {"d_loss": [], "g_loss": []}
         period = self.d_steps + self.g_steps
         it = 0
+        last_saved = -1
         while it < end_iteration:
             try:
                 real_b = next(real_iter)[0]
@@ -201,12 +206,13 @@ class GANEstimator:
             if (checkpoint_every and self.model_dir
                     and it % checkpoint_every == 0):
                 self._snapshot(g_params, d_params, it)
+                last_saved = it
 
         self.g_params = jax.device_get(g_params)
         self.d_params = jax.device_get(d_params)
         self.generator.params = self.g_params
         self.discriminator.params = self.d_params
-        if self.model_dir:
+        if self.model_dir and last_saved != end_iteration:
             self._snapshot(g_params, d_params, end_iteration)
         return history
 
@@ -224,12 +230,14 @@ class GANEstimator:
         path = path or self.model_dir
         if path is None or latest_checkpoint(path) is None:
             raise FileNotFoundError(f"No GAN checkpoint under {path!r}")
-        params, _, _ = load_checkpoint(path, version)
+        params, _, meta = load_checkpoint(path, version, optim_name="gan")
         # remap saved auto-generated layer names onto this instance's names
         self.g_params = self.generator._remap_loaded(params["generator"])
         self.d_params = self.discriminator._remap_loaded(params["discriminator"])
         self.generator.params = self.g_params
         self.discriminator.params = self.d_params
+        # resume the D/G alternation where the snapshot left off
+        self._counter = int(meta.get("iteration", 0))
         return self
 
     # -- inference ---------------------------------------------------------

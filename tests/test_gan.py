@@ -45,7 +45,6 @@ class TestGANEstimator:
                            generator_optimizer=optax.adam(2e-3, b1=0.5),
                            discriminator_optimizer=optax.adam(2e-3, b1=0.5))
         real = _real_data()
-        before = est_dist = None
         hist = est.train(real, _noise, batch_size=32, end_iteration=200)
         assert hist["d_loss"] and hist["g_loss"]
         assert np.all(np.isfinite(hist["d_loss"]))
@@ -77,6 +76,8 @@ class TestGANEstimator:
         est2 = GANEstimator(gen2, disc2, model_dir=str(tmp_path)).restore()
         out2 = est2.generate(_noise(8, 7))
         np.testing.assert_allclose(out1, out2, rtol=1e-5)
+        # the D/G alternation schedule resumes where the snapshot left off
+        assert est2._counter == 4
 
     def test_bad_steps_raise(self):
         gen, disc = _nets()

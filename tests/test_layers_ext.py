@@ -1,0 +1,414 @@
+"""Extended layer set tests — numeric parity vs tf.keras (keras 3) where the
+op has a stable keras implementation, numpy references elsewhere (reference
+pattern: per-layer specs with fixed values, `keras/layers/*Spec.scala`;
+python `compare_layer` vs real Keras, `pyzoo/test/.../test_utils.py:104`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Input, Model, Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.keras2 import layers as K2
+
+
+def _build(layer, shape, seed=0):
+    return layer.build(jax.random.PRNGKey(seed), (None,) + tuple(shape))
+
+
+def _tf():
+    tf = pytest.importorskip("tensorflow")
+    return tf
+
+
+class TestAdvancedActivations:
+    def test_leaky_relu_elu_thresholded(self):
+        x = np.array([[-2.0, -0.5, 0.5, 2.0]], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(L.LeakyReLU(0.1).call({}, x)),
+            np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(L.ELU(1.0).call({}, x)),
+            np.where(x > 0, x, np.exp(x) - 1), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(L.ThresholdedReLU(1.0).call({}, x)),
+            np.where(x > 1.0, x, 0.0), rtol=1e-6)
+
+    def test_prelu_parity_with_keras(self):
+        tf = _tf()
+        x = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+        alpha = np.random.RandomState(1).rand(5).astype(np.float32)
+        ref_layer = tf.keras.layers.PReLU()
+        ref_layer.build((None, 5))
+        ref_layer.set_weights([alpha])
+        ref = ref_layer(x).numpy()
+        ours = L.PReLU()
+        p = {"alpha": jnp.asarray(alpha)}
+        np.testing.assert_allclose(np.asarray(ours.call(p, x)), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_srelu_identity_between_thresholds(self):
+        s = L.SReLU()
+        p = _build(s, (4,))
+        x = np.array([[0.1, 0.5, 0.9, 0.3]], np.float32)
+        np.testing.assert_allclose(np.asarray(s.call(p, x)), x, rtol=1e-6)
+
+    def test_srelu_grad_flows_to_all_params(self):
+        s = L.SReLU()
+        p = _build(s, (3,))
+        x = np.array([[-2.0, 0.5, 3.0]], np.float32)
+
+        def loss(p):
+            return jnp.sum(s.call(p, x))
+
+        g = jax.grad(loss)(p)
+        assert np.any(np.asarray(g["a_left"]) != 0)
+        assert np.any(np.asarray(g["a_right"]) != 0)
+
+
+class TestNoise:
+    def test_gaussian_noise_and_dropout_eval_identity(self):
+        x = np.ones((3, 4), np.float32)
+        for layer in [L.GaussianNoise(0.5), L.GaussianDropout(0.3),
+                      L.SpatialDropout2D(0.5)]:
+            np.testing.assert_array_equal(
+                np.asarray(layer.call({}, np.ones((3, 4, 4, 2), np.float32)
+                                      if "Spatial" in type(layer).__name__
+                                      else x, training=False)),
+                np.ones((3, 4, 4, 2)) if "Spatial" in type(layer).__name__
+                else x)
+
+    def test_spatial_dropout_drops_whole_maps(self):
+        x = np.ones((2, 8, 8, 16), np.float32)
+        y = np.asarray(L.SpatialDropout2D(0.5).call(
+            {}, x, training=True, rng=jax.random.PRNGKey(0)))
+        # each (batch, channel) map is either all-zero or all-scaled
+        per_map = y.reshape(2, 64, 16)
+        for b in range(2):
+            for c in range(16):
+                vals = np.unique(per_map[b, :, c])
+                assert len(vals) == 1
+
+    def test_masking(self):
+        x = np.array([[[0.0, 0.0], [1.0, 2.0], [0.0, 3.0]]], np.float32)
+        y = np.asarray(L.Masking(0.0).call({}, x))
+        np.testing.assert_array_equal(y[0, 0], [0.0, 0.0])
+        np.testing.assert_array_equal(y[0, 1], [1.0, 2.0])
+        np.testing.assert_array_equal(y[0, 2], [0.0, 3.0])
+
+
+class TestDenseVariants:
+    def test_highway_shapes_and_carry(self):
+        h = L.Highway()
+        p = _build(h, (6,))
+        x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        y = h.call(p, x)
+        assert y.shape == (3, 6)
+        # with transform bias -inf, output → input (carry gate)
+        p2 = dict(p)
+        p2["transform_bias"] = jnp.full((6,), -1e9, jnp.float32)
+        np.testing.assert_allclose(np.asarray(h.call(p2, x)), x, rtol=1e-5)
+
+    def test_maxout_dense(self):
+        m = L.MaxoutDense(3, nb_feature=4)
+        p = _build(m, (5,))
+        x = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+        y = np.asarray(m.call(p, x))
+        k = np.asarray(p["kernel"])
+        b = np.asarray(p["bias"])
+        ref = np.max(np.einsum("bd,fdo->bfo", x, k) + b, axis=1)
+        np.testing.assert_allclose(y, ref, rtol=1e-5)
+
+
+class TestConvVariants:
+    def test_separable_conv_parity(self):
+        tf = _tf()
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 8, 8, 3).astype(np.float32)
+        ours = L.SeparableConvolution2D(5, 3, 3, border_mode="valid")
+        p = _build(ours, (8, 8, 3))
+        ref_layer = tf.keras.layers.SeparableConv2D(5, 3, padding="valid")
+        ref_layer.build((None, 8, 8, 3))
+        ref_layer.set_weights([
+            np.asarray(p["depthwise"]).reshape(3, 3, 3, 1),
+            np.asarray(p["pointwise"]),
+            np.asarray(p["bias"])])
+        ref = ref_layer(x).numpy()
+        np.testing.assert_allclose(np.asarray(ours.call(p, x)), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_deconv_parity(self):
+        tf = _tf()
+        rs = np.random.RandomState(1)
+        x = rs.randn(2, 5, 5, 4).astype(np.float32)
+        ours = L.Deconvolution2D(6, 3, 3, subsample=(2, 2),
+                                 border_mode="valid")
+        p = _build(ours, (5, 5, 4))
+        ref_layer = tf.keras.layers.Conv2DTranspose(
+            6, 3, strides=(2, 2), padding="valid")
+        ref_layer.build((None, 5, 5, 4))
+        # keras kernel layout: (kh, kw, out_ch, in_ch)
+        ref_layer.set_weights([
+            np.transpose(np.asarray(p["kernel"]), (0, 1, 3, 2)),
+            np.asarray(p["bias"])])
+        ref = ref_layer(x).numpy()
+        y = np.asarray(ours.call(p, x))
+        assert y.shape == ref.shape == (2, 11, 11, 6)
+        # XLA's default conv precision runs bf16 passes (the TPU-native
+        # default); tolerance sized accordingly.
+        np.testing.assert_allclose(y, ref, rtol=2e-2, atol=5e-2)
+
+    def test_atrous_conv2d_matches_dilated_lax(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(1, 9, 9, 2).astype(np.float32)
+        ours = L.AtrousConvolution2D(3, 3, 3, atrous_rate=(2, 2))
+        p = _build(ours, (9, 9, 2))
+        y = np.asarray(ours.call(p, x))
+        assert y.shape == (1, 5, 5, 3)
+        assert ours.compute_output_shape((None, 9, 9, 2)) == (None, 5, 5, 3)
+
+    def test_atrous_anisotropic_shape(self):
+        layer = L.AtrousConvolution2D(8, 3, 3, atrous_rate=(1, 2))
+        p = _build(layer, (10, 10, 2))
+        y = layer.call(p, np.zeros((1, 10, 10, 2), np.float32))
+        assert tuple(y.shape) == \
+            layer.compute_output_shape((1, 10, 10, 2)) == (1, 8, 6, 8)
+
+    def test_locally_connected1d_numpy_ref(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 7, 3).astype(np.float32)
+        ours = L.LocallyConnected1D(4, 3, subsample_length=2)
+        p = _build(ours, (7, 3))
+        y = np.asarray(ours.call(p, x))
+        k = np.asarray(p["kernel"])  # (out_len, 3*3, 4)
+        b = np.asarray(p["bias"])
+        out_len = (7 - 3) // 2 + 1
+        ref = np.zeros((2, out_len, 4), np.float32)
+        for o in range(out_len):
+            patch = x[:, o * 2:o * 2 + 3, :].reshape(2, -1)
+            ref[:, o, :] = patch @ k[o] + b[o]
+        np.testing.assert_allclose(y, ref, rtol=1e-5)
+
+    def test_locally_connected2d_unshared(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(2, 6, 6, 2).astype(np.float32)
+        ours = L.LocallyConnected2D(3, 3, 3)
+        p = _build(ours, (6, 6, 2))
+        y = np.asarray(ours.call(p, x))
+        assert y.shape == (2, 4, 4, 3)
+        # numpy reference for one output position
+        k = np.asarray(p["kernel"])  # (16, 18, 3)
+        b = np.asarray(p["bias"])
+        patch = x[:, 1:4, 2:5, :].reshape(2, -1)  # position (1, 2) → idx 6
+        ref = patch @ k[1 * 4 + 2] + b[1, 2]
+        np.testing.assert_allclose(y[:, 1, 2, :], ref, rtol=1e-4, atol=1e-5)
+
+
+class TestCropPadUpsample:
+    def test_cropping(self):
+        x = np.arange(2 * 6 * 4, dtype=np.float32).reshape(2, 6, 4)
+        y = np.asarray(L.Cropping1D((1, 2)).call({}, x))
+        np.testing.assert_array_equal(y, x[:, 1:4, :])
+        x2 = np.random.rand(1, 6, 8, 3).astype(np.float32)
+        y2 = np.asarray(L.Cropping2D(((1, 1), (2, 2))).call({}, x2))
+        np.testing.assert_array_equal(y2, x2[:, 1:5, 2:6, :])
+        x3 = np.random.rand(1, 4, 6, 8, 2).astype(np.float32)
+        y3 = np.asarray(
+            L.Cropping3D(((1, 1), (1, 1), (2, 2))).call({}, x3))
+        np.testing.assert_array_equal(y3, x3[:, 1:3, 1:5, 2:6, :])
+
+    def test_pad_upsample(self):
+        x = np.ones((2, 3, 4), np.float32)
+        assert L.ZeroPadding1D(2).call({}, x).shape == (2, 7, 4)
+        x3 = np.ones((1, 2, 3, 4, 2), np.float32)
+        assert L.ZeroPadding3D((1, 1, 1)).call({}, x3).shape == \
+            (1, 4, 5, 6, 2)
+        assert L.UpSampling1D(3).call({}, x).shape == (2, 9, 4)
+        assert L.UpSampling3D((2, 2, 2)).call({}, x3).shape == \
+            (1, 4, 6, 8, 2)
+
+    def test_upsampling3d_parity(self):
+        tf = _tf()
+        x = np.random.rand(1, 2, 3, 2, 4).astype(np.float32)
+        ref = tf.keras.layers.UpSampling3D((2, 1, 2))(x).numpy()
+        y = np.asarray(L.UpSampling3D((2, 1, 2)).call({}, x))
+        np.testing.assert_allclose(y, ref, rtol=1e-6)
+
+    def test_pool3d(self):
+        x = np.random.rand(2, 4, 4, 4, 3).astype(np.float32)
+        y = L.MaxPooling3D().call({}, x)
+        assert y.shape == (2, 2, 2, 2, 3)
+        ya = L.AveragePooling3D().call({}, x)
+        ref = x.reshape(2, 2, 2, 2, 2, 2, 2, 3).mean(axis=(2, 4, 6))
+        np.testing.assert_allclose(np.asarray(ya), ref, rtol=1e-5)
+        assert L.GlobalMaxPooling3D().call({}, x).shape == (2, 3)
+        assert L.GlobalAveragePooling3D().call({}, x).shape == (2, 3)
+
+
+class TestConvLSTM:
+    def test_convlstm2d_parity_with_keras(self):
+        tf = _tf()
+        rs = np.random.RandomState(5)
+        x = rs.randn(2, 3, 6, 6, 2).astype(np.float32)
+        ours = L.ConvLSTM2D(4, 3, return_sequences=True)
+        p = _build(ours, (3, 6, 6, 2))
+        ref_layer = tf.keras.layers.ConvLSTM2D(
+            4, 3, padding="same", return_sequences=True,
+            recurrent_activation="hard_sigmoid")
+        ref_layer.build((None, 3, 6, 6, 2))
+        ref_layer.set_weights([
+            np.asarray(p["kernel"]), np.asarray(p["recurrent"]),
+            np.asarray(p["bias"])])
+        ref = ref_layer(x).numpy()
+        y = np.asarray(ours.call(p, x))
+        assert y.shape == ref.shape == (2, 3, 6, 6, 4)
+        np.testing.assert_allclose(y, ref, rtol=1e-3, atol=1e-4)
+
+    def test_convlstm3d(self):
+        ours = L.ConvLSTM3D(2, 3, return_sequences=True)
+        p = _build(ours, (2, 4, 4, 4, 3))
+        y = ours.call(p, np.random.rand(1, 2, 4, 4, 4, 3).astype(np.float32))
+        assert y.shape == (1, 2, 4, 4, 4, 2)
+        assert ours.compute_output_shape((None, 2, 4, 4, 4, 3)) == \
+            (None, 2, 4, 4, 4, 2)
+
+    def test_convlstm2d_last_state(self):
+        ours = L.ConvLSTM2D(4, 3)
+        p = _build(ours, (3, 6, 6, 2))
+        y = ours.call(p, np.zeros((2, 3, 6, 6, 2), np.float32))
+        assert y.shape == (2, 6, 6, 4)
+        assert ours.compute_output_shape((None, 3, 6, 6, 2)) == \
+            (None, 6, 6, 4)
+
+
+class TestNormResizeSample:
+    def test_lrn2d_numpy_ref(self):
+        rs = np.random.RandomState(6)
+        x = rs.rand(1, 3, 3, 6).astype(np.float32)
+        lrn = L.LRN2D(alpha=1e-2, k=2.0, beta=0.75, n=3)
+        y = np.asarray(lrn.call({}, x))
+        ref = np.zeros_like(x)
+        for c in range(6):
+            lo, hi = max(0, c - 1), min(6, c + 2)
+            s = np.sum(x[..., lo:hi] ** 2, axis=-1)
+            ref[..., c] = x[..., c] / (2.0 + (1e-2 / 3) * s) ** 0.75
+        np.testing.assert_allclose(y, ref, rtol=1e-5)
+
+    def test_within_channel_lrn(self):
+        x = np.ones((1, 5, 5, 2), np.float32)
+        y = np.asarray(L.WithinChannelLRN2D(size=3, alpha=1.0).call({}, x))
+        # center pixel: mean-square over 3x3 window of ones = 1
+        np.testing.assert_allclose(y[0, 2, 2], 1.0 / 2.0 ** 0.75, rtol=1e-5)
+
+    def test_resize_bilinear(self):
+        x = np.random.rand(2, 4, 4, 3).astype(np.float32)
+        y = L.ResizeBilinear(8, 6).call({}, x)
+        assert y.shape == (2, 8, 6, 3)
+
+    def test_gaussian_sampler(self):
+        mean = np.zeros((4, 3), np.float32)
+        log_var = np.zeros((4, 3), np.float32)
+        out = L.GaussianSampler().call(
+            {}, [mean, log_var], rng=jax.random.PRNGKey(0))
+        assert out.shape == (4, 3)
+        assert np.std(np.asarray(out)) > 0.1
+        det = L.GaussianSampler().call({}, [mean, log_var])
+        np.testing.assert_array_equal(np.asarray(det), mean)
+
+
+class TestTorchStyle:
+    def test_elementwise(self):
+        x = np.array([[-2.0, 0.25, 4.0]], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(L.Abs().call({}, x)), np.abs(x))
+        np.testing.assert_allclose(
+            np.asarray(L.AddConstant(1.0).call({}, x)), x + 1)
+        np.testing.assert_allclose(
+            np.asarray(L.MulConstant(2.0).call({}, x)), x * 2)
+        np.testing.assert_allclose(
+            np.asarray(L.Clamp(-1, 1).call({}, x)), np.clip(x, -1, 1))
+        np.testing.assert_allclose(
+            np.asarray(L.HardTanh().call({}, x)), np.clip(x, -1, 1))
+        np.testing.assert_allclose(
+            np.asarray(L.Square().call({}, x)), x ** 2)
+        np.testing.assert_allclose(
+            np.asarray(L.Negative().call({}, x)), -x)
+        np.testing.assert_allclose(np.asarray(L.Identity().call({}, x)), x)
+        np.testing.assert_allclose(
+            np.asarray(L.Power(2.0, scale=2.0, shift=1.0).call({}, x)),
+            (2 * x + 1) ** 2)
+        np.testing.assert_allclose(
+            np.asarray(L.HardShrink(0.5).call({}, x)),
+            np.where(np.abs(x) > 0.5, x, 0.0))
+        np.testing.assert_allclose(
+            np.asarray(L.SoftShrink(0.5).call({}, x)),
+            np.sign(x) * np.maximum(np.abs(x) - 0.5, 0))
+        np.testing.assert_allclose(
+            np.asarray(L.Threshold(0.0, -7.0).call({}, x)),
+            np.where(x > 0, x, -7.0))
+
+    def test_learnable_scale_cadd_cmul(self):
+        x = np.ones((2, 4), np.float32)
+        s = L.Scale()
+        p = _build(s, (4,))
+        np.testing.assert_allclose(np.asarray(s.call(p, x)), x)
+        ca = L.CAdd((4,))
+        np.testing.assert_allclose(
+            np.asarray(ca.call({"bias": jnp.ones(4)}, x)), x + 1)
+        cm = L.CMul((4,))
+        np.testing.assert_allclose(
+            np.asarray(cm.call({"weight": 2 * jnp.ones(4)}, x)), 2 * x)
+
+
+class TestInModels:
+    def test_ext_layers_in_sequential_fit(self):
+        model = Sequential([
+            L.Dense(8, input_shape=(4,)),
+            L.LeakyReLU(0.1),
+            L.Highway(),
+            L.Dense(1),
+        ])
+        model.compile(optimizer="adam", loss="mse")
+        x = np.random.rand(16, 4).astype(np.float32)
+        y = np.random.rand(16, 1).astype(np.float32)
+        model.fit(x, y, batch_size=8, nb_epoch=1)
+        out = model.predict(x, batch_per_thread=8)
+        assert np.asarray(out).shape == (16, 1)
+
+    def test_keras2_api_graph(self):
+        inp = Input(shape=(6, 6, 2))
+        c = K2.Conv2D(4, 3, padding="same", activation="relu")(inp)
+        pool = K2.MaxPooling2D()(c)
+        inp2 = Input(shape=(3, 3, 4))
+        added = K2.add([pool, inp2])
+        m = Model([inp, inp2], added)
+        x1 = np.random.rand(2, 6, 6, 2).astype(np.float32)
+        x2 = np.random.rand(2, 3, 3, 4).astype(np.float32)
+        out = m.predict([x1, x2], batch_per_thread=2)
+        assert np.asarray(out).shape == (2, 3, 3, 4)
+
+    def test_keras2_dense_names(self):
+        d = K2.Dense(3, kernel_initializer="he_normal")
+        p = _build(d, (4,))
+        assert p["kernel"].shape == (4, 3)
+        sub = K2.Subtract()
+        y = sub.call({}, [np.ones((2, 3)), np.ones((2, 3))])
+        np.testing.assert_array_equal(np.asarray(y), np.zeros((2, 3)))
+
+    def test_keras2_dot_axes(self):
+        rs = np.random.RandomState(0)
+        a = rs.randn(2, 3, 4).astype(np.float32)
+        b = rs.randn(2, 3, 5).astype(np.float32)
+        y = np.asarray(K2.Dot(axes=1).call({}, [a, b]))
+        ref = np.einsum("btf,btg->bfg", a, b)
+        np.testing.assert_allclose(y, ref, rtol=1e-5)
+        assert K2.Dot(axes=1).compute_output_shape(
+            [(None, 3, 4), (None, 3, 5)]) == (None, 4, 5)
+        # 2-D last-axis dot → [B, 1]
+        u = rs.randn(2, 4).astype(np.float32)
+        y2 = np.asarray(K2.Dot().call({}, [u, u]))
+        np.testing.assert_allclose(y2[:, 0], np.sum(u * u, axis=1),
+                                   rtol=1e-5)
+        with pytest.raises(ValueError, match="batch"):
+            K2.Dot(axes=0).call({}, [u, u])
