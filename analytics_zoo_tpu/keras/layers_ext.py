@@ -850,7 +850,10 @@ class WithinChannelLRN2D(Layer):
 
 
 class ResizeBilinear(Layer):
-    """`ResizeBilinear.scala`: bilinear spatial resize (NHWC)."""
+    """`ResizeBilinear.scala`: bilinear spatial resize (NHWC).
+    `align_corners=True` uses corner-aligned source coordinates
+    (out_i · (in−1)/(out−1)), matching TF's align_corners grid; False uses
+    jax.image's half-pixel-centered grid."""
 
     def __init__(self, output_height: int, output_width: int,
                  align_corners: bool = False, **kw):
@@ -858,9 +861,29 @@ class ResizeBilinear(Layer):
         self.out_hw = (output_height, output_width)
         self.align_corners = align_corners
 
+    @staticmethod
+    def _interp_axis(x, out_size, axis):
+        in_size = x.shape[axis]
+        if out_size == 1 or in_size == 1:
+            coords = jnp.zeros((out_size,))
+        else:
+            coords = jnp.linspace(0.0, in_size - 1.0, out_size)
+        lo = jnp.clip(jnp.floor(coords).astype(jnp.int32), 0, in_size - 1)
+        hi = jnp.clip(lo + 1, 0, in_size - 1)
+        w = (coords - lo).astype(x.dtype)
+        shape = [1] * x.ndim
+        shape[axis] = out_size
+        w = w.reshape(shape)
+        return (jnp.take(x, lo, axis=axis) * (1 - w)
+                + jnp.take(x, hi, axis=axis) * w)
+
     def call(self, params, x, *, training=False, rng=None):
         b, _, _, c = x.shape
-        return jax.image.resize(x, (b,) + self.out_hw + (c,), "bilinear")
+        oh, ow = self.out_hw
+        if not self.align_corners:
+            return jax.image.resize(x, (b, oh, ow, c), "bilinear")
+        y = self._interp_axis(x, oh, axis=1)
+        return self._interp_axis(y, ow, axis=2)
 
     def compute_output_shape(self, input_shape):
         return (input_shape[0],) + self.out_hw + (input_shape[-1],)
@@ -868,12 +891,16 @@ class ResizeBilinear(Layer):
 
 class GaussianSampler(Layer):
     """`GaussianSampler.scala` (VAE reparameterization): input
-    [mean, log_var] → mean + exp(log_var/2)·ε."""
+    [mean, log_var] → mean + exp(log_var/2)·ε in training; the mean at
+    inference."""
 
     def call(self, params, xs, *, training=False, rng=None):
         mean, log_var = xs
-        if rng is None:
+        if not training:
             return mean
+        if rng is None:
+            raise ValueError(f"{self.name}: needs an rng in training "
+                             "(reparameterization noise)")
         eps = jax.random.normal(rng, jnp.shape(mean), mean.dtype)
         return mean + jnp.exp(log_var * 0.5) * eps
 

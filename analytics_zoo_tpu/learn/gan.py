@@ -30,7 +30,8 @@ from analytics_zoo_tpu.keras.engine import KerasNet
 from analytics_zoo_tpu.learn import trainer
 from analytics_zoo_tpu.learn.checkpoint import (CheckpointManager,
                                                 latest_checkpoint,
-                                                load_checkpoint)
+                                                load_checkpoint,
+                                                restore_opt_state)
 
 log = logging.getLogger("analytics_zoo_tpu.gan")
 
@@ -72,6 +73,17 @@ def least_squares_discriminator_loss(real_logits: jax.Array,
     return jnp.mean((real_logits - 1.0) ** 2) + jnp.mean(fake_logits ** 2)
 
 
+def _remap_opt_tree(net, tree):
+    """Rename saved layer names to this instance's names inside a loaded
+    optimizer-state tree. Every dict in an optax state for our optimizers
+    is a params-shaped moment tree, so the net's own param remap applies."""
+    if isinstance(tree, dict):
+        return net._remap_loaded(tree)
+    if isinstance(tree, (list, tuple)):
+        return [_remap_opt_tree(net, v) for v in tree]
+    return tree
+
+
 class GANEstimator:
     """Alternating G/D trainer over a device mesh.
 
@@ -103,6 +115,7 @@ class GANEstimator:
         self.g_params = None
         self.d_params = None
         self._counter = 0
+        self._opt_tree = None
 
     # -- setup -------------------------------------------------------------
     def _ensure_built(self, noise_sample, real_sample, rng: jax.Array):
@@ -174,8 +187,17 @@ class GANEstimator:
         d_step, g_step = self._build_steps()
         g_params = trainer._put_replicated(self.g_params, mesh)
         d_params = trainer._put_replicated(self.d_params, mesh)
-        g_opt_state = trainer._put_replicated(self.g_opt.init(g_params), mesh)
-        d_opt_state = trainer._put_replicated(self.d_opt.init(d_params), mesh)
+        g_opt_state = self.g_opt.init(g_params)
+        d_opt_state = self.d_opt.init(d_params)
+        if self._opt_tree is not None:
+            restored = restore_opt_state(
+                {"discriminator": d_opt_state, "generator": g_opt_state},
+                self._opt_tree)
+            g_opt_state = restored["generator"]
+            d_opt_state = restored["discriminator"]
+            self._opt_tree = None
+        g_opt_state = trainer._put_replicated(g_opt_state, mesh)
+        d_opt_state = trainer._put_replicated(d_opt_state, mesh)
 
         history: Dict[str, List[float]] = {"d_loss": [], "g_loss": []}
         period = self.d_steps + self.g_steps
@@ -203,41 +225,57 @@ class GANEstimator:
                 history["g_loss"].append(float(l))
             self._counter += 1
             it += 1
+            # versions use the CUMULATIVE counter so continued training
+            # never writes a lower version than an earlier run
             if (checkpoint_every and self.model_dir
-                    and it % checkpoint_every == 0):
-                self._snapshot(g_params, d_params, it)
-                last_saved = it
+                    and self._counter % checkpoint_every == 0):
+                self._snapshot(g_params, d_params, g_opt_state, d_opt_state)
+                last_saved = self._counter
 
         self.g_params = jax.device_get(g_params)
         self.d_params = jax.device_get(d_params)
         self.generator.params = self.g_params
         self.discriminator.params = self.d_params
-        if self.model_dir and last_saved != end_iteration:
-            self._snapshot(g_params, d_params, end_iteration)
+        if self.model_dir and last_saved != self._counter:
+            self._snapshot(g_params, d_params, g_opt_state, d_opt_state)
         return history
 
-    def _snapshot(self, g_params, d_params, iteration: int):
+    def _snapshot(self, g_params, d_params, g_opt_state, d_opt_state):
         if self._ckpt_mgr is None:
             self._ckpt_mgr = CheckpointManager(self.model_dir,
                                                optim_name="gan")
-        self._ckpt_mgr.save(iteration,
+        self._ckpt_mgr.save(self._counter,
                             {"generator": jax.device_get(g_params),
                              "discriminator": jax.device_get(d_params)},
-                            extra={"iteration": iteration})
+                            opt_state={"generator": g_opt_state,
+                                       "discriminator": d_opt_state},
+                            extra={"iteration": self._counter})
 
     def restore(self, path: Optional[str] = None,
                 version: Optional[int] = None) -> "GANEstimator":
         path = path or self.model_dir
         if path is None or latest_checkpoint(path) is None:
             raise FileNotFoundError(f"No GAN checkpoint under {path!r}")
-        params, _, meta = load_checkpoint(path, version, optim_name="gan")
+        params, opt_tree, meta = load_checkpoint(path, version,
+                                                 optim_name="gan")
+        if opt_tree is not None:
+            # mu/nu subtrees are params-shaped dicts keyed by the SAVED
+            # instance's auto layer names — remap them like the params
+            opt_tree = {
+                "generator": _remap_opt_tree(self.generator,
+                                             opt_tree["generator"]),
+                "discriminator": _remap_opt_tree(self.discriminator,
+                                                 opt_tree["discriminator"]),
+            }
         # remap saved auto-generated layer names onto this instance's names
         self.g_params = self.generator._remap_loaded(params["generator"])
         self.d_params = self.discriminator._remap_loaded(params["discriminator"])
         self.generator.params = self.g_params
         self.discriminator.params = self.d_params
-        # resume the D/G alternation where the snapshot left off
+        # resume the D/G alternation where the snapshot left off; optimizer
+        # moments are poured back into fresh opt.init state on next train()
         self._counter = int(meta.get("iteration", 0))
+        self._opt_tree = opt_tree
         return self
 
     # -- inference ---------------------------------------------------------

@@ -1,0 +1,45 @@
+"""Time-series anomaly detection with the LSTM AnomalyDetector (the
+reference's `pyzoo/zoo/examples/anomalydetection/`, `apps/anomaly-detection/`).
+
+    python examples/anomaly_detection.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.anomalydetection import (
+    AnomalyDetector, detect_anomalies, unroll)
+
+
+def synthetic_series(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    t = np.arange(n)
+    base = np.sin(2 * np.pi * t / 50) + 0.1 * rng.randn(n)
+    # inject spikes the detector should flag
+    spikes = rng.choice(n, 8, replace=False)
+    base[spikes] += rng.choice([-4.0, 4.0], 8)
+    return base.astype(np.float32), spikes
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    series, true_spikes = synthetic_series()
+    unroll_len = 24
+    x, y = unroll(series, unroll_len)
+    n_train = int(len(x) * 0.8)
+    x_train, y_train = x[:n_train], y[:n_train]
+    x_test, y_test = x[n_train:], y[n_train:]
+
+    model = AnomalyDetector(feature_shape=(unroll_len, 1),
+                            hidden_layers=(16, 8), dropouts=(0.2, 0.2))
+    model.compile("adam", "mse")
+    model.fit(x_train, y_train, batch_size=128, nb_epoch=3)
+
+    y_pred = np.asarray(model.predict(x_test, batch_per_thread=128)).ravel()
+    anomaly_idx = detect_anomalies(y_test, y_pred, anomaly_size=5)
+    print(f"test mse: {np.mean((y_pred - y_test) ** 2):.4f}")
+    print(f"flagged anomaly window indices: {sorted(anomaly_idx.tolist())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Autograd Variable DSL + custom loss (the reference's
+`pyzoo/zoo/examples/autograd/custom.py` and `customloss.py`): build a
+Lambda-style model and train it with a mean-absolute-error expressed in the
+Variable math DSL.
+
+    python examples/autograd_custom_loss.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.ops import autograd as A
+
+
+def add_one_one(inputs):
+    return inputs + 1.0
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    x = np.random.rand(256, 4).astype(np.float32)
+    y = (x.sum(axis=1, keepdims=True) + 1.0).astype(np.float32)
+
+    model = Sequential([
+        L.Dense(8, input_shape=(4,), activation="relu"),
+        L.Dense(1),
+    ])
+    # mean-absolute-error written in the Variable DSL
+    y_true = A.Variable(input_shape=(1,))
+    y_pred = A.Variable(input_shape=(1,))
+    mae = A.CustomLoss(A.mean(A.abs(y_true - y_pred), axis=1),
+                       y_true, y_pred)
+    model.compile("adam", mae)
+    hist = model.fit(x, y, batch_size=64, nb_epoch=8)
+    print("final custom-loss value:", round(hist["loss"][-1], 4))
+
+    # Lambda layer from a plain function (reference's `Lambda` path)
+    lam = Sequential([A.Lambda(add_one_one, input_shape=(4,))])
+    out = np.asarray(lam.predict(x[:4], batch_per_thread=4))
+    np.testing.assert_allclose(out, x[:4] + 1.0, rtol=1e-6)
+    print("Lambda(add_one) OK")
+
+
+if __name__ == "__main__":
+    main()

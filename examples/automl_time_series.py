@@ -1,0 +1,48 @@
+"""AutoML time-series pipeline search (the reference's
+`pyzoo/zoo/examples/automl/`, `zouwu/autots`): AutoTSTrainer searches
+feature/model configs, returns the best TSPipeline; save/load round-trip.
+
+    python examples/automl_time_series.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.automl.recipe import LSTMGridRandomRecipe
+from analytics_zoo_tpu.zouwu.autots import AutoTSTrainer, TSPipeline
+
+
+def synthetic_df(n=400):
+    dt = pd.date_range("2024-01-01", periods=n, freq="h")
+    value = (np.sin(2 * np.pi * np.arange(n) / 24)
+             + 0.05 * np.random.RandomState(0).randn(n))
+    return pd.DataFrame({"datetime": dt, "value": value.astype(np.float32)})
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    df = synthetic_df()
+    n_train = int(len(df) * 0.8)
+    train_df, val_df = df.iloc[:n_train], df.iloc[n_train:]
+
+    trainer = AutoTSTrainer(dt_col="datetime", target_col="value")
+    pipeline = trainer.fit(train_df, validation_df=val_df,
+                           recipe=LSTMGridRandomRecipe(num_rand_samples=1))
+    metrics = pipeline.evaluate(val_df, metrics=["mse", "mae"])
+    print("best config:", {k: v for k, v in pipeline.config.items()
+                           if k in ("model", "lstm_1_units", "past_seq_len")})
+    print("validation:", metrics)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = pipeline.save(os.path.join(d, "tsppl"))
+        reloaded = TSPipeline.load(path)
+        m2 = reloaded.evaluate(val_df, metrics=["mse"])
+        print("reloaded validation mse:", m2)
+
+
+if __name__ == "__main__":
+    main()

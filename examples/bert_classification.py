@@ -1,0 +1,40 @@
+"""BERT sequence classification (the reference's TFPark BERTClassifier,
+`pyzoo/zoo/tfpark/text/estimator/bert_classifier.py:64`, baseline config 4)
+on a tiny randomly-initialized BERT and synthetic token data.
+
+    python examples/bert_classification.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.bert import BERTClassifier
+
+
+def synthetic_batches(n=64, seq_len=32, vocab=100, classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, classes, n).astype(np.int32)
+    ids = rng.randint(5, vocab, (n, seq_len)).astype(np.int32)
+    ids[y == 1, :4] = 2  # class-1 sequences start with a marker token
+    token_type = np.zeros((n, seq_len), np.int32)
+    mask = np.ones((n, seq_len), np.int32)
+    return [ids, token_type, mask], y
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    x, y = synthetic_batches()
+    clf = BERTClassifier(num_classes=2, vocab=100, hidden_size=32,
+                         n_block=2, n_head=2, seq_len=32,
+                         intermediate_size=64)
+    clf.default_compile(lr=1e-3, total_steps=40)
+    clf.fit(x, y, batch_size=16, nb_epoch=5)
+    metrics = clf.evaluate(x, y, batch_per_thread=32)
+    print("metrics:", metrics)
+    logits = np.asarray(clf.predict(x, batch_per_thread=32))
+    acc = float((logits.argmax(-1) == y).mean())
+    print(f"train accuracy: {acc:.2f}")
+
+
+if __name__ == "__main__":
+    main()

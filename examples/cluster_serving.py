@@ -1,0 +1,50 @@
+"""Cluster Serving end-to-end in one process (the reference's
+`pyzoo/zoo/examples/serving/`, `zoo/.../serving/`): a jit-batched
+InferenceModel behind a stream broker, driven by the InputQueue/OutputQueue
+client protocol.
+
+    python examples/cluster_serving.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.serving.broker import MemoryBroker
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.server import ClusterServing
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    model = Sequential([
+        L.Dense(16, input_shape=(8,), activation="relu"),
+        L.Dense(3, activation="softmax"),
+    ])
+    model.ensure_built(np.zeros((1, 8), np.float32))
+    infer = InferenceModel(concurrent_num=2).load_keras(model)
+
+    broker = MemoryBroker()
+    serving = ClusterServing(infer, broker=broker, batch_size=8)
+
+    inq = InputQueue(broker)
+    outq = OutputQueue(broker)
+    uris = [inq.enqueue(data=np.random.rand(8).astype(np.float32))
+            for _ in range(20)]
+
+    served = 0
+    while served < 20:
+        served += serving.serve_once()
+
+    results = [outq.query(u) for u in uris]
+    probs = np.stack(results)
+    print(f"served {served} records; prob rows sum to "
+          f"{np.round(probs.sum(axis=1)[:5], 3)}")
+    print("serving metrics:", {k: round(v, 4) if isinstance(v, float) else v
+                               for k, v in serving.metrics().items()})
+
+
+if __name__ == "__main__":
+    main()

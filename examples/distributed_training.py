@@ -1,0 +1,44 @@
+"""Distributed data/tensor-parallel training through the Orca-style
+Estimator (the reference's `pyzoo/zoo/examples/orca/learn/`; the five
+Spark/Ray gradient transports collapse into GSPMD sharding over the device
+mesh here).
+
+Run on any device count — a TPU pod slice, one chip, or a virtual CPU mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python examples/distributed_training.py
+"""
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context, stop_orca_context
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.learn.estimator import Estimator
+
+
+def main():
+    n_dev = jax.device_count()
+    # all devices on the data axis; switch `data=`/`tensor=` to re-shard
+    ctx = init_orca_context(cluster_mode="local", data=n_dev)
+    print(f"mesh: {ctx.mesh}")
+
+    model = Sequential([
+        L.Dense(64, input_shape=(16,), activation="relu"),
+        L.Dense(64, activation="relu"),
+        L.Dense(1),
+    ])
+    model.compile("adam", "mse")
+    est = Estimator.from_keras(model)
+
+    x = np.random.rand(1024, 16).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True).astype(np.float32)
+    est.fit({"x": x, "y": y}, epochs=3, batch_size=16 * n_dev)
+    mse = est.evaluate({"x": x, "y": y}, batch_per_thread=64)
+    print("eval:", mse)
+    stop_orca_context()
+
+
+if __name__ == "__main__":
+    main()

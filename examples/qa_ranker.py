@@ -1,0 +1,46 @@
+"""Question-answer ranking with KNRM (the reference's
+`pyzoo/zoo/examples/qaranker/`, WikiQA-style workload) on synthetic pairs
+where relevant answers share tokens with the question.
+
+    python examples/qa_ranker.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.textmatching import KNRM
+
+
+def synthetic_pairs(n=512, vocab=200, q_len=10, a_len=20, seed=0):
+    rng = np.random.RandomState(seed)
+    q = rng.randint(1, vocab, (n, q_len))
+    a = rng.randint(1, vocab, (n, a_len))
+    y = rng.randint(0, 2, n).astype(np.float32)
+    # positive answers copy question tokens (lexical overlap signal)
+    for i in np.where(y == 1)[0]:
+        a[i, :q_len] = q[i]
+    return np.concatenate([q, a], axis=1).astype(np.int32), y
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    x, y = synthetic_pairs()
+    ranker = KNRM(text1_length=10, text2_length=20, vocab_size=200,
+                  embed_size=16, target_mode="classification")
+    ranker.compile("adam", "binary_crossentropy", ["accuracy"])
+    ranker.fit(x, y, batch_size=64, nb_epoch=3)
+    metrics = ranker.evaluate(x, y, batch_per_thread=128)
+    print("metrics:", metrics)
+    # rank 4 candidate answers for one question (3 random, 1 overlapping)
+    q = x[:1, :10]
+    cands = np.random.RandomState(7).randint(1, 200, (4, 20))
+    cands[2, :10] = q[0]
+    pairs = np.concatenate([np.repeat(q, 4, axis=0), cands], axis=1)
+    scores = np.asarray(ranker.predict(pairs.astype(np.int32),
+                                       batch_per_thread=4)).ravel()
+    print("candidate scores:", np.round(scores, 3),
+          "→ best:", int(np.argmax(scores)))
+
+
+if __name__ == "__main__":
+    main()

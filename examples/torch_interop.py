@@ -1,0 +1,37 @@
+"""PyTorch interop (the reference's `pyzoo/zoo/examples/pytorch/train/` via
+JEP + TorchModel; here the torch module converts into native layers whose
+weights carry over, then trains as XLA).
+
+    python examples/torch_interop.py
+"""
+
+import numpy as np
+import torch.nn as nn
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.learn.estimator import Estimator
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    torch_model = nn.Sequential(
+        nn.Linear(10, 32), nn.ReLU(),
+        nn.Linear(32, 16), nn.ReLU(),
+        nn.Linear(16, 1),
+    )
+    est = Estimator.from_torch(torch_model, loss="mse", optimizer="adam")
+
+    x = np.random.rand(512, 10).astype(np.float32)
+    y = (2 * x.mean(axis=1, keepdims=True)).astype(np.float32)
+    est.fit({"x": x, "y": y}, epochs=4, batch_size=64)
+    print("eval:", est.evaluate({"x": x, "y": y}, batch_per_thread=128))
+
+    # converted-model predictions start from the torch module's weights
+    import torch
+    with torch.no_grad():
+        ref0 = torch_model(torch.zeros(1, 10)).numpy()
+    print("torch f(0) before training:", ref0.ravel()[:1])
+
+
+if __name__ == "__main__":
+    main()

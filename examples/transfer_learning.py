@@ -1,0 +1,51 @@
+"""Transfer learning by graph surgery (the reference's
+`pyzoo/zoo/examples/nnframes/transfer/` + `Net.scala` newGraph/freeze):
+train a base model, cut it at an intermediate layer, freeze the trunk, and
+fine-tune a new head on a different task.
+
+    python examples/transfer_learning.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu import net as znet
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as L
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    # base task: 3-class problem
+    inp = Input(shape=(8,))
+    h1 = L.Dense(16, activation="relu", name="feat1")(inp)
+    h2 = L.Dense(12, activation="relu", name="feat2")(h1)
+    out = L.Dense(3, name="head")(h2)
+    base = Model(inp, out)
+    base.compile("adam", "sparse_categorical_crossentropy")
+    x = np.random.rand(256, 8).astype(np.float32)
+    y = (x.sum(axis=1) * 2).astype(np.int32) % 3
+    base.fit(x, y, batch_size=64, nb_epoch=2)
+
+    # cut at feat2 → feature extractor carrying trained weights
+    trunk = znet.new_graph(base, ["feat2"])
+    feats = np.asarray(trunk.predict(x[:4], batch_per_thread=4))
+    print("trunk features:", feats.shape)
+
+    # new binary head grafted onto the trunk output node, trunk weights
+    # carried over and frozen; only new_head trains
+    new_out = L.Dense(2, name="new_head")(h2)
+    combined = Model(inp, new_out)
+    combined.ensure_built(x[:1])
+    for name in ("feat1", "feat2"):
+        combined.params[name] = base.params[name]
+    tuned = znet.freeze(combined, ["feat1", "feat2"])
+    tuned.compile("adam", "sparse_categorical_crossentropy", ["accuracy"])
+    y2 = (x[:, 0] > 0.5).astype(np.int32)
+    tuned.fit(x, y2, batch_size=64, nb_epoch=3)
+    print("fine-tune metrics:", tuned.evaluate(x, y2, batch_per_thread=128))
+    assert set(tuned.params) == {"new_head"}
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Wide & Deep recommendation (the reference's
+`apps/recommendation-wide-n-deep/`, census-style features) on synthetic
+user/item data.
+
+    python examples/wide_and_deep.py [--model-type wide_n_deep|wide|deep]
+"""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.recommendation import WideAndDeep
+
+
+def synthetic(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    # wide: two crossed categorical features, one-hot-ish multi-hot blocks
+    gender = rng.randint(0, 2, n)
+    age_bucket = rng.randint(0, 8, n)
+    occupation = rng.randint(0, 16, n)
+    wide = np.zeros((n, 2 + 8), np.float32)
+    wide[np.arange(n), gender] = 1.0
+    wide[np.arange(n), 2 + age_bucket] = 1.0
+    indicator = np.zeros((n, 16), np.float32)
+    indicator[np.arange(n), occupation] = 1.0
+    embed_ids = np.stack([rng.randint(0, 100, n),
+                          rng.randint(0, 50, n)], axis=1).astype(np.int32)
+    continuous = rng.rand(n, 2).astype(np.float32)
+    label = ((gender + age_bucket + occupation
+              + embed_ids[:, 0] // 20) % 5).astype(np.int32)
+    return wide, indicator, embed_ids, continuous, label
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-type", default="wide_n_deep",
+                    choices=["wide_n_deep", "wide", "deep"])
+    args = ap.parse_args()
+
+    init_orca_context(cluster_mode="local")
+    wide, indicator, embed_ids, continuous, label = synthetic()
+    wnd = WideAndDeep(class_num=5, model_type=args.model_type,
+                      wide_base_dims=(2, 8), wide_cross_dims=(),
+                      indicator_dims=(16,), embed_in_dims=(100, 50),
+                      embed_out_dims=(8, 8), continuous_cols=("c0", "c1"),
+                      hidden_layers=(32, 16))
+    wnd.compile("adam", "sparse_categorical_crossentropy", ["accuracy"])
+    if args.model_type == "wide":
+        x = [wide]
+    elif args.model_type == "deep":
+        x = [indicator, embed_ids, continuous]
+    else:
+        x = [wide, indicator, embed_ids, continuous]
+    wnd.fit(x, label, batch_size=256, nb_epoch=3)
+    print("metrics:", wnd.evaluate(x, label, batch_per_thread=256))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Zouwu time-series forecasting + anomaly thresholding (the reference's
+`pyzoo/zoo/zouwu/` forecasters and ThresholdDetector).
+
+    python examples/zouwu_forecast.py [--model lstm|tcn|seq2seq|mtnet]
+"""
+
+import argparse
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.anomalydetection import ThresholdDetector
+from analytics_zoo_tpu.zouwu.forecast import (
+    LSTMForecaster, MTNetForecaster, Seq2SeqForecaster, TCNForecaster)
+
+
+def rolling(series, past, horizon=1):
+    n = len(series) - past - horizon + 1
+    x = np.stack([series[i:i + past] for i in range(n)])[..., None]
+    y = np.stack([series[i + past:i + past + horizon] for i in range(n)])
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="lstm",
+                    choices=["lstm", "tcn", "seq2seq", "mtnet"])
+    args = ap.parse_args()
+
+    init_orca_context(cluster_mode="local")
+    t = np.arange(600)
+    series = (np.sin(2 * np.pi * t / 24)
+              + 0.05 * np.random.RandomState(0).randn(600)).astype(np.float32)
+
+    past = 48
+    if args.model == "lstm":
+        fc = LSTMForecaster(past_seq_len=past, feature_dim=1,
+                            lstm_1_units=16, lstm_2_units=8)
+    elif args.model == "tcn":
+        fc = TCNForecaster(past_seq_len=past, feature_dim=1, target_dim=1)
+    elif args.model == "seq2seq":
+        fc = Seq2SeqForecaster(past_seq_len=past, feature_dim=1,
+                               target_dim=1)
+    else:
+        fc = MTNetForecaster(target_dim=1, feature_dim=1,
+                             long_series_num=4, series_length=12)
+        past = fc.past_seq_len
+
+    x, y = rolling(series, past)
+    n_train = int(len(x) * 0.8)
+    fc.fit(x[:n_train], y[:n_train], epochs=3, batch_size=64)
+    pred = fc.predict(x[n_train:]).reshape(-1)
+    truth = y[n_train:].reshape(-1)
+    print("eval:", fc.evaluate(x[n_train:], y[n_train:],
+                               metrics=("mse", "mae")))
+
+    det = ThresholdDetector(ratio=0.02)
+    det.fit(truth, pred)
+    flags = det.score(truth, pred)
+    print(f"threshold detector flagged {int(flags.sum())} points")
+
+
+if __name__ == "__main__":
+    main()

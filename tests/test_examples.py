@@ -1,0 +1,49 @@
+"""Example-suite smoke tests (the reference's pattern: shell harnesses run
+real examples end-to-end — `apps/run-app-tests*.sh`, `pyzoo/dev/run-tests`).
+Each example runs as a subprocess on the CPU backend with tiny synthetic
+data; passing = exit 0."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+EXAMPLES = [
+    ("recommendation_ncf.py", []),
+    ("anomaly_detection.py", []),
+    ("text_classification.py", []),
+    ("qa_ranker.py", []),
+    ("seq2seq_chatbot.py", []),
+    ("wide_and_deep.py", []),
+    ("image_finetune_nnframes.py", []),
+    ("object_detection.py", []),
+    ("zouwu_forecast.py", ["--model", "lstm"]),
+    ("automl_time_series.py", []),
+    ("bert_classification.py", []),
+    ("cluster_serving.py", []),
+    ("autograd_custom_loss.py", []),
+    ("transfer_learning.py", []),
+    ("distributed_training.py", []),
+    ("torch_interop.py", []),
+]
+
+
+@pytest.mark.parametrize("script,args",
+                         EXAMPLES, ids=[e[0] for e in EXAMPLES])
+def test_example_runs(script, args):
+    repo_root = os.path.abspath(os.path.join(EXAMPLES_DIR, ".."))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    path = os.path.join(EXAMPLES_DIR, script)
+    proc = subprocess.run([sys.executable, path, *args], env=env,
+                          cwd=repo_root, capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}")

@@ -78,6 +78,22 @@ class TestGANEstimator:
         np.testing.assert_allclose(out1, out2, rtol=1e-5)
         # the D/G alternation schedule resumes where the snapshot left off
         assert est2._counter == 4
+        # optimizer moments were saved and pour back in on continue
+        assert est2._opt_tree is not None
+        est2.train(_real_data(64), _noise, batch_size=32, end_iteration=2)
+        assert est2._opt_tree is None
+        assert est2._counter == 6
+
+    def test_continued_training_version_monotonic(self, tmp_path):
+        gen, disc = _nets()
+        est = GANEstimator(gen, disc, model_dir=str(tmp_path))
+        est.train(_real_data(64), _noise, batch_size=32, end_iteration=5)
+        # second call on the same estimator continues the cumulative count,
+        # so its snapshot version is HIGHER than the first run's
+        est.train(_real_data(64), _noise, batch_size=32, end_iteration=3)
+        from analytics_zoo_tpu.learn.checkpoint import latest_checkpoint
+        _, version = latest_checkpoint(str(tmp_path))
+        assert version == 8
 
     def test_bad_steps_raise(self):
         gen, disc = _nets()

@@ -306,15 +306,29 @@ class TestNormResizeSample:
         y = L.ResizeBilinear(8, 6).call({}, x)
         assert y.shape == (2, 8, 6, 3)
 
+    def test_resize_bilinear_align_corners(self):
+        tf = _tf()
+        x = np.random.rand(1, 3, 5, 2).astype(np.float32)
+        ref = tf.compat.v1.image.resize_bilinear(
+            x, (7, 9), align_corners=True).numpy()
+        y = np.asarray(L.ResizeBilinear(7, 9, align_corners=True)
+                       .call({}, x))
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+        # corners map exactly
+        np.testing.assert_allclose(y[0, 0, 0], x[0, 0, 0], rtol=1e-6)
+        np.testing.assert_allclose(y[0, -1, -1], x[0, -1, -1], rtol=1e-6)
+
     def test_gaussian_sampler(self):
         mean = np.zeros((4, 3), np.float32)
         log_var = np.zeros((4, 3), np.float32)
         out = L.GaussianSampler().call(
-            {}, [mean, log_var], rng=jax.random.PRNGKey(0))
+            {}, [mean, log_var], training=True, rng=jax.random.PRNGKey(0))
         assert out.shape == (4, 3)
         assert np.std(np.asarray(out)) > 0.1
         det = L.GaussianSampler().call({}, [mean, log_var])
         np.testing.assert_array_equal(np.asarray(det), mean)
+        with pytest.raises(ValueError, match="rng"):
+            L.GaussianSampler().call({}, [mean, log_var], training=True)
 
 
 class TestTorchStyle:
