@@ -1,0 +1,64 @@
+"""Elasticsearch connector.
+
+Reference: `pyzoo/zoo/orca/data/elastic_search.py:22-94` (`read_df`,
+`write_df`, `read_rdd` over the ES-Hadoop Spark connector). Here the
+official `elasticsearch` python client plays that role; the environment
+does not bundle it, so every entry point degrades to a clear ImportError
+(same shape as the reference, which needs the es-hadoop jar on the
+classpath).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import pandas as pd
+
+
+def _client(es_config: Dict):
+    try:
+        from elasticsearch import Elasticsearch
+    except ImportError as e:
+        raise ImportError(
+            "elastic_search needs the `elasticsearch` python package "
+            "(the reference likewise needs the es-hadoop connector jar)"
+        ) from e
+    hosts = es_config.get("hosts") or [
+        f"http://{es_config.get('host', 'localhost')}:"
+        f"{es_config.get('port', 9200)}"]
+    kwargs = {}
+    if es_config.get("user"):
+        kwargs["basic_auth"] = (es_config["user"],
+                                es_config.get("password", ""))
+    return Elasticsearch(hosts, **kwargs)
+
+
+class elastic_search:  # noqa: N801 — reference spelling
+    """`elastic_search.read_df/write_df` (elastic_search.py:32,77)."""
+
+    @staticmethod
+    def read_df(es_config: Dict, es_resource: str,
+                query: Optional[Dict] = None,
+                size: int = 10000) -> pd.DataFrame:
+        es = _client(es_config)
+        body = {"query": query or {"match_all": {}}, "size": size}
+        res = es.search(index=es_resource, body=body)
+        rows = [hit["_source"] for hit in res["hits"]["hits"]]
+        return pd.json_normalize(rows)
+
+    @staticmethod
+    def write_df(es_config: Dict, es_resource: str,
+                 df: pd.DataFrame) -> int:
+        es = _client(es_config)
+        n = 0
+        for _, row in df.iterrows():
+            es.index(index=es_resource, document=row.to_dict())
+            n += 1
+        return n
+
+    @staticmethod
+    def flatten_df(df: pd.DataFrame) -> pd.DataFrame:
+        """`flatten_df` (elastic_search.py:57): expand nested dict columns
+        into dotted top-level columns."""
+        flat = pd.json_normalize(df.to_dict(orient="records"))
+        return flat

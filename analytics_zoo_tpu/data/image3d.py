@@ -1,0 +1,141 @@
+"""3-D image (volume) preprocessing.
+
+Reference: `zoo/.../feature/image3d/` (Affine.scala, Cropper.scala,
+Rotation.scala) and the python mirror
+`pyzoo/zoo/feature/image3d/transformation.py:37-102` (Crop3D, RandomCrop3D,
+CenterCrop3D, Rotate3D, AffineTransform3D). Volumes are [D, H, W] or
+[D, H, W, C] float arrays; transforms run host-side per record (the same
+place the reference runs them — inside the data pipeline, not the model).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.data.image import ImageProcessing
+
+
+def _split_channels(vol: np.ndarray):
+    if vol.ndim == 3:
+        return vol[..., None], True
+    return vol, False
+
+
+class ImageProcessing3D(ImageProcessing):
+    """Marker base (`ImagePreprocessing3D`, transformation.py:29)."""
+
+
+class Crop3D(ImageProcessing3D):
+    """`Crop3D(start, patch_size)` (transformation.py:37): crop
+    patch_size = [d, h, w] starting at start = [d0, h0, w0]."""
+
+    def __init__(self, start: Sequence[int], patch_size: Sequence[int]):
+        self.start = tuple(int(v) for v in start)
+        self.patch_size = tuple(int(v) for v in patch_size)
+
+    def apply(self, vol: np.ndarray) -> np.ndarray:
+        d0, h0, w0 = self.start
+        d, h, w = self.patch_size
+        if d0 + d > vol.shape[0] or h0 + h > vol.shape[1] \
+                or w0 + w > vol.shape[2]:
+            raise ValueError(
+                f"Crop {self.start}+{self.patch_size} exceeds volume "
+                f"shape {vol.shape[:3]}")
+        return vol[d0:d0 + d, h0:h0 + h, w0:w0 + w]
+
+
+class CenterCrop3D(ImageProcessing3D):
+    def __init__(self, crop_depth: int, crop_height: int, crop_width: int):
+        self.size = (crop_depth, crop_height, crop_width)
+
+    def apply(self, vol: np.ndarray) -> np.ndarray:
+        starts = [(s - c) // 2 for s, c in zip(vol.shape[:3], self.size)]
+        return Crop3D(starts, self.size).apply(vol)
+
+
+class RandomCrop3D(ImageProcessing3D):
+    def __init__(self, crop_depth: int, crop_height: int, crop_width: int,
+                 seed: Optional[int] = None):
+        self.size = (crop_depth, crop_height, crop_width)
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, vol: np.ndarray) -> np.ndarray:
+        starts = [self.rng.randint(0, s - c + 1)
+                  for s, c in zip(vol.shape[:3], self.size)]
+        return Crop3D(starts, self.size).apply(vol)
+
+
+class AffineTransform3D(ImageProcessing3D):
+    """`AffineTransform3D(affine_mat, translation, clamp_mode)`
+    (transformation.py:88 / Affine.scala): resample the volume through an
+    affine map around the volume center with trilinear interpolation.
+    clamp_mode 'clamp' edge-extends; 'padding' fills with pad_value."""
+
+    def __init__(self, affine_mat: np.ndarray,
+                 translation: Optional[np.ndarray] = None,
+                 clamp_mode: str = "clamp", pad_value: float = 0.0):
+        self.mat = np.asarray(affine_mat, np.float64).reshape(3, 3)
+        self.translation = (np.zeros(3) if translation is None
+                            else np.asarray(translation, np.float64))
+        if clamp_mode not in ("clamp", "padding"):
+            raise ValueError(f"Unsupported clamp_mode: {clamp_mode}")
+        self.clamp_mode = clamp_mode
+        self.pad_value = float(pad_value)
+
+    def apply(self, vol: np.ndarray) -> np.ndarray:
+        v, squeeze = _split_channels(np.asarray(vol, np.float32))
+        D, H, W, C = v.shape
+        center = (np.asarray([D, H, W], np.float64) - 1.0) / 2.0
+        # output grid coords → source coords: src = A·(dst−c) + c + t
+        dz, dy, dx = np.meshgrid(np.arange(D), np.arange(H), np.arange(W),
+                                 indexing="ij")
+        dst = np.stack([dz, dy, dx], axis=-1).reshape(-1, 3).astype(
+            np.float64)
+        src = (dst - center) @ self.mat.T + center + self.translation
+
+        if self.clamp_mode == "clamp":
+            src = np.clip(src, 0, np.asarray([D - 1, H - 1, W - 1],
+                                             np.float64))
+            valid = np.ones(len(src), bool)
+        else:
+            valid = np.all((src >= 0)
+                           & (src <= [D - 1, H - 1, W - 1]), axis=1)
+            src = np.clip(src, 0, np.asarray([D - 1, H - 1, W - 1],
+                                             np.float64))
+
+        lo = np.floor(src).astype(np.int64)
+        hi = np.minimum(lo + 1, [D - 1, H - 1, W - 1])
+        f = (src - lo).astype(np.float32)
+
+        def gather(zi, yi, xi):
+            return v[zi, yi, xi]                       # [N, C]
+
+        out = np.zeros((len(src), C), np.float32)
+        for bz, wz in ((lo[:, 0], 1 - f[:, 0]), (hi[:, 0], f[:, 0])):
+            for by, wy in ((lo[:, 1], 1 - f[:, 1]), (hi[:, 1], f[:, 1])):
+                for bx, wx in ((lo[:, 2], 1 - f[:, 2]), (hi[:, 2], f[:, 2])):
+                    out += gather(bz, by, bx) * (wz * wy * wx)[:, None]
+        if self.clamp_mode == "padding":
+            out[~valid] = self.pad_value
+        out = out.reshape(D, H, W, C)
+        return out[..., 0] if squeeze else out
+
+
+class Rotate3D(AffineTransform3D):
+    """`Rotate3D(rotation_angles)` (transformation.py:75 / Rotation.scala):
+    intrinsic rotations (radians) around the z, y, x axes applied around
+    the volume center."""
+
+    def __init__(self, rotation_angles: Sequence[float],
+                 clamp_mode: str = "clamp", pad_value: float = 0.0):
+        az, ay, ax = (float(a) for a in rotation_angles)
+        cz, sz = np.cos(az), np.sin(az)
+        cy, sy = np.cos(ay), np.sin(ay)
+        cx, sx = np.cos(ax), np.sin(ax)
+        rz = np.asarray([[1, 0, 0], [0, cz, -sz], [0, sz, cz]])
+        ry = np.asarray([[cy, 0, sy], [0, 1, 0], [-sy, 0, cy]])
+        rx = np.asarray([[cx, -sx, 0], [sx, cx, 0], [0, 0, 1]])
+        super().__init__(rz @ ry @ rx, clamp_mode=clamp_mode,
+                         pad_value=pad_value)

@@ -1,0 +1,55 @@
+"""tf.data-style Dataset API over XShards.
+
+Reference: `pyzoo/zoo/orca/data/tf/data.py:124-221` — `Dataset` wraps
+XShards with lazily-composed per-shard transforms (`from_tensor_slices`,
+`map`), consumed by the estimators. Here the composed pipeline resolves to
+a TPUDataset at fit/predict time.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from analytics_zoo_tpu.data.shards import XShards
+
+
+class Dataset:
+    """Lazy per-element transform pipeline over sharded data."""
+
+    def __init__(self, xshards: XShards, transforms=None):
+        self.xshards = xshards
+        self.transforms = list(transforms or [])
+
+    @staticmethod
+    def from_tensor_slices(xshards: XShards) -> "Dataset":
+        """`Dataset.from_tensor_slices` (data.py:190): elements are rows of
+        the shards' arrays/dicts/tuples."""
+        if not isinstance(xshards, XShards):
+            xshards = XShards.partition(xshards)
+        return Dataset(xshards)
+
+    def map(self, map_func: Callable) -> "Dataset":
+        """`map` (data.py:193): per-element transform, applied lazily."""
+        return Dataset(self.xshards, self.transforms + [map_func])
+
+    # -- materialization ---------------------------------------------------
+    def _apply(self, shard):
+        import jax
+        n = len(jax.tree_util.tree_leaves(shard)[0])
+        rows = []
+        for i in range(n):
+            row = jax.tree_util.tree_map(lambda a: a[i], shard)
+            for fn in self.transforms:
+                row = fn(row)
+            rows.append(row)
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *rows)
+
+    def to_xshards(self) -> XShards:
+        return self.xshards.transform_shard(self._apply)
+
+    def to_dataset(self, batch_size: int = -1, batch_per_thread: int = -1):
+        from analytics_zoo_tpu.data.dataset import TPUDataset
+        return TPUDataset.from_xshards(self.to_xshards(), batch_size,
+                                       batch_per_thread)

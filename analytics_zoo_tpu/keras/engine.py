@@ -186,7 +186,24 @@ class KerasNet:
         from analytics_zoo_tpu.ops import metrics as zmetrics
         from analytics_zoo_tpu.ops import objectives, optimizers
         loss_str = loss if isinstance(loss, str) else None
-        self.loss = objectives.get(loss)
+        if isinstance(loss, (list, tuple)):
+            # Keras multi-output contract: one loss per output, summed
+            fns = [objectives.get(l) for l in loss]
+
+            def _combined(y_true, y_pred):
+                if not isinstance(y_pred, (list, tuple)) \
+                        or len(y_pred) != len(fns):
+                    n = len(y_pred) if isinstance(y_pred, (list, tuple)) \
+                        else 1
+                    raise ValueError(
+                        f"compile() got {len(fns)} losses but the model "
+                        f"produces {n} output(s)")
+                return sum(fn(t, p)
+                           for fn, t, p in zip(fns, y_true, y_pred))
+
+            self.loss = _combined
+        else:
+            self.loss = objectives.get(loss)
         self.optimizer = optimizers.get(optimizer)
         self.metrics = zmetrics.resolve(metrics, loss_str)
 

@@ -176,11 +176,14 @@ class GANEstimator:
         dp = mesh.data_parallel_size if mesh else 1
         trainer.check_global_batch(batch_size, dp)
 
-        rng = jax.random.PRNGKey(seed)
+        # fold the cumulative counter into every stream so resumed /
+        # continued training sees fresh noise and shuffle order
+        base_seed = seed + self._counter
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._counter)
         rng, init_rng = jax.random.split(rng)
-        noise0 = noise_fn(batch_size, seed)
+        noise0 = noise_fn(batch_size, base_seed)
         real_iter = trainer.iter_batches(real_data, None, batch_size,
-                                         shuffle=True, seed=seed)
+                                         shuffle=True, seed=base_seed)
         real0 = next(iter(trainer.iter_batches(real_data, None, batch_size)))[0]
         self._ensure_built(noise0, real0, init_rng)
 
@@ -208,9 +211,9 @@ class GANEstimator:
                 real_b = next(real_iter)[0]
             except StopIteration:
                 real_iter = trainer.iter_batches(real_data, None, batch_size,
-                                                 shuffle=True, seed=seed + it)
+                                                 shuffle=True, seed=base_seed + it)
                 real_b = next(real_iter)[0]
-            noise_b = noise_fn(batch_size, seed + 1 + it)
+            noise_b = noise_fn(batch_size, base_seed + 1 + it)
             real_b = trainer._put_batch(real_b, mesh)
             noise_b = trainer._put_batch(noise_b, mesh)
             rng, step_rng = jax.random.split(rng)

@@ -1,0 +1,37 @@
+"""Single-host trainer (no mesh/context required).
+
+Reference: `zoo/.../pipeline/estimator/LocalEstimator.scala` — a
+single-JVM multi-thread trainer used by the `localEstimator` examples;
+here a thin single-device wrapper over the Keras engine fit loop (XLA's
+intra-op threading plays the multi-thread role).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.keras.engine import KerasNet
+
+
+class LocalEstimator:
+    """`LocalEstimator(model, criterion, optimizer)` then
+    `fit(x, y, epochs, batch_size)` / `evaluate` / `predict`."""
+
+    def __init__(self, model: KerasNet, criterion: Any = "mse",
+                 optimizer: Any = "sgd",
+                 metrics: Optional[Sequence] = None):
+        self.model = model
+        self.model.compile(optimizer, criterion, metrics)
+
+    def fit(self, x, y, epochs: int = 1, batch_size: int = 32,
+            validation_data=None) -> Dict[str, list]:
+        return self.model.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+                              validation_data=validation_data)
+
+    def evaluate(self, x, y, batch_size: int = 32) -> Dict[str, float]:
+        return self.model.evaluate(x, y, batch_per_thread=batch_size)
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        return np.asarray(self.model.predict(x, batch_per_thread=batch_size))

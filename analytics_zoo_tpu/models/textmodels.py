@@ -1,0 +1,196 @@
+"""Named-entity / POS / intent models (TFPark text.keras equivalents).
+
+Reference: `pyzoo/zoo/tfpark/text/keras/` — NER (`ner.py:21`, BiLSTM-CRF
+over word + char features), SequenceTagger (`pos_tagging.py:21`, 3×BiLSTM
+with dual pos/chunk heads), IntentEntity (`intent_extraction.py:21`, joint
+intent classification + slot filling). There the architectures come from
+nlp-architect Keras models driven through TFPark; here they are built
+directly on the native layer library — same input/output contracts:
+
+- word indices [B, S]; char indices [B, S, W] (chars per word)
+- NER → tags [B, S, num_entities]
+- SequenceTagger → (pos [B, S, P], chunk [B, S, C])
+- IntentEntity → (intent [B, I], tags [B, S, E])
+
+`crf_mode`: the tag head emits scores; CRF training/decoding uses
+`ops.crf.crf_loss` / `viterbi_decode` with the model's `transitions` param.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.ops import crf as crf_ops
+
+
+def _char_feature(chars_in, char_vocab: int, char_emb: int, lstm_dim: int,
+                  name: str):
+    """[B, S, W] → per-word char BiLSTM feature [B, S, 2·lstm_dim]."""
+    emb = L.Embedding(char_vocab, char_emb, name=f"{name}_char_emb")(
+        chars_in)
+    return L.TimeDistributed(
+        L.Bidirectional(L.LSTM(lstm_dim, name=f"{name}_char_lstm")),
+        name=f"{name}_char_td")(emb)
+
+
+class NER(ZooModel):
+    """`ner.py:21`: word + char features → 2×BiLSTM tagger → entity
+    scores. `crf_mode='reg'` adds a learnable transitions matrix used by
+    `crf_loss`/`decode`."""
+
+    def __init__(self, num_entities: int, word_vocab_size: int,
+                 char_vocab_size: int, word_length: int = 12,
+                 word_emb_dim: int = 100, char_emb_dim: int = 30,
+                 tagger_lstm_dim: int = 100, dropout: float = 0.5,
+                 crf_mode: str = "reg"):
+        super().__init__()
+        if crf_mode not in ("reg", "pad"):
+            raise ValueError(f"Unsupported crf_mode: {crf_mode}")
+        self._config = dict(num_entities=num_entities,
+                            word_vocab_size=word_vocab_size,
+                            char_vocab_size=char_vocab_size,
+                            word_length=word_length,
+                            word_emb_dim=word_emb_dim,
+                            char_emb_dim=char_emb_dim,
+                            tagger_lstm_dim=tagger_lstm_dim,
+                            dropout=dropout, crf_mode=crf_mode)
+        self.num_entities = num_entities
+        self.crf_mode = crf_mode
+        words = Input(shape=(None,))
+        chars = Input(shape=(None, word_length))
+        w = L.Embedding(word_vocab_size, word_emb_dim,
+                        name="word_emb")(words)
+        c = _char_feature(chars, char_vocab_size, char_emb_dim,
+                          char_emb_dim, "ner")
+        feats = L.merge([w, c], mode="concat", concat_axis=-1)
+        feats = L.Dropout(dropout, name="ner_drop")(feats)
+        h = L.Bidirectional(L.LSTM(tagger_lstm_dim, return_sequences=True,
+                                   name="tagger1"))(feats)
+        h = L.Bidirectional(L.LSTM(tagger_lstm_dim, return_sequences=True,
+                                   name="tagger2"))(h)
+        scores = L.TimeDistributed(
+            L.Dense(num_entities, name="tag_dense"), name="tag_td")(h)
+        self.model = Model([words, chars], scores)
+        self._transitions: Optional[np.ndarray] = None
+
+    @property
+    def transitions(self) -> np.ndarray:
+        if self._transitions is None:
+            self._transitions = np.zeros(
+                (self.num_entities, self.num_entities), np.float32)
+        return self._transitions
+
+    @transitions.setter
+    def transitions(self, v):
+        self._transitions = np.asarray(v, np.float32)
+
+    def crf_loss(self, x, tags, mask=None) -> float:
+        """Exact CRF NLL of `tags` under the current emissions."""
+        emissions = self.model.predict(x, batch_per_thread=len(tags))
+        return float(crf_ops.crf_loss(np.asarray(emissions), tags,
+                                      self.transitions, mask))
+
+    def decode(self, x, mask=None) -> np.ndarray:
+        """Viterbi-decode tag paths (CRF head); emissions argmax when
+        transitions are zero degenerates to per-step argmax."""
+        emissions = np.asarray(self.model.predict(
+            x, batch_per_thread=len(x[0]) if isinstance(x, list) else
+            len(x)))
+        tags, _ = crf_ops.viterbi_decode(emissions, self.transitions, mask)
+        return np.asarray(tags)
+
+
+class SequenceTagger(ZooModel):
+    """`pos_tagging.py:21`: 3 stacked BiLSTMs; softmax pos head + chunk
+    head conditioned on the pos features (nlp-architect chunker shape)."""
+
+    def __init__(self, num_pos_labels: int, num_chunk_labels: int,
+                 word_vocab_size: int, char_vocab_size: Optional[int] = None,
+                 word_length: int = 12, feature_size: int = 100,
+                 dropout: float = 0.2, classifier: str = "softmax"):
+        super().__init__()
+        classifier = classifier.lower()
+        if classifier not in ("softmax", "crf"):
+            raise ValueError("classifier should be either softmax or crf")
+        self._config = dict(num_pos_labels=num_pos_labels,
+                            num_chunk_labels=num_chunk_labels,
+                            word_vocab_size=word_vocab_size,
+                            char_vocab_size=char_vocab_size,
+                            word_length=word_length,
+                            feature_size=feature_size, dropout=dropout,
+                            classifier=classifier)
+        words = Input(shape=(None,))
+        inputs = [words]
+        w = L.Embedding(word_vocab_size, feature_size,
+                        name="word_emb")(words)
+        feats = w
+        if char_vocab_size is not None:
+            chars = Input(shape=(None, word_length))
+            inputs.append(chars)
+            c = _char_feature(chars, char_vocab_size, feature_size // 2,
+                              feature_size // 2, "tagger")
+            feats = L.merge([w, c], mode="concat", concat_axis=-1)
+        h = feats
+        for i in range(3):
+            h = L.Bidirectional(L.LSTM(feature_size, return_sequences=True,
+                                       name=f"bilstm{i}"))(h)
+            h = L.Dropout(dropout, name=f"drop{i}")(h)
+        pos = L.TimeDistributed(
+            L.Dense(num_pos_labels, activation="softmax", name="pos_dense"),
+            name="pos_td")(h)
+        merged = L.merge([h, pos], mode="concat", concat_axis=-1)
+        chunk = L.TimeDistributed(
+            L.Dense(num_chunk_labels, activation="softmax",
+                    name="chunk_dense"), name="chunk_td")(merged)
+        self.model = Model(inputs if len(inputs) > 1 else inputs[0],
+                           [pos, chunk])
+
+
+POSTagger = SequenceTagger
+
+
+class IntentEntity(ZooModel):
+    """`intent_extraction.py:21`: joint intent + slots. Char BiLSTM word
+    features + word embeddings → tagger BiLSTM; intent head pools the
+    tagger states, entity head tags per step."""
+
+    def __init__(self, num_intents: int, num_entities: int,
+                 word_vocab_size: int, char_vocab_size: int,
+                 word_length: int = 12, word_emb_dim: int = 100,
+                 char_emb_dim: int = 30, char_lstm_dim: int = 30,
+                 tagger_lstm_dim: int = 100, dropout: float = 0.2):
+        super().__init__()
+        self._config = dict(num_intents=num_intents,
+                            num_entities=num_entities,
+                            word_vocab_size=word_vocab_size,
+                            char_vocab_size=char_vocab_size,
+                            word_length=word_length,
+                            word_emb_dim=word_emb_dim,
+                            char_emb_dim=char_emb_dim,
+                            char_lstm_dim=char_lstm_dim,
+                            tagger_lstm_dim=tagger_lstm_dim,
+                            dropout=dropout)
+        words = Input(shape=(None,))
+        chars = Input(shape=(None, word_length))
+        w = L.Embedding(word_vocab_size, word_emb_dim,
+                        name="word_emb")(words)
+        c = _char_feature(chars, char_vocab_size, char_emb_dim,
+                          char_lstm_dim, "intent")
+        feats = L.merge([w, c], mode="concat", concat_axis=-1)
+        feats = L.Dropout(dropout, name="in_drop")(feats)
+        seq = L.Bidirectional(L.LSTM(tagger_lstm_dim, return_sequences=True,
+                                     name="tagger"))(feats)
+        seq = L.Dropout(dropout, name="tag_drop")(seq)
+        intent_feat = L.GlobalMaxPooling1D()(seq)
+        intent = L.Dense(num_intents, activation="softmax",
+                         name="intent_dense")(intent_feat)
+        tags = L.TimeDistributed(
+            L.Dense(num_entities, activation="softmax", name="ent_dense"),
+            name="ent_td")(seq)
+        self.model = Model([words, chars], [intent, tags])

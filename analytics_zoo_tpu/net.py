@@ -139,6 +139,14 @@ class Net:
             return TFNet.from_frozen_graph(path, inputs, outputs)
         return TFNet.from_saved_model(path)
 
+    @staticmethod
+    def load_onnx(path: str):
+        """ONNX import (`pipeline/api/onnx/onnx_loader.py:141` analogue):
+        decode the ModelProto wire format, map ops onto native layers, pin
+        exported weights."""
+        from analytics_zoo_tpu.onnx import load_onnx
+        return load_onnx(path)
+
 
 # ---------------------------------------------------------------------------
 # Graph surgery (`NetUtils.newGraph` / `freeze`)

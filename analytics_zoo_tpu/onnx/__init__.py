@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.onnx.onnx_loader import load_onnx  # noqa: F401

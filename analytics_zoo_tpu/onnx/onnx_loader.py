@@ -1,0 +1,352 @@
+"""ONNX → native Keras-graph importer.
+
+Reference: `pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:141` + the mapper
+classes under `pyzoo/zoo/pipeline/api/onnx/mapper/` — there, ONNX nodes map
+onto Zoo Keras layers on the JVM; here they map onto the jax layer library
+and the whole imported graph jit-compiles to one XLA program.
+
+ONNX tensors are NCHW; the imported graph keeps that layout end-to-end by
+instantiating conv/pool layers with `dim_ordering="th"` so torch-exported
+weights (OIHW) and Flatten orderings stay bit-compatible. Weights from
+graph initializers are pinned into the layers' `build`.
+
+Supported ops (the set every torchvision-style classifier and the
+reference's mapper suite need): Conv, Gemm, MatMul, Add, Sub, Mul, Div,
+Relu, LeakyRelu, Elu, Sigmoid, Tanh, Softmax, LogSoftmax, MaxPool,
+AveragePool, GlobalAveragePool, GlobalMaxPool, BatchNormalization, Flatten,
+Reshape, Dropout, Identity, Concat, Constant, Unsqueeze, Squeeze, Pad.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.learn.torch_bridge import _with_weights
+from analytics_zoo_tpu.onnx import wire
+from analytics_zoo_tpu.ops.autograd import LambdaLayer
+
+# ONNX TensorProto.DataType → numpy
+_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+           7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+def _tensor_to_ndarray(t: Dict) -> np.ndarray:
+    dims = t.get("dims", [])
+    dtype = _DTYPES.get(t.get("data_type", [1])[0], np.float32)
+    if t.get("raw_data"):
+        arr = np.frombuffer(t["raw_data"][0], dtype=dtype)
+    elif t.get("float_data"):
+        arr = np.asarray(t["float_data"], np.float32)
+    elif t.get("int64_data"):
+        arr = np.asarray(t["int64_data"], np.int64)
+    elif t.get("int32_data"):
+        arr = np.asarray(t["int32_data"], np.int32)
+    elif t.get("double_data"):
+        arr = np.asarray(t["double_data"], np.float64)
+    else:
+        arr = np.zeros(dims, dtype)
+    return arr.reshape(dims) if dims else arr
+
+
+def _attrs(node: Dict) -> Dict[str, Any]:
+    out = {}
+    for a in node.get("attribute", []):
+        name = a["name"][0]
+        if a.get("ints"):
+            out[name] = list(a["ints"])
+        elif a.get("i"):
+            out[name] = a["i"][0]
+        elif a.get("floats"):
+            out[name] = list(a["floats"])
+        elif a.get("f"):
+            out[name] = a["f"][0]
+        elif a.get("s"):
+            out[name] = a["s"][0].decode("utf-8", "replace")
+        elif a.get("t"):
+            out[name] = _tensor_to_ndarray(a["t"][0])
+        else:
+            out[name] = a.get("i", [0])[0]
+    return out
+
+
+def _value_shape(vi: Dict) -> Optional[List[Optional[int]]]:
+    try:
+        tt = vi["type"][0]["tensor_type"][0]
+        dims = tt["shape"][0].get("dim", [])
+    except (KeyError, IndexError):
+        return None
+    shape: List[Optional[int]] = []
+    for d in dims:
+        if d.get("dim_value"):
+            shape.append(int(d["dim_value"][0]))
+        else:
+            shape.append(None)
+    return shape
+
+
+def _sym_pads(pads: Sequence[int], rank: int):
+    """ONNX pads = [x1_begin..xk_begin, x1_end..xk_end]."""
+    begin = pads[:rank]
+    end = pads[rank:]
+    return list(zip(begin, end))
+
+
+class _OnnxGraphBuilder:
+    def __init__(self, graph: Dict):
+        self.graph = graph
+        self.inits = {t["name"][0]: _tensor_to_ndarray(t)
+                      for t in graph.get("initializer", [])}
+        self.consts: Dict[str, np.ndarray] = dict(self.inits)
+        self.nodes: Dict[str, Any] = {}     # tensor name → symbolic Node
+        self.inputs = []
+
+    # -- helpers -----------------------------------------------------------
+    def _pool(self, node, attrs, cls, default_count_include_pad=False):
+        k = attrs.get("kernel_shape", [2, 2])
+        strides = attrs.get("strides", k)
+        pads = attrs.get("pads", [0] * 4)
+        x = self.nodes[node["input"][0]]
+        if any(pads):
+            sym = _sym_pads(pads, 2)
+            if all(a == b for a, b in sym):
+                x = L.ZeroPadding2D((sym[0][0], sym[1][0]),
+                                    dim_ordering="th")(x)
+            else:
+                raise NotImplementedError("asymmetric pool pads")
+        return cls(pool_size=tuple(k), strides=tuple(strides),
+                   border_mode="valid", dim_ordering="th")(x)
+
+    def _act(self, node, fn_name, **kw):
+        layer = {"Relu": lambda: L.Activation("relu"),
+                 "Sigmoid": lambda: L.Activation("sigmoid"),
+                 "Tanh": lambda: L.Activation("tanh"),
+                 "Softmax": lambda: L.Activation("softmax"),
+                 "LogSoftmax": lambda: L.Activation("log_softmax"),
+                 "LeakyRelu": lambda: L.LeakyReLU(kw.get("alpha", 0.01)),
+                 "Elu": lambda: L.ELU(kw.get("alpha", 1.0))}[fn_name]()
+        return layer(self.nodes[node["input"][0]])
+
+    def _binop(self, node, op):
+        a_name, b_name = node["input"][:2]
+        if b_name in self.consts and a_name in self.nodes:
+            c = self.consts[b_name].astype(np.float32)
+            fns = {"Add": lambda x: x + c, "Sub": lambda x: x - c,
+                   "Mul": lambda x: x * c, "Div": lambda x: x / c}
+            return LambdaLayer(fns[op])(self.nodes[a_name])
+        if op == "Add":
+            return L.Merge(mode="sum")([self.nodes[a_name],
+                                        self.nodes[b_name]])
+        if op == "Mul":
+            return L.Merge(mode="mul")([self.nodes[a_name],
+                                        self.nodes[b_name]])
+        if op == "Sub":
+            from analytics_zoo_tpu.keras2.layers import Subtract
+            return Subtract()([self.nodes[a_name], self.nodes[b_name]])
+        raise NotImplementedError(f"tensor-tensor {op}")
+
+    # -- op dispatch -------------------------------------------------------
+    def handle(self, node: Dict):
+        op = node["op_type"][0]
+        attrs = _attrs(node)
+        out_name = node["output"][0]
+
+        if op == "Constant":
+            self.consts[out_name] = np.asarray(attrs["value"])
+            return
+        if op in ("Identity", "Dropout"):
+            src = node["input"][0]
+            if src in self.consts:
+                self.consts[out_name] = self.consts[src]
+            else:
+                # inference-mode dropout/identity: pass-through node
+                self.nodes[out_name] = self.nodes[src]
+            return
+        if op == "Conv":
+            self.nodes[out_name] = self._conv(node, attrs)
+        elif op == "Gemm":
+            self.nodes[out_name] = self._gemm(node, attrs)
+        elif op == "MatMul":
+            self.nodes[out_name] = self._matmul(node)
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            self.nodes[out_name] = self._binop(node, op)
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softmax", "LogSoftmax"):
+            self.nodes[out_name] = self._act(node, op)
+        elif op in ("LeakyRelu", "Elu"):
+            self.nodes[out_name] = self._act(node, op,
+                                             alpha=attrs.get("alpha"))
+        elif op == "MaxPool":
+            self.nodes[out_name] = self._pool(node, attrs, L.MaxPooling2D)
+        elif op == "AveragePool":
+            self.nodes[out_name] = self._pool(node, attrs,
+                                              L.AveragePooling2D)
+        elif op == "GlobalAveragePool":
+            self.nodes[out_name] = LambdaLayer(
+                lambda x: x.mean(axis=(2, 3), keepdims=True))(
+                    self.nodes[node["input"][0]])
+        elif op == "GlobalMaxPool":
+            self.nodes[out_name] = LambdaLayer(
+                lambda x: x.max(axis=(2, 3), keepdims=True))(
+                    self.nodes[node["input"][0]])
+        elif op == "BatchNormalization":
+            self.nodes[out_name] = self._batchnorm(node, attrs)
+        elif op == "Flatten":
+            self.nodes[out_name] = L.Flatten()(
+                self.nodes[node["input"][0]])
+        elif op == "Reshape":
+            self.nodes[out_name] = self._reshape(node)
+        elif op == "Concat":
+            axis = int(attrs.get("axis", 1))
+            self.nodes[out_name] = L.Merge(mode="concat", concat_axis=axis)(
+                [self.nodes[i] for i in node["input"]])
+        elif op == "Unsqueeze":
+            axes = attrs.get("axes") or [
+                int(self.consts[node["input"][1]].reshape(-1)[0])]
+            self.nodes[out_name] = L.ExpandDim(int(axes[0]))(
+                self.nodes[node["input"][0]])
+        elif op == "Squeeze":
+            axes = attrs.get("axes") or [
+                int(self.consts[node["input"][1]].reshape(-1)[0])]
+            self.nodes[out_name] = L.Squeeze(int(axes[0]))(
+                self.nodes[node["input"][0]])
+        elif op == "Pad":
+            self.nodes[out_name] = self._pad(node, attrs)
+        else:
+            raise NotImplementedError(
+                f"ONNX op {op!r} is not supported by the importer")
+
+    def _conv(self, node, attrs):
+        w = self.inits[node["input"][1]]           # OIHW
+        b = self.inits.get(node["input"][2]) if len(node["input"]) > 2 \
+            else None
+        group = int(attrs.get("group", 1))
+        if group != 1:
+            raise NotImplementedError("grouped Conv")
+        strides = attrs.get("strides", [1, 1])
+        dilations = attrs.get("dilations", [1, 1])
+        pads = attrs.get("pads", [0, 0, 0, 0])
+        x = self.nodes[node["input"][0]]
+        if any(pads):
+            sym = _sym_pads(pads, 2)
+            if all(a == b2 for a, b2 in sym):
+                x = L.ZeroPadding2D((sym[0][0], sym[1][0]),
+                                    dim_ordering="th")(x)
+            else:
+                raise NotImplementedError("asymmetric conv pads")
+        out_ch, _, kh, kw = w.shape
+        if list(dilations) != [1, 1]:
+            layer = L.AtrousConvolution2D(
+                out_ch, kh, kw, atrous_rate=tuple(dilations),
+                subsample=tuple(strides), border_mode="valid",
+                dim_ordering="th", use_bias=b is not None)
+        else:
+            layer = L.Convolution2D(
+                out_ch, kh, kw, subsample=tuple(strides),
+                border_mode="valid", dim_ordering="th",
+                use_bias=b is not None)
+        params = {"kernel": np.transpose(w, (2, 3, 1, 0)).copy()}  # → HWIO
+        if b is not None:
+            params["bias"] = b
+        return _with_weights(layer, params)(x)
+
+    def _gemm(self, node, attrs):
+        w = self.inits[node["input"][1]]
+        b = self.inits.get(node["input"][2]) if len(node["input"]) > 2 \
+            else None
+        if int(attrs.get("transB", 0)):
+            w = w.T
+        if int(attrs.get("transA", 0)):
+            raise NotImplementedError("Gemm transA")
+        layer = L.Dense(w.shape[1], use_bias=b is not None)
+        params = {"kernel": w.copy()}
+        if b is not None:
+            params["bias"] = b
+        return _with_weights(layer, params)(self.nodes[node["input"][0]])
+
+    def _matmul(self, node):
+        a, b = node["input"][:2]
+        if b in self.inits:
+            w = self.inits[b]
+            layer = L.Dense(w.shape[-1], use_bias=False)
+            return _with_weights(layer, {"kernel": w.copy()})(self.nodes[a])
+        from analytics_zoo_tpu.ops.autograd import mm
+        raise NotImplementedError("tensor-tensor MatMul")
+
+    def _batchnorm(self, node, attrs):
+        gamma = self.inits[node["input"][1]]
+        beta = self.inits[node["input"][2]]
+        mean = self.inits[node["input"][3]]
+        var = self.inits[node["input"][4]]
+        layer = L.BatchNormalization(
+            epsilon=float(attrs.get("epsilon", 1e-5)), axis=1)
+        return _with_weights(layer, {
+            "gamma": gamma, "beta": beta,
+            "moving_mean": mean, "moving_var": var,
+        })(self.nodes[node["input"][0]])
+
+    def _reshape(self, node):
+        shape = self.consts[node["input"][1]].astype(np.int64).tolist()
+        # ONNX shape includes batch; 0 = copy input dim. Batch stays
+        # implicit in our Reshape.
+        target = [int(-1 if d == -1 else d) for d in shape[1:]]
+        return L.Reshape(tuple(target))(self.nodes[node["input"][0]])
+
+    def _pad(self, node, attrs):
+        pads = attrs.get("pads")
+        if pads is None:
+            pads = self.consts[node["input"][1]].astype(np.int64).tolist()
+        rank = len(pads) // 2
+        sym = _sym_pads(pads, rank)
+        if rank == 4 and sym[0] == (0, 0) and sym[1] == (0, 0) \
+                and all(a == b for a, b in sym[2:]):
+            return L.ZeroPadding2D((sym[2][0], sym[3][0]),
+                                   dim_ordering="th")(
+                self.nodes[node["input"][0]])
+        raise NotImplementedError(f"Pad config {pads}")
+
+    # -- assembly ----------------------------------------------------------
+    def build(self) -> Model:
+        for vi in self.graph.get("input", []):
+            name = vi["name"][0]
+            if name in self.inits:
+                continue
+            shape = _value_shape(vi)
+            if shape is None or len(shape) < 2:
+                raise ValueError(f"Graph input {name} lacks a static shape")
+            inp = Input(shape=tuple(int(d) if d else None
+                                    for d in shape[1:]))
+            self.nodes[name] = inp
+            self.inputs.append(inp)
+        for node in self.graph.get("node", []):
+            self.handle(node)
+        outs = [self.nodes[vi["name"][0]]
+                for vi in self.graph.get("output", [])]
+        model = Model(self.inputs if len(self.inputs) > 1
+                      else self.inputs[0],
+                      outs if len(outs) > 1 else outs[0])
+        return model
+
+
+def load_onnx(path_or_bytes) -> Model:
+    """Load an .onnx file (or bytes) into a native Model with the exported
+    weights pinned. Call `.predict(x)` / continue training with `compile` +
+    `fit` as usual."""
+    if isinstance(path_or_bytes, (bytes, bytearray, memoryview)):
+        blob = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as fh:
+            blob = fh.read()
+    model_msg = wire.decode(blob, wire.MODEL)
+    graph = model_msg["graph"][0]
+    model = _OnnxGraphBuilder(graph).build()
+    # materialize pinned weights immediately
+    sample = []
+    for inp in (model.inputs if isinstance(model.inputs, list)
+                else [model.inputs]):
+        shape = tuple(1 if d is None else d for d in inp.shape)
+        sample.append(np.zeros(shape, np.float32))
+    model.ensure_built(sample if len(sample) > 1 else sample[0])
+    return model

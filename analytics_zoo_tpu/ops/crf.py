@@ -1,0 +1,114 @@
+"""Linear-chain CRF ops (sequence tagging).
+
+The reference's NER model tags through nlp-architect's CRF layer
+(`pyzoo/zoo/tfpark/text/keras/ner.py:21`, crf_mode 'reg'/'pad'). Here the
+CRF is two pure functions over emission scores — both `lax.scan`s, so they
+jit and batch on TPU:
+
+- `crf_log_likelihood`: forward-algorithm partition function → exact
+  sequence log-likelihood (training loss = its negation).
+- `viterbi_decode`: max-product dynamic program → best tag path.
+
+Shapes: emissions [B, T, K], tags [B, T] int, transitions [K, K]
+(transitions[i, j] = score of moving from tag i to tag j), optional mask
+[B, T] (1 = real step) for 'pad' mode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _score_sequence(emissions, tags, transitions, mask):
+    """Unnormalized score of the given tag path."""
+    B, T, K = emissions.shape
+    emit = jnp.take_along_axis(emissions, tags[..., None],
+                               axis=2)[..., 0]          # [B, T]
+    trans = transitions[tags[:, :-1], tags[:, 1:]]      # [B, T-1]
+    emit_score = jnp.sum(emit * mask, axis=1)
+    trans_score = jnp.sum(trans * mask[:, 1:], axis=1)
+    return emit_score + trans_score
+
+
+def _log_partition(emissions, transitions, mask):
+    """Forward algorithm over time (scan), masked steps pass through."""
+    B, T, K = emissions.shape
+
+    def step(alpha, inputs):
+        emit_t, mask_t = inputs                          # [B, K], [B]
+        # alpha[b, i] + transitions[i, j] + emit[b, j] → logsumexp over i
+        scores = alpha[:, :, None] + transitions[None] + emit_t[:, None, :]
+        new_alpha = jax.scipy.special.logsumexp(scores, axis=1)
+        alpha = jnp.where(mask_t[:, None] > 0, new_alpha, alpha)
+        return alpha, None
+
+    alpha0 = emissions[:, 0]
+    xs = (jnp.swapaxes(emissions[:, 1:], 0, 1),
+          jnp.swapaxes(mask[:, 1:], 0, 1))
+    alpha, _ = jax.lax.scan(step, alpha0, xs)
+    return jax.scipy.special.logsumexp(alpha, axis=1)    # [B]
+
+
+def crf_log_likelihood(emissions, tags, transitions,
+                       mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Per-sequence log p(tags | emissions); negate for the loss."""
+    emissions = jnp.asarray(emissions)
+    tags = jnp.asarray(tags, jnp.int32)
+    if mask is None:
+        mask = jnp.ones(tags.shape, emissions.dtype)
+    else:
+        mask = jnp.asarray(mask, emissions.dtype)
+    score = _score_sequence(emissions, tags, transitions, mask)
+    log_z = _log_partition(emissions, transitions, mask)
+    return score - log_z
+
+
+def crf_loss(emissions, tags, transitions,
+             mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Mean negative log-likelihood (training objective)."""
+    return -jnp.mean(crf_log_likelihood(emissions, tags, transitions, mask))
+
+
+def viterbi_decode(emissions, transitions,
+                   mask: Optional[jnp.ndarray] = None
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Best path per sequence → (tags [B, T], score [B]). Masked (padded)
+    steps repeat the last real tag."""
+    emissions = jnp.asarray(emissions)
+    B, T, K = emissions.shape
+    if mask is None:
+        mask = jnp.ones((B, T), emissions.dtype)
+    else:
+        mask = jnp.asarray(mask, emissions.dtype)
+
+    def fwd(carry, inputs):
+        delta = carry                                     # [B, K]
+        emit_t, mask_t = inputs
+        scores = delta[:, :, None] + transitions[None]    # [B, K, K]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, K]
+        new_delta = jnp.max(scores, axis=1) + emit_t
+        delta = jnp.where(mask_t[:, None] > 0, new_delta, delta)
+        # for masked steps the backpointer is the identity
+        best_prev = jnp.where(mask_t[:, None] > 0, best_prev,
+                              jnp.arange(K)[None, :])
+        return delta, best_prev
+
+    delta0 = emissions[:, 0]
+    xs = (jnp.swapaxes(emissions[:, 1:], 0, 1),
+          jnp.swapaxes(mask[:, 1:], 0, 1))
+    delta, backptrs = jax.lax.scan(fwd, delta0, xs)       # [T-1, B, K]
+
+    last = jnp.argmax(delta, axis=1)                      # [B]
+    score = jnp.max(delta, axis=1)
+
+    def back(carry, bp_t):
+        tag = carry                                       # [B]
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first, rev_tags = jax.lax.scan(back, last, backptrs, reverse=True)
+    tags = jnp.concatenate([first[None], rev_tags], axis=0)   # [T, B]
+    return jnp.swapaxes(tags, 0, 1), score

@@ -94,4 +94,6 @@ class OutputQueue:
     def _decode(raw: str):
         if raw == "NaN":   # per-record failure marker
             return float("nan")
+        if raw.startswith("["):  # filtered result string, e.g. topN(5)
+            return raw
         return decode_ndarray(json.loads(raw))

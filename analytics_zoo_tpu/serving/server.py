@@ -34,11 +34,19 @@ class ClusterServing:
     def __init__(self, model: InferenceModel,
                  broker: Union[Broker, str, None] = None,
                  stream: str = "serving_stream",
-                 batch_size: int = 32, batch_timeout_ms: int = 5):
+                 batch_size: int = 32, batch_timeout_ms: int = 5,
+                 output_filter: Optional[str] = None):
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self.stream = stream
+        # e.g. "topN(5)" — the reference's PostProcessing filter grammar;
+        # validated here so a bad spec fails at construction, not as
+        # per-record NaNs mid-stream
+        if output_filter is not None:
+            from analytics_zoo_tpu.serving.pre_post import apply_filter
+            apply_filter(np.zeros(2, np.float32), output_filter)
+        self.output_filter = output_filter
         self.result_key = f"result:{stream}"
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
@@ -78,6 +86,7 @@ class ClusterServing:
 
     def _process(self, records):
         # decode; per-record decode failure -> NaN without killing the batch
+        from analytics_zoo_tpu.serving.pre_post import decode_record_field
         decoded = []
         for rid, rec in records:
             try:
@@ -85,7 +94,8 @@ class ClusterServing:
                 # single-tensor fast path: field "t" or "image"
                 field = "t" if "t" in data else ("image" if "image" in data
                                                  else next(iter(data)))
-                decoded.append((rec["uri"], decode_ndarray(data[field])))
+                decoded.append((rec["uri"],
+                                decode_record_field(data[field])))
             except Exception as e:  # noqa: BLE001 — degrade per record
                 log.warning("decode failure for %s: %s", rec.get("uri"), e)
                 self.broker.hset(self.result_key, rec.get("uri", rid), "NaN")
@@ -101,9 +111,14 @@ class ClusterServing:
             try:
                 preds = self.model.predict(batch)
                 for (uri, _), pred in zip(items, preds):
-                    self.broker.hset(
-                        self.result_key, uri,
-                        json.dumps(encode_ndarray(np.asarray(pred))))
+                    if self.output_filter:
+                        from analytics_zoo_tpu.serving.pre_post import \
+                            apply_filter
+                        value = apply_filter(np.asarray(pred),
+                                             self.output_filter)
+                    else:
+                        value = json.dumps(encode_ndarray(np.asarray(pred)))
+                    self.broker.hset(self.result_key, uri, value)
             except Exception as e:  # noqa: BLE001 — stream must survive
                 log.error("inference failure for batch %s: %s", shape, e)
                 for uri, _ in items:

@@ -1,0 +1,97 @@
+"""Parquet image dataset + tf-style Dataset + ES gating tests
+(reference: `pyzoo/test/zoo/orca/data/`)."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.parquet_dataset import (
+    ParquetDataset, SchemaField, write_mnist, write_ndarrays)
+from analytics_zoo_tpu.data.shards import XShards
+from analytics_zoo_tpu.data.tf_style import Dataset
+
+
+class TestParquetDataset:
+    def test_write_read_roundtrip(self, tmp_path):
+        rs = np.random.RandomState(0)
+        images = rs.rand(25, 8, 8, 3).astype(np.float32)
+        labels = rs.randint(0, 10, 25).astype(np.int64)
+        path = write_ndarrays(images, labels, str(tmp_path / "ds"),
+                              block_size=10)
+        shards = ParquetDataset.read_as_xshards(path)
+        assert shards.num_partitions() == 3    # 10 + 10 + 5
+        merged = np.concatenate([s["image"] for s in shards.collect()])
+        np.testing.assert_allclose(merged, images, rtol=1e-6)
+
+    def test_read_as_dataset(self, tmp_path):
+        images = np.random.rand(12, 4, 4, 1).astype(np.float32)
+        labels = np.arange(12).astype(np.int64)
+        path = write_ndarrays(images, labels, str(tmp_path / "ds"))
+        ds = ParquetDataset.read_as_dataset(path, batch_per_thread=4)
+        assert ds is not None
+
+    def test_overwrite_and_error_modes(self, tmp_path):
+        p = str(tmp_path / "ds")
+        write_ndarrays(np.zeros((4, 2, 2, 1), np.float32),
+                       np.zeros(4, np.int64), p)
+        write_ndarrays(np.zeros((4, 2, 2, 1), np.float32),
+                       np.zeros(4, np.int64), p)  # overwrite default
+        with pytest.raises(FileExistsError):
+            ParquetDataset.write(p, iter([]), {}, write_mode="error")
+
+    def test_scalar_fields(self, tmp_path):
+        schema = {"t": SchemaField((3,), np.float32)}
+        recs = [{"t": np.ones(3), "name": f"r{i}"} for i in range(5)]
+        path = ParquetDataset.write(str(tmp_path / "ds"), iter(recs),
+                                    schema)
+        shard = ParquetDataset.read_as_xshards(path).collect()[0]
+        assert list(shard["name"][:2]) == ["r0", "r1"]
+        assert shard["t"].shape == (5, 3)
+
+    def test_write_mnist(self, tmp_path):
+        rs = np.random.RandomState(1)
+        images = rs.randint(0, 255, (6, 28, 28), np.uint8)
+        labels = rs.randint(0, 10, 6).astype(np.uint8)
+        img_path = str(tmp_path / "img.gz")
+        lab_path = str(tmp_path / "lab.gz")
+        with gzip.open(img_path, "wb") as f:
+            f.write((2051).to_bytes(4, "big") + (6).to_bytes(4, "big")
+                    + (28).to_bytes(4, "big") + (28).to_bytes(4, "big")
+                    + images.tobytes())
+        with gzip.open(lab_path, "wb") as f:
+            f.write((2049).to_bytes(4, "big") + (6).to_bytes(4, "big")
+                    + labels.tobytes())
+        path = write_mnist(img_path, lab_path, str(tmp_path / "mnist"))
+        shard = ParquetDataset.read_as_xshards(path).collect()[0]
+        np.testing.assert_array_equal(
+            shard["image"].reshape(6, 28, 28), images)
+        np.testing.assert_array_equal(shard["label"], labels)
+
+
+class TestTFStyleDataset:
+    def test_from_tensor_slices_map(self):
+        data = {"x": np.arange(10, dtype=np.float32),
+                "y": np.arange(10, dtype=np.float32) * 2}
+        shards = XShards.partition(data, num_shards=2)
+        ds = (Dataset.from_tensor_slices(shards)
+              .map(lambda row: {"x": row["x"] + 1.0, "y": row["y"]}))
+        out = ds.to_xshards().collect()
+        allx = np.concatenate([s["x"] for s in out])
+        np.testing.assert_allclose(np.sort(allx),
+                                   np.arange(10) + 1.0)
+
+    def test_to_dataset(self):
+        data = {"x": np.random.rand(8, 3).astype(np.float32),
+                "y": np.random.rand(8, 1).astype(np.float32)}
+        ds = Dataset.from_tensor_slices(XShards.partition(data, 2))
+        tpu_ds = ds.to_dataset(batch_per_thread=4)
+        assert tpu_ds is not None
+
+
+class TestElasticSearchGate:
+    def test_clear_import_error(self):
+        from analytics_zoo_tpu.data.elastic_search import elastic_search
+        with pytest.raises(ImportError, match="elasticsearch"):
+            elastic_search.read_df({"host": "localhost"}, "idx")

@@ -1,0 +1,85 @@
+"""image3d transform tests (reference:
+`pyzoo/test/zoo/feature/image3d/`, Scala `image3d` specs)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data.image3d import (
+    AffineTransform3D, CenterCrop3D, Crop3D, RandomCrop3D, Rotate3D)
+
+
+@pytest.fixture()
+def vol():
+    return np.random.RandomState(0).rand(7, 9, 11).astype(np.float32)
+
+
+class TestCrop:
+    def test_crop3d(self, vol):
+        out = Crop3D([1, 2, 3], [4, 5, 6]).apply(vol)
+        np.testing.assert_array_equal(out, vol[1:5, 2:7, 3:9])
+
+    def test_crop_out_of_bounds_raises(self, vol):
+        with pytest.raises(ValueError, match="exceeds"):
+            Crop3D([5, 0, 0], [4, 2, 2]).apply(vol)
+
+    def test_center_crop(self, vol):
+        out = CenterCrop3D(3, 5, 7).apply(vol)
+        np.testing.assert_array_equal(out, vol[2:5, 2:7, 2:9])
+
+    def test_random_crop_shape_and_bounds(self, vol):
+        rc = RandomCrop3D(3, 4, 5, seed=0)
+        for _ in range(5):
+            out = rc.apply(vol)
+            assert out.shape == (3, 4, 5)
+
+    def test_channels_preserved(self):
+        v = np.random.rand(6, 6, 6, 2).astype(np.float32)
+        assert Crop3D([0, 0, 0], [3, 3, 3]).apply(v).shape == (3, 3, 3, 2)
+
+
+class TestAffineRotate:
+    def test_identity_affine_exact(self, vol):
+        out = AffineTransform3D(np.eye(3)).apply(vol)
+        np.testing.assert_allclose(out, vol, rtol=1e-6, atol=1e-6)
+
+    def test_zero_rotation_exact(self, vol):
+        out = Rotate3D([0.0, 0.0, 0.0]).apply(vol)
+        np.testing.assert_allclose(out, vol, rtol=1e-6, atol=1e-6)
+
+    def test_pi_rotation_flips_hw(self):
+        v = np.random.RandomState(1).rand(5, 7, 9).astype(np.float32)
+        out = Rotate3D([np.pi, 0.0, 0.0]).apply(v)
+        np.testing.assert_allclose(out, v[:, ::-1, ::-1], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rotation_roundtrip_interior(self, vol):
+        fwd = Rotate3D([np.pi / 2, 0.0, 0.0], clamp_mode="padding")
+        # 90° about the depth axis needs square H×W to round-trip
+        v = vol[:, :9, :9]
+        once = fwd.apply(v)
+        back = Rotate3D([-np.pi / 2, 0.0, 0.0],
+                        clamp_mode="padding").apply(once)
+        # interior voxels survive the round trip
+        np.testing.assert_allclose(back[1:-1, 2:-2, 2:-2],
+                                   v[1:-1, 2:-2, 2:-2], rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_padding_mode_fills_corners(self):
+        v = np.ones((5, 9, 9), np.float32)
+        out = Rotate3D([np.pi / 4, 0.0, 0.0], clamp_mode="padding",
+                       pad_value=-7.0).apply(v)
+        assert out[0, 0, 0] == -7.0          # corner leaves the volume
+        assert out[2, 4, 4] == pytest.approx(1.0)   # center stays
+
+    def test_translation(self):
+        v = np.zeros((5, 5, 5), np.float32)
+        v[2, 2, 2] = 1.0
+        out = AffineTransform3D(np.eye(3),
+                                translation=np.asarray([1.0, 0, 0]),
+                                clamp_mode="padding").apply(v)
+        # src = dst + t → value moves to dst = src − t
+        assert out[1, 2, 2] == pytest.approx(1.0)
+
+    def test_bad_clamp_mode(self):
+        with pytest.raises(ValueError, match="clamp_mode"):
+            AffineTransform3D(np.eye(3), clamp_mode="wrap")

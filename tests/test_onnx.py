@@ -1,0 +1,248 @@
+"""ONNX importer tests (reference: `pyzoo/test/zoo/pipeline/api/onnx/` —
+per-op mapper tests against exported graphs). The environment has no onnx
+package, so fixtures are real ModelProto wire bytes built with the
+symmetric encoder in `onnx.wire`; numerics are checked against numpy."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.onnx import load_onnx
+from analytics_zoo_tpu.onnx import wire
+
+
+def _tensor(name, arr):
+    arr = np.asarray(arr)
+    dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7}[arr.dtype]
+    return {"name": [name], "dims": list(arr.shape), "data_type": [dt],
+            "raw_data": [arr.tobytes()]}
+
+
+def _vinfo(name, shape):
+    dims = [{"dim_value": [d]} if d else {"dim_param": ["N"]}
+            for d in shape]
+    return {"name": [name],
+            "type": [{"tensor_type": [{"elem_type": [1],
+                                       "shape": [{"dim": dims}]}]}]}
+
+
+def _attr_ints(name, vals):
+    return {"name": [name], "ints": list(vals), "type": [7]}
+
+
+def _attr_int(name, v):
+    return {"name": [name], "i": [v], "type": [2]}
+
+
+def _attr_float(name, v):
+    return {"name": [name], "f": [v], "type": [1]}
+
+
+def _model(graph):
+    return wire.encode({"ir_version": [8], "producer_name": ["test"],
+                        "graph": [graph],
+                        "opset_import": [{"version": [13]}]}, wire.MODEL)
+
+
+class TestWireRoundtrip:
+    def test_encode_decode_roundtrip(self):
+        msg = {"ir_version": [8], "producer_name": ["hello"],
+               "graph": [{"name": ["g"],
+                          "node": [{"op_type": ["Relu"],
+                                    "input": ["x"], "output": ["y"],
+                                    "attribute": [_attr_float("alpha", 0.5)]
+                                    }]}]}
+        blob = wire.encode(msg, wire.MODEL)
+        back = wire.decode(blob, wire.MODEL)
+        assert back["producer_name"] == ["hello"]
+        node = back["graph"][0]["node"][0]
+        assert node["op_type"] == ["Relu"]
+        assert node["attribute"][0]["f"][0] == pytest.approx(0.5)
+
+    def test_unknown_fields_skipped(self):
+        # encode with a schema containing an extra field the decoder's
+        # schema doesn't know → decoder must skip it cleanly
+        extended = dict(wire.MODEL)
+        extended[99] = ("mystery", "string")
+        blob = wire.encode({"ir_version": [8], "mystery": ["???"]},
+                           extended)
+        back = wire.decode(blob, wire.MODEL)
+        assert back["ir_version"] == [8]
+        assert "mystery" not in back
+
+    def test_packed_ints_roundtrip(self):
+        t = _tensor("t", np.arange(6, dtype=np.int64).reshape(2, 3))
+        blob = wire.encode(t, wire.TENSOR)
+        back = wire.decode(blob, wire.TENSOR)
+        assert back["dims"] == [2, 3]
+
+
+class TestOnnxOps:
+    def test_gemm_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        w = rs.randn(5, 3).astype(np.float32)   # [out, in] with transB
+        b = rs.randn(5).astype(np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 3])],
+            "output": [_vinfo("y", [0, 5])],
+            "initializer": [_tensor("w", w), _tensor("b", b)],
+            "node": [{"op_type": ["Gemm"], "input": ["x", "w", "b"],
+                      "output": ["y"],
+                      "attribute": [_attr_int("transB", 1)]}],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.randn(4, 3).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=4))
+        np.testing.assert_allclose(got, x @ w.T + b, rtol=1e-4, atol=1e-5)
+
+    def test_conv_bn_relu_pool_flatten_softmax(self):
+        rs = np.random.RandomState(1)
+        w = rs.randn(4, 2, 3, 3).astype(np.float32)      # OIHW
+        bias = rs.randn(4).astype(np.float32)
+        gamma = rs.rand(4).astype(np.float32) + 0.5
+        beta = rs.randn(4).astype(np.float32)
+        mean = rs.randn(4).astype(np.float32)
+        var = rs.rand(4).astype(np.float32) + 0.5
+        w2 = rs.randn(3, 4 * 4 * 4).astype(np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 2, 8, 8])],
+            "output": [_vinfo("y", [0, 3])],
+            "initializer": [
+                _tensor("w", w), _tensor("b", bias), _tensor("gamma", gamma),
+                _tensor("beta", beta), _tensor("mean", mean),
+                _tensor("var", var), _tensor("w2", w2)],
+            "node": [
+                {"op_type": ["Conv"], "input": ["x", "w", "b"],
+                 "output": ["c"],
+                 "attribute": [_attr_ints("kernel_shape", [3, 3]),
+                               _attr_ints("pads", [1, 1, 1, 1]),
+                               _attr_ints("strides", [1, 1])]},
+                {"op_type": ["BatchNormalization"],
+                 "input": ["c", "gamma", "beta", "mean", "var"],
+                 "output": ["bn"],
+                 "attribute": [_attr_float("epsilon", 1e-5)]},
+                {"op_type": ["Relu"], "input": ["bn"], "output": ["r"]},
+                {"op_type": ["MaxPool"], "input": ["r"], "output": ["p"],
+                 "attribute": [_attr_ints("kernel_shape", [2, 2]),
+                               _attr_ints("strides", [2, 2])]},
+                {"op_type": ["Flatten"], "input": ["p"], "output": ["f"]},
+                {"op_type": ["Gemm"], "input": ["f", "w2"], "output": ["g"],
+                 "attribute": [_attr_int("transB", 1)]},
+                {"op_type": ["Softmax"], "input": ["g"], "output": ["y"]},
+            ],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.randn(2, 2, 8, 8).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=2))
+
+        # numpy reference
+        from scipy.signal import correlate
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        conv = np.zeros((2, 4, 8, 8), np.float32)
+        for n in range(2):
+            for o in range(4):
+                acc = np.zeros((8, 8))
+                for i in range(2):
+                    acc += correlate(xp[n, i], w[o, i], mode="valid")
+                conv[n, o] = acc + bias[o]
+        bn = ((conv - mean[None, :, None, None])
+              / np.sqrt(var[None, :, None, None] + 1e-5)
+              * gamma[None, :, None, None] + beta[None, :, None, None])
+        r = np.maximum(bn, 0)
+        p = r.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+        f = p.reshape(2, -1)
+        logits = f @ w2.T
+        ref = np.exp(logits - logits.max(-1, keepdims=True))
+        ref = ref / ref.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_residual_add_and_concat(self):
+        rs = np.random.RandomState(2)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 6])],
+            "output": [_vinfo("y", [0, 12])],
+            "initializer": [],
+            "node": [
+                {"op_type": ["Relu"], "input": ["x"], "output": ["r"]},
+                {"op_type": ["Add"], "input": ["r", "x"], "output": ["a"]},
+                {"op_type": ["Concat"], "input": ["a", "x"],
+                 "output": ["y"],
+                 "attribute": [_attr_int("axis", 1)]},
+            ],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.randn(3, 6).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=4))
+        ref = np.concatenate([np.maximum(x, 0) + x, x], axis=1)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_global_avg_pool_reshape(self):
+        rs = np.random.RandomState(3)
+        shape_const = np.asarray([0, -1], np.int64)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 5, 4, 4])],
+            "output": [_vinfo("y", [0, 5])],
+            "initializer": [_tensor("shape", shape_const)],
+            "node": [
+                {"op_type": ["GlobalAveragePool"], "input": ["x"],
+                 "output": ["p"]},
+                {"op_type": ["Reshape"], "input": ["p", "shape"],
+                 "output": ["y"]},
+            ],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.randn(2, 5, 4, 4).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=2))
+        np.testing.assert_allclose(got, x.mean(axis=(2, 3)), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_constant_scalar_add(self):
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 4])],
+            "output": [_vinfo("y", [0, 4])],
+            "initializer": [_tensor("c", np.asarray([2.0], np.float32))],
+            "node": [{"op_type": ["Add"], "input": ["x", "c"],
+                      "output": ["y"]}],
+        }
+        model = load_onnx(_model(graph))
+        x = np.ones((2, 4), np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=2))
+        np.testing.assert_allclose(got, x + 2.0, rtol=1e-6)
+
+    def test_unsupported_op_raises(self):
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 4])],
+            "output": [_vinfo("y", [0, 4])],
+            "node": [{"op_type": ["Einsum"], "input": ["x"],
+                      "output": ["y"]}],
+        }
+        with pytest.raises(NotImplementedError, match="Einsum"):
+            load_onnx(_model(graph))
+
+    def test_training_continues_from_imported_weights(self):
+        rs = np.random.RandomState(4)
+        w = rs.randn(1, 4).astype(np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 4])],
+            "output": [_vinfo("y", [0, 1])],
+            "initializer": [_tensor("w", w)],
+            "node": [{"op_type": ["Gemm"], "input": ["x", "w"],
+                      "output": ["y"],
+                      "attribute": [_attr_int("transB", 1)]}],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.rand(64, 4).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True).astype(np.float32)
+        before = float(np.mean(
+            (np.asarray(model.predict(x, batch_per_thread=64)) - y) ** 2))
+        model.compile("adam", "mse")
+        model.fit(x, y, batch_size=32, nb_epoch=10)
+        after = float(np.mean(
+            (np.asarray(model.predict(x, batch_per_thread=64)) - y) ** 2))
+        assert after < before

@@ -1,0 +1,95 @@
+"""Scheduler + LocalEstimator tests (reference:
+`pyzoo/test/zoo/orca/learn/test_optimizers.py` shape)."""
+
+import numpy as np
+import optax
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.learn.local_estimator import LocalEstimator
+from analytics_zoo_tpu.learn.schedule import (
+    Default, Exponential, MultiStep, Plateau, Poly, SequentialSchedule,
+    Step, Warmup)
+
+
+class TestSchedules:
+    def test_poly(self):
+        fn = Poly(power=2.0, max_iteration=100).make(1.0)
+        assert float(fn(0)) == pytest.approx(1.0)
+        assert float(fn(50)) == pytest.approx(0.25)
+        assert float(fn(100)) == pytest.approx(0.0)
+        assert float(fn(200)) == pytest.approx(0.0)  # clipped
+
+    def test_exponential(self):
+        fn = Exponential(10, 0.5).make(1.0)
+        assert float(fn(10)) == pytest.approx(0.5)
+        assert float(fn(5)) == pytest.approx(0.5 ** 0.5)
+        stair = Exponential(10, 0.5, stair_case=True).make(1.0)
+        assert float(stair(19)) == pytest.approx(0.5)
+
+    def test_step_multistep(self):
+        fn = Step(30, 0.1).make(1.0)
+        assert float(fn(29)) == pytest.approx(1.0)
+        assert float(fn(30)) == pytest.approx(0.1)
+        assert float(fn(60)) == pytest.approx(0.01, rel=1e-4)
+        ms = MultiStep([10, 40], 0.1).make(1.0)
+        assert float(ms(5)) == pytest.approx(1.0)
+        assert float(ms(20)) == pytest.approx(0.1)
+        assert float(ms(50)) == pytest.approx(0.01, rel=1e-4)
+
+    def test_warmup_then_poly_sequential(self):
+        seq = (SequentialSchedule(iteration_per_epoch=10)
+               .add(Warmup(0.01), 5)
+               .add(Default(), 10))
+        fn = seq.make(0.1)
+        assert float(fn(0)) == pytest.approx(0.1)
+        assert float(fn(4)) == pytest.approx(0.14)
+        assert float(fn(5)) == pytest.approx(0.1)     # stage 2, fixed
+        assert float(fn(100)) == pytest.approx(0.1)
+
+    def test_schedule_drives_optax(self):
+        fn = Step(5, 0.1).make(0.5)
+        opt = optax.sgd(fn)
+        params = {"w": np.ones(3, np.float32)}
+        state = opt.init(params)
+        g = {"w": np.ones(3, np.float32)}
+        for _ in range(6):
+            updates, state = opt.update(g, state, params)
+        # 6th step uses lr 0.05
+        np.testing.assert_allclose(np.asarray(updates["w"]),
+                                   -0.05 * np.ones(3), rtol=1e-5)
+
+    def test_plateau(self):
+        p = Plateau(factor=0.5, patience=1, mode="min", base_lr=1.0)
+        assert p.on_metric(1.0) == 1.0     # first → best
+        assert p.on_metric(0.5) == 1.0     # improved
+        assert p.on_metric(0.6) == 1.0     # wait 1
+        assert p.on_metric(0.7) == 0.5     # patience exceeded → cut
+        p2 = Plateau(mode="max", base_lr=1.0, patience=0)
+        p2.on_metric(0.5)
+        assert p2.on_metric(0.9) == 1.0    # improving in max mode
+        assert p2.on_metric(0.1) == 0.1    # drop → immediate cut
+
+    def test_plateau_bad_mode(self):
+        with pytest.raises(ValueError, match="mode"):
+            Plateau(mode="sideways")
+
+
+class TestLocalEstimator:
+    def test_fit_eval_predict(self):
+        zoo.init_orca_context(cluster_mode="local")
+        try:
+            model = Sequential([L.Dense(8, input_shape=(4,),
+                                        activation="relu"), L.Dense(1)])
+            est = LocalEstimator(model, criterion="mse", optimizer="adam")
+            x = np.random.rand(64, 4).astype(np.float32)
+            y = x.sum(axis=1, keepdims=True).astype(np.float32)
+            hist = est.fit(x, y, epochs=3, batch_size=16)
+            assert hist["loss"][-1] < hist["loss"][0]
+            ev = est.evaluate(x, y)
+            assert "loss" in ev or ev
+            assert est.predict(x).shape == (64, 1)
+        finally:
+            zoo.stop_orca_context()

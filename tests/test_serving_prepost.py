@@ -1,0 +1,96 @@
+"""Serving pre/post processing tests (reference: serving
+`PreProcessing.scala` / `PostProcessing.scala` / `ArrowSerializer.scala`
+specs under `zoo/src/test/.../serving/`)."""
+
+import base64
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving.broker import MemoryBroker, encode_ndarray
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.pre_post import (
+    apply_filter, arrow_decode, arrow_encode, arrow_encode_b64,
+    decode_record_field, format_top_n, top_n)
+from analytics_zoo_tpu.serving.server import ClusterServing
+
+
+class TestArrowCodec:
+    def test_roundtrip(self):
+        arr = np.random.RandomState(0).rand(3, 4, 5).astype(np.float32)
+        out = arrow_decode(arrow_encode(arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_b64_roundtrip(self):
+        arr = np.random.RandomState(1).rand(7).astype(np.float32)
+        out = arrow_decode(arrow_encode_b64(arr))
+        np.testing.assert_array_equal(out, arr)
+
+
+class TestPrePost:
+    def test_decode_record_field_variants(self):
+        arr = np.random.RandomState(2).rand(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(
+            decode_record_field(encode_ndarray(arr)), arr)
+        np.testing.assert_array_equal(
+            decode_record_field({"arrow": arrow_encode_b64(arr)}), arr)
+        np.testing.assert_array_equal(
+            decode_record_field(arrow_encode(arr)), arr)
+        np.testing.assert_array_equal(
+            decode_record_field(arr.tolist()), arr)
+        with pytest.raises(ValueError, match="Unknown record encoding"):
+            decode_record_field({"mystery": 1})
+
+    def test_decode_image_b64(self):
+        from PIL import Image
+        import io
+        img = Image.fromarray(
+            (np.random.RandomState(3).rand(8, 8, 3) * 255).astype(np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        rec = {"image_b64": base64.b64encode(buf.getvalue()).decode()}
+        out = decode_record_field(rec)
+        assert out.shape == (8, 8, 3)
+
+    def test_top_n(self):
+        pred = np.asarray([0.1, 0.5, 0.2, 0.15, 0.05])
+        rows = top_n(pred, 3)
+        assert [i for i, _ in rows] == [1, 2, 3]
+        s = format_top_n(pred, 2)
+        assert s.startswith("[1:0.5") and s.endswith("]")
+
+    def test_apply_filter(self):
+        pred = np.asarray([0.9, 0.1])
+        assert apply_filter(pred, "topN(1)").startswith("[0:0.9")
+        with pytest.raises(ValueError, match="Unsupported serving filter"):
+            apply_filter(pred, "argmax()")
+
+
+class TestFilteredServing:
+    def test_end_to_end_topn(self):
+        import analytics_zoo_tpu as zoo
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        zoo.init_orca_context(cluster_mode="local")
+        try:
+            model = Sequential([
+                L.Dense(4, input_shape=(6,), activation="softmax")])
+            model.ensure_built(np.zeros((1, 6), np.float32))
+            infer = InferenceModel().load_keras(model)
+            broker = MemoryBroker()
+            serving = ClusterServing(infer, broker=broker, batch_size=4,
+                                     output_filter="topN(2)")
+            inq = InputQueue(broker)
+            uris = [inq.enqueue(t=np.random.rand(6).astype(np.float32))
+                    for _ in range(3)]
+            served = 0
+            while served < 3:
+                served += serving.serve_once()
+            outq = OutputQueue(broker)
+            for u in uris:
+                res = outq.query(u)
+                assert isinstance(res, str) and res.startswith("[")
+                assert len(res.strip("[]").split(",")) == 2
+        finally:
+            zoo.stop_orca_context()

@@ -1,0 +1,178 @@
+"""NER / SequenceTagger / IntentEntity + CRF op tests (reference:
+`pyzoo/test/zoo/tfpark/test_text_models.py`)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.models.textmodels import (IntentEntity, NER,
+                                                 POSTagger, SequenceTagger)
+from analytics_zoo_tpu.ops import crf
+
+
+@pytest.fixture(autouse=True)
+def ctx():
+    c = zoo.init_orca_context(cluster_mode="local")
+    yield c
+    zoo.stop_orca_context()
+
+
+def _data(n=8, s=6, w=5, wv=50, cv=20, seed=0):
+    rng = np.random.RandomState(seed)
+    words = rng.randint(0, wv, (n, s)).astype(np.int32)
+    chars = rng.randint(0, cv, (n, s, w)).astype(np.int32)
+    return words, chars
+
+
+class TestCRFOps:
+    def _brute_force(self, emissions, transitions):
+        """Enumerate all paths for tiny shapes."""
+        B, T, K = emissions.shape
+        logZ = np.zeros(B)
+        best = np.zeros((B, T), np.int64)
+        for b in range(B):
+            scores = {}
+            for path in itertools.product(range(K), repeat=T):
+                s = emissions[b, 0, path[0]]
+                for t in range(1, T):
+                    s += transitions[path[t - 1], path[t]] \
+                        + emissions[b, t, path[t]]
+                scores[path] = s
+            vals = np.asarray(list(scores.values()))
+            logZ[b] = np.log(np.sum(np.exp(vals - vals.max()))) + vals.max()
+            best[b] = list(max(scores, key=scores.get))
+        return logZ, best
+
+    def test_log_likelihood_matches_enumeration(self):
+        rng = np.random.RandomState(0)
+        em = rng.randn(3, 4, 3).astype(np.float32)
+        tr = rng.randn(3, 3).astype(np.float32)
+        tags = rng.randint(0, 3, (3, 4))
+        logZ, _ = self._brute_force(em, tr)
+        ll = np.asarray(crf.crf_log_likelihood(em, tags, tr))
+        # manual path score
+        for b in range(3):
+            s = em[b, 0, tags[b, 0]]
+            for t in range(1, 4):
+                s += tr[tags[b, t - 1], tags[b, t]] + em[b, t, tags[b, t]]
+            np.testing.assert_allclose(ll[b], s - logZ[b], rtol=1e-4)
+
+    def test_viterbi_matches_enumeration(self):
+        rng = np.random.RandomState(1)
+        em = rng.randn(4, 5, 3).astype(np.float32)
+        tr = rng.randn(3, 3).astype(np.float32)
+        _, best = self._brute_force(em, tr)
+        tags, score = crf.viterbi_decode(em, tr)
+        np.testing.assert_array_equal(np.asarray(tags), best)
+
+    def test_masked_likelihood_ignores_padding(self):
+        rng = np.random.RandomState(2)
+        em = rng.randn(2, 5, 3).astype(np.float32)
+        tr = rng.randn(3, 3).astype(np.float32)
+        tags = rng.randint(0, 3, (2, 5))
+        mask = np.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        ll_masked = np.asarray(crf.crf_log_likelihood(em, tags, tr, mask))
+        ll_short = np.asarray(crf.crf_log_likelihood(
+            em[:1, :3], tags[:1, :3], tr))
+        np.testing.assert_allclose(ll_masked[0], ll_short[0], rtol=1e-4)
+
+    def test_crf_loss_trains_transitions(self):
+        import jax
+        rng = np.random.RandomState(3)
+        em = rng.randn(4, 6, 3).astype(np.float32)
+        tags = rng.randint(0, 3, (4, 6))
+        tr0 = np.zeros((3, 3), np.float32)
+        g = jax.grad(lambda tr: crf.crf_loss(em, tags, tr))(tr0)
+        assert np.any(np.asarray(g) != 0)
+
+
+class TestNER:
+    def test_forward_and_fit(self):
+        words, chars = _data()
+        ner = NER(num_entities=4, word_vocab_size=50, char_vocab_size=20,
+                  word_length=5, word_emb_dim=16, char_emb_dim=8,
+                  tagger_lstm_dim=12)
+        tags = np.random.RandomState(1).randint(0, 4, (8, 6)).astype(
+            np.int32)
+        from analytics_zoo_tpu.ops.objectives import get as get_loss
+        ner.compile("adam", get_loss("sparse_categorical_crossentropy",
+                                     from_logits=True))
+        ner.fit([words, chars], tags, batch_size=8, nb_epoch=1)
+        out = np.asarray(ner.predict([words, chars], batch_per_thread=8))
+        assert out.shape == (8, 6, 4)
+
+    def test_crf_decode_shapes(self):
+        words, chars = _data()
+        ner = NER(num_entities=3, word_vocab_size=50, char_vocab_size=20,
+                  word_length=5, word_emb_dim=8, char_emb_dim=4,
+                  tagger_lstm_dim=6)
+        ner.model.ensure_built([words, chars])
+        ner.transitions = np.random.RandomState(0).randn(3, 3)
+        decoded = ner.decode([words, chars])
+        assert decoded.shape == (8, 6)
+        assert decoded.min() >= 0 and decoded.max() < 3
+        loss = ner.crf_loss([words, chars],
+                            np.zeros((8, 6), np.int32))
+        assert np.isfinite(loss)
+
+    def test_bad_crf_mode(self):
+        with pytest.raises(ValueError, match="crf_mode"):
+            NER(3, 10, 10, crf_mode="wild")
+
+
+class TestSequenceTagger:
+    def test_dual_heads(self):
+        words, chars = _data()
+        tagger = SequenceTagger(num_pos_labels=5, num_chunk_labels=3,
+                                word_vocab_size=50, char_vocab_size=20,
+                                word_length=5, feature_size=8)
+        pos, chunk = tagger.predict([words, chars], batch_per_thread=8)
+        assert np.asarray(pos).shape == (8, 6, 5)
+        assert np.asarray(chunk).shape == (8, 6, 3)
+        # probabilities
+        np.testing.assert_allclose(np.asarray(pos).sum(-1),
+                                   np.ones((8, 6)), rtol=1e-4)
+
+    def test_word_only_input(self):
+        words, _ = _data()
+        tagger = POSTagger(num_pos_labels=4, num_chunk_labels=2,
+                           word_vocab_size=50, feature_size=8)
+        pos, chunk = tagger.predict(words, batch_per_thread=8)
+        assert np.asarray(pos).shape == (8, 6, 4)
+
+    def test_multi_output_fit(self):
+        words, chars = _data()
+        tagger = SequenceTagger(num_pos_labels=4, num_chunk_labels=3,
+                                word_vocab_size=50, char_vocab_size=20,
+                                word_length=5, feature_size=8)
+        rng = np.random.RandomState(2)
+        pos_y = rng.randint(0, 4, (8, 6)).astype(np.int32)
+        chunk_y = rng.randint(0, 3, (8, 6)).astype(np.int32)
+        tagger.compile("adam", ["sparse_categorical_crossentropy",
+                                "sparse_categorical_crossentropy"])
+        tagger.fit([words, chars], [pos_y, chunk_y], batch_size=8,
+                   nb_epoch=1)
+
+    def test_bad_classifier(self):
+        with pytest.raises(ValueError, match="classifier"):
+            SequenceTagger(3, 2, 10, classifier="svm")
+
+
+class TestIntentEntity:
+    def test_joint_outputs_and_fit(self):
+        words, chars = _data()
+        model = IntentEntity(num_intents=3, num_entities=4,
+                             word_vocab_size=50, char_vocab_size=20,
+                             word_length=5, word_emb_dim=8, char_emb_dim=4,
+                             char_lstm_dim=4, tagger_lstm_dim=8)
+        intent, tags = model.predict([words, chars], batch_per_thread=8)
+        assert np.asarray(intent).shape == (8, 3)
+        assert np.asarray(tags).shape == (8, 6, 4)
+        rng = np.random.RandomState(3)
+        iy = rng.randint(0, 3, 8).astype(np.int32)
+        ty = rng.randint(0, 4, (8, 6)).astype(np.int32)
+        model.compile("adam", ["sparse_categorical_crossentropy",
+                               "sparse_categorical_crossentropy"])
+        model.fit([words, chars], [iy, ty], batch_size=8, nb_epoch=1)
