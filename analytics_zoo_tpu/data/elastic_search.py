@@ -49,12 +49,12 @@ class elastic_search:  # noqa: N801 — reference spelling
     @staticmethod
     def write_df(es_config: Dict, es_resource: str,
                  df: pd.DataFrame) -> int:
+        from elasticsearch import helpers
         es = _client(es_config)
-        n = 0
-        for _, row in df.iterrows():
-            es.index(index=es_resource, document=row.to_dict())
-            n += 1
-        return n
+        actions = ({"_index": es_resource, "_source": row.to_dict()}
+                   for _, row in df.iterrows())
+        ok, _ = helpers.bulk(es, actions)
+        return int(ok)
 
     @staticmethod
     def flatten_df(df: pd.DataFrame) -> pd.DataFrame:

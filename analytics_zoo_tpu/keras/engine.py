@@ -198,6 +198,12 @@ class KerasNet:
                     raise ValueError(
                         f"compile() got {len(fns)} losses but the model "
                         f"produces {n} output(s)")
+                if not isinstance(y_true, (list, tuple)) \
+                        or len(y_true) != len(fns):
+                    raise ValueError(
+                        f"multi-output loss needs a list of {len(fns)} "
+                        "label arrays (got a single array — it would zip "
+                        "batch rows, not outputs)")
                 return sum(fn(t, p)
                            for fn, t, p in zip(fns, y_true, y_pred))
 

@@ -41,12 +41,18 @@ def _dropout(rng, rate: float, x):
 def dot_product_attention(q, k, v, mask=None, dropout_rng=None,
                           dropout_rate: float = 0.0, use_flash: bool = False):
     """q,k,v: [B, H, T, Dh]; mask: additive [B, 1, 1, T] or [B,1,T,T].
-    Softmax statistics in f32 regardless of input dtype. With use_flash and
-    no attention dropout, the Pallas kernel handles TPU long sequences
-    (attention-dropout still needs materialized weights → reference path)."""
+    Softmax statistics in f32 regardless of input dtype. With use_flash the
+    Pallas kernel runs forward AND backward (custom VJP); attention dropout
+    happens inside the kernel (bits regenerated in the backward pass)."""
     no_drop = dropout_rng is None or dropout_rate == 0.0
-    if use_flash and no_drop:
-        return flash_attention(q, k, v, mask=mask)
+    if use_flash:
+        seed = None
+        if not no_drop:
+            seed = jax.random.randint(dropout_rng, (), 0, 2 ** 31 - 1)
+        return flash_attention(q, k, v, mask=mask,
+                               dropout_rate=0.0 if no_drop
+                               else dropout_rate,
+                               dropout_seed=seed)
     if no_drop:
         return _reference_attention(q, k, v, mask)
     depth = q.shape[-1]

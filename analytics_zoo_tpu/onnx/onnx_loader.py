@@ -105,18 +105,39 @@ class _OnnxGraphBuilder:
         self.inputs = []
 
     # -- helpers -----------------------------------------------------------
-    def _pool(self, node, attrs, cls, default_count_include_pad=False):
+    def _pool(self, node, attrs, cls):
         k = attrs.get("kernel_shape", [2, 2])
         strides = attrs.get("strides", k)
         pads = attrs.get("pads", [0] * 4)
         x = self.nodes[node["input"][0]]
         if any(pads):
             sym = _sym_pads(pads, 2)
-            if all(a == b for a, b in sym):
-                x = L.ZeroPadding2D((sym[0][0], sym[1][0]),
-                                    dim_ordering="th")(x)
-            else:
+            if not all(a == b for a, b in sym):
                 raise NotImplementedError("asymmetric pool pads")
+            if cls is L.AveragePooling2D \
+                    and not int(attrs.get("count_include_pad", 0)):
+                # ONNX default excludes pad zeros from the average:
+                # sum-pool(padded x) / sum-pool(padded ones)
+                ph, pw = sym[0][0], sym[1][0]
+                kk, ss = tuple(k), tuple(strides)
+
+                def avg_exclude_pad(t, ph=ph, pw=pw, kk=kk, ss=ss):
+                    import jax
+                    import jax.numpy as jnp
+                    pad_cfg = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+                    tp = jnp.pad(t, pad_cfg)
+                    cnt = jnp.pad(jnp.ones_like(t), pad_cfg)
+                    win = (1, 1) + kk
+                    st = (1, 1) + ss
+                    s = jax.lax.reduce_window(tp, 0.0, jax.lax.add, win,
+                                              st, "VALID")
+                    n = jax.lax.reduce_window(cnt, 0.0, jax.lax.add, win,
+                                              st, "VALID")
+                    return s / n
+
+                return LambdaLayer(avg_exclude_pad)(x)
+            x = L.ZeroPadding2D((sym[0][0], sym[1][0]),
+                                dim_ordering="th")(x)
         return cls(pool_size=tuple(k), strides=tuple(strides),
                    border_mode="valid", dim_ordering="th")(x)
 
@@ -137,6 +158,11 @@ class _OnnxGraphBuilder:
             fns = {"Add": lambda x: x + c, "Sub": lambda x: x - c,
                    "Mul": lambda x: x * c, "Div": lambda x: x / c}
             return LambdaLayer(fns[op])(self.nodes[a_name])
+        if a_name in self.consts and b_name in self.nodes:
+            c = self.consts[a_name].astype(np.float32)
+            fns = {"Add": lambda x: c + x, "Sub": lambda x: c - x,
+                   "Mul": lambda x: c * x, "Div": lambda x: c / x}
+            return LambdaLayer(fns[op])(self.nodes[b_name])
         if op == "Add":
             return L.Merge(mode="sum")([self.nodes[a_name],
                                         self.nodes[b_name]])
@@ -203,15 +229,19 @@ class _OnnxGraphBuilder:
             self.nodes[out_name] = L.Merge(mode="concat", concat_axis=axis)(
                 [self.nodes[i] for i in node["input"]])
         elif op == "Unsqueeze":
-            axes = attrs.get("axes") or [
-                int(self.consts[node["input"][1]].reshape(-1)[0])]
-            self.nodes[out_name] = L.ExpandDim(int(axes[0]))(
-                self.nodes[node["input"][0]])
+            axes = attrs.get("axes") or \
+                self.consts[node["input"][1]].reshape(-1).tolist()
+            node_out = self.nodes[node["input"][0]]
+            for ax in sorted(int(a) for a in axes):   # ascending keeps
+                node_out = L.ExpandDim(ax)(node_out)  # later axes valid
+            self.nodes[out_name] = node_out
         elif op == "Squeeze":
-            axes = attrs.get("axes") or [
-                int(self.consts[node["input"][1]].reshape(-1)[0])]
-            self.nodes[out_name] = L.Squeeze(int(axes[0]))(
-                self.nodes[node["input"][0]])
+            axes = attrs.get("axes") or \
+                self.consts[node["input"][1]].reshape(-1).tolist()
+            node_out = self.nodes[node["input"][0]]
+            for ax in sorted((int(a) for a in axes), reverse=True):
+                node_out = L.Squeeze(ax)(node_out)
+            self.nodes[out_name] = node_out
         elif op == "Pad":
             self.nodes[out_name] = self._pad(node, attrs)
         else:
@@ -219,8 +249,8 @@ class _OnnxGraphBuilder:
                 f"ONNX op {op!r} is not supported by the importer")
 
     def _conv(self, node, attrs):
-        w = self.inits[node["input"][1]]           # OIHW
-        b = self.inits.get(node["input"][2]) if len(node["input"]) > 2 \
+        w = self.consts[node["input"][1]]          # OIHW
+        b = self.consts.get(node["input"][2]) if len(node["input"]) > 2 \
             else None
         group = int(attrs.get("group", 1))
         if group != 1:
@@ -253,8 +283,8 @@ class _OnnxGraphBuilder:
         return _with_weights(layer, params)(x)
 
     def _gemm(self, node, attrs):
-        w = self.inits[node["input"][1]]
-        b = self.inits.get(node["input"][2]) if len(node["input"]) > 2 \
+        w = self.consts[node["input"][1]]
+        b = self.consts.get(node["input"][2]) if len(node["input"]) > 2 \
             else None
         if int(attrs.get("transB", 0)):
             w = w.T
@@ -268,18 +298,18 @@ class _OnnxGraphBuilder:
 
     def _matmul(self, node):
         a, b = node["input"][:2]
-        if b in self.inits:
-            w = self.inits[b]
+        if b in self.consts:
+            w = self.consts[b]
             layer = L.Dense(w.shape[-1], use_bias=False)
             return _with_weights(layer, {"kernel": w.copy()})(self.nodes[a])
         from analytics_zoo_tpu.ops.autograd import mm
         raise NotImplementedError("tensor-tensor MatMul")
 
     def _batchnorm(self, node, attrs):
-        gamma = self.inits[node["input"][1]]
-        beta = self.inits[node["input"][2]]
-        mean = self.inits[node["input"][3]]
-        var = self.inits[node["input"][4]]
+        gamma = self.consts[node["input"][1]]
+        beta = self.consts[node["input"][2]]
+        mean = self.consts[node["input"][3]]
+        var = self.consts[node["input"][4]]
         layer = L.BatchNormalization(
             epsilon=float(attrs.get("epsilon", 1e-5)), axis=1)
         return _with_weights(layer, {

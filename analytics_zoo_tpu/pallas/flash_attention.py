@@ -1,19 +1,34 @@
-"""Flash attention — Pallas TPU kernel with reference fallback.
+"""Flash attention — Pallas TPU kernels with reference fallback.
 
 The reference has no fused attention at all (its longest-sequence support is
 full O(L²) attention on one device, survey §5 long-context note); this module
-is part of the beyond-reference long-context capability. The Pallas kernel
-tiles Q over the grid and streams K/V blocks through VMEM with online softmax
-(the standard flash algorithm, see `/opt/skills/guides/pallas_guide.md`), so
-memory is O(block² · heads) instead of O(L²).
+is part of the beyond-reference long-context capability.
+
+Kernel structure (the canonical TPU flash shape, pallas_guide.md): the grid
+is (batch·heads, q-blocks, k-blocks) with the k axis innermost and marked
+"arbitrary", so Pallas pipelines K/V block DMAs while online-softmax state
+(acc, m, l) lives in VMEM scratch across k steps — VMEM stays O(block²)
+at any sequence length. Matmuls run in the input dtype (bf16 on the MXU)
+with f32 accumulation; softmax statistics stay f32. The backward pass is a
+custom VJP with two more kernels (dQ over q-blocks, dK/dV over k-blocks)
+recomputing weights from the saved logsumexp instead of materializing [T,T]
+— so training (BERT, ring attention shards) runs flash end-to-end.
+
+Attention dropout runs INSIDE the kernels: `pltpu.prng_seed(seed, tile)`
+reseeds per (batch·head, q-block, k-block) tile, so the backward kernels
+regenerate bit-identical masks without storing them. The softmax
+denominator uses undropped weights (dropout applies to the normalized
+weights — `drop(p)/l == drop(p/l)`), matching the semantics of dropping
+softmax output.
 
 `flash_attention` falls back to a jnp implementation when Pallas is
-unavailable for the current backend (e.g. CPU tests) — same numerics, no
-tiling.
+unavailable for the current backend (e.g. CPU tests) — same math, no
+tiling; dropout there uses jax.random (different bits, same distribution).
 """
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -21,7 +36,8 @@ import jax
 import jax.numpy as jnp
 
 
-def _reference_attention(q, k, v, mask=None):
+def _reference_attention(q, k, v, mask=None, dropout_rate: float = 0.0,
+                         dropout_key=None):
     """Exact O(L²) attention — the shared non-flash numerics (also what
     `keras.transformer.dot_product_attention` delegates to)."""
     depth = q.shape[-1]
@@ -30,6 +46,10 @@ def _reference_attention(q, k, v, mask=None):
     if mask is not None:
         scores = scores + mask
     weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and dropout_key is not None:
+        keep = 1.0 - dropout_rate
+        m = jax.random.bernoulli(dropout_key, keep, weights.shape)
+        weights = jnp.where(m, weights / keep, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
@@ -45,78 +65,318 @@ def _flash_supported(mask) -> bool:
 
 
 def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_seed: Optional[jax.Array] = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None):
     """q,k,v: [B, H, T, Dh]. mask: additive [B,1,1,T] (padding) or
-    [B,1,T,T] (full; reference path only). Returns [B, H, T, Dh]."""
+    [B,1,T,T] (full; reference path only). `dropout_rate` > 0 needs
+    `dropout_seed` (scalar int32). Differentiable (custom VJP); the mask
+    receives a zero cotangent (padding masks are data, not parameters).
+    Returns [B, H, T, Dh]."""
+    use_dropout = dropout_rate > 0.0 and dropout_seed is not None
     if not (_flash_supported(mask) or interpret):
-        return _reference_attention(q, k, v, mask)
-    return _flash_pallas(q, k, v, mask, block_q, block_k, interpret)
-
-
-def _flash_pallas(q, k, v, mask, block_q, block_k, interpret):
-    from jax.experimental import pallas as pl
-
+        key = None
+        if use_dropout:
+            key = jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.int32)
+                                     if not hasattr(dropout_seed, "dtype")
+                                     else dropout_seed)
+        return _reference_attention(q, k, v, mask,
+                                    dropout_rate if use_dropout else 0.0,
+                                    key)
     B, H, T, D = q.shape
+    if mask is None:
+        mask = jnp.zeros((B, 1, 1, T), jnp.float32)
     block = math.lcm(block_q, block_k)
     if T % block:
-        # pad sequence to the lcm of both block sizes with masked-out keys
         pad = (-T) % block
         qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
         kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
         vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        if mask is None:
-            mask = jnp.zeros((B, 1, 1, T), jnp.float32)
         maskp = jnp.pad(mask, ((0, 0), (0, 0), (0, 0), (0, pad)),
                         constant_values=-1e9)
-        out = _flash_pallas(qp, kp, vp, maskp, block_q, block_k, interpret)
+        out = flash_attention(qp, kp, vp, maskp, dropout_rate, dropout_seed,
+                              block_q, block_k, interpret)
         return out[:, :, :T]
+    seed = jnp.asarray(dropout_seed if use_dropout else 0,
+                       jnp.int32).reshape(1, 1)
+    rate = float(dropout_rate) if use_dropout else 0.0
+    return _flash(q, k, v, mask, seed, rate, block_q, block_k,
+                  bool(interpret) if interpret is not None else False)
 
-    if mask is None:
-        mask = jnp.zeros((B, 1, 1, T), jnp.float32)
+
+# ---------------------------------------------------------------------------
+# custom-VJP core (assumes T % lcm(block_q, block_k) == 0, mask [B,1,1,T])
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, mask, seed, rate, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k,
+                        interpret)
+    return out
+
+
+def _dropout_threshold(rate: float) -> int:
+    # keep iff bits >= threshold; uint32 compare
+    return min(int(rate * 2 ** 32), 2 ** 32 - 1)
+
+
+def _keep_scale(s_ref, rate, n_qb, n_kb, qi, ki, shape):
+    """Deterministic per-tile dropout scale: 1/keep where kept, 0 where
+    dropped. Identical bits in forward and both backward kernels (the tile
+    index folds (bh, qi, ki); prng_seed on this mosaic takes 2 scalars)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh = pl.program_id(0)
+    tile = (bh * n_qb + qi) * n_kb + ki
+    pltpu.prng_seed(s_ref[0, 0], tile)
+    bits = pltpu.prng_random_bits(shape)
+    keep = bits.astype(jnp.uint32) >= jnp.uint32(_dropout_threshold(rate))
+    return jnp.where(keep, 1.0 / (1.0 - rate), 0.0)
+
+
+def _fwd_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
+                o_ref, lse_ref, acc_sc, m_sc, l_sc):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, -jnp.inf)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    qb = q_ref[0]                                          # [bq, D]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    mb = m_ref[0]                                          # [1, bk]
+    scores = jnp.dot(qb, kb.T,
+                     preferred_element_type=jnp.float32) * scale + mb
+    m_prev, l_prev = m_sc[...], l_sc[...]
+    m_new = jnp.maximum(m_prev, scores.max(axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)
+    if rate > 0.0:
+        p_drop = p * _keep_scale(s_ref, rate, n_qb, n_kb, qi, ki,
+                                 (block_q, block_k))
+    else:
+        p_drop = p
+    acc_sc[...] = acc_sc[...] * alpha + jnp.dot(
+        p_drop.astype(v_ref.dtype), vb, preferred_element_type=jnp.float32)
+    m_sc[...] = m_new
+    l_sc[...] = l_prev * alpha + p.sum(axis=1, keepdims=True)
+
+    @pl.when(ki == n_kb - 1)
+    def _flush():
+        o_ref[0] = (acc_sc[...] / l_sc[...]).astype(o_ref.dtype)
+        lse_ref[0] = m_sc[...] + jnp.log(l_sc[...])        # [bq, 1]
+
+
+def _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, D = q.shape
     scale = 1.0 / math.sqrt(D)
-    n_kb = T // block_k
-
-    def kernel(q_ref, k_ref, v_ref, m_ref, o_ref):
-        # One Q block vs all K/V blocks with online softmax; 2D-shaped
-        # carries because TPU vector ops want >=2D (pallas_guide.md).
-        qb = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
-        acc = jnp.zeros((block_q, D), jnp.float32)
-        m_i = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
-        l_i = jnp.zeros((block_q, 1), jnp.float32)
-
-        def body(s, carry):
-            acc, m_i, l_i = carry
-            kb = k_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
-            vb = v_ref[0, pl.ds(s * block_k, block_k), :].astype(jnp.float32)
-            mb = m_ref[0, :, pl.ds(s * block_k, block_k)]   # [1, bk]
-            scores = qb @ kb.T + mb                         # [bq, bk]
-            m_new = jnp.maximum(m_i, scores.max(axis=1, keepdims=True))
-            alpha = jnp.exp(m_i - m_new)
-            p = jnp.exp(scores - m_new)
-            acc = acc * alpha + p @ vb
-            l_i = l_i * alpha + p.sum(axis=1, keepdims=True)
-            return acc, m_new, l_i
-
-        acc, m_i, l_i = jax.lax.fori_loop(0, n_kb, body, (acc, m_i, l_i))
-        o_ref[0] = (acc / l_i).astype(o_ref.dtype)
-
+    n_qb, n_kb = T // block_q, T // block_k
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
-    mf = jnp.repeat(mask[:, 0, :, :], H, axis=0)            # [B*H, 1, T]
+    mf = jnp.repeat(mask[:, 0, :, :], H, axis=0)           # [B*H, 1, T]
 
-    out = pl.pallas_call(
-        kernel,
-        grid=(B * H, T // block_q),
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, rate, scale, n_qb, n_kb),
+        grid=(B * H, n_qb, n_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, T), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, mf, seed)
+    out = out.reshape(B, H, T, D)
+    return out, (q, k, v, mask, seed, out, lse)
+
+
+def _dq_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
+               do_ref, lse_ref, delta_ref, dq_ref, dq_sc):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    qb = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    mb = m_ref[0]
+    dob = do_ref[0]
+    lse = lse_ref[0]                                       # [bq, 1]
+    delta = delta_ref[0]                                   # [bq, 1]
+    pnorm = jnp.exp(jnp.dot(qb, kb.T,
+                            preferred_element_type=jnp.float32)
+                    * scale + mb - lse)                    # softmax weights
+    dw = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+    if rate > 0.0:
+        dw = dw * _keep_scale(s_ref, rate, n_qb, n_kb, qi, ki,
+                              (block_q, block_k))
+    ds = pnorm * (dw - delta)                              # [bq, bk]
+    dq_sc[...] += jnp.dot(ds.astype(k_ref.dtype), kb,
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _flush():
+        dq_ref[0] = (dq_sc[...] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
+                do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    qb = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    mb = m_ref[0]                                          # [1, bk]
+    dob = do_ref[0]
+    lse = lse_ref[0]                                       # [bq, 1]
+    delta = delta_ref[0]
+    pnorm = jnp.exp(jnp.dot(qb, kb.T,
+                            preferred_element_type=jnp.float32)
+                    * scale + mb - lse)                    # [bq, bk]
+    dw = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+    if rate > 0.0:
+        keep_scale = _keep_scale(s_ref, rate, n_qb, n_kb, qi, ki,
+                                 (block_q, block_k))
+        dw = dw * keep_scale
+        dv_p = pnorm * keep_scale
+    else:
+        dv_p = pnorm
+    ds = pnorm * (dw - delta)
+    dk_sc[...] += jnp.dot(ds.T.astype(q_ref.dtype), qb,
+                          preferred_element_type=jnp.float32)
+    dv_sc[...] += jnp.dot(dv_p.T.astype(do_ref.dtype), dob,
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_qb - 1)
+    def _flush():
+        dk_ref[0] = (dk_sc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(rate, block_q, block_k, interpret, res, dout):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, mask, seed, out, lse = res
+    B, H, T, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    n_qb, n_kb = T // block_q, T // block_k
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    dof = dout.reshape(B * H, T, D)
+    mf = jnp.repeat(mask[:, 0, :, :], H, axis=0)
+    # delta[i] = rowsum(dO * O) — the softmax-jacobian diagonal term
+    delta = jnp.sum(dof.astype(jnp.float32)
+                    * out.reshape(B * H, T, D).astype(jnp.float32),
+                    axis=-1, keepdims=True)                # [BH, T, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, rate, scale, n_qb, n_kb),
+        grid=(B * H, n_qb, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        interpret=bool(interpret) if interpret is not None else False,
-    )(qf, kf, vf, mf)
-    return out.reshape(B, H, T, D)
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, mf, seed, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, rate, scale, n_qb, n_kb),
+        grid=(B * H, n_kb, n_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, mf, seed, dof, lse, delta)
+
+    shape = (B, H, T, D)
+    # padding masks are data, not parameters — zero cotangent
+    return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape),
+            jnp.zeros_like(mask), jnp.zeros_like(seed))
+
+
+def _flash_fwd_rule(q, k, v, mask, seed, rate, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k,
+                      interpret)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd)

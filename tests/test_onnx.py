@@ -213,6 +213,73 @@ class TestOnnxOps:
         got = np.asarray(model.predict(x, batch_per_thread=2))
         np.testing.assert_allclose(got, x + 2.0, rtol=1e-6)
 
+    def test_avgpool_excludes_padding(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 1, 4, 4])],
+            "output": [_vinfo("y", [0, 1, 4, 4])],
+            "node": [{"op_type": ["AveragePool"], "input": ["x"],
+                      "output": ["y"],
+                      "attribute": [_attr_ints("kernel_shape", [3, 3]),
+                                    _attr_ints("strides", [1, 1]),
+                                    _attr_ints("pads", [1, 1, 1, 1])]}],
+        }
+        model = load_onnx(_model(graph))
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        # count_include_pad=0 (default): averages of ones stay 1 at borders
+        np.testing.assert_allclose(got, np.ones((1, 1, 4, 4)), rtol=1e-5)
+
+    def test_const_first_sub(self):
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 4])],
+            "output": [_vinfo("y", [0, 4])],
+            "initializer": [_tensor("c", np.asarray([1.0], np.float32))],
+            "node": [{"op_type": ["Sub"], "input": ["c", "x"],
+                      "output": ["y"]}],
+        }
+        model = load_onnx(_model(graph))
+        x = np.full((2, 4), 0.25, np.float32)
+        np.testing.assert_allclose(
+            np.asarray(model.predict(x, batch_per_thread=2)), 1.0 - x,
+            rtol=1e-6)
+
+    def test_weights_from_constant_node(self):
+        rs = np.random.RandomState(5)
+        w = rs.randn(3, 4).astype(np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 4])],
+            "output": [_vinfo("y", [0, 3])],
+            "node": [
+                {"op_type": ["Constant"], "input": [], "output": ["w"],
+                 "attribute": [{"name": ["value"], "t": [_tensor("w", w)],
+                                "type": [4]}]},
+                {"op_type": ["Gemm"], "input": ["x", "w"], "output": ["y"],
+                 "attribute": [_attr_int("transB", 1)]},
+            ],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.rand(2, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(model.predict(x, batch_per_thread=2)), x @ w.T,
+            rtol=1e-4, atol=1e-5)
+
+    def test_multi_axis_unsqueeze(self):
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 5])],
+            "output": [_vinfo("y", [0, 5, 1, 1])],
+            "node": [{"op_type": ["Unsqueeze"], "input": ["x"],
+                      "output": ["y"],
+                      "attribute": [_attr_ints("axes", [2, 3])]}],
+        }
+        model = load_onnx(_model(graph))
+        x = np.random.rand(2, 5).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=2))
+        assert got.shape == (2, 5, 1, 1)
+
     def test_unsupported_op_raises(self):
         graph = {
             "name": ["g"],
