@@ -1,0 +1,1 @@
+from analytics_zoo_tpu.caffe.caffe_loader import load_caffe  # noqa: F401

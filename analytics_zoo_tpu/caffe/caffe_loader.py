@@ -1,0 +1,390 @@
+"""Caffe → native Keras-graph importer.
+
+Reference: `zoo/.../models/caffe/CaffeLoader.scala:718` +
+`LayerConverter.scala:792` (prototxt/caffemodel → BigDL graph). Here:
+- the deploy prototxt (protobuf TEXT format) provides the architecture and
+  input shapes, parsed by a ~60-line recursive text-format reader;
+- the .caffemodel (binary NetParameter) provides the weights, decoded with
+  the same wire decoder the ONNX importer uses (`onnx/wire.py`) against the
+  caffe.proto field numbers;
+- layers map onto the jax layer library in NCHW (`dim_ordering="th"`), so
+  caffe's OIHW kernels and flatten order carry over bit-compatibly.
+
+Supported layers (the classic classification-net set the reference's
+converter suite covers): Input, Convolution, InnerProduct, Pooling
+(MAX/AVE, caffe ceil-mode output sizes emulated with asymmetric padding),
+ReLU, Sigmoid, TanH, Softmax, Dropout (inference no-op), LRN
+(across-channels), BatchNorm (+ scale-factor blob), Scale, Concat, Eltwise
+(SUM/PROD/MAX), Flatten.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.learn.torch_bridge import _with_weights
+from analytics_zoo_tpu.onnx import wire
+from analytics_zoo_tpu.ops.autograd import LambdaLayer
+
+# ---------------------------------------------------------------------------
+# caffe.proto schemas (field numbers frozen by the BVLC proto)
+# ---------------------------------------------------------------------------
+BLOB_SHAPE = {1: ("dim", "varint")}
+
+BLOB = {
+    1: ("num", "varint"), 2: ("channels", "varint"),
+    3: ("height", "varint"), 4: ("width", "varint"),
+    5: ("data", "float"), 7: ("shape", ("msg", BLOB_SHAPE)),
+}
+
+LAYER = {
+    1: ("name", "string"),
+    2: ("type", "string"),
+    3: ("bottom", "string"),
+    4: ("top", "string"),
+    7: ("blobs", ("msg", BLOB)),
+}
+
+NET = {
+    1: ("name", "string"),
+    100: ("layer", ("msg", LAYER)),
+}
+
+
+# ---------------------------------------------------------------------------
+# prototxt text-format parser
+# ---------------------------------------------------------------------------
+_TOKEN = re.compile(r'"(?:[^"\\]|\\.)*"|[{}:]|[^\s{}:]+')
+
+
+def parse_prototxt(text: str) -> Dict[str, List]:
+    """Protobuf text format → {field: [values...]} tree (every field
+    repeated, mirroring the wire decoder's shape)."""
+    # strip comments
+    text = re.sub(r"#.*", "", text)
+    tokens = _TOKEN.findall(text)
+    pos = 0
+
+    def parse_block():
+        nonlocal pos
+        out: Dict[str, List] = {}
+        while pos < len(tokens):
+            tok = tokens[pos]
+            if tok == "}":
+                pos += 1
+                return out
+            name = tok
+            pos += 1
+            if pos < len(tokens) and tokens[pos] == ":":
+                pos += 1
+                val = tokens[pos]
+                pos += 1
+                if val.startswith('"'):
+                    val = val[1:-1]
+                else:
+                    try:
+                        val = int(val)
+                    except ValueError:
+                        try:
+                            val = float(val)
+                        except ValueError:
+                            pass  # enum name / bool keyword stays str
+                out.setdefault(name, []).append(val)
+            elif pos < len(tokens) and tokens[pos] == "{":
+                pos += 1
+                out.setdefault(name, []).append(parse_block())
+            else:
+                raise ValueError(f"Malformed prototxt near {name!r}")
+        return out
+
+    return parse_block()
+
+
+def _blob_array(blob: Dict) -> np.ndarray:
+    data = np.asarray(blob.get("data", []), np.float32)
+    if blob.get("shape"):
+        dims = blob["shape"][0].get("dim", [])
+    else:  # legacy num/channels/height/width
+        dims = [blob.get(k, [1])[0]
+                for k in ("num", "channels", "height", "width")]
+        while len(dims) > 1 and dims[0] == 1:
+            dims = dims[1:]
+    return data.reshape([int(d) for d in dims]) if dims else data
+
+
+def _first(d: Dict, key: str, default=None):
+    v = d.get(key)
+    return v[0] if v else default
+
+
+def _pool_pad_for_ceil(size: int, k: int, s: int, p: int):
+    """Caffe pooling uses CEIL output sizing; emulate with extra right/
+    bottom padding so a floor-mode valid pool matches."""
+    out = int(math.ceil((size + 2 * p - k) / s)) + 1
+    # caffe clips windows that start beyond the padded input
+    if p > 0 and (out - 1) * s >= size + p:
+        out -= 1
+    extra = (out - 1) * s + k - (size + 2 * p)
+    return out, max(extra, 0)
+
+
+class _CaffeGraphBuilder:
+    def __init__(self, arch: Dict, weights: Dict[str, List[np.ndarray]]):
+        self.arch = arch
+        self.weights = weights
+        self.nodes: Dict[str, Any] = {}
+        self.inputs: List = []
+        self.shapes: Dict[str, tuple] = {}   # tensor name → (C, H, W)
+
+    def _in(self, layer: Dict):
+        return self.nodes[layer["bottom"][0]]
+
+    # -- layer handlers ----------------------------------------------------
+    def _input(self, layer: Dict):
+        ip = (layer.get("input_param") or [{}])[0]
+        shape_blk = (ip.get("shape") or [{}])[0]
+        dims = [int(d) for d in shape_blk.get("dim", [])]
+        if not dims:
+            raise ValueError(
+                f"Input layer {_first(layer, 'name')!r} needs input_param "
+                "{ shape { dim ... } }")
+        inp = Input(shape=tuple(dims[1:]))
+        self.inputs.append(inp)
+        top = layer["top"][0]
+        self.nodes[top] = inp
+        self.shapes[top] = tuple(dims[1:])
+
+    def _conv(self, layer: Dict, name: str):
+        p = (layer.get("convolution_param") or [{}])[0]
+        num_out = int(_first(p, "num_output"))
+        kh = int(_first(p, "kernel_h", _first(p, "kernel_size", 1)))
+        kw = int(_first(p, "kernel_w", _first(p, "kernel_size", 1)))
+        sh = int(_first(p, "stride_h", _first(p, "stride", 1)))
+        sw = int(_first(p, "stride_w", _first(p, "stride", 1)))
+        ph = int(_first(p, "pad_h", _first(p, "pad", 0)))
+        pw = int(_first(p, "pad_w", _first(p, "pad", 0)))
+        group = int(_first(p, "group", 1))
+        if group != 1:
+            raise NotImplementedError("grouped Convolution")
+        bias_term = str(_first(p, "bias_term", "true")).lower() != "false"
+        x = self._in(layer)
+        if ph or pw:
+            x = L.ZeroPadding2D((ph, pw), dim_ordering="th")(x)
+        blobs = self.weights.get(name, [])
+        if not blobs:
+            raise ValueError(f"No weights for Convolution {name!r}")
+        w = blobs[0]                                  # OIHW
+        params = {"kernel": np.transpose(w, (2, 3, 1, 0)).copy()}
+        if bias_term and len(blobs) > 1:
+            params["bias"] = blobs[1]
+        conv = L.Convolution2D(num_out, kh, kw, subsample=(sh, sw),
+                               border_mode="valid", dim_ordering="th",
+                               use_bias=bias_term and len(blobs) > 1)
+        return _with_weights(conv, params)(x)
+
+    def _inner_product(self, layer: Dict, name: str, in_rank: int):
+        p = (layer.get("inner_product_param", [{}]) or [{}])[0]
+        num_out = int(_first(p, "num_output"))
+        bias_term = str(_first(p, "bias_term", "true")).lower() != "false"
+        x = self._in(layer)
+        if in_rank > 2:
+            x = L.Flatten()(x)        # caffe IP flattens implicitly
+        blobs = self.weights.get(name, [])
+        if not blobs:
+            raise ValueError(f"No weights for InnerProduct {name!r}")
+        w = blobs[0]                                  # [out, in]
+        params = {"kernel": w.reshape(num_out, -1).T.copy()}
+        if bias_term and len(blobs) > 1:
+            params["bias"] = blobs[1]
+        dense = L.Dense(num_out,
+                        use_bias=bias_term and len(blobs) > 1)
+        return _with_weights(dense, params)(x)
+
+    def _pool(self, layer: Dict, shape):
+        p = (layer.get("pooling_param", [{}]) or [{}])[0]
+        mode = str(_first(p, "pool", "MAX")).upper()
+        if str(_first(p, "global_pooling", "false")).lower() == "true":
+            cls = L.GlobalMaxPooling2D if mode in ("MAX", "0") \
+                else L.GlobalAveragePooling2D
+            # caffe global pooling keeps [N, C, 1, 1]
+            pooled = cls(dim_ordering="th")(self._in(layer))
+            return L.Reshape((shape[0], 1, 1))(pooled)
+        k = int(_first(p, "kernel_size", 2))
+        s = int(_first(p, "stride", 1))
+        pad = int(_first(p, "pad", 0))
+        _, extra_h = _pool_pad_for_ceil(shape[1], k, s, pad)
+        _, extra_w = _pool_pad_for_ceil(shape[2], k, s, pad)
+        x = self._in(layer)
+        if pad or extra_h or extra_w:
+            def pad_fn(t, ph=pad, pw=pad, eh=extra_h, ew=extra_w):
+                import jax.numpy as jnp
+                if "AVE" in mode or mode == "1":
+                    return jnp.pad(t, ((0, 0), (0, 0), (ph, ph + eh),
+                                       (pw, pw + ew)))
+                return jnp.pad(t, ((0, 0), (0, 0), (ph, ph + eh),
+                                   (pw, pw + ew)),
+                               constant_values=-np.inf)
+            x = LambdaLayer(pad_fn)(x)
+        cls = L.MaxPooling2D if mode in ("MAX", "0") else L.AveragePooling2D
+        return cls(pool_size=(k, k), strides=(s, s), border_mode="valid",
+                   dim_ordering="th")(x)
+
+    def _batchnorm(self, layer: Dict, name: str):
+        p = (layer.get("batch_norm_param", [{}]) or [{}])[0]
+        eps = float(_first(p, "eps", 1e-5))
+        blobs = self.weights.get(name, [])
+        if len(blobs) < 3:
+            raise ValueError(f"BatchNorm {name!r} needs 3 blobs")
+        factor = float(blobs[2].reshape(-1)[0]) or 1.0
+        mean = blobs[0] / factor
+        var = blobs[1] / factor
+        C = mean.shape[0]
+        bn = L.BatchNormalization(epsilon=eps, axis=1)
+        return _with_weights(bn, {
+            "gamma": np.ones(C, np.float32),
+            "beta": np.zeros(C, np.float32),
+            "moving_mean": mean, "moving_var": var})(self._in(layer))
+
+    def _scale(self, layer: Dict, name: str):
+        p = (layer.get("scale_param", [{}]) or [{}])[0]
+        bias_term = str(_first(p, "bias_term", "false")).lower() == "true"
+        blobs = self.weights.get(name, [])
+        gamma = blobs[0].reshape(-1)
+        beta = blobs[1].reshape(-1) if bias_term and len(blobs) > 1 \
+            else np.zeros_like(gamma)
+
+        def scale_fn(t, g=gamma, b=beta):
+            return t * g[None, :, None, None] + b[None, :, None, None]
+        return LambdaLayer(scale_fn)(self._in(layer))
+
+    def _lrn(self, layer: Dict):
+        p = (layer.get("lrn_param", [{}]) or [{}])[0]
+        size = int(_first(p, "local_size", 5))
+        alpha = float(_first(p, "alpha", 1.0))
+        beta = float(_first(p, "beta", 0.75))
+        kk = float(_first(p, "k", 1.0))
+        region = str(_first(p, "norm_region", "ACROSS_CHANNELS"))
+        if "WITHIN" in region.upper():
+            raise NotImplementedError("WITHIN_CHANNEL LRN")
+        # caffe divides alpha by local_size already in its formula — our
+        # LRN2D does the same (alpha/n), so pass through
+        return L.LRN2D(alpha=alpha, k=kk, beta=beta, n=size,
+                       dim_ordering="th")(self._in(layer))
+
+    def _eltwise(self, layer: Dict):
+        p = (layer.get("eltwise_param", [{}]) or [{}])[0]
+        op = str(_first(p, "operation", "SUM")).upper()
+        mode = {"SUM": "sum", "1": "sum", "PROD": "mul", "0": "mul",
+                "MAX": "max", "2": "max"}.get(op)
+        if mode is None:
+            raise NotImplementedError(f"Eltwise {op}")
+        return L.Merge(mode=mode)([self.nodes[b] for b in layer["bottom"]])
+
+    # -- assembly ----------------------------------------------------------
+    def handle(self, layer: Dict):
+        ltype = _first(layer, "type")
+        name = _first(layer, "name")
+        tops = layer.get("top", [])
+        top = tops[0] if tops else name
+        bottom = layer.get("bottom", [None])[0]
+        in_shape = self.shapes.get(bottom)
+
+        if ltype == "Input":
+            self._input(layer)
+            return
+        if ltype in ("Data", "ImageData", "Accuracy", "SoftmaxWithLoss",
+                     "Silence"):
+            return                        # train-only layers skipped
+        if ltype == "Convolution":
+            node = self._conv(layer, name)
+        elif ltype == "InnerProduct":
+            node = self._inner_product(layer, name,
+                                       len(in_shape) + 1 if in_shape
+                                       else 2)
+        elif ltype == "Pooling":
+            node = self._pool(layer, in_shape)
+        elif ltype == "ReLU":
+            node = L.Activation("relu")(self._in(layer))
+        elif ltype == "Sigmoid":
+            node = L.Activation("sigmoid")(self._in(layer))
+        elif ltype == "TanH":
+            node = L.Activation("tanh")(self._in(layer))
+        elif ltype == "Softmax":
+            node = LambdaLayer(
+                lambda t: __import__("jax").nn.softmax(t, axis=1))(
+                    self._in(layer))
+        elif ltype == "Dropout":
+            node = self._in(layer)        # inference no-op (in-place)
+        elif ltype == "BatchNorm":
+            node = self._batchnorm(layer, name)
+        elif ltype == "Scale":
+            node = self._scale(layer, name)
+        elif ltype == "LRN":
+            node = self._lrn(layer)
+        elif ltype == "Concat":
+            p = (layer.get("concat_param", [{}]) or [{}])[0]
+            axis = int(_first(p, "axis", 1))
+            node = L.Merge(mode="concat", concat_axis=axis)(
+                [self.nodes[b] for b in layer["bottom"]])
+        elif ltype == "Eltwise":
+            node = self._eltwise(layer)
+        elif ltype == "Flatten":
+            node = L.Flatten()(self._in(layer))
+        else:
+            raise NotImplementedError(
+                f"Caffe layer type {ltype!r} is not supported")
+        self.nodes[top] = node
+        self.shapes[top] = tuple(node.shape[1:]) \
+            if hasattr(node, "shape") else None
+
+    def build(self) -> Model:
+        # legacy top-level input declaration
+        if self.arch.get("input"):
+            dims = [int(d) for d in self.arch.get("input_dim", [])]
+            if self.arch.get("input_shape"):
+                dims = [int(d)
+                        for d in self.arch["input_shape"][0].get("dim", [])]
+            name = self.arch["input"][0]
+            inp = Input(shape=tuple(dims[1:]))
+            self.inputs.append(inp)
+            self.nodes[name] = inp
+            self.shapes[name] = tuple(dims[1:])
+        for layer in self.arch.get("layer", []):
+            self.handle(layer)
+        # network output: the top that is never consumed as a bottom
+        consumed = {b for lay in self.arch.get("layer", [])
+                    for b in lay.get("bottom", [])}
+        outs = [n for t, n in self.nodes.items()
+                if t not in consumed and not any(n is i
+                                                 for i in self.inputs)]
+        return Model(self.inputs if len(self.inputs) > 1
+                     else self.inputs[0],
+                     outs if len(outs) > 1 else outs[-1])
+
+
+def load_caffe(def_path: str, model_path: str) -> Model:
+    """`Net.loadCaffe(defPath, modelPath)` (`Net.scala:103`): deploy
+    prototxt + binary caffemodel → native Model with pinned weights."""
+    with open(def_path) as fh:
+        arch = parse_prototxt(fh.read())
+    with open(model_path, "rb") as fh:
+        net = wire.decode(fh.read(), NET)
+    weights = {}
+    for layer in net.get("layer", []):
+        blobs = [_blob_array(b) for b in layer.get("blobs", [])]
+        if blobs:
+            weights[layer["name"][0]] = blobs
+    model = _CaffeGraphBuilder(arch, weights).build()
+    sample = []
+    for inp in (model.inputs if isinstance(model.inputs, list)
+                else [model.inputs]):
+        shape = tuple(1 if d is None else d for d in inp.shape)
+        sample.append(np.zeros(shape, np.float32))
+    model.ensure_built(sample if len(sample) > 1 else sample[0])
+    return model

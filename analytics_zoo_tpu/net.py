@@ -140,6 +140,13 @@ class Net:
         return TFNet.from_saved_model(path)
 
     @staticmethod
+    def load_caffe(def_path: str, model_path: str):
+        """Caffe import (`CaffeLoader.scala:718` analogue): deploy prototxt
+        + binary caffemodel → native Model with pinned weights."""
+        from analytics_zoo_tpu.caffe import load_caffe
+        return load_caffe(def_path, model_path)
+
+    @staticmethod
     def load_onnx(path: str):
         """ONNX import (`pipeline/api/onnx/onnx_loader.py:141` analogue):
         decode the ModelProto wire format, map ops onto native layers, pin

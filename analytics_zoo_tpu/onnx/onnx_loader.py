@@ -136,8 +136,16 @@ class _OnnxGraphBuilder:
                     return s / n
 
                 return LambdaLayer(avg_exclude_pad)(x)
-            x = L.ZeroPadding2D((sym[0][0], sym[1][0]),
-                                dim_ordering="th")(x)
+            ph, pw = sym[0][0], sym[1][0]
+            if cls is L.MaxPooling2D:
+                # ONNX MaxPool pads with -inf, not zeros
+                def neg_pad(t, ph=ph, pw=pw):
+                    import jax.numpy as jnp
+                    return jnp.pad(t, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                                   constant_values=-jnp.inf)
+                x = LambdaLayer(neg_pad)(x)
+            else:
+                x = L.ZeroPadding2D((ph, pw), dim_ordering="th")(x)
         return cls(pool_size=tuple(k), strides=tuple(strides),
                    border_mode="valid", dim_ordering="th")(x)
 

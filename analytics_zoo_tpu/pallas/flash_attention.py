@@ -74,7 +74,17 @@ def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
     `dropout_seed` (scalar int32). Differentiable (custom VJP); the mask
     receives a zero cotangent (padding masks are data, not parameters).
     Returns [B, H, T, Dh]."""
-    use_dropout = dropout_rate > 0.0 and dropout_seed is not None
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("flash_attention: dropout_rate > 0 needs a "
+                         "dropout_seed (deterministic in-kernel masks)")
+    use_dropout = dropout_rate > 0.0
+    if mask is not None and mask.ndim == 4 and mask.shape[2] != 1:
+        # full [B,1,T,T] masks always take the exact reference path — the
+        # kernels assume a broadcastable padding mask
+        key = jax.random.PRNGKey(dropout_seed) if use_dropout else None
+        return _reference_attention(q, k, v, mask,
+                                    dropout_rate if use_dropout else 0.0,
+                                    key)
     if not (_flash_supported(mask) or interpret):
         key = None
         if use_dropout:

@@ -1,0 +1,60 @@
+"""Variational autoencoder (the reference's `apps/variational-autoencoder/`
+notebooks) built from the functional API + GaussianSampler reparameterization
+layer, trained with a CustomLoss combining reconstruction + KL.
+
+    python examples/variational_autoencoder.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.keras import Input, Model
+from analytics_zoo_tpu.keras import layers as L
+
+
+def synthetic_digits(n=512, d=64, seed=0):
+    """Two latent factors → observable via fixed random projection."""
+    rng = np.random.RandomState(seed)
+    z = rng.randn(n, 2).astype(np.float32)
+    proj = rng.randn(2, d).astype(np.float32)
+    x = np.tanh(z @ proj) + 0.05 * rng.randn(n, d).astype(np.float32)
+    return x.astype(np.float32)
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    x = synthetic_digits()
+    latent = 2
+
+    inp = Input(shape=(64,))
+    h = L.Dense(32, activation="relu", name="enc1")(inp)
+    z_mean = L.Dense(latent, name="z_mean")(h)
+    z_log_var = L.Dense(latent, name="z_log_var")(h)
+    z = L.GaussianSampler(name="sampler")([z_mean, z_log_var])
+    dh = L.Dense(32, activation="relu", name="dec1")(z)
+    recon = L.Dense(64, name="recon")(dh)
+    # outputs: reconstruction + the latent stats the loss needs
+    vae = Model(inp, [recon, z_mean, z_log_var])
+
+    def vae_loss(y_true, y_pred):
+        import jax.numpy as jnp
+        recon_out, mu, log_var = y_pred
+        xt = y_true[0]
+        rec = jnp.mean(jnp.sum((recon_out - xt) ** 2, axis=1))
+        kl = -0.5 * jnp.mean(jnp.sum(
+            1 + log_var - mu ** 2 - jnp.exp(log_var), axis=1))
+        return rec + 0.1 * kl
+
+    vae.compile("adam", vae_loss)
+    hist = vae.fit([x], [x, x[:, :2] * 0, x[:, :2] * 0],
+                   batch_size=64, nb_epoch=10)
+    print("final VAE loss:", round(hist["loss"][-1], 3))
+
+    recon_out, mu, _ = vae.predict(x[:8], batch_per_thread=8)
+    err = float(np.mean((np.asarray(recon_out) - x[:8]) ** 2))
+    print(f"reconstruction mse on held-out rows: {err:.4f}")
+    print("latent means shape:", np.asarray(mu).shape)
+
+
+if __name__ == "__main__":
+    main()

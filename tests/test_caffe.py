@@ -1,0 +1,178 @@
+"""Caffe importer tests (reference: `CaffeLoaderSpec`/`LayerConverter`
+specs). Fixtures are synthetic: prototxt text + caffemodel wire bytes
+built with the shared protobuf encoder; numerics checked against numpy/
+scipy."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.caffe import load_caffe
+from analytics_zoo_tpu.caffe.caffe_loader import NET, parse_prototxt
+from analytics_zoo_tpu.onnx import wire
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    return {"shape": [{"dim": list(arr.shape)}],
+            "data": list(arr.reshape(-1))}
+
+
+def _write(tmp_path, prototxt, layers_with_blobs):
+    d = tmp_path / "net.prototxt"
+    d.write_text(prototxt)
+    m = tmp_path / "net.caffemodel"
+    net = {"name": ["test"],
+           "layer": [{"name": [n], "type": ["X"],
+                      "blobs": [_blob(b) for b in blobs]}
+                     for n, blobs in layers_with_blobs.items()]}
+    m.write_bytes(wire.encode(net, NET))
+    return str(d), str(m)
+
+
+class TestPrototxtParser:
+    def test_nested_blocks_and_values(self):
+        txt = '''
+        name: "lenet"  # a comment
+        layer {
+          name: "conv1"
+          type: "Convolution"
+          bottom: "data"
+          top: "conv1"
+          convolution_param { num_output: 20 kernel_size: 5 stride: 1 }
+        }
+        '''
+        tree = parse_prototxt(txt)
+        assert tree["name"] == ["lenet"]
+        lay = tree["layer"][0]
+        assert lay["type"] == ["Convolution"]
+        cp = lay["convolution_param"][0]
+        assert cp["num_output"] == [20]
+        assert cp["kernel_size"] == [5]
+
+    def test_repeated_fields(self):
+        tree = parse_prototxt("input: \"data\"\ninput_dim: 1\n"
+                              "input_dim: 3\ninput_dim: 8\ninput_dim: 8\n")
+        assert tree["input_dim"] == [1, 3, 8, 8]
+
+
+class TestCaffeImport:
+    def test_lenet_style_net(self, tmp_path):
+        rs = np.random.RandomState(0)
+        w_conv = rs.randn(4, 2, 3, 3).astype(np.float32)
+        b_conv = rs.randn(4).astype(np.float32)
+        w_ip = rs.randn(3, 4 * 4 * 4).astype(np.float32)
+        b_ip = rs.randn(3).astype(np.float32)
+        prototxt = '''
+        name: "tiny"
+        layer {
+          name: "data" type: "Input" top: "data"
+          input_param { shape { dim: 1 dim: 2 dim: 8 dim: 8 } }
+        }
+        layer {
+          name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+          convolution_param { num_output: 4 kernel_size: 3 pad: 1 }
+        }
+        layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1r" }
+        layer {
+          name: "pool1" type: "Pooling" bottom: "conv1r" top: "pool1"
+          pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+        }
+        layer {
+          name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+          inner_product_param { num_output: 3 }
+        }
+        layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt,
+                                {"conv1": [w_conv, b_conv],
+                                 "ip1": [w_ip, b_ip]})
+        model = load_caffe(def_p, model_p)
+        x = rs.rand(1, 2, 8, 8).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+
+        from scipy.signal import correlate
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        conv = np.zeros((1, 4, 8, 8), np.float32)
+        for o in range(4):
+            acc = np.zeros((8, 8))
+            for i in range(2):
+                acc += correlate(xp[0, i], w_conv[o, i], mode="valid")
+            conv[0, o] = acc + b_conv[o]
+        r = np.maximum(conv, 0)
+        pool = r.reshape(1, 4, 4, 2, 4, 2).max(axis=(3, 5))
+        logits = pool.reshape(1, -1) @ w_ip.T + b_ip
+        e = np.exp(logits - logits.max())
+        ref = e / e.sum()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_bn_scale_eltwise(self, tmp_path):
+        rs = np.random.RandomState(1)
+        mean = rs.randn(3).astype(np.float32)
+        var = rs.rand(3).astype(np.float32) + 0.5
+        factor = np.asarray([2.0], np.float32)
+        gamma = rs.rand(3).astype(np.float32) + 0.5
+        beta = rs.randn(3).astype(np.float32)
+        prototxt = '''
+        layer {
+          name: "data" type: "Input" top: "data"
+          input_param { shape { dim: 1 dim: 3 dim: 4 dim: 4 } }
+        }
+        layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn"
+                batch_norm_param { eps: 0.001 } }
+        layer { name: "sc" type: "Scale" bottom: "bn" top: "sc"
+                scale_param { bias_term: true } }
+        layer { name: "sum" type: "Eltwise" bottom: "sc" bottom: "data"
+                top: "sum" eltwise_param { operation: SUM } }
+        '''
+        def_p, model_p = _write(
+            tmp_path, prototxt,
+            {"bn": [mean * 2.0, var * 2.0, factor],
+             "sc": [gamma, beta]})
+        model = load_caffe(def_p, model_p)
+        x = rs.rand(1, 3, 4, 4).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        bn = (x - mean[None, :, None, None]) / np.sqrt(
+            var[None, :, None, None] + 1e-3)
+        ref = bn * gamma[None, :, None, None] \
+            + beta[None, :, None, None] + x
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_ceil_mode_pooling(self, tmp_path):
+        # caffe: input 7, k=3, s=2 → ceil((7-3)/2)+1 = 3
+        prototxt = '''
+        layer {
+          name: "data" type: "Input" top: "data"
+          input_param { shape { dim: 1 dim: 1 dim: 7 dim: 7 } }
+        }
+        layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+                pooling_param { pool: MAX kernel_size: 3 stride: 2 } }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt, {})
+        model = load_caffe(def_p, model_p)
+        x = np.random.RandomState(2).rand(1, 1, 7, 7).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        assert got.shape == (1, 1, 3, 3)
+        # last window covers rows 4:7 (clipped)
+        assert got[0, 0, 2, 2] == pytest.approx(x[0, 0, 4:7, 4:7].max())
+
+    def test_legacy_top_level_input(self, tmp_path):
+        prototxt = '''
+        input: "data"
+        input_dim: 1  input_dim: 2  input_dim: 4  input_dim: 4
+        layer { name: "r" type: "ReLU" bottom: "data" top: "r" }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt, {})
+        model = load_caffe(def_p, model_p)
+        x = np.random.RandomState(3).randn(1, 2, 4, 4).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        np.testing.assert_allclose(got, np.maximum(x, 0), rtol=1e-6)
+
+    def test_unsupported_layer_raises(self, tmp_path):
+        prototxt = '''
+        layer { name: "data" type: "Input" top: "data"
+                input_param { shape { dim: 1 dim: 2 } } }
+        layer { name: "w" type: "WarpCtc" bottom: "data" top: "w" }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt, {})
+        with pytest.raises(NotImplementedError, match="WarpCtc"):
+            load_caffe(def_p, model_p)

@@ -28,6 +28,9 @@ EXAMPLES = [
     ("transfer_learning.py", []),
     ("distributed_training.py", []),
     ("torch_interop.py", []),
+    ("variational_autoencoder.py", []),
+    ("session_recommender.py", []),
+    ("long_context_attention.py", []),
 ]
 
 

@@ -80,6 +80,22 @@ class TestFlashVJP:
                                            interpret=True))
             np.testing.assert_allclose(o, o_ref, rtol=1e-5, atol=1e-5)
 
+    def test_full_mask_takes_reference_path_even_interpreted(self):
+        q, k, v = _qkv(T=128)
+        T = 128
+        causal = jnp.where(jnp.arange(T)[None, None, :, None]
+                           >= jnp.arange(T)[None, None, None, :],
+                           0.0, -1e9) * jnp.ones((2, 1, T, T))
+        o1 = np.asarray(flash_attention(q, k, v, mask=causal,
+                                        interpret=True))
+        o2 = np.asarray(_reference_attention(q, k, v, causal))
+        np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
+
+    def test_dropout_without_seed_raises(self):
+        q, k, v = _qkv(T=128)
+        with pytest.raises(ValueError, match="dropout_seed"):
+            flash_attention(q, k, v, dropout_rate=0.1)
+
     def test_cpu_fallback_dropout_distribution(self):
         # non-interpret on CPU → reference fallback with jax.random bits
         q, k, v = _qkv(T=128)

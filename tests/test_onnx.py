@@ -230,6 +230,24 @@ class TestOnnxOps:
         # count_include_pad=0 (default): averages of ones stay 1 at borders
         np.testing.assert_allclose(got, np.ones((1, 1, 4, 4)), rtol=1e-5)
 
+    def test_maxpool_pads_with_neg_inf(self):
+        x = np.full((1, 1, 4, 4), -1.0, np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 1, 4, 4])],
+            "output": [_vinfo("y", [0, 1, 4, 4])],
+            "node": [{"op_type": ["MaxPool"], "input": ["x"],
+                      "output": ["y"],
+                      "attribute": [_attr_ints("kernel_shape", [3, 3]),
+                                    _attr_ints("strides", [1, 1]),
+                                    _attr_ints("pads", [1, 1, 1, 1])]}],
+        }
+        model = load_onnx(_model(graph))
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        # ONNX MaxPool pads with -inf: all-(-1) input stays -1 at borders
+        np.testing.assert_allclose(got, np.full((1, 1, 4, 4), -1.0),
+                                   rtol=1e-6)
+
     def test_const_first_sub(self):
         graph = {
             "name": ["g"],
