@@ -1,0 +1,126 @@
+"""AutoXGBoost — hyperparameter search over gradient-boosted trees.
+
+Reference: `pyzoo/zoo/orca/automl/` AutoXGBoost glue (XGBoost hyperparams
+searched with the automl search engine). Uses the `xgboost` package when
+present; otherwise falls back to sklearn's HistGradientBoosting (same
+model family, keeps the API usable in environments without xgboost — the
+reference likewise degrades when its optional deps are missing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.automl.search import SearchEngine, hp
+
+
+def _make_model(task: str, config: Dict):
+    common = dict(
+        n_estimators=int(config.get("n_estimators", 100)),
+        max_depth=int(config.get("max_depth", 6)),
+        learning_rate=float(config.get("lr", 0.1)),
+    )
+    try:
+        import xgboost as xgb
+        cls = xgb.XGBRegressor if task == "regression" else xgb.XGBClassifier
+        return cls(subsample=float(config.get("subsample", 1.0)),
+                   min_child_weight=int(config.get("min_child_weight", 1)),
+                   **common)
+    except ImportError:
+        from sklearn.ensemble import (HistGradientBoostingClassifier,
+                                      HistGradientBoostingRegressor)
+        cls = HistGradientBoostingRegressor if task == "regression" \
+            else HistGradientBoostingClassifier
+        return cls(max_iter=common["n_estimators"],
+                   max_depth=common["max_depth"],
+                   learning_rate=common["learning_rate"])
+
+
+def _default_space() -> Dict:
+    return {
+        "n_estimators": hp.randint(50, 300),
+        "max_depth": hp.choice([3, 4, 5, 6, 8]),
+        "lr": hp.loguniform(1e-2, 3e-1),
+        "subsample": hp.uniform(0.6, 1.0),
+        "min_child_weight": hp.choice([1, 2, 3]),
+    }
+
+
+class _AutoXGB:
+    task = "regression"
+
+    def __init__(self, search_space: Optional[Dict] = None,
+                 n_sampling: int = 4, seed: int = 0):
+        self.search_space = search_space or _default_space()
+        self.n_sampling = n_sampling
+        self.seed = seed
+        self.best_config: Optional[Dict] = None
+        self.best_model = None
+
+    def _score(self, model, x, y) -> float:
+        pred = model.predict(x)
+        if self.task == "regression":
+            return -float(np.mean((pred - y) ** 2))       # higher better
+        return float(np.mean(pred == y))
+
+    def fit(self, x, y, validation_data=None) -> "_AutoXGB":
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if validation_data is None:
+            n = int(len(x) * 0.8)
+            xv, yv = x[n:], y[n:]
+            x, y = x[:n], y[:n]
+        else:
+            xv, yv = (np.asarray(validation_data[0]),
+                      np.asarray(validation_data[1]))
+        models = {}
+
+        def train_fn(config, data, budget):
+            model = _make_model(self.task, config)
+            model.fit(data[0], data[1])
+            score = self._score(model, xv, yv)
+            models[id(model)] = model
+            return {"score": score, "_model_id": id(model)}
+
+        engine = SearchEngine(metric="score", mode="max",
+                              num_samples=self.n_sampling, seed=self.seed)
+        engine.compile((x, y), train_fn,
+                       search_space=self.search_space)
+        engine.run()
+        best = engine.get_best_trials(1)[0]
+        self.best_config = best.config
+        self.best_model = models[best.results["_model_id"]]
+        return self
+
+    def predict(self, x) -> np.ndarray:
+        if self.best_model is None:
+            raise RuntimeError("fit() first")
+        return np.asarray(self.best_model.predict(np.asarray(x)))
+
+    def evaluate(self, x, y, metrics: Sequence[str] = ("mse",)
+                 ) -> Dict[str, float]:
+        pred = self.predict(x)
+        y = np.asarray(y)
+        out = {}
+        for m in metrics:
+            if m == "mse":
+                out[m] = float(np.mean((pred - y) ** 2))
+            elif m == "mae":
+                out[m] = float(np.mean(np.abs(pred - y)))
+            elif m == "accuracy":
+                out[m] = float(np.mean(pred == y))
+            else:
+                raise ValueError(f"Unsupported metric {m}")
+        return out
+
+
+class AutoXGBRegressor(_AutoXGB):
+    """`AutoXGBRegressor` (orca.automl)."""
+    task = "regression"
+
+
+class AutoXGBClassifier(_AutoXGB):
+    """`AutoXGBClassifier` (orca.automl)."""
+    task = "classification"

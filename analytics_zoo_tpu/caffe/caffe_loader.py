@@ -66,8 +66,9 @@ _TOKEN = re.compile(r'"(?:[^"\\]|\\.)*"|[{}:]|[^\s{}:]+')
 def parse_prototxt(text: str) -> Dict[str, List]:
     """Protobuf text format → {field: [values...]} tree (every field
     repeated, mirroring the wire decoder's shape)."""
-    # strip comments
-    text = re.sub(r"#.*", "", text)
+    # strip comments — but not '#' inside quoted strings
+    text = re.sub(r'("(?:[^"\\]|\\.)*")|#.*',
+                  lambda m: m.group(1) or "", text)
     tokens = _TOKEN.findall(text)
     pos = 0
 
@@ -172,6 +173,8 @@ class _CaffeGraphBuilder:
         group = int(_first(p, "group", 1))
         if group != 1:
             raise NotImplementedError("grouped Convolution")
+        if int(_first(p, "dilation", 1)) != 1:
+            raise NotImplementedError("dilated Convolution")
         bias_term = str(_first(p, "bias_term", "true")).lower() != "false"
         x = self._in(layer)
         if ph or pw:
@@ -215,25 +218,46 @@ class _CaffeGraphBuilder:
             # caffe global pooling keeps [N, C, 1, 1]
             pooled = cls(dim_ordering="th")(self._in(layer))
             return L.Reshape((shape[0], 1, 1))(pooled)
-        k = int(_first(p, "kernel_size", 2))
-        s = int(_first(p, "stride", 1))
-        pad = int(_first(p, "pad", 0))
-        _, extra_h = _pool_pad_for_ceil(shape[1], k, s, pad)
-        _, extra_w = _pool_pad_for_ceil(shape[2], k, s, pad)
+        kh = int(_first(p, "kernel_h", _first(p, "kernel_size", 2)))
+        kw = int(_first(p, "kernel_w", _first(p, "kernel_size", 2)))
+        sh = int(_first(p, "stride_h", _first(p, "stride", 1)))
+        sw = int(_first(p, "stride_w", _first(p, "stride", 1)))
+        ph = int(_first(p, "pad_h", _first(p, "pad", 0)))
+        pw = int(_first(p, "pad_w", _first(p, "pad", 0)))
+        _, extra_h = _pool_pad_for_ceil(shape[1], kh, sh, ph)
+        _, extra_w = _pool_pad_for_ceil(shape[2], kw, sw, pw)
         x = self._in(layer)
-        if pad or extra_h or extra_w:
-            def pad_fn(t, ph=pad, pw=pad, eh=extra_h, ew=extra_w):
+        is_ave = "AVE" in mode or mode == "1"
+        if is_ave and (ph or pw or extra_h or extra_w):
+            # caffe AVE divides by the window area clipped to the PADDED
+            # input (pad zeros count; the ceil-extra region does not)
+            def ave_fn(t, ph=ph, pw=pw, eh=extra_h, ew=extra_w,
+                       kh=kh, kw=kw, sh=sh, sw=sw):
+                import jax
                 import jax.numpy as jnp
-                if "AVE" in mode or mode == "1":
-                    return jnp.pad(t, ((0, 0), (0, 0), (ph, ph + eh),
-                                       (pw, pw + ew)))
+                tp = jnp.pad(t, ((0, 0), (0, 0), (ph, ph + eh),
+                                 (pw, pw + ew)))
+                cnt = jnp.pad(jnp.ones_like(t),
+                              ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+                cnt = jnp.pad(cnt, ((0, 0), (0, 0), (0, eh), (0, ew)))
+                win = (1, 1, kh, kw)
+                st = (1, 1, sh, sw)
+                ssum = jax.lax.reduce_window(tp, 0.0, jax.lax.add, win,
+                                             st, "VALID")
+                area = jax.lax.reduce_window(cnt, 0.0, jax.lax.add, win,
+                                             st, "VALID")
+                return ssum / jnp.maximum(area, 1.0)
+            return LambdaLayer(ave_fn)(x)
+        if ph or pw or extra_h or extra_w:
+            def pad_fn(t, ph=ph, pw=pw, eh=extra_h, ew=extra_w):
+                import jax.numpy as jnp
                 return jnp.pad(t, ((0, 0), (0, 0), (ph, ph + eh),
                                    (pw, pw + ew)),
                                constant_values=-np.inf)
             x = LambdaLayer(pad_fn)(x)
         cls = L.MaxPooling2D if mode in ("MAX", "0") else L.AveragePooling2D
-        return cls(pool_size=(k, k), strides=(s, s), border_mode="valid",
-                   dim_ordering="th")(x)
+        return cls(pool_size=(kh, kw), strides=(sh, sw),
+                   border_mode="valid", dim_ordering="th")(x)
 
     def _batchnorm(self, layer: Dict, name: str):
         p = (layer.get("batch_norm_param", [{}]) or [{}])[0]
@@ -357,12 +381,20 @@ class _CaffeGraphBuilder:
             self.shapes[name] = tuple(dims[1:])
         for layer in self.arch.get("layer", []):
             self.handle(layer)
-        # network output: the top that is never consumed as a bottom
-        consumed = {b for lay in self.arch.get("layer", [])
-                    for b in lay.get("bottom", [])}
+        # network output: the top that is never consumed as a bottom;
+        # a tensor re-produced in place (top == bottom, the caffe ReLU/BN
+        # idiom) does not count as consumed by its own producer
+        consumed = set()
+        for lay in self.arch.get("layer", []):
+            tops = set(lay.get("top", []))
+            for b in lay.get("bottom", []):
+                if b not in tops:
+                    consumed.add(b)
         outs = [n for t, n in self.nodes.items()
                 if t not in consumed and not any(n is i
                                                  for i in self.inputs)]
+        if not outs and self.nodes:
+            outs = [list(self.nodes.values())[-1]]
         return Model(self.inputs if len(self.inputs) > 1
                      else self.inputs[0],
                      outs if len(outs) > 1 else outs[-1])

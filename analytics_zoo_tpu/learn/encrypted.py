@@ -1,0 +1,101 @@
+"""Encrypted model storage.
+
+Reference: `InferenceModel.doLoadBigDL`/`doLoadTensorflow` accept
+encrypted model files (`pipeline/inference/InferenceModel.scala:121-226`,
+AES-CBC with PBKDF2-derived keys from a secret+salt pair; see
+`EncryptSupportive`). Same contract here: `encrypt_file`/`decrypt_file`
+derive an AES-128-GCM key with PBKDF2-HMAC-SHA256 and seal whole files;
+`save_encrypted_pytree`/`load_encrypted_pytree` wrap checkpoint trees.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any
+
+_MAGIC = b"AZTPUENC1"
+_ITERATIONS = 65536
+
+
+def _derive_key(secret: str, salt: bytes) -> bytes:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.kdf.pbkdf2 import PBKDF2HMAC
+    kdf = PBKDF2HMAC(algorithm=hashes.SHA256(), length=16, salt=salt,
+                     iterations=_ITERATIONS)
+    return kdf.derive(secret.encode("utf-8"))
+
+
+def encrypt_bytes(data: bytes, secret: str, salt: str = "analytics-zoo"
+                  ) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    nonce = os.urandom(12)
+    key = _derive_key(secret, salt.encode("utf-8"))
+    sealed = AESGCM(key).encrypt(nonce, data, _MAGIC)
+    return _MAGIC + nonce + sealed
+
+
+def decrypt_bytes(blob: bytes, secret: str, salt: str = "analytics-zoo"
+                  ) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    if not blob.startswith(_MAGIC):
+        raise ValueError("Not an encrypted model blob (bad magic)")
+    nonce = blob[len(_MAGIC):len(_MAGIC) + 12]
+    sealed = blob[len(_MAGIC) + 12:]
+    key = _derive_key(secret, salt.encode("utf-8"))
+    return AESGCM(key).decrypt(nonce, sealed, _MAGIC)
+
+
+def encrypt_file(src: str, dst: str, secret: str,
+                 salt: str = "analytics-zoo") -> str:
+    with open(src, "rb") as fh:
+        data = fh.read()
+    with open(dst, "wb") as fh:
+        fh.write(encrypt_bytes(data, secret, salt))
+    return dst
+
+
+def decrypt_file(src: str, dst: str, secret: str,
+                 salt: str = "analytics-zoo") -> str:
+    with open(src, "rb") as fh:
+        blob = fh.read()
+    with open(dst, "wb") as fh:
+        fh.write(decrypt_bytes(blob, secret, salt))
+    return dst
+
+
+def save_encrypted_pytree(path: str, tree: Any, secret: str,
+                          salt: str = "analytics-zoo") -> str:
+    """Serialize a param pytree (same npz+structure layout as
+    `checkpoint.save_pytree`) into ONE encrypted file."""
+    import json
+
+    from analytics_zoo_tpu.learn.checkpoint import save_pytree
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "m")
+        save_pytree(base, tree)
+        with open(base + ".npz", "rb") as fh:
+            npz = fh.read()
+        with open(base + ".structure.json", "rb") as fh:
+            struct = fh.read()
+    payload = (len(struct).to_bytes(8, "little") + struct + npz)
+    with open(path, "wb") as fh:
+        fh.write(encrypt_bytes(payload, secret, salt))
+    return path
+
+
+def load_encrypted_pytree(path: str, secret: str,
+                          salt: str = "analytics-zoo") -> Any:
+    from analytics_zoo_tpu.learn.checkpoint import load_pytree
+    with open(path, "rb") as fh:
+        payload = decrypt_bytes(fh.read(), secret, salt)
+    n = int.from_bytes(payload[:8], "little")
+    struct = payload[8:8 + n]
+    npz = payload[8 + n:]
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "m")
+        with open(base + ".structure.json", "wb") as fh:
+            fh.write(struct)
+        with open(base + ".npz", "wb") as fh:
+            fh.write(npz)
+        return load_pytree(base)

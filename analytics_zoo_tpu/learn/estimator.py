@@ -93,6 +93,19 @@ class Estimator:
         return Estimator(model, model_dir)
 
     @staticmethod
+    def from_model_fn(model_fn: Callable, init_fn: Callable,
+                      optimizer="adam", metrics=None,
+                      model_dir: Optional[str] = None) -> "Estimator":
+        """`TFEstimator.from_model_fn` analogue (`tfpark/estimator.py:47`):
+        model_fn(params, features, labels, mode, rng) returns a dict spec —
+        {"loss": scalar} in "train"/"eval" mode, {"predictions": tree} in
+        "predict" mode. The loss is computed INSIDE model_fn (the
+        tf.estimator contract), so the compile loss is a pass-through."""
+        model = _ModelFnModel(model_fn, init_fn)
+        model.compile(optimizer, model._spec_loss, metrics)
+        return Estimator(model, model_dir)
+
+    @staticmethod
     def from_torch(model, loss=None, optimizer=None, metrics=None,
                    model_dir: Optional[str] = None) -> "Estimator":
         """Convert a torch.nn module (Sequential-style) into the native layer
@@ -206,6 +219,10 @@ class Estimator:
                         feature_cols=feature_cols, label_cols=label_cols)
         from analytics_zoo_tpu.ops import metrics as zmetrics
         ms = zmetrics.resolve(metrics) if metrics else None
+        if isinstance(self.model, _ModelFnModel) and not ms \
+                and not self.model.metrics:
+            # spec loss needs the raw features → dedicated eval path
+            return self.model._evaluate_spec(ds.x, ds.y, batch_per_thread)
         return self.model.evaluate(ds.x, ds.y,
                                    batch_per_thread=batch_per_thread,
                                    metrics=ms)
@@ -228,6 +245,76 @@ class Estimator:
         (`orca/learn/tf/estimator.py:125` semantics; version=None → latest)."""
         self._load_ckpt = (path, version)
         return self
+
+
+class _ModelFnModel(KerasNet):
+    """tf.estimator-style adapter: model_fn(params, features, labels, mode,
+    rng) → spec dict. Training feeds labels through `apply` by closing over
+    the batch (the trainer calls apply(params, x) then loss(y, out); here
+    `apply` returns features untouched in predict mode and the loss path
+    re-invokes model_fn with labels)."""
+
+    def __init__(self, model_fn: Callable, init_fn: Callable):
+        super().__init__()
+        self.model_fn = model_fn
+        self.init_fn = init_fn
+
+    def build(self, rng, input_shape):
+        return self.init_fn(rng, input_shape)
+
+    def apply(self, params, inputs, *, training=False, rng=None):
+        if training:
+            # defer: loss path recombines with labels in _spec_loss via
+            # the (params, features) closure the trainer maintains
+            return _DeferredSpec(self, params, inputs, rng)
+        spec = self.model_fn(params, inputs, None, "predict", rng)
+        return spec["predictions"]
+
+    def _spec_loss(self, y_true, deferred):
+        if not isinstance(deferred, _DeferredSpec):
+            # eval path delivers plain predictions; the spec loss needs the
+            # raw features, so evaluation goes through evaluate() (which
+            # dispatches to _evaluate_spec) or explicit compiled metrics
+            raise ValueError(
+                "from_model_fn: the spec loss is only computable in the "
+                "training path; compile explicit metrics for validation "
+                "(metrics=[...]) or call Estimator.evaluate()")
+        spec = self.model_fn(deferred.params, deferred.features, y_true,
+                             "train", deferred.rng)
+        return spec["loss"]
+
+    def _evaluate_spec(self, x, y, batch_per_thread: int = 32
+                       ) -> Dict[str, float]:
+        """Mean spec loss over batches — model_fn in eval mode."""
+        import jax
+
+        from analytics_zoo_tpu.learn import trainer as _trainer
+
+        @jax.jit
+        def batch_loss(params, xb, yb):
+            spec = self.model_fn(params, xb, yb, "eval", None)
+            return spec["loss"]
+
+        total, n = 0.0, 0
+        for xb, yb, _count in _trainer.iter_batches(
+                x, y, batch_per_thread, shuffle=False,
+                drop_remainder=False):
+            total += float(batch_loss(self.params, xb, yb))
+            n += 1
+        return {"loss": total / max(n, 1)}
+
+    def compute_output_shape(self, input_shape):
+        return None
+
+
+class _DeferredSpec:
+    """Carries (params, features, rng) from apply to the loss call."""
+
+    def __init__(self, model, params, features, rng):
+        self.model = model
+        self.params = params
+        self.features = features
+        self.rng = rng
 
 
 class _FnModel(KerasNet):

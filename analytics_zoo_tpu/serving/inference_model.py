@@ -78,6 +78,19 @@ class InferenceModel:
         self._jit = jax.jit(fn)
         return self
 
+    def load_keras_encrypted(self, model, path: str, secret: str,
+                             salt: str = "analytics-zoo"
+                             ) -> "InferenceModel":
+        """Encrypted-model analogue of `doLoadBigDL(path, secret)`
+        (InferenceModel.scala:121-226): decrypt an AES-GCM-sealed param
+        tree and attach it to the given architecture."""
+        from analytics_zoo_tpu.learn.encrypted import load_encrypted_pytree
+        from analytics_zoo_tpu.models.common import ZooModel
+        params = load_encrypted_pytree(path, secret, salt)
+        net = model.model if isinstance(model, ZooModel) else model
+        params = net._remap_loaded(params)
+        return self.load_keras(model, params=params)
+
     def load_torch(self, torch_module) -> "InferenceModel":
         """`doLoadPyTorch` analogue: convert the module natively (the
         reference embeds CPython via JEP; on TPU the model becomes XLA)."""

@@ -225,3 +225,27 @@ def _read_varint(buf: bytes, off: int) -> Tuple[int, int]:
         if not b & 0x80:
             return result, off
         shift += 7
+
+
+class InferenceSummary:
+    """Serving-side TensorBoard summaries
+    (`pipeline/inference/InferenceSummary.scala:24`): throughput and
+    latency scalars written per serving window."""
+
+    def __init__(self, log_dir: str, app_name: str = "serving"):
+        self._writer = SummaryWriter(f"{log_dir.rstrip('/')}/{app_name}")
+        self._step = 0
+
+    def record(self, records: int, window_s: float,
+               p50_ms: float = None, p99_ms: float = None):
+        self._step += 1
+        if window_s > 0:
+            self._writer.scalar("Throughput", records / window_s,
+                                self._step)
+        if p50_ms is not None:
+            self._writer.scalar("LatencyP50", p50_ms, self._step)
+        if p99_ms is not None:
+            self._writer.scalar("LatencyP99", p99_ms, self._step)
+
+    def close(self):
+        self._writer.close()

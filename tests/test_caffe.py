@@ -167,6 +167,67 @@ class TestCaffeImport:
         got = np.asarray(model.predict(x, batch_per_thread=1))
         np.testing.assert_allclose(got, np.maximum(x, 0), rtol=1e-6)
 
+    def test_in_place_final_layer(self, tmp_path):
+        prototxt = '''
+        layer { name: "data" type: "Input" top: "data"
+                input_param { shape { dim: 1 dim: 2 dim: 4 dim: 4 } } }
+        layer { name: "r" type: "ReLU" bottom: "data" top: "data" }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt, {})
+        model = load_caffe(def_p, model_p)
+        x = np.random.RandomState(4).randn(1, 2, 4, 4).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        np.testing.assert_allclose(got, np.maximum(x, 0), rtol=1e-6)
+
+    def test_rect_pooling_fields(self, tmp_path):
+        prototxt = '''
+        layer { name: "data" type: "Input" top: "data"
+                input_param { shape { dim: 1 dim: 1 dim: 6 dim: 8 } } }
+        layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+                pooling_param { pool: MAX kernel_h: 3 kernel_w: 2
+                                stride_h: 2 stride_w: 1 } }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt, {})
+        model = load_caffe(def_p, model_p)
+        x = np.random.RandomState(5).rand(1, 1, 6, 8).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        # caffe ceil: h: ceil((6-3)/2)+1 = 3 ; w: ceil((8-2)/1)+1 = 7
+        assert got.shape == (1, 1, 3, 7)
+        assert got[0, 0, 0, 0] == pytest.approx(x[0, 0, 0:3, 0:2].max())
+
+    def test_ave_pool_ceil_clipped_area(self, tmp_path):
+        # H=W=6, k=3, s=2 → ceil((6-3)/2)+1 = 3 outputs; last window covers
+        # 2 real rows/cols and caffe divides by the clipped area (4), not 9
+        prototxt = '''
+        layer { name: "data" type: "Input" top: "data"
+                input_param { shape { dim: 1 dim: 1 dim: 6 dim: 6 } } }
+        layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+                pooling_param { pool: AVE kernel_size: 3 stride: 2 } }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt, {})
+        model = load_caffe(def_p, model_p)
+        x = np.ones((1, 1, 6, 6), np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        assert got.shape == (1, 1, 3, 3)
+        np.testing.assert_allclose(got, np.ones((1, 1, 3, 3)), rtol=1e-5)
+
+    def test_dilated_conv_raises(self, tmp_path):
+        prototxt = '''
+        layer { name: "data" type: "Input" top: "data"
+                input_param { shape { dim: 1 dim: 1 dim: 6 dim: 6 } } }
+        layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+                convolution_param { num_output: 2 kernel_size: 3
+                                    dilation: 2 } }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt,
+                                {"c": [np.zeros((2, 1, 3, 3), np.float32)]})
+        with pytest.raises(NotImplementedError, match="dilated"):
+            load_caffe(def_p, model_p)
+
+    def test_hash_inside_quoted_name(self):
+        tree = parse_prototxt('name: "conv#1"  # trailing comment\n')
+        assert tree["name"] == ["conv#1"]
+
     def test_unsupported_layer_raises(self, tmp_path):
         prototxt = '''
         layer { name: "data" type: "Input" top: "data"

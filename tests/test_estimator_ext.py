@@ -1,0 +1,121 @@
+"""from_model_fn, encrypted model storage, AutoXGBoost tests."""
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.automl.auto_xgboost import (AutoXGBClassifier,
+                                                   AutoXGBRegressor)
+from analytics_zoo_tpu.learn.encrypted import (decrypt_bytes, encrypt_bytes,
+                                               load_encrypted_pytree,
+                                               save_encrypted_pytree)
+from analytics_zoo_tpu.learn.estimator import Estimator
+
+
+@pytest.fixture(autouse=True)
+def ctx():
+    c = zoo.init_orca_context(cluster_mode="local")
+    yield c
+    zoo.stop_orca_context()
+
+
+class TestFromModelFn:
+    def test_train_and_predict(self):
+        import jax
+        import jax.numpy as jnp
+
+        def init_fn(rng, input_shape):
+            return {"w": jax.random.normal(rng, (4, 1)) * 0.1,
+                    "b": jnp.zeros((1,))}
+
+        def model_fn(params, features, labels, mode, rng):
+            logits = features @ params["w"] + params["b"]
+            if mode == "predict":
+                return {"predictions": logits}
+            loss = jnp.mean((logits - labels) ** 2)
+            return {"loss": loss}
+
+        import optax
+        est = Estimator.from_model_fn(model_fn, init_fn,
+                                      optimizer=optax.adam(0.05))
+        x = np.random.rand(128, 4).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True).astype(np.float32)
+        est.fit({"x": x, "y": y}, epochs=20, batch_size=32)
+        pred = np.asarray(est.predict({"x": x}, batch_per_thread=64))
+        assert pred.shape == (128, 1)
+        mse = float(np.mean((pred - y) ** 2))
+        assert mse < 0.5
+        # evaluate goes through the spec-loss eval path (model_fn "eval")
+        ev = est.evaluate({"x": x, "y": y}, batch_per_thread=64)
+        assert ev["loss"] == pytest.approx(mse, rel=1e-3)
+
+
+class TestEncrypted:
+    def test_bytes_roundtrip_and_auth(self):
+        blob = encrypt_bytes(b"secret weights", "pw")
+        assert decrypt_bytes(blob, "pw") == b"secret weights"
+        with pytest.raises(Exception):
+            decrypt_bytes(blob, "wrong-pw")
+        with pytest.raises(ValueError, match="magic"):
+            decrypt_bytes(b"garbage", "pw")
+
+    def test_pytree_roundtrip(self, tmp_path):
+        tree = {"dense": {"kernel": np.random.rand(3, 4).astype(np.float32),
+                          "bias": np.zeros(4, np.float32)}}
+        p = str(tmp_path / "m.enc")
+        save_encrypted_pytree(p, tree, "s3cret")
+        back = load_encrypted_pytree(p, "s3cret")
+        np.testing.assert_array_equal(back["dense"]["kernel"],
+                                      tree["dense"]["kernel"])
+
+    def test_encrypted_inference_model(self, tmp_path):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        from analytics_zoo_tpu.serving.inference_model import InferenceModel
+        model = Sequential([L.Dense(3, input_shape=(4,))])
+        model.ensure_built(np.zeros((1, 4), np.float32))
+        p = str(tmp_path / "m.enc")
+        save_encrypted_pytree(p, model.params, "k3y")
+        ref = np.asarray(model.predict(np.ones((2, 4), np.float32),
+                                       batch_per_thread=2))
+        fresh = Sequential([L.Dense(3, input_shape=(4,))])
+        fresh.ensure_built(np.zeros((1, 4), np.float32))
+        infer = InferenceModel().load_keras_encrypted(fresh, p, "k3y")
+        got = infer.predict(np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5)
+
+
+class TestInferenceSummary:
+    def test_roundtrip(self, tmp_path):
+        from analytics_zoo_tpu.utils.tensorboard import (InferenceSummary,
+                                                         read_scalars)
+        s = InferenceSummary(str(tmp_path))
+        s.record(100, 0.5, p50_ms=1.2, p99_ms=3.4)
+        s.record(200, 0.5)
+        s.close()
+        back = read_scalars(str(tmp_path / "serving"))
+        assert back["Throughput"] == [(1, 200.0), (2, 400.0)]
+        assert len(back["LatencyP50"]) == 1
+
+
+class TestAutoXGBoost:
+    def test_regressor_beats_mean(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(400, 5).astype(np.float32)
+        y = (x[:, 0] * 3 + x[:, 1] ** 2).astype(np.float32)
+        reg = AutoXGBRegressor(n_sampling=3).fit(x, y)
+        assert reg.best_config is not None
+        mse = reg.evaluate(x, y, metrics=["mse"])["mse"]
+        assert mse < float(np.var(y))
+
+    def test_classifier(self):
+        rs = np.random.RandomState(1)
+        x = rs.rand(300, 4).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] > 1.0).astype(np.int32)
+        clf = AutoXGBClassifier(n_sampling=2).fit(x, y)
+        acc = clf.evaluate(x, y, metrics=["accuracy"])["accuracy"]
+        assert acc > 0.8
+
+    def test_predict_before_fit_raises(self):
+        with pytest.raises(RuntimeError, match="fit"):
+            AutoXGBRegressor().predict(np.zeros((1, 2)))
