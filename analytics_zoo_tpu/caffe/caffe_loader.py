@@ -171,10 +171,7 @@ class _CaffeGraphBuilder:
         ph = int(_first(p, "pad_h", _first(p, "pad", 0)))
         pw = int(_first(p, "pad_w", _first(p, "pad", 0)))
         group = int(_first(p, "group", 1))
-        if group != 1:
-            raise NotImplementedError("grouped Convolution")
-        if int(_first(p, "dilation", 1)) != 1:
-            raise NotImplementedError("dilated Convolution")
+        dilation = int(_first(p, "dilation", 1))
         bias_term = str(_first(p, "bias_term", "true")).lower() != "false"
         x = self._in(layer)
         if ph or pw:
@@ -182,13 +179,20 @@ class _CaffeGraphBuilder:
         blobs = self.weights.get(name, [])
         if not blobs:
             raise ValueError(f"No weights for Convolution {name!r}")
-        w = blobs[0]                                  # OIHW
+        w = blobs[0]                                  # [O, I/group, kh, kw]
         params = {"kernel": np.transpose(w, (2, 3, 1, 0)).copy()}
         if bias_term and len(blobs) > 1:
             params["bias"] = blobs[1]
-        conv = L.Convolution2D(num_out, kh, kw, subsample=(sh, sw),
-                               border_mode="valid", dim_ordering="th",
-                               use_bias=bias_term and len(blobs) > 1)
+        use_bias = bias_term and len(blobs) > 1
+        if dilation != 1:
+            conv = L.AtrousConvolution2D(
+                num_out, kh, kw, atrous_rate=(dilation, dilation),
+                subsample=(sh, sw), border_mode="valid",
+                dim_ordering="th", use_bias=use_bias, groups=group)
+        else:
+            conv = L.Convolution2D(num_out, kh, kw, subsample=(sh, sw),
+                                   border_mode="valid", dim_ordering="th",
+                                   use_bias=use_bias, groups=group)
         return _with_weights(conv, params)(x)
 
     def _inner_product(self, layer: Dict, name: str, in_rank: int):
@@ -238,7 +242,8 @@ class _CaffeGraphBuilder:
                 tp = jnp.pad(t, ((0, 0), (0, 0), (ph, ph + eh),
                                  (pw, pw + ew)))
                 cnt = jnp.pad(jnp.ones_like(t),
-                              ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+                              ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                              constant_values=1.0)
                 cnt = jnp.pad(cnt, ((0, 0), (0, 0), (0, eh), (0, ew)))
                 win = (1, 1, kh, kw)
                 st = (1, 1, sh, sw)
@@ -249,12 +254,9 @@ class _CaffeGraphBuilder:
                 return ssum / jnp.maximum(area, 1.0)
             return LambdaLayer(ave_fn)(x)
         if ph or pw or extra_h or extra_w:
-            def pad_fn(t, ph=ph, pw=pw, eh=extra_h, ew=extra_w):
-                import jax.numpy as jnp
-                return jnp.pad(t, ((0, 0), (0, 0), (ph, ph + eh),
-                                   (pw, pw + ew)),
-                               constant_values=-np.inf)
-            x = LambdaLayer(pad_fn)(x)
+            from analytics_zoo_tpu.onnx.onnx_loader import _pad_lambda
+            x = _pad_lambda(((0, 0), (0, 0), (ph, ph + extra_h),
+                             (pw, pw + extra_w)), value=-np.inf)(x)
         cls = L.MaxPooling2D if mode in ("MAX", "0") else L.AveragePooling2D
         return cls(pool_size=(kh, kw), strides=(sh, sw),
                    border_mode="valid", dim_ordering="th")(x)

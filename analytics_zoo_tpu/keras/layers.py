@@ -479,7 +479,8 @@ class _ConvND(Layer):
     def __init__(self, nb_filter: int, kernel_size: Sequence[int],
                  activation=None, subsample: Sequence[int] = None,
                  border_mode: str = "valid", dim_ordering: str = "tf",
-                 use_bias: bool = True, init="glorot_uniform", **kw):
+                 use_bias: bool = True, init="glorot_uniform",
+                 groups: int = 1, **kw):
         super().__init__(**kw)
         self.nb_filter = nb_filter
         self.kernel_size = tuple(kernel_size)
@@ -491,13 +492,18 @@ class _ConvND(Layer):
         self.dim_ordering = dim_ordering
         self.use_bias = use_bias
         self.init = get_init(init)
+        self.groups = int(groups)
 
     def build(self, rng, input_shape):
         if self.dim_ordering == "th":
             in_ch = input_shape[1]
         else:
             in_ch = input_shape[-1]
-        kshape = self.kernel_size + (in_ch, self.nb_filter)
+        if in_ch % self.groups or self.nb_filter % self.groups:
+            raise ValueError(
+                f"groups={self.groups} must divide in_ch={in_ch} and "
+                f"nb_filter={self.nb_filter}")
+        kshape = self.kernel_size + (in_ch // self.groups, self.nb_filter)
         p = {"kernel": self.init(rng, kshape, jnp.float32)}
         if self.use_bias:
             p["bias"] = jnp.zeros((self.nb_filter,), jnp.float32)
@@ -507,7 +513,8 @@ class _ConvND(Layer):
         x = _to_channels_last(x, self.dim_ordering, self.spatial_rank)
         y = jax.lax.conv_general_dilated(
             x, params["kernel"], window_strides=self.strides,
-            padding=self.padding, dimension_numbers=self.dn)
+            padding=self.padding, dimension_numbers=self.dn,
+            feature_group_count=self.groups)
         if self.use_bias:
             y = y + params["bias"]
         y = self.activation(y)

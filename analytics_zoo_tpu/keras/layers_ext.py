@@ -413,7 +413,8 @@ class AtrousConvolution2D(_ConvND):
         y = jax.lax.conv_general_dilated(
             x, params["kernel"], window_strides=self.strides,
             padding=self.padding, rhs_dilation=self.atrous_rate,
-            dimension_numbers=self.dn)
+            dimension_numbers=self.dn,
+            feature_group_count=self.groups)
         if self.use_bias:
             y = y + params["bias"]
         y = self.activation(y)

@@ -14,8 +14,10 @@ import os
 import tempfile
 from typing import Any
 
-_MAGIC = b"AZTPUENC1"
+_MAGIC_V1 = b"AZTPUENC1"
+_MAGIC = b"AZTPUENC2"
 _ITERATIONS = 65536
+_SALT_LEN = 16
 
 
 def _derive_key(secret: str, salt: bytes) -> bytes:
@@ -28,22 +30,33 @@ def _derive_key(secret: str, salt: bytes) -> bytes:
 
 def encrypt_bytes(data: bytes, secret: str, salt: str = "analytics-zoo"
                   ) -> bytes:
+    """v2 format: MAGIC | random 16-byte file salt | 12-byte nonce | sealed.
+    The KDF salt is the caller salt concatenated with the per-file random
+    salt, so equal secrets never share a derived key across files."""
     from cryptography.hazmat.primitives.ciphers.aead import AESGCM
     nonce = os.urandom(12)
-    key = _derive_key(secret, salt.encode("utf-8"))
+    file_salt = os.urandom(_SALT_LEN)
+    key = _derive_key(secret, salt.encode("utf-8") + file_salt)
     sealed = AESGCM(key).encrypt(nonce, data, _MAGIC)
-    return _MAGIC + nonce + sealed
+    return _MAGIC + file_salt + nonce + sealed
 
 
 def decrypt_bytes(blob: bytes, secret: str, salt: str = "analytics-zoo"
                   ) -> bytes:
     from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-    if not blob.startswith(_MAGIC):
-        raise ValueError("Not an encrypted model blob (bad magic)")
-    nonce = blob[len(_MAGIC):len(_MAGIC) + 12]
-    sealed = blob[len(_MAGIC) + 12:]
-    key = _derive_key(secret, salt.encode("utf-8"))
-    return AESGCM(key).decrypt(nonce, sealed, _MAGIC)
+    if blob.startswith(_MAGIC):
+        off = len(_MAGIC)
+        file_salt = blob[off:off + _SALT_LEN]
+        nonce = blob[off + _SALT_LEN:off + _SALT_LEN + 12]
+        sealed = blob[off + _SALT_LEN + 12:]
+        key = _derive_key(secret, salt.encode("utf-8") + file_salt)
+        return AESGCM(key).decrypt(nonce, sealed, _MAGIC)
+    if blob.startswith(_MAGIC_V1):  # legacy fixed-salt files
+        nonce = blob[len(_MAGIC_V1):len(_MAGIC_V1) + 12]
+        sealed = blob[len(_MAGIC_V1) + 12:]
+        key = _derive_key(secret, salt.encode("utf-8"))
+        return AESGCM(key).decrypt(nonce, sealed, _MAGIC_V1)
+    raise ValueError("Not an encrypted model blob (bad magic)")
 
 
 def encrypt_file(src: str, dst: str, secret: str,

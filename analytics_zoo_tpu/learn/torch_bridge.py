@@ -72,8 +72,9 @@ def _convert(module) -> List:
         layer = L.Convolution2D(
             module.out_channels, module.kernel_size[0], module.kernel_size[1],
             subsample=module.stride, border_mode="same" if same else "valid",
-            dim_ordering="th", use_bias=module.bias is not None)
-        w = module.weight.detach().numpy()            # [O, I, H, W]
+            dim_ordering="th", use_bias=module.bias is not None,
+            groups=module.groups)
+        w = module.weight.detach().numpy()            # [O, I/groups, H, W]
         params = {"kernel": np.transpose(w, (2, 3, 1, 0)).copy()}  # HWIO
         if module.bias is not None:
             params["bias"] = module.bias.detach().numpy().copy()

@@ -95,6 +95,15 @@ def _sym_pads(pads: Sequence[int], rank: int):
     return list(zip(begin, end))
 
 
+def _pad_lambda(pad_cfg, value: float = 0.0):
+    """A LambdaLayer that jnp.pads with `value` — shared by every conv/pool
+    padding path so pad semantics live in one place."""
+    def fn(t, pc=tuple(pad_cfg), v=value):
+        import jax.numpy as jnp
+        return jnp.pad(t, pc, constant_values=v)
+    return LambdaLayer(fn)
+
+
 class _OnnxGraphBuilder:
     def __init__(self, graph: Dict):
         self.graph = graph
@@ -107,26 +116,23 @@ class _OnnxGraphBuilder:
     # -- helpers -----------------------------------------------------------
     def _pool(self, node, attrs, cls):
         k = attrs.get("kernel_shape", [2, 2])
-        strides = attrs.get("strides", k)
+        strides = attrs.get("strides", [1] * len(k))  # ONNX default is 1
         pads = attrs.get("pads", [0] * 4)
         x = self.nodes[node["input"][0]]
         if any(pads):
-            sym = _sym_pads(pads, 2)
-            if not all(a == b for a, b in sym):
-                raise NotImplementedError("asymmetric pool pads")
+            (pt, pb), (pl, pr) = _sym_pads(pads, 2)
+            pad_cfg = ((0, 0), (0, 0), (pt, pb), (pl, pr))
             if cls is L.AveragePooling2D \
                     and not int(attrs.get("count_include_pad", 0)):
                 # ONNX default excludes pad zeros from the average:
                 # sum-pool(padded x) / sum-pool(padded ones)
-                ph, pw = sym[0][0], sym[1][0]
                 kk, ss = tuple(k), tuple(strides)
 
-                def avg_exclude_pad(t, ph=ph, pw=pw, kk=kk, ss=ss):
+                def avg_exclude_pad(t, pc=pad_cfg, kk=kk, ss=ss):
                     import jax
                     import jax.numpy as jnp
-                    pad_cfg = ((0, 0), (0, 0), (ph, ph), (pw, pw))
-                    tp = jnp.pad(t, pad_cfg)
-                    cnt = jnp.pad(jnp.ones_like(t), pad_cfg)
+                    tp = jnp.pad(t, pc)
+                    cnt = jnp.pad(jnp.ones_like(t), pc)
                     win = (1, 1) + kk
                     st = (1, 1) + ss
                     s = jax.lax.reduce_window(tp, 0.0, jax.lax.add, win,
@@ -136,16 +142,10 @@ class _OnnxGraphBuilder:
                     return s / n
 
                 return LambdaLayer(avg_exclude_pad)(x)
-            ph, pw = sym[0][0], sym[1][0]
-            if cls is L.MaxPooling2D:
-                # ONNX MaxPool pads with -inf, not zeros
-                def neg_pad(t, ph=ph, pw=pw):
-                    import jax.numpy as jnp
-                    return jnp.pad(t, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
-                                   constant_values=-jnp.inf)
-                x = LambdaLayer(neg_pad)(x)
-            else:
-                x = L.ZeroPadding2D((ph, pw), dim_ordering="th")(x)
+            # ONNX MaxPool pads with -inf, not zeros
+            x = _pad_lambda(pad_cfg,
+                            value=-np.inf if cls is L.MaxPooling2D
+                            else 0.0)(x)
         return cls(pool_size=tuple(k), strides=tuple(strides),
                    border_mode="valid", dim_ordering="th")(x)
 
@@ -171,16 +171,11 @@ class _OnnxGraphBuilder:
             fns = {"Add": lambda x: c + x, "Sub": lambda x: c - x,
                    "Mul": lambda x: c * x, "Div": lambda x: c / x}
             return LambdaLayer(fns[op])(self.nodes[b_name])
-        if op == "Add":
-            return L.Merge(mode="sum")([self.nodes[a_name],
-                                        self.nodes[b_name]])
-        if op == "Mul":
-            return L.Merge(mode="mul")([self.nodes[a_name],
-                                        self.nodes[b_name]])
-        if op == "Sub":
-            from analytics_zoo_tpu.keras2.layers import Subtract
-            return Subtract()([self.nodes[a_name], self.nodes[b_name]])
-        raise NotImplementedError(f"tensor-tensor {op}")
+        # tensor-tensor with numpy broadcasting semantics
+        fns = {"Add": lambda a, b: a + b, "Sub": lambda a, b: a - b,
+               "Mul": lambda a, b: a * b, "Div": lambda a, b: a / b}
+        return LambdaLayer(fns[op])([self.nodes[a_name],
+                                     self.nodes[b_name]])
 
     # -- op dispatch -------------------------------------------------------
     def handle(self, node: Dict):
@@ -231,7 +226,7 @@ class _OnnxGraphBuilder:
             self.nodes[out_name] = L.Flatten()(
                 self.nodes[node["input"][0]])
         elif op == "Reshape":
-            self.nodes[out_name] = self._reshape(node)
+            self.nodes[out_name] = self._reshape(node, attrs)
         elif op == "Concat":
             axis = int(attrs.get("axis", 1))
             self.nodes[out_name] = L.Merge(mode="concat", concat_axis=axis)(
@@ -261,30 +256,24 @@ class _OnnxGraphBuilder:
         b = self.consts.get(node["input"][2]) if len(node["input"]) > 2 \
             else None
         group = int(attrs.get("group", 1))
-        if group != 1:
-            raise NotImplementedError("grouped Conv")
         strides = attrs.get("strides", [1, 1])
         dilations = attrs.get("dilations", [1, 1])
         pads = attrs.get("pads", [0, 0, 0, 0])
         x = self.nodes[node["input"][0]]
         if any(pads):
-            sym = _sym_pads(pads, 2)
-            if all(a == b2 for a, b2 in sym):
-                x = L.ZeroPadding2D((sym[0][0], sym[1][0]),
-                                    dim_ordering="th")(x)
-            else:
-                raise NotImplementedError("asymmetric conv pads")
+            (pt, pb), (pl, pr) = _sym_pads(pads, 2)
+            x = _pad_lambda(((0, 0), (0, 0), (pt, pb), (pl, pr)))(x)
         out_ch, _, kh, kw = w.shape
         if list(dilations) != [1, 1]:
             layer = L.AtrousConvolution2D(
                 out_ch, kh, kw, atrous_rate=tuple(dilations),
                 subsample=tuple(strides), border_mode="valid",
-                dim_ordering="th", use_bias=b is not None)
+                dim_ordering="th", use_bias=b is not None, groups=group)
         else:
             layer = L.Convolution2D(
                 out_ch, kh, kw, subsample=tuple(strides),
                 border_mode="valid", dim_ordering="th",
-                use_bias=b is not None)
+                use_bias=b is not None, groups=group)
         params = {"kernel": np.transpose(w, (2, 3, 1, 0)).copy()}  # → HWIO
         if b is not None:
             params["bias"] = b
@@ -298,20 +287,26 @@ class _OnnxGraphBuilder:
             w = w.T
         if int(attrs.get("transA", 0)):
             raise NotImplementedError("Gemm transA")
+        alpha = float(attrs.get("alpha", 1.0))
+        beta = float(attrs.get("beta", 1.0))
         layer = L.Dense(w.shape[1], use_bias=b is not None)
-        params = {"kernel": w.copy()}
+        params = {"kernel": (w * alpha).astype(w.dtype)
+                  if alpha != 1.0 else w.copy()}
         if b is not None:
-            params["bias"] = b
+            params["bias"] = (b * beta).astype(b.dtype) if beta != 1.0 else b
         return _with_weights(layer, params)(self.nodes[node["input"][0]])
 
     def _matmul(self, node):
         a, b = node["input"][:2]
-        if b in self.consts:
+        if b in self.consts and a in self.nodes:
             w = self.consts[b]
             layer = L.Dense(w.shape[-1], use_bias=False)
             return _with_weights(layer, {"kernel": w.copy()})(self.nodes[a])
-        from analytics_zoo_tpu.ops.autograd import mm
-        raise NotImplementedError("tensor-tensor MatMul")
+        if a in self.consts:
+            c = self.consts[a].astype(np.float32)
+            return LambdaLayer(lambda y, c=c: c @ y)(self.nodes[b])
+        return LambdaLayer(lambda x, y: x @ y)([self.nodes[a],
+                                                self.nodes[b]])
 
     def _batchnorm(self, node, attrs):
         gamma = self.consts[node["input"][1]]
@@ -325,12 +320,24 @@ class _OnnxGraphBuilder:
             "moving_mean": mean, "moving_var": var,
         })(self.nodes[node["input"][0]])
 
-    def _reshape(self, node):
+    def _reshape(self, node, attrs):
         shape = self.consts[node["input"][1]].astype(np.int64).tolist()
-        # ONNX shape includes batch; 0 = copy input dim. Batch stays
-        # implicit in our Reshape.
-        target = [int(-1 if d == -1 else d) for d in shape[1:]]
-        return L.Reshape(tuple(target))(self.nodes[node["input"][0]])
+        if int(attrs.get("allowzero", 0)) and 0 in shape:
+            raise NotImplementedError("Reshape allowzero=1 with a 0 dim")
+        # ONNX shape includes batch; 0 = copy the corresponding input dim
+        # (allowzero=0 default). Batch stays implicit in our Reshape.
+        src = self.nodes[node["input"][0]]
+        in_shape = list(getattr(src, "shape", ()) or ())  # (None, ...) batch
+        target = []
+        for i, d in enumerate(shape[1:]):   # in_shape[i + 1] is the match
+            if d == 0:
+                if i + 1 >= len(in_shape) or in_shape[i + 1] is None:
+                    raise NotImplementedError(
+                        "Reshape 0-dim with unknown input dimension")
+                target.append(int(in_shape[i + 1]))
+            else:
+                target.append(int(d))
+        return L.Reshape(tuple(target))(src)
 
     def _pad(self, node, attrs):
         pads = attrs.get("pads")

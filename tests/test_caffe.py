@@ -211,18 +211,74 @@ class TestCaffeImport:
         assert got.shape == (1, 1, 3, 3)
         np.testing.assert_allclose(got, np.ones((1, 1, 3, 3)), rtol=1e-5)
 
-    def test_dilated_conv_raises(self, tmp_path):
+    def test_dilated_conv(self, tmp_path):
+        # dilation=2: effective kernel 5, 6→2 outputs (ref
+        # LayerConverter.scala dilation handling)
+        rs = np.random.RandomState(6)
+        w = rs.randn(2, 1, 3, 3).astype(np.float32)
         prototxt = '''
         layer { name: "data" type: "Input" top: "data"
                 input_param { shape { dim: 1 dim: 1 dim: 6 dim: 6 } } }
         layer { name: "c" type: "Convolution" bottom: "data" top: "c"
                 convolution_param { num_output: 2 kernel_size: 3
-                                    dilation: 2 } }
+                                    dilation: 2 bias_term: false } }
         '''
-        def_p, model_p = _write(tmp_path, prototxt,
-                                {"c": [np.zeros((2, 1, 3, 3), np.float32)]})
-        with pytest.raises(NotImplementedError, match="dilated"):
-            load_caffe(def_p, model_p)
+        def_p, model_p = _write(tmp_path, prototxt, {"c": [w]})
+        model = load_caffe(def_p, model_p)
+        x = rs.rand(1, 1, 6, 6).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        assert got.shape == (1, 2, 2, 2)
+        ref = np.zeros((1, 2, 2, 2), np.float32)
+        for o in range(2):
+            for oy in range(2):
+                for ox in range(2):
+                    patch = x[0, 0, oy:oy + 5:2, ox:ox + 5:2]
+                    ref[0, o, oy, ox] = (patch * w[o, 0]).sum()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_grouped_conv(self, tmp_path):
+        # group=2 over 4 input channels → each pair of outputs sees its own
+        # half of the input (ref LayerConverter.scala nGroup)
+        rs = np.random.RandomState(7)
+        w = rs.randn(4, 2, 3, 3).astype(np.float32)   # [O, I/group, kh, kw]
+        prototxt = '''
+        layer { name: "data" type: "Input" top: "data"
+                input_param { shape { dim: 1 dim: 4 dim: 5 dim: 5 } } }
+        layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+                convolution_param { num_output: 4 kernel_size: 3
+                                    group: 2 bias_term: false } }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt, {"c": [w]})
+        model = load_caffe(def_p, model_p)
+        x = rs.rand(1, 4, 5, 5).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        from scipy.signal import correlate
+        ref = np.zeros((1, 4, 3, 3), np.float32)
+        for o in range(4):
+            g = o // 2
+            for i in range(2):
+                ref[0, o] += correlate(x[0, 2 * g + i], w[o, i],
+                                       mode="valid")
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_ave_pool_pad_counts_in_area(self, tmp_path):
+        # caffe AVE divides by the window area clipped to the PADDED input:
+        # 4x4 ones, k=3 s=1 p=1 → corner windows hold 4 ones / area 9
+        prototxt = '''
+        layer { name: "data" type: "Input" top: "data"
+                input_param { shape { dim: 1 dim: 1 dim: 4 dim: 4 } } }
+        layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+                pooling_param { pool: AVE kernel_size: 3 stride: 1
+                                pad: 1 } }
+        '''
+        def_p, model_p = _write(tmp_path, prototxt, {})
+        model = load_caffe(def_p, model_p)
+        x = np.ones((1, 1, 4, 4), np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        assert got.shape == (1, 1, 4, 4)
+        assert got[0, 0, 0, 0] == pytest.approx(4.0 / 9.0, rel=1e-5)
+        assert got[0, 0, 0, 1] == pytest.approx(6.0 / 9.0, rel=1e-5)
+        assert got[0, 0, 1, 1] == pytest.approx(1.0, rel=1e-5)
 
     def test_hash_inside_quoted_name(self):
         tree = parse_prototxt('name: "conv#1"  # trailing comment\n')

@@ -175,6 +175,18 @@ class TestFromTorch:
         got = est.predict(x, batch_per_thread=2)
         np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
 
+    def test_grouped_conv_converts(self):
+        import torch
+        import torch.nn as nn
+        tm = nn.Sequential(nn.Conv2d(4, 4, 3, groups=2, padding=1),
+                           nn.ReLU())
+        est = Estimator.from_torch(tm, loss="mse", optimizer="sgd")
+        x = np.random.RandomState(1).rand(2, 4, 8, 8).astype(np.float32)
+        with torch.no_grad():
+            expected = tm(torch.from_numpy(x)).numpy()
+        got = est.predict(x, batch_per_thread=2)
+        np.testing.assert_allclose(got, expected, rtol=2e-2, atol=1e-2)
+
     def test_unsupported_module_rejected(self):
         import torch.nn as nn
         with pytest.raises(ValueError, match="Unsupported torch module"):

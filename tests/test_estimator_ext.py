@@ -59,6 +59,29 @@ class TestEncrypted:
         with pytest.raises(ValueError, match="magic"):
             decrypt_bytes(b"garbage", "pw")
 
+    def test_per_file_salt_uniqueness(self):
+        # v2: random per-file salt in the header → same (data, secret)
+        # yields different blobs, and both still decrypt
+        a = encrypt_bytes(b"weights", "pw")
+        b = encrypt_bytes(b"weights", "pw")
+        assert a != b
+        assert decrypt_bytes(a, "pw") == b"weights"
+        assert decrypt_bytes(b, "pw") == b"weights"
+
+    def test_v1_legacy_blob_decrypts(self):
+        # hand-built v1 blob (fixed-salt format) must still open
+        import os as _os
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        from analytics_zoo_tpu.learn.encrypted import (_MAGIC_V1,
+                                                       _derive_key)
+        nonce = _os.urandom(12)
+        key = _derive_key("pw", b"analytics-zoo")
+        blob = _MAGIC_V1 + nonce + AESGCM(key).encrypt(
+            nonce, b"old data", _MAGIC_V1)
+        assert decrypt_bytes(blob, "pw") == b"old data"
+
     def test_pytree_roundtrip(self, tmp_path):
         tree = {"dense": {"kernel": np.random.rand(3, 4).astype(np.float32),
                           "bias": np.zeros(4, np.float32)}}

@@ -331,3 +331,146 @@ class TestOnnxOps:
         after = float(np.mean(
             (np.asarray(model.predict(x, batch_per_thread=64)) - y) ** 2))
         assert after < before
+
+class TestOnnxOpsRound2:
+    """Regression tests for round-2 importer fixes: default pool strides,
+    Gemm alpha/beta, grouped/depthwise conv, asymmetric pads, tensor-tensor
+    binops, Reshape 0-dims (ONNX spec defaults; ref mapper/gemm.py:35,
+    mapper/maxpool.py:37)."""
+
+    def test_pool_default_strides_is_one(self):
+        # ONNX default strides = 1 (NOT kernel_shape): 4x4 k=2 → 3x3
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 1, 4, 4])],
+            "output": [_vinfo("y", [0, 1, 3, 3])],
+            "node": [{"op_type": ["MaxPool"], "input": ["x"],
+                      "output": ["y"],
+                      "attribute": [_attr_ints("kernel_shape", [2, 2])]}],
+        }
+        model = load_onnx(_model(graph))
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        assert got.shape == (1, 1, 3, 3)
+        ref = np.asarray([[[[5, 6, 7], [9, 10, 11], [13, 14, 15]]]],
+                         np.float32)
+        np.testing.assert_allclose(got, ref)
+
+    def test_gemm_alpha_beta(self):
+        rs = np.random.RandomState(7)
+        w = rs.randn(4, 3).astype(np.float32)
+        b = rs.randn(4).astype(np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 3])],
+            "output": [_vinfo("y", [0, 4])],
+            "initializer": [_tensor("w", w), _tensor("b", b)],
+            "node": [{"op_type": ["Gemm"], "input": ["x", "w", "b"],
+                      "output": ["y"],
+                      "attribute": [_attr_int("transB", 1),
+                                    _attr_float("alpha", 0.5),
+                                    _attr_float("beta", 2.0)]}],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.randn(2, 3).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=2))
+        np.testing.assert_allclose(got, 0.5 * (x @ w.T) + 2.0 * b,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_depthwise_conv_group(self):
+        rs = np.random.RandomState(8)
+        C = 3
+        w = rs.randn(C, 1, 3, 3).astype(np.float32)   # group == C
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, C, 6, 6])],
+            "output": [_vinfo("y", [0, C, 4, 4])],
+            "initializer": [_tensor("w", w)],
+            "node": [{"op_type": ["Conv"], "input": ["x", "w"],
+                      "output": ["y"],
+                      "attribute": [_attr_ints("kernel_shape", [3, 3]),
+                                    _attr_int("group", C)]}],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.rand(1, C, 6, 6).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        from scipy.signal import correlate
+        ref = np.stack([correlate(x[0, c], w[c, 0], mode="valid")
+                        for c in range(C)])[None]
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_grouped_conv_two_groups(self):
+        rs = np.random.RandomState(9)
+        w = rs.randn(4, 2, 3, 3).astype(np.float32)   # 4 out, in 4, group 2
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 4, 5, 5])],
+            "output": [_vinfo("y", [0, 4, 3, 3])],
+            "initializer": [_tensor("w", w)],
+            "node": [{"op_type": ["Conv"], "input": ["x", "w"],
+                      "output": ["y"],
+                      "attribute": [_attr_ints("kernel_shape", [3, 3]),
+                                    _attr_int("group", 2)]}],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.rand(1, 4, 5, 5).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        from scipy.signal import correlate
+        ref = np.zeros((1, 4, 3, 3), np.float32)
+        for o in range(4):
+            g = o // 2                                 # 2 outputs per group
+            for i in range(2):
+                ref[0, o] += correlate(x[0, 2 * g + i], w[o, i],
+                                       mode="valid")
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_asymmetric_conv_pads(self):
+        rs = np.random.RandomState(10)
+        w = rs.randn(1, 1, 2, 2).astype(np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 1, 4, 4])],
+            "output": [_vinfo("y", [0, 1, 4, 4])],
+            "initializer": [_tensor("w", w)],
+            "node": [{"op_type": ["Conv"], "input": ["x", "w"],
+                      "output": ["y"],
+                      "attribute": [_attr_ints("kernel_shape", [2, 2]),
+                                    _attr_ints("pads", [1, 1, 0, 0])]}],
+        }
+        model = load_onnx(_model(graph))
+        x = rs.rand(1, 1, 4, 4).astype(np.float32)
+        got = np.asarray(model.predict(x, batch_per_thread=1))
+        from scipy.signal import correlate
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 0), (1, 0)))
+        ref = correlate(xp[0, 0], w[0, 0], mode="valid")[None, None]
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_tensor_tensor_div(self):
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("a", [0, 4]), _vinfo("b", [0, 4])],
+            "output": [_vinfo("y", [0, 4])],
+            "node": [{"op_type": ["Div"], "input": ["a", "b"],
+                      "output": ["y"]}],
+        }
+        model = load_onnx(_model(graph))
+        rs = np.random.RandomState(11)
+        a = rs.rand(2, 4).astype(np.float32) + 1.0
+        b = rs.rand(2, 4).astype(np.float32) + 1.0
+        got = np.asarray(model.predict([a, b], batch_per_thread=2))
+        np.testing.assert_allclose(got, a / b, rtol=1e-5)
+
+    def test_reshape_zero_copies_input_dim(self):
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 3, 4])],
+            "output": [_vinfo("y", [0, 3, 2, 2])],
+            "initializer": [_tensor(
+                "s", np.asarray([0, 0, 2, 2], np.int64))],
+            "node": [{"op_type": ["Reshape"], "input": ["x", "s"],
+                      "output": ["y"]}],
+        }
+        model = load_onnx(_model(graph))
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        got = np.asarray(model.predict(x, batch_per_thread=2))
+        np.testing.assert_allclose(got, x.reshape(2, 3, 2, 2))
