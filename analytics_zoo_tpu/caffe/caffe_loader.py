@@ -254,9 +254,9 @@ class _CaffeGraphBuilder:
                 return ssum / jnp.maximum(area, 1.0)
             return LambdaLayer(ave_fn)(x)
         if ph or pw or extra_h or extra_w:
-            from analytics_zoo_tpu.onnx.onnx_loader import _pad_lambda
-            x = _pad_lambda(((0, 0), (0, 0), (ph, ph + extra_h),
-                             (pw, pw + extra_w)), value=-np.inf)(x)
+            from analytics_zoo_tpu.ops.autograd import pad_lambda
+            x = pad_lambda(((0, 0), (0, 0), (ph, ph + extra_h),
+                            (pw, pw + extra_w)), value=-np.inf)(x)
         cls = L.MaxPooling2D if mode in ("MAX", "0") else L.AveragePooling2D
         return cls(pool_size=(kh, kw), strides=(sh, sw),
                    border_mode="valid", dim_ordering="th")(x)

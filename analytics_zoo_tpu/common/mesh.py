@@ -99,6 +99,11 @@ class DeviceMesh:
         batch-like axis (data × fsdp), rest replicated."""
         return NamedSharding(self.mesh, PartitionSpec(BATCH_AXES))
 
+    def stacked_batch_sharding(self) -> NamedSharding:
+        """Sharding for a (steps, batch, ...) stack of training batches:
+        leading scan dim replicated, batch dim split like batch_sharding."""
+        return NamedSharding(self.mesh, PartitionSpec(None, BATCH_AXES))
+
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, PartitionSpec())
 

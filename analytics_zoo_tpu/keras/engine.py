@@ -212,6 +212,12 @@ class KerasNet:
             self.loss = objectives.get(loss)
         self.optimizer = optimizers.get(optimizer)
         self.metrics = zmetrics.resolve(metrics, loss_str)
+        # recompiling invalidates any jitted closures built over the old
+        # optimizer/loss/metrics (id() reuse after GC makes key checks
+        # alone unreliable)
+        for cache in ("_train_cache", "_eval_cache", "_predict_cache"):
+            if hasattr(self, cache):
+                delattr(self, cache)
 
     def set_tensorboard(self, log_dir: str, app_name: str):
         """`Topology.scala:208`."""

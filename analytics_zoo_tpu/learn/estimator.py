@@ -120,8 +120,12 @@ class Estimator:
     # -- training with retry/resume ---------------------------------------
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
             validation_data=None, checkpoint_trigger=None,
-            feature_cols=None, label_cols=None, seed: int = 0
-            ) -> Dict[str, List[float]]:
+            feature_cols=None, label_cols=None, seed: int = 0,
+            **fit_kwargs) -> Dict[str, List[float]]:
+        """`fit_kwargs` pass through to the trainer loop: `steps_per_run=k`
+        fuses k steps per dispatch, `mixed_precision=True` runs bf16
+        compute with f32 masters, `prefetch=False` disables the
+        background batch pipeline."""
         ds = to_dataset(data, batch_size=batch_size or 32,
                         feature_cols=feature_cols, label_cols=label_cols)
         # a pre-built TPUDataset's own batch/shuffle settings win over fit()
@@ -162,7 +166,7 @@ class Estimator:
                     shuffle=ds.shuffle,
                     checkpoint_trigger=checkpoint_trigger,
                     seed=seed + epoch_done,
-                    batch_iter_factory=batch_iter_factory)
+                    batch_iter_factory=batch_iter_factory, **fit_kwargs)
                 for k, v in h.items():
                     history.setdefault(k, []).extend(v)
                 break

@@ -83,14 +83,116 @@ def check_global_batch(batch_size: int, dp: int) -> None:
             f"contract (tf_dataset.py:142-147)")
 
 
-def _put_batch(tree, mesh):
-    """mesh=None → single default device (non-distributed escape hatch)."""
+def _put_batch(tree, mesh, stacked: bool = False):
+    """mesh=None → single default device (non-distributed escape hatch).
+    stacked=True for (steps, batch, ...) multi-step stacks."""
     if mesh is None:
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(jnp.asarray(a)), tree)
-    sharding = mesh.batch_sharding()
+    sharding = mesh.stacked_batch_sharding() if stacked \
+        else mesh.batch_sharding()
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(jnp.asarray(a), sharding), tree)
+
+
+def _materialize(x):
+    """THE host-sync point of the training loop: every device→host readback
+    in fit_keras funnels through here so tests can count syncs (one per
+    logging interval, not one per step)."""
+    return jax.device_get(x)
+
+
+class _Prefetcher:
+    """Background-thread batch prefetch: prepares + device_puts the next
+    item while the device runs the current one. Depth-bounded so host
+    memory stays flat. The TPU analogue of the reference FeatureSet's
+    prefetching cached tier."""
+
+    _END = object()
+
+    def __init__(self, source_iter, transfer, depth: int = 2):
+        import queue
+        import threading
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._err = None
+        self._stop = False
+        self._queue_mod = queue
+
+        def worker():
+            try:
+                for item in source_iter:
+                    out = transfer(item)
+                    while not self._stop:
+                        try:
+                            self._q.put(out, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop:
+                        return
+            except BaseException as e:   # propagate to consumer
+                self._err = e
+            finally:
+                # blocking put with stop checks: a full queue must not
+                # swallow the END sentinel (the consumer would hang)
+                while not self._stop:
+                    try:
+                        self._q.put(self._END, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._t = threading.Thread(target=worker, daemon=True)
+        self._t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self):
+        """Unblock and retire the worker (early exit via end_trigger)."""
+        self._stop = True
+        try:
+            while True:
+                self._q.get_nowait()
+        except self._queue_mod.Empty:
+            pass
+
+
+def _chunk_batches(it, k: int):
+    """Group (xb, yb, real) triples into lists of up to k for multi-step
+    runs. The final short group is emitted as-is (compiled separately at
+    most once per distinct length)."""
+    group = []
+    for item in it:
+        group.append(item)
+        if len(group) == k:
+            yield group
+            group = []
+    if group:
+        yield group
+
+
+def _stack_group(group, mesh):
+    """Stack k (xb, yb, real) batches into device-resident (k, B, ...)
+    arrays sharded so the batch dim stays split over the mesh."""
+    xs = jax.tree_util.tree_map(lambda *a: np.stack(a),
+                                *[g[0] for g in group])
+    ys = None
+    if group[0][1] is not None:
+        ys = jax.tree_util.tree_map(lambda *a: np.stack(a),
+                                    *[g[1] for g in group])
+    real = sum(g[2] for g in group)
+    return (_put_batch(xs, mesh, stacked=True),
+            _put_batch(ys, mesh, stacked=True) if ys is not None else None,
+            real, len(group))
 
 
 def _put_replicated(tree, mesh):
@@ -117,32 +219,85 @@ def _merge_state(params, state_updates):
     return merged
 
 
-def build_train_step(apply_fn: Callable, loss_fn: Callable,
-                     optimizer: optax.GradientTransformation,
-                     apply_and_state_fn: Optional[Callable] = None
-                     ) -> Callable:
-    """One iteration as a pure function. jit + sharded inputs → GSPMD emits
-    the gradient all-reduce; donation reuses parameter buffers in HBM.
-    Stateful layers (BatchNorm moving stats) return updates through the aux
-    channel and are merged outside the gradient path."""
+def _cast_tree(tree, dtype, only=jnp.float32):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype) if a.dtype == only else a, tree)
 
-    def train_step(params, opt_state, xb, yb, rng):
+
+def _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
+                   mixed_precision):
+    def one_step(params, opt_state, xb, yb, rng):
         def compute_loss(p):
+            if mixed_precision:
+                p = _cast_tree(p, jnp.bfloat16)
             if apply_and_state_fn is not None:
                 pred, state_upd = apply_and_state_fn(p, xb, training=True,
                                                      rng=rng)
             else:
                 pred, state_upd = apply_fn(p, xb, training=True, rng=rng), {}
+            if mixed_precision:
+                pred = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.float32), pred)
             return loss_fn(yb, pred), state_upd
 
         (loss, state_upd), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(params)
+        if mixed_precision:
+            grads = _cast_tree(grads, jnp.float32, only=jnp.bfloat16)
+            # stateful updates (BatchNorm moving stats) were computed from
+            # the bf16-cast params — cast back so the f32 master tree never
+            # picks up bf16 leaves (dtype drift + donation mismatch)
+            state_upd = _cast_tree(state_upd, jnp.float32,
+                                   only=jnp.bfloat16)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         params = _merge_state(params, state_upd)
         return params, opt_state, loss
 
-    return jax.jit(train_step, donate_argnums=(0, 1))
+    return one_step
+
+
+def build_train_step(apply_fn: Callable, loss_fn: Callable,
+                     optimizer: optax.GradientTransformation,
+                     apply_and_state_fn: Optional[Callable] = None,
+                     mixed_precision: bool = False
+                     ) -> Callable:
+    """One iteration as a pure function. jit + sharded inputs → GSPMD emits
+    the gradient all-reduce; donation reuses parameter buffers in HBM.
+    Stateful layers (BatchNorm moving stats) return updates through the aux
+    channel and are merged outside the gradient path.
+    mixed_precision=True keeps f32 master params and runs the fwd/bwd
+    matmuls in bf16 (MXU-native)."""
+    one_step = _make_one_step(apply_fn, loss_fn, optimizer,
+                              apply_and_state_fn, mixed_precision)
+    return jax.jit(one_step, donate_argnums=(0, 1))
+
+
+def build_train_run(apply_fn: Callable, loss_fn: Callable,
+                    optimizer: optax.GradientTransformation,
+                    apply_and_state_fn: Optional[Callable] = None,
+                    mixed_precision: bool = False) -> Callable:
+    """Multi-step variant: one jit'd program `lax.scan`s over a
+    (k, batch, ...) stack of batches, so k steps cost ONE dispatch and ONE
+    loss readback. This is the framework's hot path — the analogue of the
+    reference engine owning its hot loop (`Topology.scala:1160-1337`)."""
+    one_step = _make_one_step(apply_fn, loss_fn, optimizer,
+                              apply_and_state_fn, mixed_precision)
+
+    def train_run(params, opt_state, xs, ys, rng):
+        def body(carry, batch):
+            params, opt_state, rng = carry
+            rng, sub = jax.random.split(rng)
+            xb, yb = batch
+            params, opt_state, loss = one_step(params, opt_state, xb, yb,
+                                               sub)
+            return (params, opt_state, rng), loss
+
+        (params, opt_state, rng), losses = jax.lax.scan(
+            body, (params, opt_state, rng), (xs, ys))
+        return params, opt_state, rng, losses
+
+    return jax.jit(train_run, donate_argnums=(0, 1))
 
 
 def build_eval_step(apply_fn: Callable, metrics: Sequence) -> Callable:
@@ -160,15 +315,28 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               validation_data=None, distributed: bool = True,
               shuffle: bool = True, checkpoint_trigger=None,
               end_trigger=None, seed: int = 0,
-              batch_iter_factory: Optional[Callable] = None
-              ) -> Dict[str, List[float]]:
+              batch_iter_factory: Optional[Callable] = None,
+              steps_per_run: int = 1, mixed_precision: bool = False,
+              prefetch: bool = True) -> Dict[str, List[float]]:
     """`KerasNet.fit` backend. Returns a Keras-style history dict.
     `batch_iter_factory(epoch) -> iterator of (xb, yb, real)` overrides the
-    default in-memory batching (lazy/disk-tier datasets)."""
+    default in-memory batching (lazy/disk-tier datasets).
+
+    The loop is fully asynchronous: batches are device_put by a prefetch
+    thread while the device computes, the per-step loss stays on device,
+    and the ONLY host sync is one `_materialize` per epoch (plus any
+    loss-reading trigger the caller installs). `steps_per_run=k` fuses k
+    steps into one `lax.scan` program — one dispatch per k steps —
+    trading trigger granularity (checked every k iterations) for dispatch
+    overhead. `mixed_precision` runs fwd/bwd in bf16 with f32 masters.
+    After fit, `model.params` holds DEVICE arrays (no gratuitous
+    device→host pull; save/checkpoint paths transfer on demand)."""
     ctx = get_context()
     mesh = ctx.mesh if distributed else None
     dp = mesh.data_parallel_size if mesh else 1
     check_global_batch(batch_size, dp)
+    if steps_per_run < 1:
+        raise ValueError(f"steps_per_run must be >=1, got {steps_per_run}")
 
     if batch_iter_factory is None:
         n = _tree_len(x)
@@ -196,9 +364,22 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                            "(`Topology.scala:139` contract)")
     params = _put_replicated(model.params, mesh)
     opt_state = _put_replicated(optimizer.init(params), mesh)
-    train_step = build_train_step(
-        model.apply, model.loss, optimizer,
-        apply_and_state_fn=getattr(model, "apply_and_state", None))
+
+    # Cache the jitted step on the model: repeated fit calls (warm restarts,
+    # per-round loops) must hit the compile cache, not rebuild a fresh
+    # closure every call.
+    multi = steps_per_run > 1
+    cache_key = (id(optimizer), id(model.loss), multi, mixed_precision)
+    cached = getattr(model, "_train_cache", None)
+    if cached is not None and cached[0] == cache_key:
+        train_step = cached[1]
+    else:
+        builder = build_train_run if multi else build_train_step
+        train_step = builder(
+            model.apply, model.loss, optimizer,
+            apply_and_state_fn=getattr(model, "apply_and_state", None),
+            mixed_precision=mixed_precision)
+        model._train_cache = (cache_key, train_step)
 
     ckpt_mgr = None
     if model._checkpoint_path:
@@ -214,66 +395,103 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
 
     history: Dict[str, List[float]] = {"loss": []}
     iteration = 0
-    for epoch in range(epochs):
-        ep_loss, ep_batches = 0.0, 0
-        t0 = time.time()
-        n_seen = 0
-        for xb, yb, real in batch_iter_factory(epoch):
-            xb = _put_batch(xb, mesh)
-            yb = _put_batch(yb, mesh) if yb is not None else None
-            rng, step_rng = jax.random.split(rng)
-            params, opt_state, loss = train_step(params, opt_state, xb, yb,
-                                                 step_rng)
-            iteration += 1
-            ep_batches += 1
-            n_seen += real
-            ep_loss += float(loss)
-            if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
-                    tg.TriggerState(epoch=epoch, iteration=iteration,
-                                    loss=float(loss))):
-                ckpt_mgr.save(iteration, jax.device_get(params),
-                              jax.device_get(opt_state),
-                              extra={"epoch": epoch, "iteration": iteration})
-            if end_trigger and end_trigger(
-                    tg.TriggerState(epoch=epoch, iteration=iteration,
-                                    loss=float(loss))):
-                break
-        dt = time.time() - t0
-        mean_loss = ep_loss / max(ep_batches, 1)
-        history["loss"].append(mean_loss)
-        throughput = n_seen / max(dt, 1e-9)
+    batches = None
+    try:
+        for epoch in range(epochs):
+          losses_dev: List[Any] = []   # device scalars/vectors; sync at end
+          t0 = time.time()
+          n_seen = 0
+
+          if multi:
+              def transfer(group):
+                  return _stack_group(group, mesh)
+              source = _chunk_batches(batch_iter_factory(epoch), steps_per_run)
+          else:
+              def transfer(item):
+                  xb, yb, real = item
+                  return (_put_batch(xb, mesh),
+                          _put_batch(yb, mesh) if yb is not None else None,
+                          real, 1)
+              source = batch_iter_factory(epoch)
+          batches = _Prefetcher(source, transfer) if prefetch \
+              else map(transfer, source)
+
+          for xb, yb, real, k in batches:
+              if multi:
+                  rng, run_rng = jax.random.split(rng)
+                  params, opt_state, _, loss = train_step(
+                      params, opt_state, xb, yb, run_rng)
+              else:
+                  rng, step_rng = jax.random.split(rng)
+                  params, opt_state, loss = train_step(params, opt_state,
+                                                       xb, yb, step_rng)
+              iteration += k
+              n_seen += real
+              losses_dev.append(loss)
+              # loss stays a device scalar: triggers that read .loss (Min/
+              # MaxLoss) force their own sync; counter triggers stay async
+              last_loss = loss[-1] if multi else loss
+              if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
+                      tg.TriggerState(epoch=epoch, iteration=iteration,
+                                      loss=last_loss)):
+                  ckpt_mgr.save(iteration, jax.device_get(params),
+                                jax.device_get(opt_state),
+                                extra={"epoch": epoch, "iteration": iteration})
+              if end_trigger and end_trigger(
+                      tg.TriggerState(epoch=epoch, iteration=iteration,
+                                      loss=last_loss)):
+                  break
+          if isinstance(batches, _Prefetcher):
+              batches.close()    # early break leaves the worker mid-queue
+          # ONE host sync per epoch: materialize every step loss together.
+          # This blocks until the last step's program has finished, so dt
+          # measures device compute, not dispatch.
+          step_losses = np.concatenate(
+              [np.atleast_1d(v) for v in _materialize(losses_dev)]) \
+              if losses_dev else np.zeros((0,))
+          dt = time.time() - t0
+          mean_loss = float(step_losses.mean()) if len(step_losses) else 0.0
+          history["loss"].append(mean_loss)
+          throughput = n_seen / max(dt, 1e-9)
+          if writer:
+              writer.scalar("Loss", mean_loss, iteration)
+              writer.scalar("Throughput", throughput, iteration)
+          log.info("Epoch %d/%d  loss=%.4f  %.0f samples/s",
+                   epoch + 1, epochs, mean_loss, throughput)
+
+          if validation_data is not None:
+              vx, vy = validation_data
+              model.params = params          # device-resident hand-off
+              val = evaluate_keras(model, vx, vy,
+                                   batch_per_thread=max(batch_size // dp, 1))
+              for k, v in val.items():
+                  history.setdefault("val_" + k, []).append(v)
+              if writer:
+                  for k, v in val.items():
+                      writer.scalar("val_" + k, v, iteration)
+
+          # epoch-boundary checkpoint trigger (EveryEpoch semantics)
+          if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
+                  tg.TriggerState(epoch=epoch + 1, iteration=iteration,
+                                  epoch_finished=True)):
+              ckpt_mgr.save(iteration, jax.device_get(params),
+                            jax.device_get(opt_state),
+                            extra={"epoch": epoch + 1, "iteration": iteration})
+          if end_trigger and end_trigger(
+                  tg.TriggerState(epoch=epoch + 1, iteration=iteration,
+                                  epoch_finished=True)):
+              break
+
+    finally:
+        # Keep parameters on device (even on an interrupted fit, so the
+        # model never points at donated/deleted buffers): repeated
+        # fit/evaluate/predict chains stay in HBM; save/checkpoint
+        # paths device_get on demand.
+        model.params = params
+        if isinstance(batches, _Prefetcher):
+            batches.close()
         if writer:
-            writer.scalar("Loss", mean_loss, iteration)
-            writer.scalar("Throughput", throughput, iteration)
-        log.info("Epoch %d/%d  loss=%.4f  %.0f samples/s",
-                 epoch + 1, epochs, mean_loss, throughput)
-
-        if validation_data is not None:
-            vx, vy = validation_data
-            model.params = jax.device_get(params)
-            val = evaluate_keras(model, vx, vy,
-                                 batch_per_thread=max(batch_size // dp, 1))
-            for k, v in val.items():
-                history.setdefault("val_" + k, []).append(v)
-            if writer:
-                for k, v in val.items():
-                    writer.scalar("val_" + k, v, iteration)
-
-        # epoch-boundary checkpoint trigger (EveryEpoch semantics)
-        if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
-                tg.TriggerState(epoch=epoch + 1, iteration=iteration,
-                                epoch_finished=True)):
-            ckpt_mgr.save(iteration, jax.device_get(params),
-                          jax.device_get(opt_state),
-                          extra={"epoch": epoch + 1, "iteration": iteration})
-        if end_trigger and end_trigger(
-                tg.TriggerState(epoch=epoch + 1, iteration=iteration,
-                                epoch_finished=True)):
-            break
-
-    model.params = jax.device_get(params)
-    if writer:
-        writer.close()
+            writer.close()
     return history
 
 
@@ -309,17 +527,32 @@ def evaluate_keras(model, x, y=None, batch_per_thread: int = 32,
         xb = _put_batch(xb, mesh)
         yb = _put_batch(yb, mesh) if yb is not None else None
         states = eval_step(params, states, xb, yb)
-    # tail batch (smaller; compiled separately once)
+    # tail batch: pad to the SAME full-batch shape (reuses the predict jit,
+    # no extra compile, no unjitted host apply), slice the real rows, and
+    # fold them into the accumulators host-side
     n = _tree_len(x)
     tail = n % batch
     if tail:
-        sel = np.arange(n - tail, n)
-        xb = jax.tree_util.tree_map(lambda a: np.asarray(a)[sel], x)
-        yb = jax.tree_util.tree_map(lambda a: np.asarray(a)[sel], y) \
-            if y is not None else None
-        states = [m.update(s, yb, model.apply(model.params, xb))
-                  for m, s in zip(ms, states)]
+        sel = np.concatenate([np.arange(n - tail, n),
+                              np.repeat([n - 1], batch - tail)])
+        xb = _put_batch(jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[sel], x), mesh)
+        yb = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[sel[:tail]], y) if y is not None \
+            else None
+        pred = jax.device_get(_forward_jit(model)(params, xb))
+        pred = jax.tree_util.tree_map(lambda a: np.asarray(a)[:tail], pred)
+        states = [m.update(s, yb, pred) for m, s in zip(ms, states)]
     return {m.name: float(m.compute(s)) for m, s in zip(ms, states)}
+
+
+def _forward_jit(model):
+    """Cached inference forward — shared by predict and the eval tail."""
+    fj = getattr(model, "_predict_cache", None)
+    if fj is None:
+        fj = jax.jit(lambda p, xb: model.apply(p, xb, training=False))
+        model._predict_cache = fj
+    return fj
 
 
 def predict_keras(model, x, batch_per_thread: int = 32) -> np.ndarray:
@@ -330,10 +563,7 @@ def predict_keras(model, x, batch_per_thread: int = 32) -> np.ndarray:
                                          drop_remainder=False,
                                          pad_to_batch=True))[0])
     params = _put_replicated(model.params, mesh)
-    apply_jit = getattr(model, "_predict_cache", None)
-    if apply_jit is None:
-        apply_jit = jax.jit(lambda p, xb: model.apply(p, xb, training=False))
-        model._predict_cache = apply_jit
+    apply_jit = _forward_jit(model)
     outs: List[np.ndarray] = []
     for xb, _, real in iter_batches(x, None, batch, drop_remainder=False,
                                     pad_to_batch=True):

@@ -28,6 +28,7 @@ from analytics_zoo_tpu.keras import layers as L
 from analytics_zoo_tpu.learn.torch_bridge import _with_weights
 from analytics_zoo_tpu.onnx import wire
 from analytics_zoo_tpu.ops.autograd import LambdaLayer
+from analytics_zoo_tpu.ops.autograd import pad_lambda as _pad_lambda
 
 # ONNX TensorProto.DataType → numpy
 _DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
@@ -95,13 +96,6 @@ def _sym_pads(pads: Sequence[int], rank: int):
     return list(zip(begin, end))
 
 
-def _pad_lambda(pad_cfg, value: float = 0.0):
-    """A LambdaLayer that jnp.pads with `value` — shared by every conv/pool
-    padding path so pad semantics live in one place."""
-    def fn(t, pc=tuple(pad_cfg), v=value):
-        import jax.numpy as jnp
-        return jnp.pad(t, pc, constant_values=v)
-    return LambdaLayer(fn)
 
 
 class _OnnxGraphBuilder:

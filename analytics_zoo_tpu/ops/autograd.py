@@ -56,6 +56,15 @@ class LambdaLayer(Layer):
 Lambda = LambdaLayer
 
 
+def pad_lambda(pad_cfg, value: float = 0.0) -> LambdaLayer:
+    """A LambdaLayer that jnp.pads with `value` — the one shared padding
+    path for the ONNX/Caffe importers' conv and pool mappings."""
+    def fn(t, pc=tuple(pad_cfg), v=value):
+        import jax.numpy as jnp
+        return jnp.pad(t, pc, constant_values=v)
+    return LambdaLayer(fn)
+
+
 class Variable:
     """Symbolic tensor with math operators (`math.scala:378`). Wraps a graph
     Node; interchangeable with Keras functional-API nodes."""

@@ -1,0 +1,46 @@
+"""Real-chip test subset (VERDICT r1 #2): runs whenever a TPU backend is
+reachable; cleanly skipped otherwise.
+
+`tests/conftest.py` pins the whole pytest process to the virtual CPU mesh
+before jax initializes. This directory collects AFTER every tests/test_*.py
+module (pytest walks files before subdirectories), so by the time these run
+the CPU suite is done and the process can be re-pointed at the TPU with the
+same backend-reset used by `__graft_entry__.dryrun_multichip`.
+"""
+
+import os
+
+import jax
+import pytest
+
+# tests/conftest.py force-sets JAX_PLATFORMS=cpu; the machine's original
+# platform (the TPU plugin) is what we must restore. Prefer an explicit
+# override, else the axon plugin the image ships.
+_TPU_PLATFORM = os.environ.get("ZOO_TPU_PLATFORM", "axon")
+
+
+def _switch_to_tpu() -> bool:
+    try:
+        import jax._src.xla_bridge as xb
+        xb._clear_backends()
+    except (ImportError, AttributeError):
+        return False
+    jax.clear_caches()
+    os.environ["JAX_PLATFORMS"] = _TPU_PLATFORM
+    try:
+        jax.config.update("jax_platforms", _TPU_PLATFORM)
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    if dev.platform != "tpu":
+        return False
+    # match the framework's TPU default (init_zoo_context): rbg PRNG
+    jax.config.update("jax_default_prng_impl", "rbg")
+    return True
+
+
+@pytest.fixture(scope="session", autouse=True)
+def tpu_backend():
+    if not _switch_to_tpu():
+        pytest.skip("no TPU backend reachable", allow_module_level=False)
+    yield
