@@ -84,8 +84,11 @@ class _AutoXGB:
             models[id(model)] = model
             return {"score": score, "_model_id": id(model)}
 
+        # thread backend only: train_fn shares the `models` dict with this
+        # process (xgboost/sklearn release the GIL during fit)
         engine = SearchEngine(metric="score", mode="max",
-                              num_samples=self.n_sampling, seed=self.seed)
+                              num_samples=self.n_sampling, seed=self.seed,
+                              backend="local")
         engine.compile((x, y), train_fn,
                        search_space=self.search_space)
         engine.run()

@@ -136,6 +136,8 @@ class TimeSequencePredictor:
     def fit(self, input_df: pd.DataFrame,
             validation_df: Optional[pd.DataFrame] = None,
             recipe: Optional[Recipe] = None, metric: str = "mse",
+            search_alg: Optional[str] = None,
+            n_workers: Optional[int] = None, backend: str = "local",
             ) -> TimeSequencePipeline:
         recipe = recipe or LSTMGridRandomRecipe(num_rand_samples=1)
         if validation_df is None:
@@ -150,9 +152,14 @@ class TimeSequencePredictor:
             return {metric: _metric_value(metric, vy, y_pred)}
 
         mode = "max" if metric == "r2" else "min"
+        # TPE replaces the ASHA schedule (mutually exclusive in the
+        # engine): Bayesian suggestions all run at full budget
+        scheduler = None if search_alg == "tpe" else "asha"
         engine = SearchEngine(metric=metric, mode=mode, seed=self.seed,
-                              scheduler="asha", grace_budget=1,
-                              max_budget=recipe.training_iteration)
+                              scheduler=scheduler, grace_budget=1,
+                              max_budget=recipe.training_iteration,
+                              search_alg=search_alg, n_workers=n_workers,
+                              backend=backend)
         engine.compile((input_df, validation_df), train_fn, recipe=recipe)
         engine.run()
         self.search_engine = engine

@@ -1,11 +1,23 @@
-"""Search engine: sampling DSL + trial runner + successive halving.
+"""Search engine: sampling DSL + trial runner + successive halving + TPE.
 
 Reference: `RayTuneSearchEngine` (`automl/search/ray_tune_search_engine.py:37`,
-`compile` `:61`, `run` `:171`) with SearchAlg (skopt BO) and schedulers
-(ASHA). Here: the same `compile(data, model_builder, recipe)` / `run()` /
-`get_best_trials` surface, executed in-process. Trials are pure functions
+`compile` `:61`, `run` `:171`) with SearchAlg (skopt/BayesOpt wiring
+`:244-282`) and schedulers (ASHA). Here: the same
+`compile(data, model_builder, recipe)` / `run()` / `get_best_trials`
+surface. Trials are pure functions
 `train_fn(config, data, budget) -> {"metric": float, ...}` so the engine is
 agnostic to what a trial trains (a jit'd TPU model, an sklearn fit, ...).
+
+Execution backends (a TPU host has idle CPU cores during CPU-bound TS
+trials):
+  - "local": thread pool (default; jax/numpy release the GIL),
+  - "process": spawn-based process pool (picklable train_fn/data only),
+  - "ray": `ray.remote` when ray is importable, else falls back to local.
+Search algorithms: "random" (sample the space up front) or "tpe" —
+a Tree-structured Parzen Estimator (the reference's BO role): after
+`tpe_startup` random trials, numeric dims are modelled with good/bad
+Parzen (KDE) densities, categorical dims with smoothed good-set counts,
+and candidates maximize the density ratio l(x)/g(x).
 """
 
 from __future__ import annotations
@@ -14,6 +26,7 @@ import copy
 import itertools
 import logging
 import math
+import os
 import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -114,6 +127,128 @@ def _expand(space: Dict[str, Any], num_samples: int,
     return out
 
 
+# ---------------------------------------------------------------------------
+# TPE sampler (the BO search_alg; ref ray_tune_search_engine.py:244-282 role)
+# ---------------------------------------------------------------------------
+class _TPE:
+    """Tree-structured Parzen Estimator over the recipe space.
+
+    Observations are (config, metric) pairs; the best `gamma` fraction
+    forms the "good" set. Numeric dims: 1-D gaussian KDE per set (in log
+    space for loguniform); propose by sampling the good KDE and keeping
+    the candidate with the best good/bad density ratio. Choice dims:
+    categorical distribution from add-one-smoothed good-set counts."""
+
+    def __init__(self, space: Dict[str, Any], mode: str, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int = 0):
+        self.space = space
+        self.mode = mode
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+
+    # numeric encoding per dim kind --------------------------------------
+    def _numeric(self, v) -> bool:
+        return isinstance(v, (_Uniform, _QUniform, _LogUniform, _RandInt))
+
+    def _encode(self, sampler, value) -> float:
+        if isinstance(sampler, _LogUniform):
+            return math.log(value)
+        return float(value)
+
+    def _decode(self, sampler, x: float):
+        if isinstance(sampler, _LogUniform):
+            x = math.exp(x)
+            lo, hi = math.exp(sampler.lo), math.exp(sampler.hi)
+            return min(max(x, lo), hi)
+        if isinstance(sampler, _RandInt):
+            return int(min(max(round(x), sampler.lo), sampler.hi - 1))
+        if isinstance(sampler, _QUniform):
+            q = sampler.q
+            v = min(max(x, sampler.lo), sampler.hi)
+            return type(q)(round(v / q) * q)
+        return min(max(x, sampler.lo), sampler.hi)
+
+    def _random_dim(self, v):
+        if isinstance(v, _Grid):
+            return self.rng.choice(v.options)
+        return v.sample(self.rng) if isinstance(v, _Sampler) else v
+
+    def _kde_sample(self, xs: List[float], bw: float) -> float:
+        mu = self.rng.choice(xs)
+        return self.rng.gauss(mu, bw)
+
+    @staticmethod
+    def _kde_logpdf(x: float, xs: List[float], bw: float) -> float:
+        # max-component approximation is fine for ranking candidates
+        return max(-((x - mu) ** 2) / (2 * bw * bw) for mu in xs)
+
+    def suggest(self, observed: List["Trial"]) -> Dict[str, Any]:
+        ok = [t for t in observed if t.ok]
+        if len(ok) < 4:          # not enough evidence: random sample
+            return {k: self._random_dim(v) for k, v in self.space.items()}
+        key = (lambda t: t.metric) if self.mode == "min" \
+            else (lambda t: -t.metric)
+        ranked = sorted(ok, key=key)
+        n_good = max(2, int(len(ranked) * self.gamma))
+        good, bad = ranked[:n_good], ranked[n_good:] or ranked[-2:]
+
+        cfg = {}
+        for k, v in self.space.items():
+            if not isinstance(v, (_Sampler, _Grid)):
+                cfg[k] = v
+                continue
+            if isinstance(v, (_Choice, _Grid)):
+                # grid dims participate as categoricals once the schedule
+                # moves past the exhaustive startup expansion
+                counts = {repr(o): 1.0 for o in v.options}  # +1 smoothing
+                for t in good:
+                    r = repr(t.config.get(k))
+                    if r in counts:
+                        counts[r] += 1.0
+                total = sum(counts.values())
+                pick = self.rng.random() * total
+                acc = 0.0
+                chosen = v.options[-1]
+                for o in v.options:
+                    acc += counts[repr(o)]
+                    if pick <= acc:
+                        chosen = o
+                        break
+                cfg[k] = chosen
+                continue
+            g = [self._encode(v, t.config[k]) for t in good
+                 if k in t.config]
+            b = [self._encode(v, t.config[k]) for t in bad
+                 if k in t.config] or g
+            spread = (max(g + b) - min(g + b)) or 1.0
+            bw = max(spread / max(len(g), 2), 1e-12)
+            best_x, best_score = None, -math.inf
+            for _ in range(self.n_candidates):
+                x = self._kde_sample(g, bw)
+                score = (self._kde_logpdf(x, g, bw)
+                         - self._kde_logpdf(x, b, bw))
+                if score > best_score:
+                    best_x, best_score = x, score
+            cfg[k] = self._decode(v, best_x)
+        return cfg
+
+
+# module-level so the spawn-based process pool can pickle it
+def _run_trial_payload(payload):
+    train_fn, data, config, budget, metric = payload
+    try:
+        results = train_fn(config, data, budget)
+        return (results, float(results[metric]), None)
+    except Exception as e:  # noqa: BLE001 — a bad config must not kill
+        return ({}, None, f"{type(e).__name__}: {e}")
+
+
+def _run_trial_ray(train_fn, data, config, budget, metric):
+    """Ray task body: train_fn/data arrive as shared object-store refs."""
+    return _run_trial_payload((train_fn, data, config, budget, metric))
+
+
 @dataclass
 class Trial:
     config: Dict[str, Any]
@@ -140,25 +275,41 @@ class SearchEngine:
                  num_samples: int = 1, seed: int = 0,
                  scheduler: Optional[str] = None, eta: int = 3,
                  grace_budget: int = 1, max_budget: int = 9,
-                 backend: str = "local"):
+                 backend: str = "local", n_workers: Optional[int] = None,
+                 search_alg: Optional[str] = None):
         if mode not in ("min", "max"):
             raise ValueError("mode must be min|max")
+        if search_alg not in (None, "random", "tpe"):
+            raise ValueError("search_alg must be random|tpe")
+        if scheduler == "asha" and search_alg == "tpe":
+            raise ValueError(
+                "search_alg='tpe' and scheduler='asha' are mutually "
+                "exclusive in this engine: ASHA rungs re-evaluate a fixed "
+                "population while TPE grows one. Drop the scheduler to use "
+                "TPE at full budget.")
         if backend == "ray":
-            # Ray Tune dispatch is not wired in this build; be explicit
-            # rather than silently running local (trials execute serially
-            # in-process either way on a single TPU host).
-            log.warning("backend='ray' is not wired in this build; trials "
-                        "run in-process on this host")
-            backend = "local"
+            try:
+                import ray  # noqa: F401
+            except ImportError:
+                log.warning("backend='ray' requested but ray is not "
+                            "importable; falling back to the local "
+                            "thread-pool backend")
+                backend = "local"
+        elif backend not in ("local", "process", "serial"):
+            raise ValueError("backend must be local|process|ray|serial")
         self.metric, self.mode = metric, mode
         self.num_samples, self.seed = num_samples, seed
         self.scheduler, self.eta = scheduler, eta
         self.grace_budget, self.max_budget = grace_budget, max_budget
         self.backend = backend
+        self.n_workers = n_workers or min(os.cpu_count() or 1, 8)
+        self.search_alg = search_alg or "random"
         self.trials: List[Trial] = []
         self._train_fn: Optional[Callable] = None
         self._data = None
         self._configs: List[Dict] = []
+        self._space: Dict[str, Any] = {}
+        self._ray_refs = None
 
     # -- compile/run surface (`ray_tune_search_engine.py:61,171`) ----------
     def compile(self, data, train_fn: Callable, recipe=None,
@@ -172,7 +323,9 @@ class SearchEngine:
             raise ValueError("Provide a recipe or search_space")
         self._train_fn = train_fn
         self._data = data
+        self._space = dict(search_space)
         self._configs = _expand(search_space, self.num_samples, self.seed)
+        self._ray_refs = None          # new fn/data → new object-store refs
         return self
 
     def run(self) -> List[Trial]:
@@ -180,28 +333,86 @@ class SearchEngine:
             raise RuntimeError("compile() first")
         if self.scheduler == "asha":
             self.trials = self._run_asha()
+        elif self.search_alg == "tpe":
+            self.trials = self._run_tpe()
         else:
-            self.trials = [self._run_one(c, self.max_budget)
-                           for c in self._configs]
+            self.trials = self._map_trials(self._configs, self.max_budget)
         return self.trials
 
+    # -- trial dispatch (serial / threads / processes / ray) ---------------
+    def _map_trials(self, configs: List[Dict], budget: int) -> List[Trial]:
+        payloads = [(self._train_fn, self._data, c, budget, self.metric)
+                    for c in configs]
+        if self.backend == "serial" or len(configs) <= 1:
+            outs = [_run_trial_payload(p) for p in payloads]
+        elif self.backend == "ray":
+            import ray
+            if not ray.is_initialized():
+                ray.init(num_cpus=self.n_workers,
+                         ignore_reinit_error=True)
+            if self._ray_refs is None:
+                # ship train_fn + data to the object store ONCE, not once
+                # per trial per rung
+                self._ray_refs = (ray.put(self._train_fn),
+                                  ray.put(self._data),
+                                  ray.remote(_run_trial_ray))
+            fn_ref, data_ref, remote = self._ray_refs
+            outs = ray.get([remote.remote(fn_ref, data_ref, c, budget,
+                                          self.metric) for c in configs])
+        elif self.backend == "process":
+            # spawn (never fork: the parent holds a live XLA runtime)
+            import concurrent.futures as cf
+            import multiprocessing as mp
+            import pickle
+            try:
+                pickle.dumps(payloads[0])
+            except Exception as e:
+                raise ValueError(
+                    "backend='process' needs a picklable train_fn and "
+                    "data (module-level function, no closures); use "
+                    "backend='local' for closure train_fns") from e
+            ctx = mp.get_context("spawn")
+            with cf.ProcessPoolExecutor(self.n_workers,
+                                        mp_context=ctx) as ex:
+                outs = list(ex.map(_run_trial_payload, payloads))
+        else:                                   # "local": thread pool
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(self.n_workers) as ex:
+                outs = list(ex.map(_run_trial_payload, payloads))
+        trials = []
+        for c, (results, metric, err) in zip(configs, outs):
+            t = Trial(config=copy.deepcopy(c), budget=budget,
+                      results=results, metric=metric, error=err)
+            if err:
+                log.warning("trial failed for %s: %s", c, err)
+            trials.append(t)
+        return trials
+
     def _run_one(self, config: Dict, budget: int) -> Trial:
-        t = Trial(config=copy.deepcopy(config), budget=budget)
-        try:
-            results = self._train_fn(config, self._data, budget)
-            t.results = results
-            t.metric = float(results[self.metric])
-        except Exception as e:  # noqa: BLE001 — a bad config must not kill
-            log.warning("trial failed for %s: %s", config, e)
-            t.error = f"{type(e).__name__}: {e}"
-        return t
+        return self._map_trials([config], budget)[0]
+
+    def _run_tpe(self) -> List[Trial]:
+        """Model-based sequential optimization in n_workers-sized waves:
+        total trials = len(expanded configs) (recipe num_samples)."""
+        total = len(self._configs)
+        tpe = _TPE(self._space, self.mode, seed=self.seed)
+        done: List[Trial] = []
+        # startup wave: first configs from the random expansion
+        startup = min(max(4, self.n_workers), total)
+        done.extend(self._map_trials(self._configs[:startup],
+                                     self.max_budget))
+        while len(done) < total:
+            wave = min(self.n_workers, total - len(done))
+            configs = [tpe.suggest(done) for _ in range(wave)]
+            done.extend(self._map_trials(configs, self.max_budget))
+        return done
 
     def _run_asha(self) -> List[Trial]:
         alive = list(self._configs)
         budget = self.grace_budget
         done: List[Trial] = []
         while alive:
-            rung = [self._run_one(c, budget) for c in alive]
+            rung = self._map_trials(alive, budget)
             ok = sorted((t for t in rung if t.ok), key=self._key)
             done.extend(t for t in rung if not t.ok)
             if budget >= self.max_budget or len(ok) <= 1:

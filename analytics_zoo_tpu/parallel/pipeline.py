@@ -57,7 +57,8 @@ def _pipeline_shard(params, mbs, stage_fn: Callable, axis: str, n_stages: int):
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
-                   mesh: DeviceMesh, axis: str = "pipeline"):
+                   mesh: DeviceMesh, axis: str = "pipeline",
+                   seq_axis: str = None):
     """Run `stage_fn(params_s, x) -> y` (same x/y shape) for stages
     s = 0..S-1 as a pipeline.
 
@@ -65,6 +66,12 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
     stage), sharded over `axis`. microbatches: [n_micro, mb_size, ...];
     the batch dim shards over the data axes as usual. Returns
     [n_micro, mb_size, ...] outputs (identical on every pipeline rank).
+
+    `seq_axis`: when the microbatches carry a sequence dim at position 2
+    that is already sharded over a mesh axis (ring-attention output),
+    name it here so the pipeline consumes it sharded instead of forcing
+    an all-gather + full rematerialization between the two shard_maps
+    (per-token stages never need the full sequence).
     """
     S = mesh.size(axis)
     n_stacked = {leaf.shape[0]
@@ -83,7 +90,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
 
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
-    mb_spec = P(None, BATCH_AXES)
+    mb_spec = P(None, BATCH_AXES, seq_axis) if seq_axis \
+        else P(None, BATCH_AXES)
 
     def shard(params, mbs):
         params = jax.tree_util.tree_map(
@@ -97,12 +105,19 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
 
 
 def to_microbatches(x, n_micro: int):
-    """[B, ...] -> [n_micro, B/n_micro, ...]."""
+    """[B, ...] -> [n_micro, B/n_micro, ...] by INTERLEAVING (microbatch i
+    takes rows i::n_micro). A contiguous split of a data-sharded batch
+    would land the n_micro dim on the data axis (forcing a reshard every
+    pipeline tick); interleaving keeps the per-microbatch batch dim
+    sharded exactly like the full batch."""
     B = x.shape[0]
     if B % n_micro:
         raise ValueError(f"batch {B} not divisible by n_micro {n_micro}")
-    return x.reshape((n_micro, B // n_micro) + x.shape[1:])
+    return jnp.swapaxes(
+        x.reshape((B // n_micro, n_micro) + x.shape[1:]), 0, 1)
 
 
 def from_microbatches(y):
-    return y.reshape((-1,) + y.shape[2:])
+    """Inverse of `to_microbatches` (restores original row order)."""
+    n_micro, mb = y.shape[0], y.shape[1]
+    return jnp.swapaxes(y, 0, 1).reshape((n_micro * mb,) + y.shape[2:])

@@ -60,8 +60,12 @@ class AutoTSTrainer:
     def fit(self, train_df: pd.DataFrame,
             validation_df: Optional[pd.DataFrame] = None,
             recipe: Optional[Recipe] = None,
-            metric: str = "mse") -> TSPipeline:
+            metric: str = "mse", **search_kwargs) -> TSPipeline:
+        """`search_kwargs` reach the SearchEngine: `n_workers=8` runs
+        trials concurrently, `search_alg="tpe"` turns on the Bayesian
+        sampler, `backend="ray"` dispatches via ray when importable."""
         recipe = recipe or LSTMGridRandomRecipe(num_rand_samples=1)
         pipeline = self._predictor.fit(train_df, validation_df,
-                                       recipe=recipe, metric=metric)
+                                       recipe=recipe, metric=metric,
+                                       **search_kwargs)
         return TSPipeline(pipeline)

@@ -74,6 +74,128 @@ class TestSearchEngine:
         assert eng.get_best_config()["x"] == 0
 
 
+def _sleepy_fn(config, data, budget):
+    # module-level so the spawn process pool can pickle it
+    import time as _t
+    _t.sleep(0.25)
+    return {"mse": (config["x"] - 3) ** 2}
+
+
+def _rosenbrock_fn(config, data, budget):
+    x, y = config["x"], config["y"]
+    return {"mse": (1 - x) ** 2 + 5.0 * (y - x * x) ** 2}
+
+
+class TestParallelSearch:
+    def test_wall_clock_scales_with_workers(self):
+        import time as _t
+        space = {"x": hp.grid_search(list(range(8)))}
+        t0 = _t.perf_counter()
+        SearchEngine(metric="mse", backend="serial").compile(
+            None, _sleepy_fn, search_space=space).run()
+        serial = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        eng = SearchEngine(metric="mse", backend="local", n_workers=8)
+        eng.compile(None, _sleepy_fn, search_space=space).run()
+        parallel = _t.perf_counter() - t0
+        assert eng.get_best_config()["x"] == 3
+        assert parallel < serial * 0.5, (serial, parallel)
+
+    def test_process_backend(self):
+        space = {"x": hp.grid_search([1, 2, 3, 4])}
+        eng = SearchEngine(metric="mse", backend="process", n_workers=2)
+        eng.compile(None, _sleepy_fn, search_space=space).run()
+        assert eng.get_best_config()["x"] == 3
+
+    def test_asha_rungs_parallel(self):
+        eng = SearchEngine(metric="mse", scheduler="asha", eta=2,
+                           grace_budget=1, max_budget=8, n_workers=8)
+        eng.compile(None, lambda c, d, b: {"mse": (c["x"] - 3) ** 2 + 1.0 / b},
+                    search_space={"x": hp.grid_search(list(range(8)))})
+        eng.run()
+        assert eng.get_best_config()["x"] == 3
+
+    def test_ray_backend_falls_back_without_ray(self, caplog):
+        import logging
+        with caplog.at_level(logging.WARNING, "analytics_zoo_tpu.automl"):
+            eng = SearchEngine(metric="mse", backend="ray")
+        assert eng.backend in ("local", "ray")
+        try:
+            import ray  # noqa: F401
+        except ImportError:
+            assert eng.backend == "local"
+            assert any("ray" in r.message for r in caplog.records)
+
+
+class TestTPESearch:
+    def test_tpe_beats_random_on_fixed_budget(self):
+        space = {"x": hp.uniform(-2.0, 2.0), "y": hp.uniform(-1.0, 3.0)}
+        budget = 48
+        rand = SearchEngine(metric="mse", num_samples=budget, seed=5,
+                            backend="serial")
+        rand.compile(None, _rosenbrock_fn, search_space=space).run()
+        tpe = SearchEngine(metric="mse", num_samples=budget, seed=5,
+                           backend="serial", search_alg="tpe")
+        tpe.compile(None, _rosenbrock_fn, search_space=space).run()
+        best_r = rand.get_best_trials(1)[0].metric
+        best_t = tpe.get_best_trials(1)[0].metric
+        assert len(tpe.trials) == budget
+        assert best_t <= best_r, (best_t, best_r)
+
+    def test_tpe_keeps_grid_dims(self):
+        # grid keys must appear in every TPE-suggested config (as
+        # categoricals), not just in the startup expansion
+        space = {"cell": hp.grid_search(["a", "b"]),
+                 "x": hp.uniform(0.0, 1.0)}
+
+        def fn(config, data, budget):
+            return {"mse": (0.0 if config["cell"] == "b" else 1.0)
+                    + config["x"]}
+
+        eng = SearchEngine(metric="mse", num_samples=12, seed=1,
+                           backend="serial", search_alg="tpe")
+        eng.compile(None, fn, search_space=space).run()
+        assert all(t.ok for t in eng.trials), \
+            [t.error for t in eng.trials if not t.ok]
+        assert all("cell" in t.config for t in eng.trials)
+        assert eng.get_best_config()["cell"] == "b"
+
+    def test_tpe_with_asha_rejected(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            SearchEngine(metric="mse", scheduler="asha", search_alg="tpe")
+
+    def test_process_backend_rejects_closures(self):
+        captured = []
+
+        def closure_fn(config, data, budget):
+            captured.append(config)
+            return {"mse": 0.0}
+
+        eng = SearchEngine(metric="mse", backend="process", n_workers=2)
+        eng.compile(None, closure_fn,
+                    search_space={"x": hp.grid_search([1, 2, 3])})
+        with pytest.raises(ValueError, match="picklable"):
+            eng.run()
+
+    def test_tpe_handles_choice_and_randint(self):
+        import math
+        space = {"cell": hp.choice(["lstm", "gru"]),
+                 "units": hp.randint(8, 64),
+                 "lr": hp.loguniform(1e-4, 1e-1)}
+
+        def fn(config, data, budget):
+            base = 0.0 if config["cell"] == "gru" else 1.0
+            return {"mse": base + abs(config["units"] - 32) / 32
+                    + abs(math.log10(config["lr"]) + 2)}
+
+        eng = SearchEngine(metric="mse", num_samples=40, seed=3,
+                           backend="serial", search_alg="tpe")
+        eng.compile(None, fn, search_space=space).run()
+        best = eng.get_best_config()
+        assert best["cell"] == "gru"
+        assert 8 <= best["units"] < 64
+
+
 class TestFeatureTransformer:
     def test_shapes_and_inverse(self):
         df = make_df(100)
