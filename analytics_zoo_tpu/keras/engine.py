@@ -537,7 +537,10 @@ class Model(KerasNet):
                 shapes[id(node)] = node.shape
             else:
                 in_shapes = [shapes[id(i)] for i in node.inputs]
-                shape_in = in_shapes if len(in_shapes) > 1 else in_shapes[0]
+                # zero-input nodes are parameter/constant sources
+                # (ops/autograd.py Parameter): build sees shape_in=None
+                shape_in = in_shapes if len(in_shapes) > 1 else (
+                    in_shapes[0] if in_shapes else None)
                 if node.layer.name not in params:
                     rng, sub = jax.random.split(rng)
                     params[node.layer.name] = node.layer.build(sub, shape_in)
@@ -566,7 +569,7 @@ class Model(KerasNet):
             if node.layer is None:
                 raise ValueError("Disconnected input node in graph")
             args = [values[id(i)] for i in node.inputs]
-            arg = args if len(args) > 1 else args[0]
+            arg = args if len(args) > 1 else (args[0] if args else None)
             if rng is not None:
                 rng, sub = jax.random.split(rng)
             else:

@@ -29,8 +29,9 @@ def _infer_shape(fn: Callable, in_shapes: Sequence) -> tuple:
 
     outs = jax.eval_shape(fn, *[dummy(s) for s in in_shapes])
     shape = outs.shape
-    # restore the None batch dim if inputs had one
-    if in_shapes and in_shapes[0] and in_shapes[0][0] is None and shape:
+    # restore the None batch dim if any input had one (Parameter/Constant
+    # sources have fully-concrete shapes and broadcast against the batch)
+    if shape and any(s and s[0] is None for s in in_shapes):
         shape = (None,) + tuple(shape[1:])
     return tuple(shape)
 
@@ -133,6 +134,57 @@ class Variable:
     def __getitem__(self, idx):
         return Variable._lift(lambda a: a[idx], self, name="slice")
 
+    def _resolve_nonbatch_dim(self, dim: int, op: str) -> int:
+        """Normalize `dim` against this variable's rank and reject the batch
+        dimension (the reference contract for slice/index_select)."""
+        rank = len(self.shape)
+        if not -rank <= dim < rank:
+            raise ValueError(f"{op}: dim {dim} out of range for rank {rank}")
+        d = dim % rank
+        if d == 0 and self.shape[0] is None:
+            raise ValueError(f"Cannot {op} the batch dimension")
+        return d
+
+    # -- torch-style narrowing (`autograd.py:317,340`) ---------------------
+    def slice(self, dim: int, start_index: int, length: int = 1) -> "Variable":
+        """Narrow `dim` to [start_index, start_index+length) without reducing
+        rank; length=-1 runs to the end. dim counts the batch dim (0), which
+        cannot be narrowed — matching the reference contract."""
+        d = self._resolve_nonbatch_dim(dim, "slice")
+
+        def fn(a, d=d, s=start_index, l=length):
+            ln = a.shape[d] - s if l == -1 else l
+            return jax.lax.slice_in_dim(a, s, s + ln, axis=d)
+        return Variable._lift(fn, self, name="slice")
+
+    def index_select(self, dim: int, index: int) -> "Variable":
+        """Select one index along `dim`, removing that dim (-1 selects the
+        last position). The batch dim cannot be selected."""
+        d = self._resolve_nonbatch_dim(dim, "index_select")
+        size = self.shape[d]
+        if size is not None and not -size <= index < size:
+            raise IndexError(
+                f"index_select: index {index} out of range for dim {dim} "
+                f"of size {size}")
+
+        def fn(a, d=d, i=index):
+            return jnp.take(a, i % a.shape[d], axis=d)
+        return Variable._lift(fn, self, name="index_select")
+
+    def squeeze(self, dim: Optional[int] = None) -> "Variable":
+        """Delete singleton dim(s). With dim=None all non-batch singleton
+        dims are removed (the dynamic batch dim is never squeezed — a dummy
+        batch of 1 must not change the graph's rank)."""
+        if dim is not None:
+            d = self._resolve_nonbatch_dim(dim, "squeeze")
+            return Variable._lift(lambda a: jnp.squeeze(a, d), self,
+                                  name="squeeze")
+
+        def fn(a):
+            axes = tuple(i for i in range(1, a.ndim) if a.shape[i] == 1)
+            return jnp.squeeze(a, axes) if axes else a
+        return Variable._lift(fn, self, name="squeeze")
+
 
 # ---------------------------------------------------------------------------
 # Module-level math functions (`pyzoo/zoo/pipeline/api/autograd.py` surface)
@@ -206,6 +258,24 @@ def dot(x: Variable, y: Variable, axes=None, normalize: bool = False
     return Variable._lift(fn, x, y, name="dot")
 
 
+def l2_normalize(v: Variable, axis: int) -> Variable:
+    """Normalize wrt the L2 norm along `axis` (`autograd.py:80`
+    l2_normalize). Uses the TF epsilon (1e-12) under the root."""
+    def fn(a):
+        sq = jnp.sum(jnp.square(a), axis=axis, keepdims=True)
+        return a * jax.lax.rsqrt(jnp.maximum(sq, 1e-12))
+    return Variable._lift(fn, v, name="l2_normalize")
+
+
+def slice(v: Variable, dim: int, start_index: int, length: int = 1  # noqa: A001
+          ) -> Variable:
+    return v.slice(dim, start_index, length)
+
+
+def index_select(v: Variable, dim: int, index: int) -> Variable:
+    return v.index_select(dim, index)
+
+
 def softmax(v: Variable, axis: int = -1) -> Variable:
     return Variable._lift(lambda a: jax.nn.softmax(a, axis=axis), v,
                           name="softmax")
@@ -228,6 +298,114 @@ def stack(vs: Sequence[Variable], axis: int = 1) -> Variable:
 def concatenate(vs: Sequence[Variable], axis: int = -1) -> Variable:
     return Variable._lift(lambda *xs: jnp.concatenate(xs, axis=axis), *vs,
                           name="concat")
+
+
+# ---------------------------------------------------------------------------
+# Parameter / Constant (`pyzoo/zoo/pipeline/api/autograd.py:462,524`)
+# ---------------------------------------------------------------------------
+class ParameterLayer(Layer):
+    """Zero-input source layer holding one trainable tensor. Default init is
+    RandomUniform(-0.05, 0.05), matching the reference's default
+    (`autograd.py:462` Parameter docstring)."""
+
+    def __init__(self, shape: Sequence[int], init_weight=None,
+                 trainable: bool = True, init_range: float = 0.05, **kw):
+        super().__init__(**kw)
+        self.pshape = tuple(int(d) for d in shape)
+        self.init_weight = init_weight
+        self.trainable = trainable
+        self.init_range = init_range
+
+    def build(self, rng, input_shape):
+        if self.init_weight is not None:
+            val = jnp.asarray(self.init_weight, jnp.float32)
+            if val.shape != self.pshape:
+                raise ValueError(
+                    f"init_weight shape {val.shape} != Parameter shape "
+                    f"{self.pshape}")
+        else:
+            val = jax.random.uniform(
+                rng, self.pshape, jnp.float32,
+                -self.init_range, self.init_range)
+        return {"value": val}
+
+    def call(self, params, x, *, training=False, rng=None):
+        v = params["value"]
+        return v if self.trainable else jax.lax.stop_gradient(v)
+
+    def compute_output_shape(self, input_shape):
+        return self.pshape
+
+
+class Parameter(Variable):
+    """A trainable standalone Variable (`autograd.py:462`). Usable anywhere
+    in a functional graph / Variable expression; its value lives in the
+    enclosing model's param tree under this Parameter's name, so it is
+    updated by the optimizer like any layer weight.
+
+    Functional-core deviation from the reference: `get_weight`/`set_weight`
+    operate on an explicit params tree (the reference mutates JVM state).
+    Before build, `set_weight` replaces the init value.
+    """
+
+    def __init__(self, shape: Sequence[int], init_weight=None,
+                 trainable: bool = True, name: Optional[str] = None):
+        layer = ParameterLayer(shape, init_weight=init_weight,
+                               trainable=trainable, name=name)
+        # zero-input source node (Layer.__call__ requires inputs)
+        super().__init__(node=Node(layer=layer, inputs=[],
+                                   shape=layer.pshape))
+        self._layer = layer
+
+    @property
+    def name(self) -> str:
+        return self._layer.name
+
+    def get_weight(self, params=None):
+        """Current value: from `params` (a built model's tree) if given,
+        else the init value."""
+        if params is not None:
+            return params[self.name]["value"]
+        return self._layer.init_weight
+
+    def set_weight(self, value, params=None):
+        """With `params`, return a new tree with this Parameter replaced;
+        without, set the init value used at the next build."""
+        value = jnp.asarray(value, jnp.float32)
+        if value.shape != self._layer.pshape:
+            raise ValueError(
+                f"set_weight shape {value.shape} != Parameter shape "
+                f"{self._layer.pshape}")
+        if params is not None:
+            new = dict(params)
+            new[self.name] = {"value": value}
+            return new
+        self._layer.init_weight = value
+        return None
+
+
+class ConstantLayer(Layer):
+    """Zero-input source layer emitting a captured constant (folded by
+    jit)."""
+
+    def __init__(self, data, **kw):
+        super().__init__(**kw)
+        self.data = jnp.asarray(data, jnp.float32)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return self.data
+
+    def compute_output_shape(self, input_shape):
+        return tuple(self.data.shape)
+
+
+class Constant(Variable):
+    """A constant Variable without weights (`autograd.py:524`)."""
+
+    def __init__(self, data, name: Optional[str] = None):
+        layer = ConstantLayer(data, name=name)
+        super().__init__(node=Node(layer=layer, inputs=[],
+                                   shape=tuple(layer.data.shape)))
 
 
 # ---------------------------------------------------------------------------

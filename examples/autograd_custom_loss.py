@@ -42,6 +42,20 @@ def main():
     np.testing.assert_allclose(out, x[:4] + 1.0, rtol=1e-6)
     print("Lambda(add_one) OK")
 
+    # Parameter: trainable standalone variables in a Variable expression
+    # (reference `autograd.py:462`): learn y = w.x + b directly.
+    import optax
+    from analytics_zoo_tpu.keras import Model
+    inp = A.Variable(input_shape=(4,))
+    w = A.Parameter((4, 1), name="w")
+    b = A.Parameter((1,), name="b")
+    lin = Model(inp, A.mm(inp, w) + b)
+    lin.compile(optax.adam(0.05), "mse")
+    lin.fit(x, y, batch_size=64, nb_epoch=40, distributed=False)
+    print("learned w:", np.asarray(w.get_weight(lin.params)).ravel().round(2),
+          "b:", np.asarray(b.get_weight(lin.params)).round(2),
+          "(target w=1,1,1,1  b=1)")
+
 
 if __name__ == "__main__":
     main()

@@ -86,6 +86,145 @@ class TestVariableMath:
         got = _eval(A.erf(a), [a], [x])
         np.testing.assert_allclose(got, np.asarray(jax.lax.erf(x)), rtol=1e-6)
 
+    def test_l2_normalize(self):
+        a = A.Variable(input_shape=(3,))
+        x = np.array([[3.0, 4.0, 0.0]], np.float32)
+        got = _eval(A.l2_normalize(a, axis=-1), [a], [x])
+        np.testing.assert_allclose(got, x / 5.0, rtol=1e-6)
+        # zero vector stays finite (epsilon under the root)
+        z = np.zeros((1, 3), np.float32)
+        got = _eval(A.l2_normalize(a, axis=-1), [a], [z])
+        assert np.isfinite(got).all()
+
+    def test_softsign_softplus(self):
+        a = A.Variable(input_shape=(3,))
+        x = np.array([[-1.0, 0.0, 2.0]], np.float32)
+        np.testing.assert_allclose(_eval(A.softsign(a), [a], [x]),
+                                   x / (1 + np.abs(x)), rtol=1e-6)
+        np.testing.assert_allclose(_eval(A.softplus(a), [a], [x]),
+                                   np.log1p(np.exp(x)), rtol=1e-6)
+
+    def test_slice_reference_semantics(self):
+        # `autograd.py:317`: input [[1,2,3],[4,5,6]]; slice(1,1,2) -> cols 1:3
+        a = A.Variable(input_shape=(3,))
+        x = np.array([[1., 2., 3.], [4., 5., 6.]], np.float32)
+        got = _eval(a.slice(1, 1, 2), [a], [x])
+        np.testing.assert_allclose(got, [[2., 3.], [5., 6.]])
+        got = _eval(a.slice(1, 2, -1), [a], [x])
+        np.testing.assert_allclose(got, [[3.], [6.]])
+        with pytest.raises(ValueError):
+            a.slice(0, 0, 1)
+
+    def test_index_select_reference_semantics(self):
+        # `autograd.py:340`: select(1,1) -> [2,5]; select(1,-1) -> [3,6]
+        a = A.Variable(input_shape=(3,))
+        x = np.array([[1., 2., 3.], [4., 5., 6.]], np.float32)
+        got = _eval(a.index_select(1, 1), [a], [x])
+        np.testing.assert_allclose(got, [2., 5.])
+        got = _eval(a.index_select(1, -1), [a], [x])
+        np.testing.assert_allclose(got, [3., 6.])
+        with pytest.raises(ValueError):
+            a.index_select(0, 0)
+
+    def test_negative_dim_cannot_reach_batch(self):
+        # dim=-2 on a rank-2 batched variable IS the batch dim — must raise,
+        # not silently narrow the batch at runtime
+        a = A.Variable(input_shape=(3,))
+        with pytest.raises(ValueError):
+            a.slice(-2, 0, 1)
+        with pytest.raises(ValueError):
+            a.index_select(-2, 0)
+        with pytest.raises(ValueError):
+            a.slice(5, 0, 1)  # out of range rank
+
+    def test_index_select_out_of_range_raises(self):
+        a = A.Variable(input_shape=(3,))
+        with pytest.raises(IndexError):
+            a.index_select(1, 4)
+        with pytest.raises(IndexError):
+            a.index_select(1, -4)
+
+    def test_squeeze_preserves_batch(self):
+        # squeeze() must never squeeze the dynamic batch dim, even when the
+        # runtime batch happens to be 1
+        a = A.Variable(input_shape=(3, 1))
+        sq = a.squeeze()
+        assert sq.shape == (None, 3)
+        x = np.arange(3, dtype=np.float32).reshape(1, 3, 1)
+        got = _eval(sq, [a], [x])
+        assert got.shape == (1, 3)
+        got5 = _eval(a.squeeze(), [a],
+                     [np.zeros((5, 3, 1), np.float32)])
+        assert got5.shape == (5, 3)
+        # explicit-dim variant
+        got = _eval(a.squeeze(2), [a], [x])
+        assert got.shape == (1, 3)
+
+
+class TestParameter:
+    def test_parameter_init_weight_and_constant(self):
+        x = A.Variable(input_shape=(3,))
+        w = A.Parameter((3,), init_weight=np.array([1., 2., 3.], np.float32))
+        c = A.Constant(np.array([10.0], np.float32))
+        expr = x * w + c
+        xv = np.ones((2, 3), np.float32)
+        got = _eval(expr, [x], [xv])
+        np.testing.assert_allclose(got, xv * [1, 2, 3] + 10.0)
+
+    def test_parameter_default_init_range(self):
+        p = A.Parameter((100,))
+        m = Model([A.Variable(input_shape=(1,)).node],
+                  (p * 1.0).node)
+        params = m.build(jax.random.PRNGKey(0))
+        val = np.asarray(p.get_weight(params))
+        assert val.shape == (100,)
+        assert (np.abs(val) <= 0.05).all() and np.abs(val).max() > 0.001
+
+    def test_parameter_trains_by_gradient(self):
+        # learn y = 3x - 1 with standalone Parameters a, b
+        x = A.Variable(input_shape=(1,))
+        a = A.Parameter((1,))
+        b = A.Parameter((1,))
+        import optax
+        model = Model(x, x * a + b)
+        model.compile(optax.adam(0.05), "mse")
+        rs = np.random.RandomState(0)
+        xv = rs.randn(256, 1).astype(np.float32)
+        yv = 3 * xv - 1
+        model.fit(xv, yv, batch_size=32, nb_epoch=60, distributed=False)
+        np.testing.assert_allclose(
+            np.asarray(a.get_weight(model.params)), [3.0], atol=0.2)
+        np.testing.assert_allclose(
+            np.asarray(b.get_weight(model.params)), [-1.0], atol=0.2)
+
+    def test_parameter_not_trainable(self):
+        x = A.Variable(input_shape=(1,))
+        w0 = np.array([2.0], np.float32)
+        a = A.Parameter((1,), init_weight=w0, trainable=False)
+        model = Model(x, x * a)
+        model.compile("adam", "mse")
+        rs = np.random.RandomState(0)
+        xv = rs.randn(64, 1).astype(np.float32)
+        model.fit(xv, 5 * xv, batch_size=32, nb_epoch=5, distributed=False)
+        np.testing.assert_allclose(np.asarray(a.get_weight(model.params)),
+                                   w0)
+
+    def test_set_weight_shape_validated(self):
+        a = A.Parameter((4, 1))
+        with pytest.raises(ValueError):
+            a.set_weight(np.zeros((2,), np.float32))
+
+    def test_set_weight(self):
+        a = A.Parameter((2,))
+        a.set_weight(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(a.get_weight(), [1.0, 2.0])
+        x = A.Variable(input_shape=(2,))
+        m = Model(x, x + a)
+        params = m.build(jax.random.PRNGKey(0))
+        params = a.set_weight(np.array([5.0, 6.0], np.float32), params)
+        got = np.asarray(m.apply(params, np.zeros((1, 2), np.float32)))
+        np.testing.assert_allclose(got, [[5.0, 6.0]])
+
 
 class TestLambdaLayer:
     def test_lambda_in_sequential(self):
