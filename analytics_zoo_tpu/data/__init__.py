@@ -2,5 +2,6 @@ from analytics_zoo_tpu.data.shards import XShards, SparkXShards  # noqa: F401
 from analytics_zoo_tpu.data.dataset import TPUDataset  # noqa: F401
 from analytics_zoo_tpu.data.feature_set import FeatureSet  # noqa: F401
 from analytics_zoo_tpu.data import readers  # noqa: F401
+from analytics_zoo_tpu.data import tfrecord  # noqa: F401
 from analytics_zoo_tpu.data.readers import (  # noqa: F401
     read_csv, read_json, read_parquet)

@@ -95,10 +95,36 @@ class TPUDataset:
         return fs.to_dataset(batch_size=batch_size,
                              batch_per_thread=batch_per_thread)
 
+    @staticmethod
+    def from_tfrecord(paths, parse_fn: Callable[[Dict[str, Any]], Tuple],
+                      batch_size: int = -1, batch_per_thread: int = -1,
+                      shuffle: bool = True, shuffle_buffer: int = 8192,
+                      verify_payload: bool = False) -> "TPUDataset":
+        """Stream a TFRecord corpus into training (the reference's
+        `TFDataset.from_tf_data_dataset`/`TFBytesDataset` role,
+        `tf_dataset.py:593,911`, minus the tf.data graph shuttling).
+
+        `paths` is a glob pattern, directory, or file list; `parse_fn` maps
+        one decoded `tf.train.Example` dict ({name: ndarray | list[bytes]})
+        to an (x, y) sample of fixed-shape arrays. Records stream through a
+        `shuffle_buffer`-sized shuffle window per epoch (file order is also
+        reshuffled per epoch); batches are stacked to static shapes and the
+        tail remainder is dropped, per the training batch contract."""
+        from analytics_zoo_tpu.data import tfrecord as tfr
+        files = tfr.expand_files(paths)
+        return _TFRecordDataset(files, parse_fn, batch_size,
+                                batch_per_thread, shuffle, shuffle_buffer,
+                                verify_payload)
+
     # -- consumption -------------------------------------------------------
     def n_samples(self) -> int:
         import jax
         return len(jax.tree_util.tree_leaves(self.x)[0])
+
+    def materialize(self) -> Tuple[Any, Any]:
+        """(x, y) as in-memory arrays — lazy/streaming subclasses override.
+        Eval/predict paths run over arrays; training streams."""
+        return self.x, self.y
 
     def global_batch(self, data_parallel: int) -> int:
         """Resolve the per-step global batch, enforcing the reference's
@@ -136,6 +162,12 @@ class _FeatureSetDataset(TPUDataset):
     def n_samples(self) -> int:
         return len(self._fs)
 
+    def materialize(self):
+        merged = self._fs.take(np.arange(len(self._fs)))
+        if isinstance(merged, dict) and "x" in merged:
+            return merged["x"], merged.get("y")
+        return merged, None
+
     def iter_train(self, data_parallel: int, seed: int = 0):
         batch = self.global_batch(data_parallel)
         for b in self._fs.iter_batches(batch, shuffle=self.shuffle,
@@ -144,3 +176,98 @@ class _FeatureSetDataset(TPUDataset):
                 yield b["x"], b.get("y"), batch
             else:
                 yield b, None, batch
+
+
+class _TFRecordDataset(TPUDataset):
+    """Streaming TFRecord corpus → static-shape batches, via a bounded
+    shuffle buffer (no full materialization; a corpus larger than host RAM
+    trains fine)."""
+
+    def __init__(self, files: List[str], parse_fn, batch_size: int,
+                 batch_per_thread: int, shuffle: bool, shuffle_buffer: int,
+                 verify_payload: bool):
+        super().__init__(x=None, y=None, batch_size=batch_size,
+                         batch_per_thread=batch_per_thread, shuffle=shuffle)
+        if parse_fn is None:
+            raise ValueError(
+                "from_tfrecord needs a parse_fn mapping an Example dict to "
+                "an (x, y) sample")
+        self._files = files
+        self._parse_fn = parse_fn
+        self._shuffle_buffer = max(1, shuffle_buffer)
+        self._verify_payload = verify_payload
+        self._n: Optional[int] = None
+
+    def n_samples(self) -> int:
+        if self._n is None:
+            from analytics_zoo_tpu.data import tfrecord as tfr
+            self._n = sum(tfr.count_records(f) for f in self._files)
+        return self._n
+
+    def first_sample(self):
+        """Parse just the first record (shape/dtype probe for model build —
+        avoids paying a full shuffle-buffer fill for one sample)."""
+        from analytics_zoo_tpu.data import tfrecord as tfr
+        payload = next(tfr.read_records(self._files[0],
+                                        verify_payload=self._verify_payload))
+        return self._parse_fn(tfr.decode_example(payload))
+
+    def materialize(self):
+        """Read the whole corpus into stacked arrays (eval/predict path —
+        training should stream via iter_train instead)."""
+        import jax
+        samples = list(self._iter_samples(np.random.RandomState(0),
+                                          ordered=True))
+        xs = [s[0] for s in samples]
+        ys = [s[1] for s in samples]
+        x = jax.tree_util.tree_map(lambda *a: np.stack(a), *xs)
+        y = None if ys[0] is None \
+            else jax.tree_util.tree_map(lambda *a: np.stack(a), *ys)
+        return x, y
+
+    def _iter_samples(self, rng: np.random.RandomState,
+                      ordered: bool = False):
+        from analytics_zoo_tpu.data import tfrecord as tfr
+        files = list(self._files)
+        if self.shuffle and not ordered:
+            rng.shuffle(files)
+        for path in files:
+            for payload in tfr.read_records(
+                    path, verify_payload=self._verify_payload):
+                yield self._parse_fn(tfr.decode_example(payload))
+
+    def iter_train(self, data_parallel: int, seed: int = 0):
+        import jax
+        batch = self.global_batch(data_parallel)
+        rng = np.random.RandomState(seed)
+
+        def stack(samples):
+            xs = [s[0] for s in samples]
+            ys = [s[1] for s in samples]
+            xb = jax.tree_util.tree_map(lambda *a: np.stack(a), *xs)
+            yb = None if ys[0] is None \
+                else jax.tree_util.tree_map(lambda *a: np.stack(a), *ys)
+            return xb, yb, batch
+
+        buf: List[Tuple] = []
+        pending: List[Tuple] = []
+        for sample in self._iter_samples(rng):
+            if self.shuffle:
+                buf.append(sample)
+                if len(buf) < self._shuffle_buffer:
+                    continue
+                i = rng.randint(len(buf))
+                buf[i], sample = buf[-1], buf[i]
+                buf.pop()
+            pending.append(sample)
+            if len(pending) == batch:
+                yield stack(pending)
+                pending = []
+        # drain the shuffle window; drop the tail remainder (static shapes)
+        if self.shuffle and buf:
+            rng.shuffle(buf)
+            for sample in buf:
+                pending.append(sample)
+                if len(pending) == batch:
+                    yield stack(pending)
+                    pending = []

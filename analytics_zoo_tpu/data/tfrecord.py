@@ -1,0 +1,224 @@
+"""TFRecord ingestion: wire-format reader/writer + tf.train.Example codec.
+
+The reference feeds training from TFRecord corpora through its TFDataset
+family (`pyzoo/zoo/tfpark/tf_dataset.py:593` `from_tf_data_dataset`, `:911`
+`TFBytesDataset`; the inception example trains from ImageNet TFRecords).
+This module is the TPU-native path from a record-file corpus to the
+trainer, with no tensorflow dependency:
+
+- the TFRecord framing (little-endian u64 length, masked crc32c of the
+  length, payload, masked crc32c of the payload) is decoded directly;
+- `tf.train.Example` protobuf payloads are decoded with the same minimal
+  wire codec the ONNX importer uses (`analytics_zoo_tpu/onnx/wire.py`) —
+  the Example schema is tiny and frozen;
+- `TPUDataset.from_tfrecord` (in `data/dataset.py`) streams shards through
+  a shuffle buffer into the static-shape batch contract.
+
+CRC32C (Castagnoli) is table-driven pure Python. Integrity checks default
+to on for the 12-byte frame header (catches truncation/misalignment
+cheaply) and off for payloads — pass `verify_payload=True` to check those
+too.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import struct
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.onnx import wire
+
+from analytics_zoo_tpu.utils.crc import crc32c, masked_crc32c  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# Record framing
+# ---------------------------------------------------------------------------
+class TFRecordWriter:
+    """Writes the TFRecord framing; records are arbitrary bytes."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._fh = open(path, "wb")
+
+    def write(self, record: bytes) -> None:
+        header = struct.pack("<Q", len(record))
+        self._fh.write(header)
+        self._fh.write(struct.pack("<I", masked_crc32c(header)))
+        self._fh.write(record)
+        self._fh.write(struct.pack("<I", masked_crc32c(record)))
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_tfrecord(path: str, records: Iterable[bytes]) -> int:
+    with TFRecordWriter(path) as w:
+        n = 0
+        for r in records:
+            w.write(r)
+            n += 1
+    return n
+
+
+def read_records(path: str, verify_payload: bool = False
+                 ) -> Iterator[bytes]:
+    """Yield raw record payloads from one TFRecord file. The 12-byte frame
+    header CRC is always verified (cheap, catches corruption/misalignment
+    immediately); payload CRC only under `verify_payload`."""
+    with open(path, "rb") as fh:
+        while True:
+            header = fh.read(8)
+            if not header:
+                return
+            if len(header) < 8:
+                raise ValueError(f"{path}: truncated record header")
+            (length,) = struct.unpack("<Q", header)
+            (len_crc,) = struct.unpack("<I", fh.read(4))
+            if len_crc != masked_crc32c(header):
+                raise ValueError(f"{path}: corrupt record length CRC")
+            payload = fh.read(length)
+            if len(payload) < length:
+                raise ValueError(f"{path}: truncated record payload")
+            (crc,) = struct.unpack("<I", fh.read(4))
+            if verify_payload and crc != masked_crc32c(payload):
+                raise ValueError(f"{path}: corrupt record payload CRC")
+            yield payload
+
+
+def count_records(path: str) -> int:
+    """Count records by walking frame headers only (no payload decode).
+    Header CRCs are verified and truncation detected, so a corrupt or
+    non-TFRecord file raises here the same way `read_records` would."""
+    n = 0
+    size = os.path.getsize(path)
+    with open(path, "rb") as fh:
+        pos = 0
+        while pos < size:
+            header = fh.read(8)
+            if len(header) < 8:
+                raise ValueError(f"{path}: truncated record header")
+            (length,) = struct.unpack("<Q", header)
+            crc_raw = fh.read(4)
+            if len(crc_raw) < 4 \
+                    or struct.unpack("<I", crc_raw)[0] != masked_crc32c(header):
+                raise ValueError(f"{path}: corrupt record length CRC")
+            pos += 12 + length + 4
+            if pos > size:
+                raise ValueError(f"{path}: truncated record payload")
+            fh.seek(pos)
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# tf.train.Example codec (schema frozen in tensorflow/core/example/*.proto)
+# ---------------------------------------------------------------------------
+_BYTES_LIST = {1: ("value", "bytes")}
+_FLOAT_LIST = {1: ("value", "float")}
+_INT64_LIST = {1: ("value", "varint")}
+_FEATURE = {
+    1: ("bytes_list", ("msg", _BYTES_LIST)),
+    2: ("float_list", ("msg", _FLOAT_LIST)),
+    3: ("int64_list", ("msg", _INT64_LIST)),
+}
+_MAP_ENTRY = {1: ("key", "string"), 2: ("value", ("msg", _FEATURE))}
+_FEATURES = {1: ("feature", ("msg", _MAP_ENTRY))}
+_EXAMPLE = {1: ("features", ("msg", _FEATURES))}
+
+_U64 = 1 << 64
+_I64_MAX = (1 << 63) - 1
+
+
+def decode_example(payload: bytes) -> Dict[str, Any]:
+    """tf.train.Example bytes → {name: np.ndarray | list[bytes]}.
+    int64 features come back as int64 ndarrays, float features as float32
+    ndarrays, bytes features as a list of bytes objects."""
+    msg = wire.decode(payload, _EXAMPLE)
+    out: Dict[str, Any] = {}
+    for features in msg.get("features", []):
+        for entry in features.get("feature", []):
+            key = entry["key"][0]
+            feat = entry["value"][0]
+            if "bytes_list" in feat:
+                out[key] = list(feat["bytes_list"][0].get("value", []))
+            elif feat.get("float_list"):
+                vals = feat["float_list"][0].get("value", [])
+                out[key] = np.asarray(vals, np.float32)
+            elif feat.get("int64_list"):
+                vals = [v - _U64 if v > _I64_MAX else v
+                        for v in feat["int64_list"][0].get("value", [])]
+                out[key] = np.asarray(vals, np.int64)
+            else:  # empty feature of unknown kind
+                out[key] = np.asarray([], np.float32)
+    return out
+
+
+def encode_example(features: Dict[str, Any]) -> bytes:
+    """{name: value} → tf.train.Example bytes. Value kinds: bytes/str (or
+    lists of them) → bytes_list; float arrays → float_list; int arrays →
+    int64_list."""
+    entries = []
+    for key, value in features.items():
+        if isinstance(value, (bytes, str)):
+            feat = {"bytes_list": {"value": [
+                value.encode() if isinstance(value, str) else value]}}
+        elif isinstance(value, (list, tuple)) and value \
+                and isinstance(value[0], (bytes, str)):
+            feat = {"bytes_list": {"value": [
+                v.encode() if isinstance(v, str) else v for v in value]}}
+        else:
+            arr = np.asarray(value)
+            flat = arr.ravel()
+            if np.issubdtype(arr.dtype, np.integer):
+                feat = {"int64_list": {"value": [
+                    int(v) + _U64 if v < 0 else int(v) for v in flat]}}
+            elif np.issubdtype(arr.dtype, np.floating):
+                feat = {"float_list": {"value": [float(v) for v in flat]}}
+            else:
+                raise TypeError(
+                    f"Feature {key!r}: unsupported dtype {arr.dtype}")
+        entries.append({"key": [key], "value": [feat]})
+    return wire.encode({"features": [{"feature": entries}]}, _EXAMPLE)
+
+
+# ---------------------------------------------------------------------------
+# Corpus helpers
+# ---------------------------------------------------------------------------
+def expand_files(paths) -> List[str]:
+    """Glob pattern / directory / explicit list → sorted file list. An
+    explicitly-listed path that doesn't exist raises (a typo'd shard must
+    not silently train on a partial corpus)."""
+    if isinstance(paths, str):
+        if os.path.isdir(paths):
+            paths = sorted(
+                os.path.join(paths, f) for f in os.listdir(paths)
+                if not f.startswith("."))
+        else:
+            paths = sorted(_glob.glob(paths)) or [paths]
+    missing = [p for p in paths if not os.path.isfile(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"TFRecord shard(s) not found: {missing!r}")
+    if not paths:
+        raise FileNotFoundError("Empty TFRecord file list")
+    return list(paths)
+
+
+def iter_examples(paths, parse_fn=None, verify_payload: bool = False
+                  ) -> Iterator[Any]:
+    """Stream decoded Examples (or `parse_fn(example_dict)` results) across
+    a shard list in order."""
+    for path in expand_files(paths):
+        for payload in read_records(path, verify_payload=verify_payload):
+            ex = decode_example(payload)
+            yield parse_fn(ex) if parse_fn is not None else ex

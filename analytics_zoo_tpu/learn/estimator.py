@@ -135,18 +135,25 @@ class Estimator:
         elif batch_size is None:
             batch_size = 32
         dp = get_context().mesh.data_parallel_size
-        lazy = ds.x is None  # disk-tier FeatureSet bridge
+        lazy = ds.x is None  # disk-tier FeatureSet / TFRecord stream bridge
         batch_iter_factory = (
             (lambda epoch: ds.iter_train(dp, seed=seed + epoch))
             if lazy else None)
+        if lazy and self.model.params is None \
+                and hasattr(ds, "first_sample"):
+            # cheap shape probe: one record, not a shuffle-buffer fill
+            sx, _ = ds.first_sample()
+            batched = jax.tree_util.tree_map(
+                lambda a: np.expand_dims(a, 0), sx)
+            self.model.ensure_built(batched, jax.random.PRNGKey(seed))
 
         val = None
         if validation_data is not None:
             vds = to_dataset(validation_data, batch_size=batch_size,
                              feature_cols=feature_cols, label_cols=label_cols)
-            val = (vds.x, vds.y)
+            val = vds.materialize()
         elif ds.val is not None:
-            val = (ds.val.x, ds.val.y)
+            val = ds.val.materialize()
 
         cfg = get_context().config
         if self.model_dir:
@@ -214,7 +221,8 @@ class Estimator:
                 ) -> np.ndarray:
         ds = to_dataset(data, batch_per_thread=batch_per_thread,
                         feature_cols=feature_cols)
-        preds = self.model.predict(ds.x, batch_per_thread=batch_per_thread)
+        x, _ = ds.materialize()
+        preds = self.model.predict(x, batch_per_thread=batch_per_thread)
         return preds
 
     def evaluate(self, data, batch_per_thread: int = 32, metrics=None,
@@ -223,11 +231,12 @@ class Estimator:
                         feature_cols=feature_cols, label_cols=label_cols)
         from analytics_zoo_tpu.ops import metrics as zmetrics
         ms = zmetrics.resolve(metrics) if metrics else None
+        x, y = ds.materialize()
         if isinstance(self.model, _ModelFnModel) and not ms \
                 and not self.model.metrics:
             # spec loss needs the raw features → dedicated eval path
-            return self.model._evaluate_spec(ds.x, ds.y, batch_per_thread)
-        return self.model.evaluate(ds.x, ds.y,
+            return self.model._evaluate_spec(x, y, batch_per_thread)
+        return self.model.evaluate(x, y,
                                    batch_per_thread=batch_per_thread,
                                    metrics=ms)
 

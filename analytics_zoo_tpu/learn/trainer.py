@@ -352,11 +352,16 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
 
     rng = jax.random.PRNGKey(seed)
     rng, init_rng = jax.random.split(rng)
-    try:
-        sample = next(iter(batch_iter_factory(0)))[0]
-    except StopIteration:
-        raise ValueError("Dataset produced no full batches; lower batch_size")
-    model.ensure_built(sample, init_rng)
+    if model.params is None:
+        # shape probe — skipped when already built (streaming datasets
+        # prebuild from a cheap first_sample instead of paying a full
+        # shuffle-buffer fill here)
+        try:
+            sample = next(iter(batch_iter_factory(0)))[0]
+        except StopIteration:
+            raise ValueError(
+                "Dataset produced no full batches; lower batch_size")
+        model.ensure_built(sample, init_rng)
 
     optimizer = model.optimizer
     if optimizer is None:

@@ -286,8 +286,8 @@ def expand_dims(v: Variable, axis: int) -> Variable:
                           name="expand_dims")
 
 
-def squeeze(v: Variable, axis: int) -> Variable:
-    return Variable._lift(lambda a: jnp.squeeze(a, axis), v, name="squeeze")
+def squeeze(v: Variable, axis: Optional[int] = None) -> Variable:
+    return v.squeeze(axis)  # batch-dim-safe method semantics
 
 
 def stack(vs: Sequence[Variable], axis: int = 1) -> Variable:

@@ -1,0 +1,37 @@
+"""Shared CRC32C (Castagnoli) + TFRecord masking — one implementation for
+the TensorBoard event writer (`utils/tensorboard.py`) and the TFRecord
+data path (`data/tfrecord.py`), both of which use the same length +
+masked-crc framing."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def _build_table() -> List[int]:
+    poly = 0x82F63B78
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_TABLE = _build_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    tbl = _TABLE
+    for b in data:
+        crc = tbl[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    """TFRecord's masked CRC: rotate right by 15, add a constant."""
+    crc = crc32c(data)
+    return ((crc >> 15) | ((crc << 17) & 0xFFFFFFFF)) \
+        + 0xA282EAD8 & 0xFFFFFFFF

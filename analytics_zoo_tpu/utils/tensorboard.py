@@ -17,34 +17,8 @@ import struct
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
-# ---------------------------------------------------------------------------
-# crc32c (Castagnoli) — table-driven, pure python
-# ---------------------------------------------------------------------------
-_CRC_TABLE: List[int] = []
-
-
-def _build_table():
-    poly = 0x82F63B78
-    for n in range(256):
-        c = n
-        for _ in range(8):
-            c = (c >> 1) ^ poly if c & 1 else c >> 1
-        _CRC_TABLE.append(c)
-
-
-_build_table()
-
-
-def crc32c(data: bytes) -> int:
-    crc = 0xFFFFFFFF
-    for b in data:
-        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
-
-
-def _masked_crc(data: bytes) -> int:
-    crc = crc32c(data)
-    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+from analytics_zoo_tpu.utils.crc import crc32c  # noqa: F401 (re-export)
+from analytics_zoo_tpu.utils.crc import masked_crc32c as _masked_crc
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,7 @@ EXAMPLES = [
     ("variational_autoencoder.py", []),
     ("session_recommender.py", []),
     ("long_context_attention.py", []),
+    ("tfrecord_training.py", []),
 ]
 
 
