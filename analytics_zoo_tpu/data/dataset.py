@@ -208,9 +208,11 @@ class _TFRecordDataset(TPUDataset):
         """Parse just the first record (shape/dtype probe for model build —
         avoids paying a full shuffle-buffer fill for one sample)."""
         from analytics_zoo_tpu.data import tfrecord as tfr
-        payload = next(tfr.read_records(self._files[0],
-                                        verify_payload=self._verify_payload))
-        return self._parse_fn(tfr.decode_example(payload))
+        for path in self._files:
+            for payload in tfr.read_records(
+                    path, verify_payload=self._verify_payload):
+                return self._parse_fn(tfr.decode_example(payload))
+        raise ValueError(f"TFRecord corpus is empty: {self._files!r}")
 
     def materialize(self):
         """Read the whole corpus into stacked arrays (eval/predict path —
@@ -218,6 +220,8 @@ class _TFRecordDataset(TPUDataset):
         import jax
         samples = list(self._iter_samples(np.random.RandomState(0),
                                           ordered=True))
+        if not samples:
+            raise ValueError(f"TFRecord corpus is empty: {self._files!r}")
         xs = [s[0] for s in samples]
         ys = [s[1] for s in samples]
         x = jax.tree_util.tree_map(lambda *a: np.stack(a), *xs)

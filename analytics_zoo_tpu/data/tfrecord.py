@@ -83,14 +83,19 @@ def read_records(path: str, verify_payload: bool = False
             if len(header) < 8:
                 raise ValueError(f"{path}: truncated record header")
             (length,) = struct.unpack("<Q", header)
-            (len_crc,) = struct.unpack("<I", fh.read(4))
-            if len_crc != masked_crc32c(header):
+            len_crc_raw = fh.read(4)
+            if len(len_crc_raw) < 4:
+                raise ValueError(f"{path}: truncated record header")
+            if struct.unpack("<I", len_crc_raw)[0] != masked_crc32c(header):
                 raise ValueError(f"{path}: corrupt record length CRC")
             payload = fh.read(length)
             if len(payload) < length:
                 raise ValueError(f"{path}: truncated record payload")
-            (crc,) = struct.unpack("<I", fh.read(4))
-            if verify_payload and crc != masked_crc32c(payload):
+            crc_raw = fh.read(4)
+            if len(crc_raw) < 4:
+                raise ValueError(f"{path}: truncated record payload")
+            if verify_payload and struct.unpack("<I", crc_raw)[0] \
+                    != masked_crc32c(payload):
                 raise ValueError(f"{path}: corrupt record payload CRC")
             yield payload
 

@@ -451,6 +451,11 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
           # ONE host sync per epoch: materialize every step loss together.
           # This blocks until the last step's program has finished, so dt
           # measures device compute, not dispatch.
+          if epoch == 0 and not losses_dev:
+              # prebuilt models skip the shape probe, so an empty/too-small
+              # dataset must still fail loudly rather than "train" 0 steps
+              raise ValueError(
+                  "Dataset produced no full batches; lower batch_size")
           step_losses = np.concatenate(
               [np.atleast_1d(v) for v in _materialize(losses_dev)]) \
               if losses_dev else np.zeros((0,))

@@ -63,6 +63,23 @@ class ZooModel:
                        "config": self._config}, fh)
         self.model.save_weights(os.path.join(path, "weights"))
 
+    def save_model_encrypted(self, path: str, secret: str, salt: str,
+                             over_write: bool = False):
+        """Encrypted save (`InferenceModel.scala:121-226` encrypted-model
+        loaders): config json in clear, weights AES-GCM-sealed as
+        weights.enc — loadable by `InferenceModel.load_keras_encrypted`
+        and the serving `secure.model_encrypted` flow."""
+        from analytics_zoo_tpu.learn.encrypted import save_encrypted_pytree
+        os.makedirs(path, exist_ok=True)
+        cfg_path = os.path.join(path, "config.json")
+        if os.path.exists(cfg_path) and not over_write:
+            raise FileExistsError(f"{path} exists; pass over_write=True")
+        with open(cfg_path, "w") as fh:
+            json.dump({"class": type(self).__name__,
+                       "config": self._config}, fh)
+        save_encrypted_pytree(os.path.join(path, "weights.enc"),
+                              self.model.params, secret, salt)
+
     @classmethod
     def load_model(cls, path: str) -> "ZooModel":
         with open(os.path.join(path, "config.json")) as fh:

@@ -23,16 +23,27 @@ def cmd_start(args) -> int:
     from analytics_zoo_tpu.serving.config import ServingConfig
     from analytics_zoo_tpu.serving.http_frontend import FrontEnd
     from analytics_zoo_tpu.serving.server import ClusterServing
+    from analytics_zoo_tpu.serving.broker import connect_broker
     cfg = ServingConfig.load(args.config)
-    model = cfg.build_model()
-    serving = ClusterServing(model, cfg.broker_url, stream=cfg.stream,
-                             batch_size=cfg.batch_size,
-                             batch_timeout_ms=cfg.batch_timeout_ms).start()
+    broker = connect_broker(cfg.broker_url)
     frontend = None
     if cfg.http_port is not None:
-        frontend = FrontEnd(serving.broker, serving,
-                            port=cfg.http_port).start()
-        print(f"http frontend on :{frontend.port}", flush=True)
+        # frontend first: with model_encrypted, build_model blocks until
+        # someone POSTs the secret/salt to /model-secure
+        frontend = FrontEnd(
+            broker, None, port=cfg.http_port,
+            tokens_per_second=cfg.tokens_per_second,
+            token_acquire_timeout_ms=cfg.token_acquire_timeout_ms,
+            tls_certfile=cfg.tls_certfile,
+            tls_keyfile=cfg.tls_keyfile).start()
+        scheme = "https" if frontend.tls else "http"
+        print(f"{scheme} frontend on :{frontend.port}", flush=True)
+    model = cfg.build_model(broker=broker)
+    serving = ClusterServing(model, broker, stream=cfg.stream,
+                             batch_size=cfg.batch_size,
+                             batch_timeout_ms=cfg.batch_timeout_ms).start()
+    if frontend is not None:
+        frontend._srv.serving = serving
     print("cluster serving started", flush=True)
 
     stop = []

@@ -55,15 +55,34 @@ class InputQueue:
 
     def predict(self, data: np.ndarray, timeout_s: float = 30.0) -> np.ndarray:
         """Sync path (`client.py:199`): enqueue then poll the result."""
-        uri = self.enqueue(None, t=np.asarray(data))
+        return self.predict_batch([np.asarray(data)], timeout_s)[0]
+
+    def predict_batch(self, samples, timeout_s: float = 30.0) -> list:
+        """Sync multi-record path: each sample is ONE serving record (the
+        per-instance contract of the reference frontend — records batch up
+        inside the serving loop, not inside one record). Results return in
+        input order; a failed record yields float('nan')."""
+        uris = [self.enqueue(None, t=np.asarray(s)) for s in samples]
         out = OutputQueue(self.broker, self.stream)
+        results: dict = {}
         deadline = time.time() + timeout_s
-        while time.time() < deadline:
-            res = out.query(uri, delete=True)
-            if res is not None:
-                return res
-            time.sleep(0.005)
-        raise TimeoutError(f"No prediction for {uri} within {timeout_s}s")
+        while len(results) < len(uris) and time.time() < deadline:
+            progress = False
+            for uri in uris:
+                if uri in results:
+                    continue
+                res = out.query(uri, delete=True)
+                if res is not None:
+                    results[uri] = res
+                    progress = True
+            if not progress:
+                time.sleep(0.005)
+        missing = [u for u in uris if u not in results]
+        if missing:
+            raise TimeoutError(
+                f"No prediction for {len(missing)}/{len(uris)} records "
+                f"within {timeout_s}s")
+        return [results[u] for u in uris]
 
 
 class OutputQueue:

@@ -77,6 +77,15 @@ class ServingConfig:
     batch_timeout_ms: int = 5
     concurrent_num: int = 1
     http_port: Optional[int] = None
+    # secure block (`ClusterServingHelper.scala:121-134` — model_encrypted
+    # gates the wait-for-secret/salt flow before weights load)
+    model_encrypted: bool = False
+    secret_timeout_s: float = 60.0
+    # frontend hardening (`FrontEndApp.scala` tokenBucket/https arguments)
+    tokens_per_second: Optional[float] = None
+    token_acquire_timeout_ms: float = 100.0
+    tls_certfile: Optional[str] = None
+    tls_keyfile: Optional[str] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     # pre-consolidation field names (ZooConfig JSON / ZOO_SERVING_* env vars)
@@ -104,20 +113,52 @@ class ServingConfig:
         cfg.concurrent_num = int(params.get("concurrent_num", 1))
         if raw.get("http_port") is not None:
             cfg.http_port = int(raw["http_port"])
+        secure = raw.get("secure", {}) or {}
+        cfg.model_encrypted = bool(secure.get("model_encrypted", False))
+        if secure.get("secret_timeout_s") is not None:
+            cfg.secret_timeout_s = float(secure["secret_timeout_s"])
+        frontend = raw.get("frontend", {}) or {}
+        if frontend.get("tokens_per_second") is not None:
+            cfg.tokens_per_second = float(frontend["tokens_per_second"])
+        if frontend.get("token_acquire_timeout_ms") is not None:
+            cfg.token_acquire_timeout_ms = float(
+                frontend["token_acquire_timeout_ms"])
+        cfg.tls_certfile = frontend.get("tls_certfile")
+        cfg.tls_keyfile = frontend.get("tls_keyfile")
         cfg.extra = raw
         return cfg
 
-    def build_model(self):
+    def build_model(self, broker=None):
         """Model resolution (`ClusterServingHelper` model-type dispatch):
         a ZooModel dir (config.json names the class), or bare weights plus
-        `model: {class: ..., config: {...constructor kwargs...}}`."""
+        `model: {class: ..., config: {...constructor kwargs...}}`.
+
+        With `secure.model_encrypted`, blocks polling the broker for the
+        secret/salt the frontend receives at POST /model-secure
+        (`ClusterServingHelper.scala:302-310`), then decrypts
+        `weights.enc` instead of reading plain weights."""
         import json
         from analytics_zoo_tpu.serving.inference_model import InferenceModel
         if not self.model_path:
             raise ValueError("config has no model.path")
         im = InferenceModel(concurrent_num=self.concurrent_num)
+        secret = salt = None
+        if self.model_encrypted:
+            if broker is None:
+                from analytics_zoo_tpu.serving.broker import connect_broker
+                broker = connect_broker(self.broker_url)
+            secret, salt = wait_model_secret(broker, self.secret_timeout_s)
+
         cfg_json = os.path.join(self.model_path, "config.json")
         if os.path.exists(cfg_json):
+            if self.model_encrypted:
+                with open(cfg_json) as fh:
+                    blob = json.load(fh)
+                cls = _find_model_class(blob["class"])
+                inst = cls(**blob.get("config", {}))
+                return im.load_keras_encrypted(
+                    inst, os.path.join(self.model_path, "weights.enc"),
+                    secret, salt)
             with open(cfg_json) as fh:
                 cls_name = json.load(fh)["class"]
             cls = _find_model_class(cls_name)
@@ -126,11 +167,39 @@ class ServingConfig:
             cls = _find_model_class(self.model_class)
             kwargs = (self.extra.get("model", {}) or {}).get("config") or {}
             inst = cls(**kwargs)
+            if self.model_encrypted:
+                return im.load_keras_encrypted(
+                    inst, os.path.join(self.model_path, "weights.enc"),
+                    secret, salt)
             inst.model.load_weights(os.path.join(self.model_path, "weights"))
             return im.load_keras(inst)
         raise ValueError(
             f"{self.model_path} is not a saved ZooModel directory "
             "(no config.json) and no model.class was given")
+
+
+def wait_model_secret(broker, timeout_s: float = 60.0,
+                      poll_s: float = 0.2):
+    """Block until the frontend posts the model secret/salt to the broker
+    (`ClusterServingHelper.scala:302-310` jedis.hget polling loop)."""
+    import time as _time
+    from analytics_zoo_tpu.serving.http_frontend import (
+        MODEL_SECURED_KEY, MODEL_SECURED_SALT, MODEL_SECURED_SECRET)
+    deadline = _time.time() + timeout_s
+    while _time.time() < deadline:
+        secret = broker.hget(MODEL_SECURED_KEY, MODEL_SECURED_SECRET)
+        salt = broker.hget(MODEL_SECURED_KEY, MODEL_SECURED_SALT)
+        if secret and salt:
+            # one-shot: scrub the secret from the broker immediately —
+            # leaving it readable would let any broker client decrypt the
+            # model long after startup
+            broker.hdel(MODEL_SECURED_KEY, MODEL_SECURED_SECRET)
+            broker.hdel(MODEL_SECURED_KEY, MODEL_SECURED_SALT)
+            return secret, salt
+        _time.sleep(poll_s)
+    raise TimeoutError(
+        f"No model secret/salt appeared on the broker within {timeout_s}s; "
+        "POST secret=...&salt=... to the frontend's /model-secure")
 
 
 def _find_model_class(name: str):

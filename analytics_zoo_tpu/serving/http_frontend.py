@@ -3,17 +3,31 @@
 
 Routes preserved: `POST /predict` (sync prediction: enqueue to the broker,
 await the result — `FrontEndApp.scala:163`), `GET /metrics` (timer snapshots
-as JSON, `:131,241`), plus `GET /` liveness ("welcome to analytics zoo web
-serving frontend"). Stdlib ThreadingHTTPServer: no extra dependency, one
-thread per in-flight request, the TPU work itself is serialized by the
-serving loop behind the broker."""
+as JSON, `:131,241`), `POST /model-secure` ("secret=xxx&salt=yyy" stored on
+the broker for encrypted-model loading, `:140-152`), plus `GET /` liveness
+("welcome to analytics zoo web serving frontend").
+
+Hardening, matching the reference's front-end options:
+- token-bucket rate limiting (`FrontEndApp.scala:59-60` guava RateLimiter,
+  `tryAcquire` at `:167`): `tokens_per_second` caps admission; a request
+  that can't get a token within `token_acquire_timeout_ms` is rejected
+  with 429.
+- TLS (`:225-227` httpsEnabled/keystore): pass `tls_certfile`/`tls_keyfile`
+  (PEM) and the listener speaks HTTPS via stdlib ssl.
+
+Stdlib ThreadingHTTPServer: no extra dependency, one thread per in-flight
+request, the TPU work itself is serialized by the serving loop behind the
+broker."""
 
 from __future__ import annotations
 
 import json
+import ssl
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Union
+from urllib.parse import parse_qs
 
 import numpy as np
 
@@ -21,6 +35,48 @@ from analytics_zoo_tpu.serving.broker import Broker, connect_broker
 from analytics_zoo_tpu.serving.client import InputQueue
 from analytics_zoo_tpu.serving.server import ClusterServing
 from analytics_zoo_tpu.serving.timer import Timer
+
+# broker keys for the model-secure flow (`Conventions.scala:33-35`)
+MODEL_SECURED_KEY = "model_secured"
+MODEL_SECURED_SECRET = "secret"
+MODEL_SECURED_SALT = "salt"
+
+
+class TokenBucket:
+    """Continuous-refill token bucket (the guava RateLimiter role,
+    `FrontEndApp.scala:59`). Thread-safe; `try_acquire` waits up to the
+    given timeout for a token."""
+
+    def __init__(self, tokens_per_second: float,
+                 capacity: Optional[float] = None):
+        if tokens_per_second <= 0:
+            raise ValueError("tokens_per_second must be > 0")
+        self.rate = float(tokens_per_second)
+        self.capacity = float(capacity if capacity is not None
+                              else max(1.0, tokens_per_second))
+        self._tokens = self.capacity
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        self._tokens = min(self.capacity,
+                           self._tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def try_acquire(self, timeout_ms: float = 0.0) -> bool:
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._refill(now)
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return True
+                wait = min((1.0 - self._tokens) / self.rate,
+                           deadline - now)
+            if wait <= 0:
+                return False
+            time.sleep(wait)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -48,22 +104,42 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, {"error": "not found"})
 
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length)
+
     def do_POST(self):
+        if self.path == "/model-secure":
+            self._model_secure()
+            return
         if self.path != "/predict":
             self._send(404, {"error": "not found"})
             return
+        limiter: Optional[TokenBucket] = self.server.rate_limiter
+        if limiter is not None and not limiter.try_acquire(
+                self.server.token_acquire_timeout_ms):
+            # `FrontEndApp.scala:167` tryAcquire failure → reject
+            self._send(429, {"error": "too many requests"})
+            return
         with self.server.request_timer.timing():
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(length))
-                # {"instances": [[...], ...]} tf-serving-style, or
-                # {"b64","dtype","shape"} raw tensor
+                req = json.loads(self._read_body())
+                # {"instances": [[...], ...]} tf-serving-style (each
+                # instance is ONE serving record — they batch inside the
+                # serving loop), or {"b64","dtype","shape"} raw tensor
                 if "instances" in req:
                     arr = np.asarray(req["instances"], np.float32)
-                else:
-                    from analytics_zoo_tpu.serving.broker import \
-                        decode_ndarray
-                    arr = decode_ndarray(req)
+                    results = self.server.input_queue.predict_batch(
+                        arr, timeout_s=self.server.timeout_s)
+                    if any(isinstance(r, float) and np.isnan(r)
+                           for r in results):
+                        self._send(500, {"error": "inference failure (NaN)"})
+                    else:
+                        self._send(200, {"predictions": np.asarray(results)
+                                         .tolist()})
+                    return
+                from analytics_zoo_tpu.serving.broker import decode_ndarray
+                arr = decode_ndarray(req)
                 result = self.server.input_queue.predict(
                     arr, timeout_s=self.server.timeout_s)
                 if isinstance(result, float) and np.isnan(result):
@@ -74,23 +150,80 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001 — frontend must not die
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
 
+    def _model_secure(self):
+        """`FrontEndApp.scala:140-152`: body `secret=xxx&salt=yyy` → broker
+        hash, where the serving side polls for it before decrypting an
+        encrypted model."""
+        try:
+            fields = parse_qs(self._read_body().decode(),
+                              strict_parsing=True)
+            secret = fields["secret"][0]
+            salt = fields["salt"][0]
+            broker: Broker = self.server.broker
+            broker.hset(MODEL_SECURED_KEY, MODEL_SECURED_SECRET, secret)
+            broker.hset(MODEL_SECURED_KEY, MODEL_SECURED_SALT, salt)
+            self._send(200, {"message": "model secured secret and salt "
+                                        "succeed to put on broker"})
+        except Exception as e:  # noqa: BLE001
+            self._send(500, {"error": f"{type(e).__name__}: {e}; please "
+                             "post a content like secret=xxx&salt=xxxx"})
+
+
+class _FrontEndServer(ThreadingHTTPServer):
+    """TLS is wrapped per-connection in the handler thread (not on the
+    listening socket): a client that connects and never handshakes must
+    stall only its own thread, not the accept loop."""
+
+    ssl_context: Optional[ssl.SSLContext] = None
+    handshake_timeout_s: float = 10.0
+
+    def finish_request(self, request, client_address):
+        if self.ssl_context is not None:
+            request.settimeout(self.handshake_timeout_s)
+            try:
+                request = self.ssl_context.wrap_socket(request,
+                                                       server_side=True)
+            except (ssl.SSLError, OSError):
+                # bad/absent handshake (port scan, slow-loris, plain HTTP
+                # against the TLS port): drop the connection quietly
+                request.close()
+                return
+            request.settimeout(None)
+        self.RequestHandlerClass(request, client_address, self)
+
 
 class FrontEnd:
-    """`FrontEndApp` equivalent: HTTP server in front of a broker stream."""
+    """`FrontEndApp` equivalent: HTTP(S) server in front of a broker
+    stream, with optional token-bucket admission control."""
 
     def __init__(self, broker: Union[Broker, str, None] = None,
                  serving: Optional[ClusterServing] = None,
                  host: str = "0.0.0.0", port: int = 10020,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0,
+                 tokens_per_second: Optional[float] = None,
+                 token_bucket_capacity: Optional[float] = None,
+                 token_acquire_timeout_ms: float = 100.0,
+                 tls_certfile: Optional[str] = None,
+                 tls_keyfile: Optional[str] = None):
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
-        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv = _FrontEndServer((host, port), _Handler)
         self._srv.daemon_threads = True
         self._srv.input_queue = InputQueue(self.broker)
+        self._srv.broker = self.broker
         self._srv.serving = serving
         self._srv.request_timer = Timer("http_predict")
         self._srv.timeout_s = timeout_s
-        self.host, self.port = self._srv.server_address
+        self._srv.rate_limiter = (
+            TokenBucket(tokens_per_second, token_bucket_capacity)
+            if tokens_per_second else None)
+        self._srv.token_acquire_timeout_ms = token_acquire_timeout_ms
+        self.tls = bool(tls_certfile)
+        if tls_certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_certfile, tls_keyfile)
+            self._srv.ssl_context = ctx
+        self.host, self.port = self._srv.server_address[:2]
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
 

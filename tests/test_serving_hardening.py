@@ -1,0 +1,250 @@
+"""Serving front-end hardening tests (reference:
+`serving/http/FrontEndApp.scala:59-60` token bucket, `:140-152`
+model-secure, `:225-227` HTTPS): 429-on-flood, TLS round-trip, and the
+encrypted-model secret/salt flow end-to-end."""
+
+import json
+import ssl
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.serving import (ClusterServing, FrontEnd,
+                                       InferenceModel, MemoryBroker)
+from analytics_zoo_tpu.serving.http_frontend import (MODEL_SECURED_KEY,
+                                                     TokenBucket)
+
+
+def make_model(in_dim=4, out_dim=3):
+    m = Sequential([L.Dense(out_dim, input_shape=(in_dim,))])
+    m.ensure_built(np.zeros((1, in_dim), np.float32))
+    im = InferenceModel()
+    im.load_keras(m)
+    return m, im
+
+
+def _post(url, payload, ctx=None, timeout=30):
+    data = payload if isinstance(payload, bytes) \
+        else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=data)
+    return urllib.request.urlopen(req, timeout=timeout, context=ctx)
+
+
+class TestTokenBucket:
+    def test_burst_then_throttle(self):
+        tb = TokenBucket(tokens_per_second=5, capacity=3)
+        assert [tb.try_acquire() for _ in range(3)] == [True] * 3
+        assert tb.try_acquire() is False  # bucket drained
+        time.sleep(0.25)                  # ~1.25 tokens refilled
+        assert tb.try_acquire() is True
+        assert tb.try_acquire() is False
+
+    def test_acquire_with_timeout_waits(self):
+        tb = TokenBucket(tokens_per_second=20, capacity=1)
+        assert tb.try_acquire()
+        t0 = time.monotonic()
+        assert tb.try_acquire(timeout_ms=500)  # ~50ms until next token
+        assert time.monotonic() - t0 < 0.5
+
+    def test_invalid_rate(self):
+        with pytest.raises(ValueError):
+            TokenBucket(0)
+
+
+class TestRateLimitedFrontend:
+    def test_429_on_flood(self):
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br).start()
+        fe = FrontEnd(br, serving, host="127.0.0.1", port=0,
+                      tokens_per_second=3, token_bucket_capacity=3,
+                      token_acquire_timeout_ms=0).start()
+        try:
+            url = f"http://127.0.0.1:{fe.port}/predict"
+            codes = []
+
+            def hit():
+                try:
+                    r = _post(url, {"instances": np.ones((1, 4)).tolist()})
+                    codes.append(r.getcode())
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+
+            threads = [threading.Thread(target=hit) for _ in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert codes.count(429) >= 6     # flood mostly rejected
+            assert codes.count(200) >= 1     # admitted ones succeed
+            assert set(codes) <= {200, 429}
+        finally:
+            fe.stop()
+            serving.stop()
+
+    def test_no_limiter_admits_all(self):
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br).start()
+        fe = FrontEnd(br, serving, host="127.0.0.1", port=0).start()
+        try:
+            url = f"http://127.0.0.1:{fe.port}/predict"
+            for _ in range(5):
+                r = _post(url, {"instances": np.ones((1, 4)).tolist()})
+                assert r.getcode() == 200
+        finally:
+            fe.stop()
+            serving.stop()
+
+
+@pytest.fixture(scope="module")
+def tls_cert(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    proc = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=localhost"],
+        capture_output=True)
+    if proc.returncode != 0:
+        pytest.skip("openssl unavailable for self-signed cert")
+    return cert, key
+
+
+class TestTLS:
+    def test_https_round_trip(self, tls_cert):
+        cert, key = tls_cert
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br).start()
+        fe = FrontEnd(br, serving, host="127.0.0.1", port=0,
+                      tls_certfile=cert, tls_keyfile=key).start()
+        try:
+            ctx = ssl.create_default_context(cafile=cert)
+            ctx.check_hostname = False  # CN=localhost vs 127.0.0.1
+            url = f"https://127.0.0.1:{fe.port}"
+            r = _post(url + "/predict",
+                      {"instances": np.ones((2, 4)).tolist()}, ctx=ctx)
+            assert np.asarray(
+                json.loads(r.read())["predictions"]).shape == (2, 3)
+            # plain HTTP against the TLS port fails
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/", timeout=5)
+        finally:
+            fe.stop()
+            serving.stop()
+
+
+class TestModelSecure:
+    def test_post_model_secure_stores_on_broker(self):
+        br = MemoryBroker()
+        fe = FrontEnd(br, None, host="127.0.0.1", port=0).start()
+        try:
+            url = f"http://127.0.0.1:{fe.port}/model-secure"
+            r = _post(url, b"secret=s3cr3t&salt=pepper")
+            assert r.getcode() == 200
+            assert br.hget(MODEL_SECURED_KEY, "secret") == "s3cr3t"
+            assert br.hget(MODEL_SECURED_KEY, "salt") == "pepper"
+            # malformed body → 500 with usage hint
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url, b"garbage")
+            assert ei.value.code == 500
+        finally:
+            fe.stop()
+
+    def test_encrypted_model_serving_end_to_end(self, tmp_path):
+        """Save an encrypted ZooModel, start config-driven serving with
+        secure.model_encrypted, unlock it via POST /model-secure, predict."""
+        from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+        from analytics_zoo_tpu.serving.config import ServingConfig
+
+        ad = AnomalyDetector(feature_shape=(5, 3), hidden_layers=(8,),
+                             dropouts=(0.0,))
+        ad.model.ensure_built(np.zeros((1, 5, 3), np.float32))
+        mdir = str(tmp_path / "enc_model")
+        ad.save_model_encrypted(mdir, "s3cr3t", "pepper")
+
+        cfg_path = tmp_path / "config.yaml"
+        cfg_path.write_text(
+            "model:\n"
+            f"  path: {mdir}\n"
+            "secure:\n"
+            "  model_encrypted: true\n"
+            "  secret_timeout_s: 20\n")
+        cfg = ServingConfig.load(str(cfg_path))
+        assert cfg.model_encrypted
+
+        br = MemoryBroker()
+        fe = FrontEnd(br, None, host="127.0.0.1", port=0).start()
+        built = {}
+
+        def build():
+            built["im"] = cfg.build_model(broker=br)
+
+        t = threading.Thread(target=build)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()  # blocked waiting for the secret
+        _post(f"http://127.0.0.1:{fe.port}/model-secure",
+              b"secret=s3cr3t&salt=pepper")
+        t.join(timeout=30)
+        assert not t.is_alive() and "im" in built
+        try:
+            serving = ClusterServing(built["im"], br).start()
+            fe._srv.serving = serving
+            r = _post(f"http://127.0.0.1:{fe.port}/predict",
+                      {"instances": np.zeros((2, 5, 3)).tolist()})
+            preds = np.asarray(json.loads(r.read())["predictions"])
+            assert preds.shape == (2, 1)
+            serving.stop()
+        finally:
+            fe.stop()
+
+    def test_wait_model_secret_times_out(self):
+        from analytics_zoo_tpu.serving.config import wait_model_secret
+        with pytest.raises(TimeoutError):
+            wait_model_secret(MemoryBroker(), timeout_s=0.5)
+
+    def test_secret_scrubbed_from_broker_after_read(self):
+        from analytics_zoo_tpu.serving.config import wait_model_secret
+        br = MemoryBroker()
+        br.hset(MODEL_SECURED_KEY, "secret", "s")
+        br.hset(MODEL_SECURED_KEY, "salt", "t")
+        assert wait_model_secret(br, timeout_s=5) == ("s", "t")
+        # one-shot: nothing left for a later broker client to steal
+        assert br.hget(MODEL_SECURED_KEY, "secret") is None
+        assert br.hget(MODEL_SECURED_KEY, "salt") is None
+
+
+class TestTLSSlowClient:
+    def test_stalled_handshake_does_not_block_accept(self, tls_cert):
+        """A client that connects and never speaks TLS must not starve
+        other connections (handshake happens per-connection thread)."""
+        import socket
+        cert, key = tls_cert
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br).start()
+        fe = FrontEnd(br, serving, host="127.0.0.1", port=0,
+                      tls_certfile=cert, tls_keyfile=key).start()
+        try:
+            stalled = socket.create_connection(("127.0.0.1", fe.port))
+            time.sleep(0.2)  # parked mid-handshake, sends nothing
+            ctx = ssl.create_default_context(cafile=cert)
+            ctx.check_hostname = False
+            r = _post(f"https://127.0.0.1:{fe.port}/predict",
+                      {"instances": np.ones((1, 4)).tolist()}, ctx=ctx,
+                      timeout=15)
+            assert r.getcode() == 200
+            stalled.close()
+        finally:
+            fe.stop()
+            serving.stop()

@@ -79,6 +79,25 @@ class TestFraming:
         with pytest.raises(ValueError, match="truncated"):
             list(tfr.read_records(path))
 
+    def test_truncated_inside_crc_field_is_valueerror(self, tmp_path):
+        # a cut inside the trailing 4-byte CRC must raise the documented
+        # ValueError, not struct.error
+        path = str(tmp_path / "trunc2.tfrecord")
+        tfr.write_tfrecord(path, [b"hello world"])
+        blob = open(path, "rb").read()
+        open(path, "wb").write(blob[:-2])
+        with pytest.raises(ValueError, match="truncated"):
+            list(tfr.read_records(path))
+
+    def test_empty_corpus_clear_errors(self, tmp_path):
+        path = str(tmp_path / "empty.tfrecord")
+        tfr.write_tfrecord(path, [])  # valid file, zero records
+        ds = TPUDataset.from_tfrecord(path, _parse, batch_size=4)
+        with pytest.raises(ValueError, match="empty"):
+            ds.first_sample()
+        with pytest.raises(ValueError, match="empty"):
+            ds.materialize()
+
 
 def _write_corpus(tmp_path, n_shards=3, per_shard=40, dim=4):
     """Labeled synthetic corpus across shards; returns expected id set."""

@@ -109,6 +109,21 @@ class TestDeterminism:
         for a, b in zip(runs[0][1], runs[1][1]):
             np.testing.assert_array_equal(a, b)
 
+    def test_prebuilt_model_empty_dataset_still_errors(self):
+        # the shape probe is skipped for prebuilt models; an empty dataset
+        # must still raise, not silently run 0 steps
+        import numpy as np
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        from analytics_zoo_tpu.learn.trainer import fit_keras
+        m = Sequential([L.Dense(1, input_shape=(4,))])
+        m.compile("sgd", "mse")
+        m.ensure_built(np.zeros((1, 4), np.float32))
+        # streaming (lazy) path: factory yields no full batches
+        with pytest.raises(ValueError, match="no full batches"):
+            fit_keras(m, None, None, batch_size=64, epochs=1,
+                      batch_iter_factory=lambda epoch: iter(()))
+
     def test_step_runs_under_transfer_guard(self):
         # once params/batch live on device, the jitted step must not
         # trigger implicit host transfers (SURVEY §5 race/determinism)
