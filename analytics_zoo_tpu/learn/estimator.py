@@ -107,13 +107,32 @@ class Estimator:
 
     @staticmethod
     def from_torch(model, loss=None, optimizer=None, metrics=None,
+                   scheduler=None, steps_per_epoch: int = 1,
                    model_dir: Optional[str] = None) -> "Estimator":
         """Convert a torch.nn module (Sequential-style) into the native layer
         library, carrying its trained weights. Supported: Linear, Conv2d,
         ReLU/Tanh/Sigmoid/Softmax/GELU, MaxPool2d/AvgPool2d, Flatten,
-        Dropout, BatchNorm1d/2d, Embedding, LSTM/GRU (single layer)."""
-        from analytics_zoo_tpu.learn.torch_bridge import convert_torch_module
+        Dropout, BatchNorm1d/2d, Embedding, LSTM/GRU (single layer).
+
+        `loss` may be a torch.nn loss module and `optimizer` a
+        torch.optim.Optimizer (+ optional torch LR `scheduler`) — the
+        reference's TorchLoss/TorchOptim interop (`TorchOptim.scala:41-60`);
+        both convert once to jax/optax equivalents, so the hot path stays
+        pure XLA."""
+        from analytics_zoo_tpu.learn.torch_bridge import (
+            convert_torch_loss, convert_torch_module,
+            convert_torch_optimizer)
         native = convert_torch_module(model)
+        # torch itself is importable here — convert_torch_module already ran
+        import torch
+        import torch.nn as nn
+        if isinstance(loss, nn.Module):
+            loss = convert_torch_loss(loss)
+        if isinstance(optimizer, torch.optim.Optimizer):
+            optimizer = convert_torch_optimizer(
+                optimizer, scheduler, steps_per_epoch)
+        elif scheduler is not None:
+            raise ValueError("scheduler is only used with a torch optimizer")
         native.compile(optimizer or "adam", loss or "mse", metrics)
         return Estimator(native, model_dir)
 

@@ -189,6 +189,229 @@ def _convert_rnn(module):
     return _with_weights(layer, params)
 
 
+# ---------------------------------------------------------------------------
+# Torch loss interop (`pipeline/api/net/TorchLoss.scala`): the reference
+# pickles a torch loss module and executes it in-JVM via JEP per minibatch.
+# On TPU the criterion must lower to XLA, so known torch losses convert to
+# equivalent jax functions once; arbitrary torch callables cannot run in the
+# jit hot path and are rejected with guidance.
+# ---------------------------------------------------------------------------
+def convert_torch_loss(loss) -> Any:
+    """torch.nn loss module → `loss(y_true, y_pred)` jax callable.
+
+    Handles the reduction flag ('mean'/'sum'); torch's (input, target)
+    argument order is flipped to the Keras (y_true, y_pred) contract.
+    """
+    import jax
+    import jax.numpy as jnp
+    import torch.nn as nn
+
+    reduction = getattr(loss, "reduction", "mean")
+    if reduction not in ("mean", "sum"):
+        raise ValueError(
+            f"torch loss reduction {reduction!r} does not convert; use "
+            "'mean' or 'sum'")
+
+    def red(v):
+        return jnp.mean(v) if reduction == "mean" else jnp.sum(v)
+
+    if isinstance(loss, nn.MSELoss):
+        return lambda yt, yp: red(jnp.square(yp - yt))
+    if isinstance(loss, nn.L1Loss):
+        return lambda yt, yp: red(jnp.abs(yp - yt))
+    if isinstance(loss, (nn.SmoothL1Loss, nn.HuberLoss)):
+        beta = float(getattr(loss, "beta", getattr(loss, "delta", 1.0)))
+
+        def smooth_l1(yt, yp, beta=beta):
+            d = jnp.abs(yp - yt)
+            quad = 0.5 * d * d / beta
+            lin = d - 0.5 * beta
+            v = jnp.where(d < beta, quad, lin)
+            if isinstance(loss, nn.HuberLoss):
+                v = v * beta  # Huber = beta * SmoothL1(beta=delta)
+            return red(v)
+        return smooth_l1
+    if isinstance(loss, (nn.CrossEntropyLoss, nn.NLLLoss)):
+        # logits (CE) / log-probs (NLL) input + int class targets; honors
+        # class weight, ignore_index, and (CE) label_smoothing — mean
+        # reduction divides by the summed weight of non-ignored rows,
+        # exactly torch's contract
+        weight = (loss.weight.detach().numpy().copy()
+                  if loss.weight is not None else None)
+        ignore_index = int(loss.ignore_index)
+        smoothing = float(getattr(loss, "label_smoothing", 0.0))
+        is_ce = isinstance(loss, nn.CrossEntropyLoss)
+
+        def ce_nll(yt, yp):
+            logp = jax.nn.log_softmax(yp, axis=-1) if is_ce else yp
+            yt_idx = jnp.reshape(yt, (-1,)).astype(jnp.int32)
+            valid = yt_idx != ignore_index
+            safe_idx = jnp.where(valid, yt_idx, 0)
+            picked = jnp.take_along_axis(
+                logp, safe_idx[:, None], axis=-1)[:, 0]
+            wvec = jnp.asarray(weight, logp.dtype) if weight is not None \
+                else jnp.ones((logp.shape[-1],), logp.dtype)
+            w = wvec[safe_idx]
+            # torch: per-class weights apply INSIDE the smoothing term,
+            # while mean reduction divides by the target-class weights
+            row = (1.0 - smoothing) * w * picked
+            if smoothing:
+                row = row + smoothing * jnp.mean(wvec * logp, axis=-1)
+            row = jnp.where(valid, row, 0.0)
+            w = jnp.where(valid, w, 0.0)
+            total = jnp.sum(-row)
+            if reduction == "sum":
+                return total
+            return total / jnp.maximum(jnp.sum(w), 1e-12)
+        return ce_nll
+    if isinstance(loss, nn.BCEWithLogitsLoss):
+        if loss.weight is not None:
+            raise ValueError(
+                "BCEWithLogitsLoss per-sample weight does not convert")
+        pos_weight = (loss.pos_weight.detach().numpy().copy()
+                      if loss.pos_weight is not None else None)
+
+        def bce_logits(yt, yp):
+            logsig = -jnp.log1p(jnp.exp(-jnp.abs(yp))) \
+                + jnp.minimum(yp, 0)          # log sigmoid(yp), stable
+            logsig_neg = logsig - yp          # log sigmoid(-yp)
+            pw = jnp.asarray(pos_weight, yp.dtype) if pos_weight is not None \
+                else 1.0
+            return red(-(pw * yt * logsig + (1 - yt) * logsig_neg))
+        return bce_logits
+    if isinstance(loss, nn.BCELoss):
+        def bce(yt, yp):
+            eps = 1e-7
+            yp = jnp.clip(yp, eps, 1 - eps)
+            return red(-(yt * jnp.log(yp) + (1 - yt) * jnp.log1p(-yp)))
+        return bce
+    if isinstance(loss, nn.KLDivLoss):
+        # torch: input is log-probs, target is probs
+        def kld(yt, yp):
+            return red(yt * (jnp.log(jnp.clip(yt, 1e-7, None)) - yp))
+        return kld
+    raise ValueError(
+        f"Unsupported torch loss {type(loss).__name__}: it cannot execute "
+        "inside the XLA hot path. Supported: MSELoss, L1Loss, SmoothL1Loss, "
+        "HuberLoss, CrossEntropyLoss, NLLLoss, BCELoss, BCEWithLogitsLoss, "
+        "KLDivLoss — or pass a pure jax fn(y_true, y_pred)")
+
+
+# ---------------------------------------------------------------------------
+# Torch optimizer / LR-scheduler interop (`TorchOptim.scala:41-60`): the
+# reference deserializes a torch optimizer or _LRScheduler per worker and
+# applies it to the allreduced flat weights, with epoch-based decay types
+# mapping trigger state onto scheduler steps. Here the hyperparameters map
+# onto optax transforms; schedulers become optax schedules (per-epoch
+# schedulers scale by steps_per_epoch like the reference's EpochDecay).
+# ---------------------------------------------------------------------------
+def convert_torch_optimizer(opt, scheduler=None, steps_per_epoch: int = 1):
+    """torch.optim.Optimizer (+ optional torch LR scheduler) → optax.
+
+    Hyperparameters come from the optimizer's first param group (the
+    reference also applies one optimizer to the single flat weight tensor).
+    `steps_per_epoch` converts per-epoch schedulers (StepLR etc. stepped
+    once per epoch, the torch idiom) into per-step optax schedules.
+    """
+    import optax
+    import torch.optim as topt
+
+    g = opt.param_groups[0] if getattr(opt, "param_groups", None) \
+        else opt.defaults
+    lr = float(g["lr"])
+    if scheduler is not None and getattr(scheduler, "base_lrs", None):
+        # param_groups carry the CURRENT (possibly already-decayed) lr;
+        # the schedule must start from the scheduler's base lr
+        lr = float(scheduler.base_lrs[0])
+    wd = float(g.get("weight_decay", 0.0) or 0.0)
+    sched = _convert_torch_scheduler(scheduler, lr, steps_per_epoch) \
+        if scheduler is not None else lr
+
+    if isinstance(opt, topt.SGD):
+        momentum = float(g.get("momentum", 0.0) or 0.0)
+        if float(g.get("dampening", 0.0) or 0.0) != 0.0:
+            raise ValueError("SGD dampening != 0 does not convert to optax")
+        tx = optax.sgd(sched, momentum=momentum or None,
+                       nesterov=bool(g.get("nesterov", False)))
+    elif isinstance(opt, topt.AdamW):
+        b1, b2 = g.get("betas", (0.9, 0.999))
+        tx = optax.adamw(sched, b1=float(b1), b2=float(b2),
+                         eps=float(g.get("eps", 1e-8)), weight_decay=wd)
+        wd = 0.0  # decoupled decay handled inside adamw
+    elif isinstance(opt, topt.Adam):
+        b1, b2 = g.get("betas", (0.9, 0.999))
+        tx = optax.adam(sched, b1=float(b1), b2=float(b2),
+                        eps=float(g.get("eps", 1e-8)))
+    elif isinstance(opt, topt.RMSprop):
+        tx = optax.rmsprop(sched, decay=float(g.get("alpha", 0.99)),
+                           eps=float(g.get("eps", 1e-8)),
+                           centered=bool(g.get("centered", False)),
+                           momentum=float(g.get("momentum", 0.0) or 0.0))
+    elif isinstance(opt, topt.Adagrad):
+        tx = optax.adagrad(
+            sched, eps=float(g.get("eps", 1e-10)),
+            initial_accumulator_value=float(
+                g.get("initial_accumulator_value", 0.0)))
+    elif isinstance(opt, topt.Adadelta):
+        tx = optax.adadelta(sched, rho=float(g.get("rho", 0.9)),
+                            eps=float(g.get("eps", 1e-6)))
+    else:
+        raise ValueError(
+            f"Unsupported torch optimizer {type(opt).__name__}. Supported: "
+            "SGD, Adam, AdamW, RMSprop, Adagrad, Adadelta — or pass an "
+            "optax transform directly")
+    if wd and not isinstance(opt, topt.AdamW):
+        # torch couples weight_decay into the gradient (L2), same here
+        tx = optax.chain(optax.add_decayed_weights(wd), tx)
+    return tx
+
+
+def _convert_torch_scheduler(scheduler, base_lr: float,
+                             steps_per_epoch: int):
+    """torch lr_scheduler → optax schedule over optimizer steps."""
+    import numpy as _np
+    from torch.optim import lr_scheduler as tls
+
+    spe = max(1, int(steps_per_epoch))
+    if isinstance(scheduler, tls.StepLR):
+        k, gamma = scheduler.step_size, scheduler.gamma
+
+        def step_lr(count):
+            epoch = count // spe
+            return base_lr * gamma ** (epoch // k)
+        return step_lr
+    if isinstance(scheduler, tls.MultiStepLR):
+        milestones = sorted(scheduler.milestones)
+        gamma = scheduler.gamma
+
+        def multistep(count):
+            epoch = count // spe
+            n = sum((epoch >= m) for m in _np.asarray(milestones))
+            return base_lr * gamma ** n
+        return multistep
+    if isinstance(scheduler, tls.ExponentialLR):
+        gamma = scheduler.gamma
+
+        def exp_lr(count):
+            return base_lr * gamma ** (count // spe)
+        return exp_lr
+    if isinstance(scheduler, tls.CosineAnnealingLR):
+        # torch's closed form (continues the cosine past T_max rather than
+        # clamping like optax.cosine_decay_schedule)
+        t_max, eta_min = scheduler.T_max, scheduler.eta_min
+
+        def cosine(count):
+            import jax.numpy as jnp
+            epoch = count // spe
+            return eta_min + (base_lr - eta_min) * 0.5 * (
+                1.0 + jnp.cos(jnp.pi * epoch / t_max))
+        return cosine
+    raise ValueError(
+        f"Unsupported torch LR scheduler {type(scheduler).__name__}. "
+        "Supported: StepLR, MultiStepLR, ExponentialLR, CosineAnnealingLR "
+        "— or pass an optax schedule directly")
+
+
 def _with_weights(layer, params):
     """Pin converted weights: build() returns them instead of random init."""
     pinned = {k: np.asarray(v, np.float32) for k, v in params.items()}

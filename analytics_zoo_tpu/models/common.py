@@ -52,8 +52,8 @@ class ZooModel:
         return self.model.summary()
 
     # -- persistence -------------------------------------------------------
-    def save_model(self, path: str, over_write: bool = False):
-        """`ZooModel.saveModel`: config json + weights."""
+    def _save_config(self, path: str, over_write: bool):
+        """Shared config-json step for the plain and encrypted savers."""
         os.makedirs(path, exist_ok=True)
         cfg_path = os.path.join(path, "config.json")
         if os.path.exists(cfg_path) and not over_write:
@@ -61,6 +61,10 @@ class ZooModel:
         with open(cfg_path, "w") as fh:
             json.dump({"class": type(self).__name__,
                        "config": self._config}, fh)
+
+    def save_model(self, path: str, over_write: bool = False):
+        """`ZooModel.saveModel`: config json + weights."""
+        self._save_config(path, over_write)
         self.model.save_weights(os.path.join(path, "weights"))
 
     def save_model_encrypted(self, path: str, secret: str, salt: str,
@@ -70,13 +74,7 @@ class ZooModel:
         weights.enc — loadable by `InferenceModel.load_keras_encrypted`
         and the serving `secure.model_encrypted` flow."""
         from analytics_zoo_tpu.learn.encrypted import save_encrypted_pytree
-        os.makedirs(path, exist_ok=True)
-        cfg_path = os.path.join(path, "config.json")
-        if os.path.exists(cfg_path) and not over_write:
-            raise FileExistsError(f"{path} exists; pass over_write=True")
-        with open(cfg_path, "w") as fh:
-            json.dump({"class": type(self).__name__,
-                       "config": self._config}, fh)
+        self._save_config(path, over_write)
         save_encrypted_pytree(os.path.join(path, "weights.enc"),
                               self.model.params, secret, salt)
 

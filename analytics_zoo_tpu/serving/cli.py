@@ -25,6 +25,10 @@ def cmd_start(args) -> int:
     from analytics_zoo_tpu.serving.server import ClusterServing
     from analytics_zoo_tpu.serving.broker import connect_broker
     cfg = ServingConfig.load(args.config)
+    if cfg.model_encrypted and cfg.http_port is None:
+        raise SystemExit(
+            "secure.model_encrypted needs http_port: the secret/salt "
+            "arrive via the frontend's POST /model-secure")
     broker = connect_broker(cfg.broker_url)
     frontend = None
     if cfg.http_port is not None:

@@ -32,6 +32,18 @@ def main():
         ref0 = torch_model(torch.zeros(1, 10)).numpy()
     print("torch f(0) before training:", ref0.ravel()[:1])
 
+    # user-supplied torch loss + optimizer + LR scheduler
+    # (`TorchOptim.scala:41-60` interop): converted once to jax/optax,
+    # the hot path stays pure XLA
+    tmodel2 = nn.Sequential(nn.Linear(10, 16), nn.ReLU(), nn.Linear(16, 1))
+    topt = torch.optim.SGD(tmodel2.parameters(), lr=0.05, momentum=0.9)
+    tsched = torch.optim.lr_scheduler.StepLR(topt, step_size=2, gamma=0.5)
+    est2 = Estimator.from_torch(tmodel2, loss=nn.SmoothL1Loss(),
+                                optimizer=topt, scheduler=tsched,
+                                steps_per_epoch=512 // 64)
+    h = est2.fit({"x": x, "y": y}, epochs=4, batch_size=64)
+    print("torch-optim loss curve:", [round(v, 4) for v in h["loss"]])
+
 
 if __name__ == "__main__":
     main()
