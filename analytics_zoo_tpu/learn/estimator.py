@@ -71,6 +71,9 @@ class Estimator:
         self.model = model
         self.model_dir = model_dir
         self._load_ckpt: Optional[Tuple[str, Optional[int]]] = None
+        # (torch optimizer, torch scheduler) whose per-epoch schedule is
+        # resolved at fit() time when steps_per_epoch was not given
+        self._torch_optim_spec = None
 
     # -- constructors ------------------------------------------------------
     @staticmethod
@@ -107,7 +110,7 @@ class Estimator:
 
     @staticmethod
     def from_torch(model, loss=None, optimizer=None, metrics=None,
-                   scheduler=None, steps_per_epoch: int = 1,
+                   scheduler=None, steps_per_epoch: Optional[int] = None,
                    model_dir: Optional[str] = None) -> "Estimator":
         """Convert a torch.nn module (Sequential-style) into the native layer
         library, carrying its trained weights. Supported: Linear, Conv2d,
@@ -118,7 +121,9 @@ class Estimator:
         torch.optim.Optimizer (+ optional torch LR `scheduler`) — the
         reference's TorchLoss/TorchOptim interop (`TorchOptim.scala:41-60`);
         both convert once to jax/optax equivalents, so the hot path stays
-        pure XLA."""
+        pure XLA. Per-epoch schedulers (torch's StepLR-stepped-per-epoch
+        idiom) need `steps_per_epoch`; when omitted it is computed at
+        fit() time from the dataset size and batch size."""
         from analytics_zoo_tpu.learn.torch_bridge import (
             convert_torch_loss, convert_torch_module,
             convert_torch_optimizer)
@@ -128,13 +133,19 @@ class Estimator:
         import torch.nn as nn
         if isinstance(loss, nn.Module):
             loss = convert_torch_loss(loss)
+        torch_spec = None
         if isinstance(optimizer, torch.optim.Optimizer):
+            if scheduler is not None and steps_per_epoch is None:
+                # real steps/epoch known only at fit(); provisional now
+                torch_spec = (optimizer, scheduler)
             optimizer = convert_torch_optimizer(
-                optimizer, scheduler, steps_per_epoch)
+                optimizer, scheduler, steps_per_epoch or 1)
         elif scheduler is not None:
             raise ValueError("scheduler is only used with a torch optimizer")
         native.compile(optimizer or "adam", loss or "mse", metrics)
-        return Estimator(native, model_dir)
+        est = Estimator(native, model_dir)
+        est._torch_optim_spec = torch_spec
+        return est
 
     # -- training with retry/resume ---------------------------------------
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
@@ -153,6 +164,19 @@ class Estimator:
             batch_size = ds.batch_size
         elif batch_size is None:
             batch_size = 32
+        if self._torch_optim_spec is not None:
+            # per-epoch torch scheduler: now that dataset + batch size are
+            # known, rebuild the optax schedule with the true steps/epoch
+            from analytics_zoo_tpu.learn.torch_bridge import \
+                convert_torch_optimizer
+            topt, tsched = self._torch_optim_spec
+            spe = max(1, ds.n_samples() // (batch_size or 32))
+            self.model.optimizer = convert_torch_optimizer(
+                topt, tsched, steps_per_epoch=spe)
+            for cache in ("_train_cache", "_eval_cache", "_predict_cache"):
+                if hasattr(self.model, cache):
+                    delattr(self.model, cache)
+
         dp = get_context().mesh.data_parallel_size
         lazy = ds.x is None  # disk-tier FeatureSet / TFRecord stream bridge
         batch_iter_factory = (

@@ -243,6 +243,10 @@ def convert_torch_loss(loss) -> Any:
         is_ce = isinstance(loss, nn.CrossEntropyLoss)
 
         def ce_nll(yt, yp):
+            if yp.ndim > 2:
+                # torch K-dim form (N, C, d1..dk): class dim is 1 — move
+                # it last and flatten to rows
+                yp = jnp.moveaxis(yp, 1, -1).reshape(-1, yp.shape[1])
             logp = jax.nn.log_softmax(yp, axis=-1) if is_ce else yp
             yt_idx = jnp.reshape(yt, (-1,)).astype(jnp.int32)
             valid = yt_idx != ignore_index
@@ -280,14 +284,22 @@ def convert_torch_loss(loss) -> Any:
             return red(-(pw * yt * logsig + (1 - yt) * logsig_neg))
         return bce_logits
     if isinstance(loss, nn.BCELoss):
+        if loss.weight is not None:
+            raise ValueError("BCELoss per-sample weight does not convert")
+
         def bce(yt, yp):
             eps = 1e-7
             yp = jnp.clip(yp, eps, 1 - eps)
             return red(-(yt * jnp.log(yp) + (1 - yt) * jnp.log1p(-yp)))
         return bce
     if isinstance(loss, nn.KLDivLoss):
-        # torch: input is log-probs, target is probs
+        # torch: input is log-probs; target is probs, or log-probs when
+        # log_target=True
+        log_target = bool(getattr(loss, "log_target", False))
+
         def kld(yt, yp):
+            if log_target:
+                return red(jnp.exp(yt) * (yt - yp))
             return red(yt * (jnp.log(jnp.clip(yt, 1e-7, None)) - yp))
         return kld
     raise ValueError(
@@ -318,6 +330,9 @@ def convert_torch_optimizer(opt, scheduler=None, steps_per_epoch: int = 1):
 
     g = opt.param_groups[0] if getattr(opt, "param_groups", None) \
         else opt.defaults
+    if g.get("maximize"):
+        raise ValueError("maximize=True does not convert (negate your "
+                         "loss instead)")
     lr = float(g["lr"])
     if scheduler is not None and getattr(scheduler, "base_lrs", None):
         # param_groups carry the CURRENT (possibly already-decayed) lr;
@@ -335,11 +350,17 @@ def convert_torch_optimizer(opt, scheduler=None, steps_per_epoch: int = 1):
                        nesterov=bool(g.get("nesterov", False)))
     elif isinstance(opt, topt.AdamW):
         b1, b2 = g.get("betas", (0.9, 0.999))
+        if g.get("amsgrad"):
+            raise ValueError("AdamW amsgrad=True does not convert")
         tx = optax.adamw(sched, b1=float(b1), b2=float(b2),
                          eps=float(g.get("eps", 1e-8)), weight_decay=wd)
         wd = 0.0  # decoupled decay handled inside adamw
     elif isinstance(opt, topt.Adam):
         b1, b2 = g.get("betas", (0.9, 0.999))
+        if g.get("amsgrad"):
+            # optax.amsgrad orders bias correction differently from torch —
+            # trajectories diverge, so refuse rather than silently drift
+            raise ValueError("Adam amsgrad=True does not convert exactly")
         tx = optax.adam(sched, b1=float(b1), b2=float(b2),
                         eps=float(g.get("eps", 1e-8)))
     elif isinstance(opt, topt.RMSprop):
@@ -348,6 +369,8 @@ def convert_torch_optimizer(opt, scheduler=None, steps_per_epoch: int = 1):
                            centered=bool(g.get("centered", False)),
                            momentum=float(g.get("momentum", 0.0) or 0.0))
     elif isinstance(opt, topt.Adagrad):
+        if float(g.get("lr_decay", 0.0) or 0.0) != 0.0:
+            raise ValueError("Adagrad lr_decay does not convert")
         tx = optax.adagrad(
             sched, eps=float(g.get("eps", 1e-10)),
             initial_accumulator_value=float(

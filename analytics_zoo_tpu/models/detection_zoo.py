@@ -1,0 +1,241 @@
+"""Object-detection tooling around the SSD model: named model configs,
+dataset label maps, and a box visualizer.
+
+Reference components mirrored:
+- `ObjectDetectionConfig.scala` — registry of model-name → (preprocess,
+  postprocess, label map) configurations resolved by
+  `ObjectDetector.load("ssd-...", dataset)`. The reference downloads
+  pretrained weights from its model-zoo URL; this environment has no
+  egress, so weights come from a local `weights_path` (saved by
+  `model.save_weights`) and a config with no weights builds the
+  architecture randomly-initialized for fine-tuning.
+- `LabelReader.scala` / `ModelLabelReader` — VOC ("pascal") and COCO
+  label maps, index 0 = background, plus file-based custom maps.
+- `Visualizer.scala` — draw detection rows (label, score, box) onto the
+  image; encode to PNG bytes or return the annotated array.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.models import objectdetection as od
+
+# ---------------------------------------------------------------------------
+# Label maps (`LabelReader.scala`): index 0 is background, matching the
+# reference's 1-based class rows in detection outputs.
+# ---------------------------------------------------------------------------
+VOC_CLASSES: Tuple[str, ...] = (
+    "__background__",
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor")
+
+COCO_CLASSES: Tuple[str, ...] = (
+    "__background__",
+    "person", "bicycle", "car", "motorcycle", "airplane", "bus", "train",
+    "truck", "boat", "traffic light", "fire hydrant", "stop sign",
+    "parking meter", "bench", "bird", "cat", "dog", "horse", "sheep",
+    "cow", "elephant", "bear", "zebra", "giraffe", "backpack", "umbrella",
+    "handbag", "tie", "suitcase", "frisbee", "skis", "snowboard",
+    "sports ball", "kite", "baseball bat", "baseball glove", "skateboard",
+    "surfboard", "tennis racket", "bottle", "wine glass", "cup", "fork",
+    "knife", "spoon", "bowl", "banana", "apple", "sandwich", "orange",
+    "broccoli", "carrot", "hot dog", "pizza", "donut", "cake", "chair",
+    "couch", "potted plant", "bed", "dining table", "toilet", "tv",
+    "laptop", "mouse", "remote", "keyboard", "cell phone", "microwave",
+    "oven", "toaster", "sink", "refrigerator", "book", "clock", "vase",
+    "scissors", "teddy bear", "hair drier", "toothbrush")
+
+
+def label_reader(dataset: str,
+                 path: Optional[str] = None) -> Dict[int, str]:
+    """`LabelReader(dataset)`: {class_index: name}. `dataset` ∈
+    {"pascal", "coco"} or "file" with `path` to a one-name-per-line file
+    (line order = class index, like the reference's resource files)."""
+    key = dataset.lower()
+    if key in ("pascal", "voc", "pascalvoc"):
+        names: Sequence[str] = VOC_CLASSES
+    elif key == "coco":
+        names = COCO_CLASSES
+    elif key == "file":
+        if not path:
+            raise ValueError('label_reader("file") needs a path')
+        with open(path) as fh:
+            names = [ln.strip() for ln in fh if ln.strip()]
+    else:
+        raise ValueError(
+            f"Unknown label dataset {dataset!r}: use 'pascal', 'coco', or "
+            "'file' with a path")
+    return dict(enumerate(names))
+
+
+# ---------------------------------------------------------------------------
+# Model config registry (`ObjectDetectionConfig.scala`)
+# ---------------------------------------------------------------------------
+@dataclass
+class DetectionConfig:
+    """One named detector configuration: architecture shape + preprocess
+    + postprocess parameters (`ImageConfigure` role)."""
+
+    image_size: int
+    scales: Sequence[float] = (0.3, 0.6)
+    aspect_ratios: Sequence[float] = (1.0, 2.0, 0.5)
+    # preprocess (`preprocessSsdVgg`: resize + channel-mean subtract)
+    mean_rgb: Tuple[float, float, float] = (123.0, 117.0, 104.0)
+    scale: float = 1.0
+    # postprocess (`ScaleDetection`)
+    score_threshold: float = 0.5
+    iou_threshold: float = 0.45
+    batch_per_partition: int = 2
+
+
+# Reference model names resolve to the TPU-native SSD at the named input
+# resolution (the reference's VGG/mobilenet backbones are pretrained Caffe
+# artifacts; the backbone here is the trainable trunk of `build_ssd`).
+MODELS: Dict[str, DetectionConfig] = {
+    "ssd-vgg16-300x300": DetectionConfig(image_size=304),
+    "ssd-vgg16-512x512": DetectionConfig(image_size=512),
+    "ssd-mobilenet-300x300": DetectionConfig(image_size=304),
+    "ssd-tpu-64x64": DetectionConfig(image_size=64, mean_rgb=(0, 0, 0),
+                                     scale=1 / 255.0),
+    "ssd-tpu-128x128": DetectionConfig(image_size=128, mean_rgb=(0, 0, 0),
+                                       scale=1 / 255.0),
+}
+
+
+def load_object_detector(model_name: str, dataset: str = "pascal",
+                         weights_path: Optional[str] = None,
+                         label_path: Optional[str] = None
+                         ) -> "ConfiguredDetector":
+    """`ObjectDetector.load(name)` shape (`ObjectDetectionConfig.apply`):
+    resolve the named config + dataset label map, build the detector, and
+    load weights when given (no egress → weights are local files)."""
+    if model_name not in MODELS:
+        raise ValueError(
+            f"Unknown detection model {model_name!r}; available: "
+            f"{sorted(MODELS)}")
+    cfg = MODELS[model_name]
+    label_map = label_reader(dataset, label_path)
+    n_classes = len(label_map)
+    model, anchors = od.build_ssd(
+        n_classes, image_size=cfg.image_size, scales=cfg.scales,
+        aspect_ratios=cfg.aspect_ratios)
+    if weights_path:
+        model.load_weights(weights_path)
+    else:
+        import jax
+        model.ensure_built(
+            np.zeros((1, cfg.image_size, cfg.image_size, 3), np.float32),
+            jax.random.PRNGKey(0))
+    k = len(cfg.aspect_ratios)
+    sizes = (cfg.image_size // 8, cfg.image_size // 16)
+    n_per_map = [s * s * k for s in sizes]
+    det = od.ObjectDetector(model, anchors, n_per_map, n_classes,
+                            label_map=label_map)
+    return ConfiguredDetector(det, cfg, model_name)
+
+
+class ConfiguredDetector:
+    """A detector bound to its config: preprocess → predict → postprocess
+    with the config's thresholds (the `ImageConfigure` composition)."""
+
+    def __init__(self, detector: od.ObjectDetector, config: DetectionConfig,
+                 name: str):
+        self.detector = detector
+        self.config = config
+        self.name = name
+
+    def preprocess(self, images) -> np.ndarray:
+        """Resize to the config's input square + mean-subtract/scale
+        (`preprocessSsdVgg`). Accepts one HWC image or a batch/list."""
+        import cv2
+        cfg = self.config
+        if isinstance(images, np.ndarray) and images.ndim == 3:
+            images = [images]
+        out = []
+        for img in images:
+            img = np.asarray(img)
+            if img.shape[:2] != (cfg.image_size, cfg.image_size):
+                img = cv2.resize(img.astype(np.float32),
+                                 (cfg.image_size, cfg.image_size))
+            out.append((img.astype(np.float32)
+                        - np.asarray(cfg.mean_rgb, np.float32))
+                       * cfg.scale)
+        return np.stack(out)
+
+    def predict(self, images, score_threshold: Optional[float] = None,
+                iou_threshold: Optional[float] = None, max_out: int = 20):
+        """Raw images → detection rows [(label, score, x1, y1, x2, y2)]
+        per image; box coords are normalized [0, 1]."""
+        cfg = self.config
+        batch = self.preprocess(images)
+        return self.detector.predict(
+            batch,
+            score_threshold=(cfg.score_threshold if score_threshold is None
+                             else score_threshold),
+            iou_threshold=(cfg.iou_threshold if iou_threshold is None
+                           else iou_threshold),
+            max_out=max_out)
+
+
+# ---------------------------------------------------------------------------
+# Visualizer (`Visualizer.scala`): rows → boxes drawn on the image
+# ---------------------------------------------------------------------------
+class Visualizer:
+    """Draw detection rows onto images. Rows are the `ObjectDetector.
+    predict` output — (label, score, x1, y1, x2, y2) with normalized
+    coords — or the reference's 1-based [class_id, score, x1..y2] with
+    pixel coords (auto-detected by value range)."""
+
+    PALETTE = [(204, 0, 0), (0, 153, 0), (0, 76, 204), (204, 153, 0),
+               (153, 0, 153), (0, 153, 153), (102, 51, 0), (255, 102, 0)]
+
+    def __init__(self, label_map: Optional[Dict[int, str]] = None,
+                 thresh: float = 0.3, encoding: str = "png"):
+        self.label_map = label_map or {}
+        self.thresh = thresh
+        self.encoding = encoding
+
+    def draw(self, image: np.ndarray, rows) -> np.ndarray:
+        """Return a copy of `image` (HWC uint8) with boxes + labels."""
+        import cv2
+        img = np.ascontiguousarray(np.asarray(image, np.uint8).copy())
+        h, w = img.shape[:2]
+        color_i = 0
+        for row in rows:
+            label, score, x1, y1, x2, y2 = row[:6]
+            if score < self.thresh:
+                continue
+            if isinstance(label, (int, np.integer)):
+                label = self.label_map.get(int(label), str(int(label)))
+            if max(abs(float(x2)), abs(float(y2))) <= 1.5:  # normalized
+                x1, x2 = x1 * w, x2 * w
+                y1, y2 = y1 * h, y2 * h
+            p1 = (int(round(float(x1))), int(round(float(y1))))
+            p2 = (int(round(float(x2))), int(round(float(y2))))
+            color = self.PALETTE[color_i % len(self.PALETTE)]
+            color_i += 1
+            cv2.rectangle(img, p1, p2, color, 2)
+            cv2.putText(img, f"{label} {float(score):.2f}",
+                        (p1[0], max(12, p1[1] - 4)),
+                        cv2.FONT_HERSHEY_SIMPLEX, 0.4, color, 1,
+                        cv2.LINE_AA)
+        return img
+
+    def encode(self, image: np.ndarray, rows) -> bytes:
+        """`visualizeDetection`: annotated image → encoded bytes."""
+        import cv2
+        ok, buf = cv2.imencode(f".{self.encoding}", self.draw(image, rows))
+        if not ok:
+            raise ValueError(f"Failed to encode as {self.encoding}")
+        return bytes(buf)
+
+    def save(self, path: str, image: np.ndarray, rows) -> str:
+        with open(path, "wb") as fh:
+            fh.write(self.encode(image, rows))
+        return path

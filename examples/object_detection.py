@@ -49,6 +49,24 @@ def main():
         top = max((r[1] for r in rows), default=0.0)
         print(f"image {i}: {len(rows)} detections, top score {top:.3f}")
 
+    # config-registry path (`ObjectDetectionConfig.scala` /
+    # `LabelReader.scala`): named model + dataset label map, then render
+    # the boxes onto the image (`Visualizer.scala`)
+    import os
+    import tempfile
+
+    from analytics_zoo_tpu.models import detection_zoo as dz
+    cfg_det = dz.load_object_detector("ssd-tpu-64x64", dataset="pascal")
+    print(f"loaded {cfg_det.name}: {cfg_det.detector.n_classes} classes "
+          f"({cfg_det.detector.label_map[15]}, ...)")
+    rows = cfg_det.predict((images[:1] * 255).astype(np.uint8),
+                           score_threshold=0.0, max_out=3)[0]
+    viz = dz.Visualizer(thresh=0.0)
+    fd, out_path = tempfile.mkstemp(suffix=".png")
+    os.close(fd)
+    out = viz.save(out_path, (images[0] * 255).astype(np.uint8), rows)
+    print(f"visualized {len(rows)} boxes -> {out}")
+
 
 if __name__ == "__main__":
     main()

@@ -20,8 +20,7 @@ def _np32(*shape, seed=0):
 def _assert_loss_matches(tloss, yt, yp, **kw):
     ours = convert_torch_loss(tloss)
     got = float(ours(yt, yp))
-    want = float(tloss(torch.from_numpy(yp), torch.from_numpy(
-        yt if yt.dtype != np.int64 else yt)).item())
+    want = float(tloss(torch.from_numpy(yp), torch.from_numpy(yt)).item())
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
@@ -114,6 +113,32 @@ class TestTorchLosses:
         want = tloss(torch.from_numpy(logp),
                      torch.from_numpy(target)).item()
         np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_kldiv_log_target(self):
+        rs = np.random.RandomState(20)
+        logp_t = np.log(rs.dirichlet(np.ones(4), size=8)).astype(np.float32)
+        logq = np.log(rs.dirichlet(np.ones(4), size=8)).astype(np.float32)
+        tloss = nn.KLDivLoss(reduction="sum", log_target=True)
+        ours = convert_torch_loss(tloss)
+        got = float(ours(logp_t, logq))
+        want = tloss(torch.from_numpy(logq),
+                     torch.from_numpy(logp_t)).item()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_kdim_segmentation(self):
+        # torch (N, C, H, W) segmentation form
+        logits = _np32(2, 3, 4, 4, seed=21)
+        target = np.random.RandomState(22).randint(0, 3, size=(2, 4, 4))
+        tloss = nn.CrossEntropyLoss()
+        ours = convert_torch_loss(tloss)
+        got = float(ours(target.astype(np.int32), logits))
+        want = tloss(torch.from_numpy(logits),
+                     torch.from_numpy(target)).item()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_bce_weight_raises(self):
+        with pytest.raises(ValueError, match="weight"):
+            convert_torch_loss(nn.BCELoss(weight=torch.ones(3)))
 
     def test_bce_logits_pos_weight(self):
         yt = (np.random.RandomState(18).rand(8, 2) > 0.5).astype(np.float32)
@@ -212,6 +237,21 @@ class TestTorchOptimizers:
             convert_torch_optimizer(torch.optim.SGD(
                 [w], lr=0.1, momentum=0.9, dampening=0.5))
 
+    def test_unconvertible_flags_raise(self):
+        w = torch.nn.Parameter(torch.zeros(2))
+        with pytest.raises(ValueError, match="amsgrad"):
+            convert_torch_optimizer(torch.optim.AdamW([w], lr=0.1,
+                                                      amsgrad=True))
+        with pytest.raises(ValueError, match="amsgrad"):
+            convert_torch_optimizer(torch.optim.Adam([w], lr=0.1,
+                                                     amsgrad=True))
+        with pytest.raises(ValueError, match="lr_decay"):
+            convert_torch_optimizer(torch.optim.Adagrad([w], lr=0.1,
+                                                        lr_decay=0.01))
+        with pytest.raises(ValueError, match="maximize"):
+            convert_torch_optimizer(torch.optim.SGD([w], lr=0.1,
+                                                    maximize=True))
+
     def test_rmsprop_centered_trajectory(self):
         opt, _, torch_w = _torch_trajectory(
             lambda ps: torch.optim.RMSprop(ps, lr=0.05, centered=True))
@@ -230,6 +270,31 @@ class TestTorchOptimizers:
 
 
 class TestEstimatorFromTorchInterop:
+    def test_fit_time_steps_per_epoch_resolution(self):
+        """With no steps_per_epoch given, a per-epoch scheduler resolves
+        against the dataset at fit() time (128 samples / 32 batch = 4)."""
+        import analytics_zoo_tpu as zoo
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        zoo.init_orca_context(cluster_mode="local")
+        try:
+            tm = nn.Sequential(nn.Linear(4, 1))
+            topt = torch.optim.SGD(tm.parameters(), lr=0.1)
+            sched = torch.optim.lr_scheduler.StepLR(topt, step_size=1,
+                                                    gamma=0.5)
+            est = Estimator.from_torch(tm, loss=nn.MSELoss(),
+                                       optimizer=topt, scheduler=sched)
+            assert est._torch_optim_spec is not None
+            x = np.zeros((128, 4), np.float32)
+            y = np.zeros((128, 1), np.float32)
+            est.fit((x, y), epochs=1, batch_size=32)
+            # schedule now counts 4 steps per epoch: lr at step 4 halves
+            import optax
+            # smoke: the rebuilt optimizer is a schedule-bearing transform
+            assert isinstance(est.model.optimizer,
+                              optax.GradientTransformation)
+        finally:
+            zoo.stop_orca_context()
+
     def test_fit_with_torch_loss_and_optimizer(self):
         import analytics_zoo_tpu as zoo
         from analytics_zoo_tpu.learn.estimator import Estimator
