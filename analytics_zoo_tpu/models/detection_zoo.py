@@ -17,8 +17,7 @@ Reference components mirrored:
 
 from __future__ import annotations
 
-import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -211,7 +210,9 @@ class Visualizer:
             label, score, x1, y1, x2, y2 = row[:6]
             if score < self.thresh:
                 continue
-            if isinstance(label, (int, np.integer)):
+            if isinstance(label, (int, np.integer)) or (
+                    isinstance(label, (float, np.floating))
+                    and float(label).is_integer()):
                 label = self.label_map.get(int(label), str(int(label)))
             if max(abs(float(x2)), abs(float(y2))) <= 1.5:  # normalized
                 x1, x2 = x1 * w, x2 * w

@@ -9,8 +9,9 @@ over three interchangeable transports:
 - MemoryBroker: in-process (single-host serving, tests).
 - TCPBroker(Server): stdlib-socket line protocol so clients in other
   processes/hosts can enqueue (this image has no redis server/client).
-- RedisBroker: drop-in when `redis` is importable; keys/streams named as the
-  reference (`serving_stream`, result hashes).
+- RedisBroker: speaks RESP2 to a real Redis over a stdlib-socket client
+  (no redis-py dependency — the image has none); keys/streams named as
+  the reference (`serving_stream`, result hashes).
 """
 
 from __future__ import annotations
@@ -236,52 +237,151 @@ class TCPBroker(Broker):
         return self._call("hdel", key, field)
 
 
+class RESPError(RuntimeError):
+    """A Redis `-ERR ...` reply."""
+
+
+class _RESPClient:
+    """Minimal RESP2 client over a stdlib socket: sends command arrays,
+    parses simple strings / errors / integers / bulk strings / arrays
+    (everything the stream + hash commands return). Thread-safe via one
+    lock per connection, matching the reference's one-Jedis-per-operator
+    usage."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._timeout_s = timeout_s
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+        self._buf = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+
+    def close(self):
+        try:
+            self._buf.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def command(self, *args, timeout_s: Optional[float] = None):
+        """Encode `args` as a RESP array of bulk strings; return the
+        decoded reply (str for simple/bulk, int, list, or None).
+        `timeout_s` overrides the connection default for this command
+        (None keeps the default; pass float('inf')-like large values for
+        BLOCK 0). A timed-out command closes the connection — the late
+        reply would otherwise desynchronize every later command."""
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            data = a if isinstance(a, bytes) else str(a).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(data), data))
+        with self._lock:
+            if timeout_s is not None:
+                self._sock.settimeout(timeout_s)
+            try:
+                self._sock.sendall(b"".join(out))
+                return self._read_reply()
+            except socket.timeout:
+                self.close()
+                raise ConnectionError(
+                    "redis command timed out; connection closed to avoid "
+                    "reply desynchronization")
+            finally:
+                if timeout_s is not None:
+                    try:
+                        self._sock.settimeout(self._timeout_s)
+                    except OSError:
+                        pass
+
+    def _read_line(self) -> bytes:
+        line = self._buf.readline()
+        if not line.endswith(b"\r\n"):
+            raise ConnectionError("redis connection closed mid-reply")
+        return line[:-2]
+
+    def _read_reply(self):
+        line = self._read_line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RESPError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = self._buf.read(n + 2)
+            if len(data) < n + 2:
+                raise ConnectionError("redis connection closed mid-bulk")
+            return data[:-2].decode()
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise ValueError(f"Unsupported RESP type byte {kind!r}")
+
+
 class RedisBroker(Broker):
-    """Real Redis backend (reference-faithful), gated on the `redis` package."""
+    """Real Redis backend, reference-faithful command set
+    (`FlinkRedisSource.scala:66-87`): XGROUP CREATE ... MKSTREAM, blocking
+    XREADGROUP with `>`, XACK+XDEL on ack, HSET/HGET results."""
 
     def __init__(self, host: str = "localhost", port: int = 6379):
-        import redis  # optional dep; ImportError surfaces to the caller
-        self._r = redis.Redis(host=host, port=port, decode_responses=True)
+        self._r = _RESPClient(host, port)
         self._groups_made = set()
 
+    def close(self):
+        self._r.close()
+
     def xadd(self, stream, record):
-        return self._r.xadd(stream, {"json": json.dumps(record)})
+        return self._r.command("XADD", stream, "*", "json",
+                               json.dumps(record))
 
     def _ensure_group(self, stream, group):
         if (stream, group) in self._groups_made:
             return
         try:
-            self._r.xgroup_create(stream, group, id="0", mkstream=True)
-        except Exception:  # noqa: BLE001 — BUSYGROUP: already exists
-            pass
+            self._r.command("XGROUP", "CREATE", stream, group, "0",
+                            "MKSTREAM")
+        except RESPError as e:
+            if "BUSYGROUP" not in str(e):
+                raise
         self._groups_made.add((stream, group))
 
     def read_group(self, stream, group, consumer, count, block_ms=100):
         self._ensure_group(stream, group)
-        resp = self._r.xreadgroup(group, consumer, {stream: ">"},
-                                  count=count, block=block_ms)
+        # socket deadline must outlast the server-side BLOCK window
+        # (block_ms=0 blocks forever server-side: wait a day, not 10s)
+        wait_s = 86400.0 if block_ms == 0 else block_ms / 1000.0 + 10.0
+        resp = self._r.command(
+            "XREADGROUP", "GROUP", group, consumer, "COUNT", count,
+            "BLOCK", block_ms, "STREAMS", stream, ">",
+            timeout_s=wait_s)
         out = []
         for _, entries in resp or []:
             for rid, fields in entries:
-                out.append((rid, json.loads(fields["json"])))
+                kv = dict(zip(fields[::2], fields[1::2]))
+                out.append((rid, json.loads(kv["json"])))
         return out
 
     def ack(self, stream, group, ids):
         if ids:
-            self._r.xack(stream, group, *ids)
-            self._r.xdel(stream, *ids)
+            self._r.command("XACK", stream, group, *ids)
+            self._r.command("XDEL", stream, *ids)
 
     def hset(self, key, field, value):
-        self._r.hset(key, field, value)
+        self._r.command("HSET", key, field, value)
 
     def hget(self, key, field):
-        return self._r.hget(key, field)
+        return self._r.command("HGET", key, field)
 
     def hgetall(self, key):
-        return self._r.hgetall(key)
+        flat = self._r.command("HGETALL", key) or []
+        return dict(zip(flat[::2], flat[1::2]))
 
     def hdel(self, key, field):
-        self._r.hdel(key, field)
+        self._r.command("HDEL", key, field)
 
 
 def connect_broker(url: Optional[str] = None) -> Broker:

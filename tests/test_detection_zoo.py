@@ -102,6 +102,17 @@ class TestVisualizer:
         out = viz.draw(img, [(12, 0.8, 2.0, 2.0, 20.0, 20.0)])  # pixel rows
         assert out.sum() > 0
 
+    def test_float_ndarray_rows_resolve_labels(self):
+        # reference-style rows often arrive as one float ndarray; the
+        # integral float class id must still hit the label map
+        img = np.zeros((32, 32, 3), np.uint8)
+        viz = dz.Visualizer(label_map={12: "dog"})
+        rows = np.asarray([[12.0, 0.8, 2.0, 2.0, 20.0, 20.0]], np.float32)
+        out_named = viz.draw(img, rows)
+        out_raw = dz.Visualizer(label_map={}).draw(img, rows)
+        # the drawn text differs ("dog" vs "12") → pixels differ
+        assert (out_named != out_raw).any()
+
     def test_encode_and_save_png(self, tmp_path):
         img = np.zeros((32, 32, 3), np.uint8)
         viz = dz.Visualizer()
