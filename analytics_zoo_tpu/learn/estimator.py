@@ -165,22 +165,24 @@ class Estimator:
         elif batch_size is None:
             batch_size = 32
         dp = get_context().mesh.data_parallel_size
+        lazy = ds.x is None  # disk-tier FeatureSet / TFRecord stream bridge
         if self._torch_optim_spec is not None:
             # per-epoch torch scheduler: now that the dataset + resolved
-            # global batch are known, rebuild the optax schedule with the
-            # true steps/epoch (global_batch handles batch_per_thread×dp
-            # exactly as iter_train does)
+            # batch are known, rebuild the optax schedule with the true
+            # steps/epoch. Lazy datasets step at global_batch (their
+            # iter_train contract); in-memory data steps at the resolved
+            # fit batch_size.
             from analytics_zoo_tpu.learn.torch_bridge import \
                 convert_torch_optimizer
             topt, tsched = self._torch_optim_spec
-            spe = max(1, ds.n_samples() // ds.global_batch(dp))
+            step_batch = ds.global_batch(dp) if lazy else batch_size
+            spe = max(1, ds.n_samples() // step_batch)
             self.model.optimizer = convert_torch_optimizer(
                 topt, tsched, steps_per_epoch=spe)
             for cache in ("_train_cache", "_eval_cache", "_predict_cache"):
                 if hasattr(self.model, cache):
                     delattr(self.model, cache)
 
-        lazy = ds.x is None  # disk-tier FeatureSet / TFRecord stream bridge
         batch_iter_factory = (
             (lambda epoch: ds.iter_train(dp, seed=seed + epoch))
             if lazy else None)

@@ -249,18 +249,27 @@ class _RESPClient:
     usage."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._host, self._port = host, port
         self._timeout_s = timeout_s
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout_s)
-        self._buf = self._sock.makefile("rb")
+        self._sock = None
+        self._buf = None
         self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self):
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout_s)
+        self._buf = self._sock.makefile("rb")
 
     def close(self):
         try:
-            self._buf.close()
-            self._sock.close()
+            if self._buf is not None:
+                self._buf.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
+        self._sock = self._buf = None
 
     def command(self, *args, timeout_s: Optional[float] = None):
         """Encode `args` as a RESP array of bulk strings; return the
@@ -274,6 +283,11 @@ class _RESPClient:
             data = a if isinstance(a, bytes) else str(a).encode()
             out.append(b"$%d\r\n%s\r\n" % (len(data), data))
         with self._lock:
+            if self._sock is None:
+                # a previous timeout/failure closed the connection —
+                # reconnect so one transient Redis stall doesn't
+                # permanently kill a long-running serving loop
+                self._connect()
             if timeout_s is not None:
                 self._sock.settimeout(timeout_s)
             try:
@@ -283,9 +297,12 @@ class _RESPClient:
                 self.close()
                 raise ConnectionError(
                     "redis command timed out; connection closed to avoid "
-                    "reply desynchronization")
+                    "reply desynchronization (next command reconnects)")
+            except (ConnectionError, OSError):
+                self.close()
+                raise
             finally:
-                if timeout_s is not None:
+                if timeout_s is not None and self._sock is not None:
                     try:
                         self._sock.settimeout(self._timeout_s)
                     except OSError:

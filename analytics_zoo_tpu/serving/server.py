@@ -69,7 +69,14 @@ class ClusterServing:
 
     def run(self):
         while not self._stop.is_set():
-            self.serve_once()
+            try:
+                self.serve_once()
+            except Exception as e:  # noqa: BLE001 — the Flink-restart role
+                # transient broker failures (redis stall/restart) must not
+                # kill the serving thread; brokers reconnect on next use
+                log.warning("serving cycle failed (%s: %s); retrying",
+                            type(e).__name__, e)
+                self._stop.wait(1.0)
 
     # -- one drain->batch->predict->sink cycle -----------------------------
     def serve_once(self) -> int:

@@ -1,0 +1,60 @@
+"""Image augmentation — the data/image pipeline (the reference's
+`apps/image-augmentation` notebook scenario).
+
+Build a ChainedPreprocessing of resize / random crop / horizontal flip /
+brightness / channel-normalize, run it over an ImageSet, and feed the
+augmented set into one training epoch.
+
+    python apps/image_augmentation.py
+"""
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.data.image import (ChainedPreprocessing,
+                                          ImageBrightness,
+                                          ImageChannelNormalize,
+                                          ImageHFlip, ImageRandomCrop,
+                                          ImageResize, ImageSet)
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    rs = np.random.RandomState(0)
+    raw = [rs.randint(0, 255, size=(40 + rs.randint(20),
+                                    40 + rs.randint(20), 3),
+                      ).astype(np.uint8) for _ in range(64)]
+    labels = rs.randint(0, 2, size=64).astype(np.int32)
+    iset = ImageSet(raw, labels)
+
+    pipeline = ChainedPreprocessing([
+        ImageResize(36, 36),
+        ImageRandomCrop(32, 32, seed=1),
+        ImageHFlip(p=0.5, seed=2),
+        ImageBrightness(-16, 16, seed=3),
+        ImageChannelNormalize(127.5, 127.5, 127.5, 127.5, 127.5, 127.5),
+    ])
+    aug = iset.transform(pipeline)
+    batch = np.stack(aug.images)
+    print(f"augmented: {batch.shape}, value range "
+          f"[{batch.min():.2f}, {batch.max():.2f}]")
+    assert batch.shape == (64, 32, 32, 3)
+    assert -2.0 < batch.min() and batch.max() < 2.0
+
+    model = Sequential([
+        L.Convolution2D(4, 3, 3, input_shape=(32, 32, 3),
+                        activation="relu", border_mode="same"),
+        L.MaxPooling2D(),
+        L.Flatten(),
+        L.Dense(2, activation="softmax"),
+    ])
+    model.compile("adam", "sparse_categorical_crossentropy")
+    hist = model.fit(batch, labels, batch_size=32, nb_epoch=2)
+    print("loss:", [round(v, 3) for v in hist["loss"]])
+    print("image augmentation app OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Smoke-run the scenario apps end-to-end in subprocesses (the
+reference's `apps/run-app-tests*.sh` harness role; same mechanism as
+tests/test_examples.py)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+APPS_DIR = os.path.join(os.path.dirname(__file__), "..", "apps")
+
+APPS = [
+    "fraud_detection.py",
+    "image_similarity.py",
+    "image_augmentation.py",
+    "sentiment_analysis.py",
+]
+
+
+@pytest.mark.parametrize("script", APPS)
+def test_app_runs(script):
+    repo_root = os.path.abspath(os.path.join(APPS_DIR, ".."))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    path = os.path.join(APPS_DIR, script)
+    proc = subprocess.run([sys.executable, path], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        f"{script} failed:\nstdout:\n{proc.stdout[-2000:]}\n" \
+        f"stderr:\n{proc.stderr[-2000:]}"
+    assert "OK" in proc.stdout

@@ -241,6 +241,40 @@ class TestRedisBrokerProtocol:
         br.hset("k", "f", "v")
         assert br.hget("k", "f") == "v"
 
+    def test_reconnects_after_connection_loss(self, redis_server):
+        # a timed-out/killed connection must not permanently dead-end the
+        # broker: the next command reconnects (serving loops run for days)
+        br = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br.hset("k", "f", "1")
+        br._r.close()  # simulate the close-on-timeout path
+        assert br.hget("k", "f") == "1"  # transparently reconnected
+
+    def test_serving_loop_survives_broker_failure(self, redis_server):
+        # ClusterServing.run must keep cycling through broker exceptions
+        # (the Flink-restart role), not die on the first ConnectionError
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        from analytics_zoo_tpu.serving import (ClusterServing,
+                                               InferenceModel, InputQueue)
+        m = Sequential([L.Dense(2, input_shape=(3,))])
+        m.ensure_built(np.zeros((1, 3), np.float32))
+        im = InferenceModel()
+        im.load_keras(m)
+        port = redis_server.server_address[1]
+        broker = RedisBroker("127.0.0.1", port)
+        serving = ClusterServing(im, broker, batch_timeout_ms=20).start()
+        try:
+            import time
+            time.sleep(0.1)
+            broker._r.close()   # yank the connection under the loop
+            time.sleep(0.2)
+            assert serving._thread.is_alive()
+            out = InputQueue(RedisBroker("127.0.0.1", port)).predict(
+                np.ones(3, np.float32), timeout_s=30)
+            assert np.asarray(out).shape == (2,)
+        finally:
+            serving.stop()
+
     def test_error_reply_raises(self, redis_server):
         br = RedisBroker("127.0.0.1", redis_server.server_address[1])
         with pytest.raises(RESPError):
