@@ -899,6 +899,9 @@ class TimeDistributed(Layer):
         return (input_shape[0], input_shape[1]) + tuple(inner_out[1:])
 
 
+# `LayerNorm.scala` exposes layer normalization under this name too
+LayerNorm = LayerNormalization
+
 # Extended Keras1-parity set (advanced activations, noise, conv variants,
 # ConvLSTM, LRN, torch-style elementwise, ...) lives in layers_ext but is
 # part of this namespace — the reference exposes one flat layer namespace.

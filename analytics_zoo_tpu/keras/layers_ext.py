@@ -53,6 +53,9 @@ __all__ = [
     "Scale", "CAdd", "CMul", "AddConstant", "MulConstant", "Abs", "Clamp",
     "HardTanh", "Exp", "Log", "Power", "Square", "Sqrt", "Negative",
     "Identity", "HardShrink", "SoftShrink", "Threshold",
+    "Softmax", "BinaryThreshold", "Mul", "Max", "RReLU", "SelectTable",
+    "SplitTensor", "Expand", "GetShape", "ExpandDim", "ShareConvolution2D",
+    "SparseDense", "SparseEmbedding",
 ]
 
 
@@ -1056,3 +1059,248 @@ class Threshold(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         return jnp.where(x > self.th, x, self.v)
+
+
+# ---------------------------------------------------------------------------
+# Long-tail parity layers (`keras/layers/*.scala` remaining inventory)
+# ---------------------------------------------------------------------------
+class Softmax(Layer):
+    """Softmax as a layer (`Softmax.scala`), last axis."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class BinaryThreshold(Layer):
+    """`BinaryThreshold.scala`: element < th → 0 else 1."""
+
+    def __init__(self, th: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.th = float(th)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.where(x < self.th, 0.0, 1.0)
+
+
+class Mul(Layer):
+    """`Mul.scala`: multiply the input by ONE learnable scalar."""
+
+    def build(self, rng, input_shape):
+        return {"weight": jax.random.uniform(rng, (1,), jnp.float32,
+                                             -0.05, 0.05)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        return x * params["weight"]
+
+
+class Max(Layer):
+    """`Max.scala`: max over dimension `dim` (1-based over the batched
+    array, i.e. dim=1 is the first non-batch dim); `return_value=False`
+    returns the argmax indices instead."""
+
+    def __init__(self, dim: int, return_value: bool = True, **kw):
+        super().__init__(**kw)
+        if dim < 1:
+            raise ValueError("Max cannot reduce the batch dimension")
+        self.dim = int(dim)
+        self.return_value = return_value
+
+    def call(self, params, x, *, training=False, rng=None):
+        if self.return_value:
+            return jnp.max(x, axis=self.dim)
+        return jnp.argmax(x, axis=self.dim).astype(jnp.int32)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        del shape[self.dim]
+        return tuple(shape)
+
+
+class RReLU(Layer):
+    """`RReLU.scala`: randomized leaky ReLU — training slope ~ U(l, u)
+    per element, eval slope = (l + u) / 2."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 **kw):
+        super().__init__(**kw)
+        self.lower, self.upper = float(lower), float(upper)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, jnp.shape(x), jnp.float32,
+                                   self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.maximum(x, 0.0) + a * jnp.minimum(x, 0.0)
+
+
+class SelectTable(Layer):
+    """`SelectTable.scala`: pick element `index` (0-based) from a list
+    input."""
+
+    def __init__(self, index: int, **kw):
+        super().__init__(**kw)
+        self.index = int(index)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not isinstance(x, (list, tuple)):
+            raise ValueError("SelectTable expects a list input")
+        return x[self.index]
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[self.index]
+
+
+class SplitTensor(Layer):
+    """`SplitTensor.scala`: split along `dimension` (0-based counting the
+    batch dim, matching the reference note) into `num` equal parts,
+    output is a list."""
+
+    def __init__(self, dimension: int, num: int, **kw):
+        super().__init__(**kw)
+        if dimension == 0:
+            raise ValueError("SplitTensor cannot split the batch dimension")
+        self.dimension, self.num = int(dimension), int(num)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return list(jnp.split(x, self.num, axis=self.dimension))
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        if shape[self.dimension] is not None:
+            if shape[self.dimension] % self.num:
+                raise ValueError(
+                    f"SplitTensor: dim {self.dimension} size "
+                    f"{shape[self.dimension]} not divisible by {self.num}")
+            shape[self.dimension] //= self.num
+        return [tuple(shape)] * self.num
+
+
+class Expand(Layer):
+    """`Expand.scala` (InternalExpand): broadcast singleton dims to
+    `tgt_sizes` (full shape including batch; -1 keeps a dim)."""
+
+    def __init__(self, tgt_sizes: Sequence[int], **kw):
+        super().__init__(**kw)
+        self.tgt_sizes = tuple(int(d) for d in tgt_sizes)
+
+    def _target(self, in_shape):
+        if len(self.tgt_sizes) != len(in_shape):
+            raise ValueError(
+                f"Expand tgt_sizes rank {len(self.tgt_sizes)} != input "
+                f"rank {len(in_shape)} (shape {tuple(in_shape)})")
+        return tuple(s if t == -1 else t
+                     for t, s in zip(self.tgt_sizes, in_shape))
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.broadcast_to(x, self._target(x.shape))
+
+    def compute_output_shape(self, input_shape):
+        return self._target(input_shape)
+
+
+class GetShape(Layer):
+    """`GetShape.scala`: outputs the input's shape as an int tensor
+    (batch dim included)."""
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.asarray(jnp.shape(x), jnp.int32)
+
+    def compute_output_shape(self, input_shape):
+        return (len(input_shape),)
+
+
+class ExpandDim(Layer):
+    """`ExpandDim` (pyzoo core.py): insert a size-1 axis at `dim`
+    (0-based over non-batch dims)."""
+
+    def __init__(self, dim: int, **kw):
+        super().__init__(**kw)
+        self.dim = int(dim)
+
+    def call(self, params, x, *, training=False, rng=None):
+        return jnp.expand_dims(x, self.dim + 1)  # skip batch
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        shape.insert(self.dim + 1, 1)
+        return tuple(shape)
+
+
+class ShareConvolution2D(Layer):
+    """`ShareConvolution2D.scala`: conv2d whose weights are intended for
+    sharing across graph sites (weight sharing falls out of calling ONE
+    layer object at several nodes in this engine); `propagate_back=False`
+    stops the input gradient (the reference flag)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1),
+                 border_mode: str = "valid", propagate_back: bool = True,
+                 **kw):
+        super().__init__(**kw)
+        from analytics_zoo_tpu.keras.layers import Convolution2D
+        self._conv = Convolution2D(nb_filter, nb_row, nb_col,
+                                   activation=activation,
+                                   subsample=subsample,
+                                   border_mode=border_mode)
+        self.propagate_back = propagate_back
+
+    def build(self, rng, input_shape):
+        return self._conv.build(rng, input_shape)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not self.propagate_back:
+            x = jax.lax.stop_gradient(x)
+        return self._conv.call(params, x, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        return self._conv.compute_output_shape(input_shape)
+
+
+class SparseDense(Layer):
+    """`SparseDense.scala` semantics on dense-coded sparse rows: a Dense
+    layer that does NOT backpropagate into its input by default (the
+    reference's gradInput suppression; `backward_start/length` would
+    select a slice — here the whole input grad is stopped unless
+    `propagate_back=True`)."""
+
+    def __init__(self, output_dim: int, activation=None,
+                 propagate_back: bool = False, **kw):
+        super().__init__(**kw)
+        from analytics_zoo_tpu.keras.layers import Dense
+        self._dense = Dense(output_dim, activation=activation)
+        self.propagate_back = propagate_back
+
+    def build(self, rng, input_shape):
+        return self._dense.build(rng, input_shape)
+
+    def call(self, params, x, *, training=False, rng=None):
+        if not self.propagate_back:
+            x = jax.lax.stop_gradient(x)
+        return self._dense.call(params, x, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        return self._dense.compute_output_shape(input_shape)
+
+
+class SparseEmbedding(Layer):
+    """`SparseEmbedding.scala`: embedding lookup for id lists padded with
+    0 (the sparse-tensor role); pad positions contribute zero vectors."""
+
+    def __init__(self, input_dim: int, output_dim: int, **kw):
+        super().__init__(**kw)
+        self.input_dim, self.output_dim = int(input_dim), int(output_dim)
+
+    def build(self, rng, input_shape):
+        scale = 0.05
+        return {"embeddings": jax.random.uniform(
+            rng, (self.input_dim, self.output_dim), jnp.float32,
+            -scale, scale)}
+
+    def call(self, params, x, *, training=False, rng=None):
+        idx = jnp.asarray(x, jnp.int32)
+        vecs = params["embeddings"][idx]
+        return vecs * (idx != 0)[..., None]
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)

@@ -261,7 +261,8 @@ class _RESPClient:
             (self._host, self._port), timeout=self._timeout_s)
         self._buf = self._sock.makefile("rb")
 
-    def close(self):
+    def _close_locked(self):
+        """Close without taking the lock — only from inside command()."""
         try:
             if self._buf is not None:
                 self._buf.close()
@@ -270,6 +271,12 @@ class _RESPClient:
         except OSError:
             pass
         self._sock = self._buf = None
+
+    def close(self):
+        # taking the lock serializes against an in-flight command; nulling
+        # _sock mid-command would raise AttributeError in the other thread
+        with self._lock:
+            self._close_locked()
 
     def command(self, *args, timeout_s: Optional[float] = None):
         """Encode `args` as a RESP array of bulk strings; return the
@@ -294,12 +301,12 @@ class _RESPClient:
                 self._sock.sendall(b"".join(out))
                 return self._read_reply()
             except socket.timeout:
-                self.close()
+                self._close_locked()
                 raise ConnectionError(
                     "redis command timed out; connection closed to avoid "
                     "reply desynchronization (next command reconnects)")
             except (ConnectionError, OSError):
-                self.close()
+                self._close_locked()
                 raise
             finally:
                 if timeout_s is not None and self._sock is not None:

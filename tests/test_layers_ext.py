@@ -426,3 +426,122 @@ class TestInModels:
                                    rtol=1e-5)
         with pytest.raises(ValueError, match="batch"):
             K2.Dot(axes=0).call({}, [u, u])
+
+
+class TestLongTailLayers:
+    """Round-2 additions: the remaining reference layer inventory
+    (`Softmax/BinaryThreshold/Mul/Max/RReLU/SelectTable/SplitTensor/
+    Expand/GetShape/ExpandDim/ShareConvolution2D/SparseDense/
+    SparseEmbedding.scala`)."""
+
+    def _run(self, layer, x, training=False, rng=None):
+        import jax
+        params = layer.build(jax.random.PRNGKey(0),
+                             (None,) + x.shape[1:])
+        return np.asarray(jax.tree_util.tree_map(
+            np.asarray, layer.call(params, jnp.asarray(x),
+                                   training=training, rng=rng))) \
+            if not isinstance(layer, (L.SelectTable, L.SplitTensor)) \
+            else layer.call(params, x, training=training, rng=rng)
+
+    def test_softmax_layer(self):
+        x = np.array([[1.0, 2.0, 3.0]], np.float32)
+        out = self._run(L.Softmax(), x)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-6)
+
+    def test_binary_threshold(self):
+        x = np.array([[-1.0, 0.0, 0.5]], np.float32)
+        np.testing.assert_allclose(self._run(L.BinaryThreshold(1e-6), x),
+                                   [[0.0, 0.0, 1.0]])
+
+    def test_mul_learnable_scalar(self):
+        import jax
+        layer = L.Mul()
+        params = layer.build(jax.random.PRNGKey(0), (None, 3))
+        assert params["weight"].shape == (1,)
+        x = np.ones((2, 3), np.float32)
+        out = np.asarray(layer.call(params, jnp.asarray(x)))
+        np.testing.assert_allclose(out, x * np.asarray(params["weight"]))
+
+    def test_max_value_and_indices(self):
+        x = np.array([[[1.0, 5.0], [3.0, 2.0]]], np.float32)  # [1,2,2]
+        np.testing.assert_allclose(self._run(L.Max(dim=1), x),
+                                   [[3.0, 5.0]])
+        np.testing.assert_allclose(
+            self._run(L.Max(dim=2, return_value=False), x), [[1, 0]])
+        assert L.Max(dim=1).compute_output_shape((None, 2, 2)) == (None, 2)
+        with pytest.raises(ValueError):
+            L.Max(dim=0)
+
+    def test_rrelu_train_vs_eval(self):
+        import jax
+        x = np.full((4, 100), -1.0, np.float32)
+        layer = L.RReLU(0.1, 0.3)
+        ev = self._run(layer, x)
+        np.testing.assert_allclose(ev, -0.2, rtol=1e-6)   # mean slope
+        tr = self._run(layer, x, training=True,
+                       rng=jax.random.PRNGKey(1))
+        assert tr.min() >= -0.3 - 1e-6 and tr.max() <= -0.1 + 1e-6
+        assert tr.std() > 0.01                            # actually random
+
+    def test_select_and_split_table(self):
+        xs = [np.ones((2, 3), np.float32), np.zeros((2, 5), np.float32)]
+        sel = L.SelectTable(1)
+        np.testing.assert_allclose(sel.call({}, xs), xs[1])
+        assert sel.compute_output_shape([(None, 3), (None, 5)]) == (None, 5)
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        parts = L.SplitTensor(1, 3).call({}, jnp.asarray(x))
+        assert len(parts) == 3
+        np.testing.assert_allclose(np.asarray(parts[1]), x[:, 2:4])
+        assert L.SplitTensor(1, 3).compute_output_shape((None, 6)) \
+            == [(None, 2)] * 3
+
+    def test_expand_and_getshape_and_expanddim(self):
+        x = np.ones((2, 1, 3), np.float32)
+        out = self._run(L.Expand((-1, 4, 3)), x)
+        assert out.shape == (2, 4, 3)
+        with pytest.raises(ValueError, match="rank"):
+            self._run(L.Expand((-1, 4, 3)), np.ones((2, 1), np.float32))
+        shp = self._run(L.GetShape(), x)
+        np.testing.assert_array_equal(shp, [2, 1, 3])
+        out = self._run(L.ExpandDim(0), x)
+        assert out.shape == (2, 1, 1, 3)
+
+    def test_share_convolution_stop_gradient(self):
+        import jax
+        layer = L.ShareConvolution2D(2, 3, 3, border_mode="same",
+                                     propagate_back=False)
+        x = np.random.RandomState(0).randn(1, 8, 8, 3).astype(np.float32)
+        params = layer.build(jax.random.PRNGKey(0), (None, 8, 8, 3))
+
+        def f(xin):
+            return jnp.sum(layer.call(params, xin))
+        g = np.asarray(jax.grad(f)(jnp.asarray(x)))
+        np.testing.assert_allclose(g, 0.0)    # input grad suppressed
+        # weights still get gradients
+        gw = jax.grad(lambda p: jnp.sum(layer.call(p, jnp.asarray(x))))(
+            params)
+        assert float(np.abs(np.asarray(
+            jax.tree_util.tree_leaves(gw)[0])).sum()) > 0
+
+    def test_sparse_dense_no_input_grad(self):
+        import jax
+        layer = L.SparseDense(4)
+        x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+        params = layer.build(jax.random.PRNGKey(0), (None, 6))
+        g = np.asarray(jax.grad(
+            lambda xin: jnp.sum(layer.call(params, xin)))(jnp.asarray(x)))
+        np.testing.assert_allclose(g, 0.0)
+        assert layer.compute_output_shape((None, 6)) == (None, 4)
+
+    def test_sparse_embedding_pads_to_zero(self):
+        import jax
+        layer = L.SparseEmbedding(10, 4)
+        params = layer.build(jax.random.PRNGKey(0), (None, 3))
+        idx = np.array([[0, 2, 5]], np.int32)
+        out = np.asarray(layer.call(params, jnp.asarray(idx)))
+        np.testing.assert_allclose(out[0, 0], 0.0)       # pad id 0
+        assert np.abs(out[0, 1]).sum() > 0
+
+    def test_layernorm_alias(self):
+        assert L.LayerNorm is L.LayerNormalization
