@@ -54,7 +54,7 @@ __all__ = [
     "HardTanh", "Exp", "Log", "Power", "Square", "Sqrt", "Negative",
     "Identity", "HardShrink", "SoftShrink", "Threshold",
     "Softmax", "BinaryThreshold", "Mul", "Max", "RReLU", "SelectTable",
-    "SplitTensor", "Expand", "GetShape", "ExpandDim", "ShareConvolution2D",
+    "SplitTensor", "Expand", "GetShape", "ShareConvolution2D",
     "SparseDense", "SparseEmbedding",
 ]
 
@@ -1126,7 +1126,9 @@ class RReLU(Layer):
         self.lower, self.upper = float(lower), float(upper)
 
     def call(self, params, x, *, training=False, rng=None):
-        if training and rng is not None:
+        if training:
+            if rng is None:
+                raise ValueError(f"{self.name} needs an rng in training")
             a = jax.random.uniform(rng, jnp.shape(x), jnp.float32,
                                    self.lower, self.upper)
         else:
@@ -1208,23 +1210,6 @@ class GetShape(Layer):
 
     def compute_output_shape(self, input_shape):
         return (len(input_shape),)
-
-
-class ExpandDim(Layer):
-    """`ExpandDim` (pyzoo core.py): insert a size-1 axis at `dim`
-    (0-based over non-batch dims)."""
-
-    def __init__(self, dim: int, **kw):
-        super().__init__(**kw)
-        self.dim = int(dim)
-
-    def call(self, params, x, *, training=False, rng=None):
-        return jnp.expand_dims(x, self.dim + 1)  # skip batch
-
-    def compute_output_shape(self, input_shape):
-        shape = list(input_shape)
-        shape.insert(self.dim + 1, 1)
-        return tuple(shape)
 
 
 class ShareConvolution2D(Layer):

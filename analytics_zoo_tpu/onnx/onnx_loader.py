@@ -241,9 +241,179 @@ class _OnnxGraphBuilder:
             self.nodes[out_name] = node_out
         elif op == "Pad":
             self.nodes[out_name] = self._pad(node, attrs)
+        elif op in ("Abs", "Exp", "Log", "Sqrt", "Neg"):
+            import jax.numpy as jnp
+            fn = {"Abs": jnp.abs, "Exp": jnp.exp, "Log": jnp.log,
+                  "Sqrt": jnp.sqrt, "Neg": jnp.negative}[op]
+            self.nodes[out_name] = LambdaLayer(fn)(
+                self.nodes[node["input"][0]])
+        elif op == "HardSigmoid":
+            import jax.numpy as jnp
+            alpha = float(attrs.get("alpha", 0.2))
+            beta = float(attrs.get("beta", 0.5))
+            self.nodes[out_name] = LambdaLayer(
+                lambda x, a=alpha, b=beta: jnp.clip(a * x + b, 0.0, 1.0))(
+                self.nodes[node["input"][0]])
+        elif op == "Clip":
+            self.nodes[out_name] = self._clip(node, attrs)
+        elif op == "Pow":
+            self.nodes[out_name] = self._pow(node)
+        elif op == "Cast":
+            src = node["input"][0]
+            dtype = self._CAST_DTYPES.get(int(attrs.get("to", 1)))
+            if dtype is None:
+                raise NotImplementedError(
+                    f"Cast to ONNX dtype {attrs.get('to')}")
+            if src in self.consts:
+                self.consts[out_name] = self.consts[src].astype(dtype)
+            else:
+                self.nodes[out_name] = LambdaLayer(
+                    lambda x, d=dtype: x.astype(d))(self.nodes[src])
+        elif op == "Gather":
+            gathered = self._gather(node, attrs)
+            if gathered is not None:      # None → constant-folded
+                self.nodes[out_name] = gathered
+        elif op == "Greater":
+            self.nodes[out_name] = self._greater(node)
+        elif op == "LRN":
+            self.nodes[out_name] = L.LRN2D(
+                alpha=float(attrs.get("alpha", 1e-4)),
+                beta=float(attrs.get("beta", 0.75)),
+                k=float(attrs.get("bias", 1.0)),
+                n=int(attrs.get("size", 5)), dim_ordering="th")(
+                self.nodes[node["input"][0]])
+        elif op in ("ReduceMean", "ReduceSum"):
+            self.nodes[out_name] = self._reduce(node, attrs, op)
+        elif op == "Shape":
+            self.nodes[out_name] = L.GetShape()(
+                self.nodes[node["input"][0]])
+        elif op == "Slice":
+            self.nodes[out_name] = self._slice(node, attrs)
+        elif op == "Transpose":
+            perm = attrs.get("perm")
+            self.nodes[out_name] = LambdaLayer(
+                lambda x, p=perm: x.transpose(
+                    tuple(int(i) for i in p) if p is not None
+                    else tuple(range(x.ndim))[::-1]))(
+                self.nodes[node["input"][0]])
         else:
             raise NotImplementedError(
                 f"ONNX op {op!r} is not supported by the importer")
+
+    def _clip(self, node, attrs):
+        # opset<11 carries min/max attrs; >=11 as optional const inputs
+        lo = attrs.get("min")
+        hi = attrs.get("max")
+        ins = node["input"]
+
+        def bound(i, current):
+            if current is not None or len(ins) <= i or not ins[i]:
+                return current
+            if ins[i] not in self.consts:
+                raise NotImplementedError(
+                    "Clip with runtime (non-constant) min/max inputs")
+            return float(np.asarray(self.consts[ins[i]]).reshape(-1)[0])
+        lo = bound(1, lo)
+        hi = bound(2, hi)
+        import jax.numpy as jnp
+        return LambdaLayer(
+            lambda x, lo=lo, hi=hi: jnp.clip(
+                x, -np.inf if lo is None else lo,
+                np.inf if hi is None else hi))(self.nodes[ins[0]])
+
+    def _pow(self, node):
+        a, b = node["input"][:2]
+        if b in self.consts:
+            c = self.consts[b].astype(np.float32)
+            return LambdaLayer(lambda x, c=c: x ** c)(self.nodes[a])
+        return LambdaLayer(lambda x, y: x ** y)([self.nodes[a],
+                                                 self.nodes[b]])
+
+    _CAST_DTYPES = {1: np.float32, 6: np.int32, 7: np.int64,
+                    9: np.bool_, 10: np.float16, 11: np.float64}
+
+    def _gather(self, node, attrs):
+        axis = int(attrs.get("axis", 0))
+        data, indices = node["input"][:2]
+        import jax.numpy as jnp
+        if data in self.consts and indices in self.consts:
+            # constant fold (shape-computation subgraphs)
+            self.consts[node["output"][0]] = np.take(
+                self.consts[data],
+                self.consts[indices].astype(np.int64), axis=axis)
+            return None
+        if data in self.consts and indices in self.nodes:
+            # embedding-style: const table gathered by a runtime tensor
+            table = self.consts[data].astype(np.float32)
+            return LambdaLayer(
+                lambda idx, t=table, ax=axis: jnp.take(
+                    t, idx.astype(jnp.int32), axis=ax))(
+                self.nodes[indices])
+        if indices in self.consts and data in self.nodes:
+            idx = self.consts[indices].astype(np.int64)
+            return LambdaLayer(
+                lambda x, i=idx, ax=axis: jnp.take(x, i, axis=ax))(
+                self.nodes[data])
+        return LambdaLayer(
+            lambda x, idx, ax=axis: jnp.take(x, idx.astype(jnp.int32),
+                                             axis=ax))(
+            [self.nodes[data], self.nodes[indices]])
+
+    def _greater(self, node):
+        a, b = node["input"][:2]
+        if b in self.consts:
+            c = self.consts[b].astype(np.float32)
+            return LambdaLayer(lambda x, c=c: x > c)(self.nodes[a])
+        return LambdaLayer(lambda x, y: x > y)([self.nodes[a],
+                                                self.nodes[b]])
+
+    def _reduce(self, node, attrs, op):
+        axes = attrs.get("axes")
+        if axes is None and len(node["input"]) > 1 and node["input"][1]:
+            if node["input"][1] not in self.consts:
+                raise NotImplementedError(
+                    f"{op} with runtime (non-constant) axes input")
+            axes = self.consts[node["input"][1]].reshape(-1).tolist()
+        axes = None if axes is None else tuple(int(a) for a in axes)
+        keep = bool(int(attrs.get("keepdims", 1)))
+        import jax.numpy as jnp
+        fn = jnp.mean if op == "ReduceMean" else jnp.sum
+        return LambdaLayer(
+            lambda x, ax=axes, k=keep: fn(x, axis=ax, keepdims=k))(
+            self.nodes[node["input"][0]])
+
+    def _slice(self, node, attrs):
+        ins = node["input"]
+        if "starts" in attrs:                   # opset < 10
+            starts = [int(v) for v in attrs["starts"]]
+            ends = [int(v) for v in attrs["ends"]]
+            axes = [int(v) for v in attrs.get(
+                "axes", range(len(starts)))]
+            steps = [1] * len(starts)
+        else:                                   # opset >= 10: const inputs
+            def const(i, default=None, required=False):
+                if len(ins) > i and ins[i]:
+                    if ins[i] not in self.consts:
+                        raise NotImplementedError(
+                            "Slice with runtime (non-constant) "
+                            "starts/ends/axes/steps inputs")
+                    return self.consts[ins[i]].reshape(-1).tolist()
+                if required:
+                    raise NotImplementedError("Slice without starts/ends")
+                return default
+            starts = [int(v) for v in const(1, required=True)]
+            ends = [int(v) for v in const(2, required=True)]
+            axes = [int(v) for v in
+                    const(3, list(range(len(starts))))]
+            steps = [int(v) for v in const(4, [1] * len(starts))]
+
+        def do_slice(x, starts=tuple(starts), ends=tuple(ends),
+                     axes=tuple(axes), steps=tuple(steps)):
+            sl = [slice(None)] * x.ndim
+            for s, e, a, st in zip(starts, ends, axes, steps):
+                sl[a] = slice(s, None if e >= 2**31 - 1 else e, st)
+            return x[tuple(sl)]
+        return LambdaLayer(do_slice)(self.nodes[ins[0]])
 
     def _conv(self, node, attrs):
         w = self.consts[node["input"][1]]          # OIHW

@@ -504,7 +504,9 @@ class TestLongTailLayers:
             self._run(L.Expand((-1, 4, 3)), np.ones((2, 1), np.float32))
         shp = self._run(L.GetShape(), x)
         np.testing.assert_array_equal(shp, [2, 1, 3])
-        out = self._run(L.ExpandDim(0), x)
+        # ExpandDim keeps its pre-existing absolute-axis semantics (the
+        # ONNX importer's Unsqueeze depends on it)
+        out = self._run(L.ExpandDim(1), x)
         assert out.shape == (2, 1, 1, 3)
 
     def test_share_convolution_stop_gradient(self):

@@ -474,3 +474,179 @@ class TestOnnxOpsRound2:
         x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
         got = np.asarray(model.predict(x, batch_per_thread=2))
         np.testing.assert_allclose(got, x.reshape(2, 3, 2, 2))
+
+
+class TestOnnxOpTail:
+    """Round-2 op coverage: the remaining reference mapper set
+    (`pyzoo/zoo/pipeline/api/onnx/mapper/`: abs/exp/log/sqrt/neg/clip/
+    hardsigmoid/pow/cast/gather/greater/lrn/reducemean/reducesum/shape/
+    slice/transpose)."""
+
+    def _run(self, nodes, x, in_shape, out_shape, inits=()):
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0] + list(in_shape))],
+            "output": [_vinfo("y", [0] + list(out_shape))],
+            "initializer": list(inits),
+            "node": nodes,
+        }
+        model = load_onnx(_model(graph))
+        return np.asarray(model.predict(x, batch_per_thread=len(x)))
+
+    def test_unary_chain(self):
+        x = np.random.RandomState(0).rand(4, 3).astype(np.float32) + 0.5
+        nodes = [
+            {"op_type": ["Sqrt"], "input": ["x"], "output": ["a"]},
+            {"op_type": ["Log"], "input": ["a"], "output": ["b"]},
+            {"op_type": ["Neg"], "input": ["b"], "output": ["c"]},
+            {"op_type": ["Exp"], "input": ["c"], "output": ["d"]},
+            {"op_type": ["Abs"], "input": ["d"], "output": ["y"]},
+        ]
+        got = self._run(nodes, x, [3], [3])
+        np.testing.assert_allclose(got, np.abs(np.exp(-np.log(np.sqrt(x)))),
+                                   rtol=1e-5)
+
+    def test_clip_attr_and_input_forms(self):
+        x = np.linspace(-2, 2, 12).astype(np.float32).reshape(4, 3)
+        got = self._run([{"op_type": ["Clip"], "input": ["x"],
+                          "output": ["y"],
+                          "attribute": [_attr_float("min", -1.0),
+                                        _attr_float("max", 1.0)]}],
+                        x, [3], [3])
+        np.testing.assert_allclose(got, np.clip(x, -1, 1))
+        lo = np.asarray(-0.5, np.float32)
+        hi = np.asarray(0.5, np.float32)
+        got = self._run([{"op_type": ["Clip"], "input": ["x", "lo", "hi"],
+                          "output": ["y"]}],
+                        x, [3], [3],
+                        inits=[_tensor("lo", lo), _tensor("hi", hi)])
+        np.testing.assert_allclose(got, np.clip(x, -0.5, 0.5))
+
+    def test_hardsigmoid_pow(self):
+        x = np.linspace(-4, 4, 8).astype(np.float32).reshape(2, 4)
+        got = self._run([{"op_type": ["HardSigmoid"], "input": ["x"],
+                          "output": ["y"],
+                          "attribute": [_attr_float("alpha", 0.25)]}],
+                        x, [4], [4])
+        np.testing.assert_allclose(got, np.clip(0.25 * x + 0.5, 0, 1),
+                                   rtol=1e-6)
+        e = np.asarray([2.0], np.float32)
+        got = self._run([{"op_type": ["Pow"], "input": ["x", "e"],
+                          "output": ["y"]}], x, [4], [4],
+                        inits=[_tensor("e", e)])
+        np.testing.assert_allclose(got, x ** 2, rtol=1e-5)
+
+    def test_cast_and_greater(self):
+        x = np.asarray([[0.5, -1.0, 2.0]], np.float32)
+        got = self._run([
+            {"op_type": ["Greater"], "input": ["x", "t"], "output": ["g"]},
+            {"op_type": ["Cast"], "input": ["g"], "output": ["y"],
+             "attribute": [_attr_int("to", 1)]},
+        ], x, [3], [3], inits=[_tensor("t", np.asarray(0.0, np.float32))])
+        np.testing.assert_allclose(got, [[1.0, 0.0, 1.0]])
+
+    def test_gather_embedding_style(self):
+        table = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.asarray([[0, 3, 1]], np.float32)  # runtime indices
+        got = self._run([{"op_type": ["Gather"], "input": ["table", "x"],
+                          "output": ["y"]}],
+                        idx, [3], [3, 3],
+                        inits=[_tensor("table", table)])
+        np.testing.assert_allclose(got, table[[0, 3, 1]][None])
+
+    def test_reduce_mean_sum(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        got = self._run([{"op_type": ["ReduceMean"], "input": ["x"],
+                          "output": ["y"],
+                          "attribute": [_attr_ints("axes", [2]),
+                                        _attr_int("keepdims", 0)]}],
+                        x, [3, 4], [3])
+        np.testing.assert_allclose(got, x.mean(axis=2))
+        got = self._run([{"op_type": ["ReduceSum"], "input": ["x"],
+                          "output": ["y"],
+                          "attribute": [_attr_ints("axes", [1]),
+                                        _attr_int("keepdims", 1)]}],
+                        x, [3, 4], [1, 4])
+        np.testing.assert_allclose(got, x.sum(axis=1, keepdims=True))
+
+    def test_slice_opset10_and_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        starts = np.asarray([1], np.int64)
+        ends = np.asarray([3], np.int64)
+        axes = np.asarray([2], np.int64)
+        got = self._run([{"op_type": ["Slice"],
+                          "input": ["x", "s", "e", "a"], "output": ["y"]}],
+                        x, [3, 4], [3, 2],
+                        inits=[_tensor("s", starts), _tensor("e", ends),
+                               _tensor("a", axes)])
+        np.testing.assert_allclose(got, x[:, :, 1:3])
+        got = self._run([{"op_type": ["Transpose"], "input": ["x"],
+                          "output": ["y"],
+                          "attribute": [_attr_ints("perm", [0, 2, 1])]}],
+                        x, [3, 4], [4, 3])
+        np.testing.assert_allclose(got, x.transpose(0, 2, 1))
+
+    def test_shape_op(self):
+        # Shape yields one rank-length vector for the whole batch (not
+        # per-sample), so apply directly instead of the row-sliced predict
+        import jax
+        x = np.zeros((2, 3, 4), np.float32)
+        graph = {
+            "name": ["g"],
+            "input": [_vinfo("x", [0, 3, 4])],
+            "output": [_vinfo("y", [3])],
+            "initializer": [],
+            "node": [{"op_type": ["Shape"], "input": ["x"],
+                      "output": ["y"]}],
+        }
+        model = load_onnx(_model(graph))
+        if model.params is None:
+            model.params = model.build(jax.random.PRNGKey(0))
+        got = np.asarray(model.apply(model.params, x))
+        np.testing.assert_array_equal(got, [2, 3, 4])
+
+    def test_gather_const_fold(self):
+        table = np.arange(4, dtype=np.float32) * 10          # (4,)
+        idx = np.asarray([1, 3], np.int64)
+        # gathered (2,)-const broadcasts into the Add as a row vector
+        got = self._run([
+            {"op_type": ["Gather"], "input": ["table", "i"],
+             "output": ["g"]},
+            {"op_type": ["Add"], "input": ["x", "g"], "output": ["y"]},
+        ], np.zeros((2, 2), np.float32), [2], [2],
+            inits=[_tensor("table", table), _tensor("i", idx)])
+        np.testing.assert_allclose(got, np.tile(table[[1, 3]], (2, 1)))
+
+    def test_runtime_tensor_inputs_raise_not_silently_noop(self):
+        # Clip/Slice/ReduceSum with runtime (non-const) control inputs
+        # must raise — a silent identity/all-axes fallback corrupts models
+        x_info = [_vinfo("x", [0, 3])]
+        for nodes in (
+            [{"op_type": ["Relu"], "input": ["x"], "output": ["r"]},
+             {"op_type": ["Clip"], "input": ["x", "r"], "output": ["y"]}],
+            [{"op_type": ["Relu"], "input": ["x"], "output": ["r"]},
+             {"op_type": ["Slice"], "input": ["x", "r", "r"],
+              "output": ["y"]}],
+            [{"op_type": ["Relu"], "input": ["x"], "output": ["r"]},
+             {"op_type": ["ReduceSum"], "input": ["x", "r"],
+              "output": ["y"]}],
+        ):
+            graph = {"name": ["g"], "input": x_info,
+                     "output": [_vinfo("y", [0, 3])], "initializer": [],
+                     "node": nodes}
+            with pytest.raises(NotImplementedError):
+                load_onnx(_model(graph))
+
+    def test_lrn(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 4, 5, 5).astype(np.float32)
+        got = self._run([{"op_type": ["LRN"], "input": ["x"],
+                          "output": ["y"],
+                          "attribute": [_attr_int("size", 3),
+                                        _attr_float("alpha", 1e-3),
+                                        _attr_float("beta", 0.75),
+                                        _attr_float("bias", 1.0)]}],
+                        x, [4, 5, 5], [4, 5, 5])
+        assert got.shape == (2, 4, 5, 5)
+        # LRN divides by >1 denominators → output strictly smaller
+        assert (np.abs(got) <= np.abs(x) + 1e-6).all()
