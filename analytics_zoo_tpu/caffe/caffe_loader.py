@@ -213,6 +213,74 @@ class _CaffeGraphBuilder:
                         use_bias=bias_term and len(blobs) > 1)
         return _with_weights(dense, params)(x)
 
+    def _slice(self, layer: Dict):
+        """caffe Slice: cut `bottom` along slice_param.axis at slice_point
+        boundaries (or evenly among tops when absent); one top per part."""
+        p = (layer.get("slice_param", [{}]) or [{}])[0]
+        axis = int(_first(p, "axis", 1))
+        tops = layer.get("top", [])
+        in_shape = self.shapes.get(layer["bottom"][0]) or ()
+        if axis < 0:
+            axis += len(in_shape) + 1     # shapes exclude batch
+        if axis < 1:
+            raise NotImplementedError(
+                "Slice along the batch dimension")
+        size = in_shape[axis - 1]
+        points = [int(v) for v in p.get("slice_point", [])]
+        if not points:
+            if size is None or size % len(tops):
+                raise NotImplementedError(
+                    "Slice without slice_point needs an evenly divisible "
+                    "axis")
+            step = size // len(tops)
+            points = [step * i for i in range(1, len(tops))]
+        bounds = [0] + points + [size]
+        src = self._in(layer)
+        for i, t in enumerate(tops):
+            lo, hi = bounds[i], bounds[i + 1]
+
+            def cut(x, lo=lo, hi=hi, ax=axis):
+                sl = [slice(None)] * x.ndim
+                sl[ax] = slice(lo, hi)
+                return x[tuple(sl)]
+            node = LambdaLayer(cut)(src)
+            self.nodes[t] = node
+            shp = list(in_shape)
+            shp[axis - 1] = hi - lo
+            self.shapes[t] = tuple(shp)
+
+    def _deconv(self, layer: Dict, name: str):
+        p = (layer.get("convolution_param") or [{}])[0]
+        num_out = int(_first(p, "num_output"))
+        kh = int(_first(p, "kernel_h", _first(p, "kernel_size", 1)))
+        kw = int(_first(p, "kernel_w", _first(p, "kernel_size", 1)))
+        sh = int(_first(p, "stride_h", _first(p, "stride", 1)))
+        sw = int(_first(p, "stride_w", _first(p, "stride", 1)))
+        ph = int(_first(p, "pad_h", _first(p, "pad", 0)))
+        pw = int(_first(p, "pad_w", _first(p, "pad", 0)))
+        if int(_first(p, "group", 1)) != 1:
+            raise NotImplementedError("Grouped Deconvolution")
+        if int(_first(p, "dilation", 1)) != 1:
+            raise NotImplementedError("Dilated Deconvolution")
+        bias_term = str(_first(p, "bias_term", "true")).lower() != "false"
+        blobs = self.weights.get(name, [])
+        if not blobs:
+            raise ValueError(f"No weights for Deconvolution {name!r}")
+        w = blobs[0]                                   # [I, O, kh, kw]
+        use_bias = bias_term and len(blobs) > 1
+        deconv = L.Deconvolution2D(num_out, kh, kw, subsample=(sh, sw),
+                                   border_mode="valid", dim_ordering="th",
+                                   use_bias=use_bias)
+        params = {"kernel": np.transpose(w, (2, 3, 0, 1)).copy()}  # HWIO
+        if use_bias:
+            params["bias"] = blobs[1]
+        node = _with_weights(deconv, params)(self._in(layer))
+        if ph or pw:
+            # caffe crops `pad` from each side of the full deconv output
+            node = L.Cropping2D(((ph, ph), (pw, pw)),
+                                dim_ordering="th")(node)
+        return node
+
     def _pool(self, layer: Dict, shape):
         p = (layer.get("pooling_param", [{}]) or [{}])[0]
         mode = str(_first(p, "pool", "MAX")).upper()
@@ -362,6 +430,93 @@ class _CaffeGraphBuilder:
             node = self._eltwise(layer)
         elif ltype == "Flatten":
             node = L.Flatten()(self._in(layer))
+        elif ltype == "PReLU":
+            blobs = self.weights.get(str(_first(layer, "name")), [])
+            prelu = L.PReLU()
+            if blobs:
+                # caffe blob is per-channel (C,); the layer's alphas carry
+                # the full non-batch shape (C,H,W) — broadcast up
+                in_shape = self.shapes.get(layer["bottom"][0])
+                alpha = blobs[0].reshape(-1)
+                full = np.broadcast_to(
+                    alpha.reshape((-1,) + (1,) * (len(in_shape) - 1)),
+                    in_shape).copy()
+                prelu = _with_weights(prelu, {"alpha": full})
+            node = prelu(self._in(layer))
+        elif ltype == "ELU":
+            p = (layer.get("elu_param", [{}]) or [{}])[0]
+            node = L.ELU(float(_first(p, "alpha", 1.0)))(self._in(layer))
+        elif ltype == "AbsVal":
+            node = L.Abs()(self._in(layer))
+        elif ltype == "Power":
+            # caffe: y = (shift + scale * x) ^ power
+            p = (layer.get("power_param", [{}]) or [{}])[0]
+            power = float(_first(p, "power", 1.0))
+            scale = float(_first(p, "scale", 1.0))
+            shift = float(_first(p, "shift", 0.0))
+            node = LambdaLayer(
+                lambda x, pw=power, sc=scale, sh=shift:
+                (sh + sc * x) ** pw)(self._in(layer))
+        elif ltype == "Exp":
+            # y = base ^ (shift + scale * x); base -1 means e
+            p = (layer.get("exp_param", [{}]) or [{}])[0]
+            base = float(_first(p, "base", -1.0))
+            scale = float(_first(p, "scale", 1.0))
+            shift = float(_first(p, "shift", 0.0))
+            import jax.numpy as jnp
+            node = LambdaLayer(
+                lambda x, b=base, sc=scale, sh=shift:
+                jnp.exp(sh + sc * x) if b == -1.0
+                else b ** (sh + sc * x))(self._in(layer))
+        elif ltype == "Log":
+            # y = log_base(shift + scale * x)
+            p = (layer.get("log_param", [{}]) or [{}])[0]
+            base = float(_first(p, "base", -1.0))
+            scale = float(_first(p, "scale", 1.0))
+            shift = float(_first(p, "shift", 0.0))
+            import jax.numpy as jnp
+            denom = 1.0 if base == -1.0 else float(np.log(base))
+            node = LambdaLayer(
+                lambda x, d=denom, sc=scale, sh=shift:
+                jnp.log(sh + sc * x) / d)(self._in(layer))
+        elif ltype == "Reshape":
+            p = (layer.get("reshape_param", [{}]) or [{}])[0]
+            shape_blk = (p.get("shape") or [{}])[0]
+            dims = [int(d) for d in shape_blk.get("dim", [])]
+            # caffe: 0 copies the input dim, -1 infers; dim[0] is batch
+            in_shape = self.shapes.get(layer["bottom"][0]) or ()
+            target = []
+            for i, d in enumerate(dims[1:]):
+                if d == 0:
+                    if i >= len(in_shape):
+                        raise NotImplementedError(
+                            "Reshape 0-dim beyond input rank")
+                    target.append(int(in_shape[i]))
+                else:
+                    target.append(d)
+            node = L.Reshape(tuple(target))(self._in(layer))
+        elif ltype == "Permute":
+            p = (layer.get("permute_param", [{}]) or [{}])[0]
+            order = [int(d) for d in p.get("order", [])]
+            if order and order[0] != 0:
+                raise NotImplementedError(
+                    "Permute moving the batch dimension")
+            # caffe fills unspecified axes in natural order
+            rank = len(self.shapes.get(layer["bottom"][0]) or ()) + 1
+            full = order + [a for a in range(rank) if a not in order]
+            node = L.Permute(tuple(full[1:]))(self._in(layer))
+        elif ltype == "Split":
+            # identity fan-out: every top aliases the bottom
+            src = self._in(layer)
+            for t in layer.get("top", []):
+                self.nodes[t] = src
+                self.shapes[t] = self.shapes.get(layer["bottom"][0])
+            return
+        elif ltype == "Slice":
+            self._slice(layer)
+            return
+        elif ltype == "Deconvolution":
+            node = self._deconv(layer, str(_first(layer, "name")))
         else:
             raise NotImplementedError(
                 f"Caffe layer type {ltype!r} is not supported")

@@ -108,6 +108,17 @@ class _OnnxGraphBuilder:
         self.inputs = []
 
     # -- helpers -----------------------------------------------------------
+    def _node(self, name: str, op: str):
+        """Resolve a runtime-tensor input; constants get a clear error
+        (ops that can fold constants do so before calling this)."""
+        if name in self.nodes:
+            return self.nodes[name]
+        if name in self.consts:
+            raise NotImplementedError(
+                f"ONNX {op} over a constant input is not supported "
+                "(no constant folding for this op)")
+        raise ValueError(f"Unknown tensor {name!r} feeding {op}")
+
     def _pool(self, node, attrs, cls):
         k = attrs.get("kernel_shape", [2, 2])
         strides = attrs.get("strides", [1] * len(k))  # ONNX default is 1
@@ -242,18 +253,23 @@ class _OnnxGraphBuilder:
         elif op == "Pad":
             self.nodes[out_name] = self._pad(node, attrs)
         elif op in ("Abs", "Exp", "Log", "Sqrt", "Neg"):
+            src = node["input"][0]
+            if src in self.consts:      # weight-prep chains: fold
+                npfn = {"Abs": np.abs, "Exp": np.exp, "Log": np.log,
+                        "Sqrt": np.sqrt, "Neg": np.negative}[op]
+                self.consts[out_name] = npfn(self.consts[src])
+                return
             import jax.numpy as jnp
             fn = {"Abs": jnp.abs, "Exp": jnp.exp, "Log": jnp.log,
                   "Sqrt": jnp.sqrt, "Neg": jnp.negative}[op]
-            self.nodes[out_name] = LambdaLayer(fn)(
-                self.nodes[node["input"][0]])
+            self.nodes[out_name] = LambdaLayer(fn)(self._node(src, op))
         elif op == "HardSigmoid":
             import jax.numpy as jnp
             alpha = float(attrs.get("alpha", 0.2))
             beta = float(attrs.get("beta", 0.5))
             self.nodes[out_name] = LambdaLayer(
                 lambda x, a=alpha, b=beta: jnp.clip(a * x + b, 0.0, 1.0))(
-                self.nodes[node["input"][0]])
+                self._node(node["input"][0], op))
         elif op == "Clip":
             self.nodes[out_name] = self._clip(node, attrs)
         elif op == "Pow":
@@ -281,21 +297,31 @@ class _OnnxGraphBuilder:
                 beta=float(attrs.get("beta", 0.75)),
                 k=float(attrs.get("bias", 1.0)),
                 n=int(attrs.get("size", 5)), dim_ordering="th")(
-                self.nodes[node["input"][0]])
+                self._node(node["input"][0], op))
         elif op in ("ReduceMean", "ReduceSum"):
             self.nodes[out_name] = self._reduce(node, attrs, op)
         elif op == "Shape":
-            self.nodes[out_name] = L.GetShape()(
-                self.nodes[node["input"][0]])
+            src = node["input"][0]
+            if src in self.consts:
+                self.consts[out_name] = np.asarray(
+                    self.consts[src].shape, np.int64)
+                return
+            self.nodes[out_name] = L.GetShape()(self._node(src, op))
         elif op == "Slice":
             self.nodes[out_name] = self._slice(node, attrs)
         elif op == "Transpose":
             perm = attrs.get("perm")
+            src = node["input"][0]
+            if src in self.consts:      # weight pre-transpose: fold
+                c = self.consts[src]
+                self.consts[out_name] = np.transpose(
+                    c, tuple(int(i) for i in perm)
+                    if perm is not None else None)
+                return
             self.nodes[out_name] = LambdaLayer(
                 lambda x, p=perm: x.transpose(
                     tuple(int(i) for i in p) if p is not None
-                    else tuple(range(x.ndim))[::-1]))(
-                self.nodes[node["input"][0]])
+                    else tuple(range(x.ndim))[::-1]))(self._node(src, op))
         else:
             raise NotImplementedError(
                 f"ONNX op {op!r} is not supported by the importer")
@@ -319,7 +345,7 @@ class _OnnxGraphBuilder:
         return LambdaLayer(
             lambda x, lo=lo, hi=hi: jnp.clip(
                 x, -np.inf if lo is None else lo,
-                np.inf if hi is None else hi))(self.nodes[ins[0]])
+                np.inf if hi is None else hi))(self._node(ins[0], "Clip"))
 
     def _pow(self, node):
         a, b = node["input"][:2]
@@ -344,7 +370,9 @@ class _OnnxGraphBuilder:
             return None
         if data in self.consts and indices in self.nodes:
             # embedding-style: const table gathered by a runtime tensor
-            table = self.consts[data].astype(np.float32)
+            # (keep the table dtype — int64 id tables must not round-trip
+            # through float32)
+            table = self.consts[data]
             return LambdaLayer(
                 lambda idx, t=table, ax=axis: jnp.take(
                     t, idx.astype(jnp.int32), axis=ax))(
@@ -380,7 +408,7 @@ class _OnnxGraphBuilder:
         fn = jnp.mean if op == "ReduceMean" else jnp.sum
         return LambdaLayer(
             lambda x, ax=axes, k=keep: fn(x, axis=ax, keepdims=k))(
-            self.nodes[node["input"][0]])
+            self._node(node["input"][0], op))
 
     def _slice(self, node, attrs):
         ins = node["input"]
@@ -413,7 +441,7 @@ class _OnnxGraphBuilder:
             for s, e, a, st in zip(starts, ends, axes, steps):
                 sl[a] = slice(s, None if e >= 2**31 - 1 else e, st)
             return x[tuple(sl)]
-        return LambdaLayer(do_slice)(self.nodes[ins[0]])
+        return LambdaLayer(do_slice)(self._node(ins[0], "Slice"))
 
     def _conv(self, node, attrs):
         w = self.consts[node["input"][1]]          # OIHW

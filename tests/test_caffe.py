@@ -293,3 +293,100 @@ class TestCaffeImport:
         def_p, model_p = _write(tmp_path, prototxt, {})
         with pytest.raises(NotImplementedError, match="WarpCtc"):
             load_caffe(def_p, model_p)
+
+
+class TestCaffeLayerTail:
+    """Round-2 layer coverage: PReLU, ELU, AbsVal, Power, Exp, Log,
+    Reshape, Permute, Split, Slice, Deconvolution (the
+    `LayerConverter.scala` breadth beyond the core set)."""
+
+    def _import(self, tmp_path, body, blobs=None, in_shape=(2, 4, 4)):
+        dims = " ".join(f"dim: {d}" for d in (1,) + in_shape)
+        prototxt = f'''
+        name: "tail"
+        layer {{
+          name: "data" type: "Input" top: "data"
+          input_param {{ shape {{ {dims} }} }}
+        }}
+        {body}
+        '''
+        proto, model = _write(tmp_path, prototxt, blobs or {})
+        return load_caffe(proto, model)
+
+    def test_prelu_per_channel(self, tmp_path):
+        alpha = np.asarray([0.1, 0.5], np.float32)
+        net = self._import(tmp_path, '''
+        layer { name: "pr" type: "PReLU" bottom: "data" top: "pr" }
+        ''', {"pr": [alpha]}, in_shape=(2, 3, 3))
+        x = -np.ones((1, 2, 3, 3), np.float32)
+        got = np.asarray(net.predict(x, batch_per_thread=1))
+        np.testing.assert_allclose(got[0, 0], -0.1, rtol=1e-6)
+        np.testing.assert_allclose(got[0, 1], -0.5, rtol=1e-6)
+
+    def test_power_exp_log_abs_elu(self, tmp_path):
+        net = self._import(tmp_path, '''
+        layer { name: "pw" type: "Power" bottom: "data" top: "pw"
+                power_param { power: 2.0 scale: 3.0 shift: 1.0 } }
+        ''', in_shape=(2, 2, 2))
+        x = np.full((1, 2, 2, 2), 0.5, np.float32)
+        got = np.asarray(net.predict(x, batch_per_thread=1))
+        np.testing.assert_allclose(got, (1 + 3 * x) ** 2, rtol=1e-5)
+
+        net = self._import(tmp_path, '''
+        layer { name: "e" type: "Exp" bottom: "data" top: "e"
+                exp_param { scale: 2.0 } }
+        layer { name: "l" type: "Log" bottom: "e" top: "l" }
+        layer { name: "a" type: "AbsVal" bottom: "l" top: "a" }
+        layer { name: "el" type: "ELU" bottom: "a" top: "el"
+                elu_param { alpha: 0.5 } }
+        ''', in_shape=(2, 2, 2))
+        got = np.asarray(net.predict(x, batch_per_thread=1))
+        np.testing.assert_allclose(got, np.abs(2 * x), rtol=1e-5)
+
+    def test_reshape_permute(self, tmp_path):
+        net = self._import(tmp_path, '''
+        layer { name: "r" type: "Reshape" bottom: "data" top: "r"
+                reshape_param { shape { dim: 0 dim: 0 dim: -1 } } }
+        layer { name: "p" type: "Permute" bottom: "r" top: "p"
+                permute_param { order: 0 order: 2 order: 1 } }
+        ''', in_shape=(2, 3, 4))
+        x = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+        got = np.asarray(net.predict(x, batch_per_thread=1))
+        np.testing.assert_allclose(got, x.reshape(1, 2, 12)
+                                   .transpose(0, 2, 1))
+
+    def test_split_and_slice(self, tmp_path):
+        net = self._import(tmp_path, '''
+        layer { name: "sl" type: "Slice" bottom: "data"
+                top: "s1" top: "s2"
+                slice_param { axis: 1 slice_point: 1 } }
+        layer { name: "e1" type: "ReLU" bottom: "s1" top: "r1" }
+        layer { name: "e2" type: "ReLU" bottom: "s2" top: "r2" }
+        ''', in_shape=(3, 2, 2))
+        x = np.random.RandomState(0).randn(1, 3, 2, 2).astype(np.float32)
+        got = net.predict(x, batch_per_thread=1)
+        g1, g2 = [np.asarray(g) for g in got]
+        np.testing.assert_allclose(g1, np.maximum(x[:, :1], 0), rtol=1e-6)
+        np.testing.assert_allclose(g2, np.maximum(x[:, 1:], 0), rtol=1e-6)
+
+    def test_deconvolution_matches_scipy_upsample(self, tmp_path):
+        rs = np.random.RandomState(0)
+        w = rs.randn(2, 3, 2, 2).astype(np.float32)   # [I, O, kh, kw]
+        b = rs.randn(3).astype(np.float32)
+        net = self._import(tmp_path, '''
+        layer { name: "dc" type: "Deconvolution" bottom: "data" top: "dc"
+                convolution_param { num_output: 3 kernel_size: 2
+                                    stride: 2 } }
+        ''', {"dc": [w, b]}, in_shape=(2, 3, 3))
+        x = rs.randn(1, 2, 3, 3).astype(np.float32)
+        got = np.asarray(net.predict(x, batch_per_thread=1))
+        assert got.shape == (1, 3, 6, 6)              # (3-1)*2+2
+        # scatter semantics: each input pixel stamps w*x into the output
+        want = np.zeros((1, 3, 6, 6), np.float32)
+        for i in range(3):
+            for j in range(3):
+                for ci in range(2):
+                    want[0, :, 2*i:2*i+2, 2*j:2*j+2] += (
+                        w[ci] * x[0, ci, i, j])
+        want += b[None, :, None, None]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
