@@ -161,18 +161,30 @@ class _CaffeGraphBuilder:
         self.nodes[top] = inp
         self.shapes[top] = tuple(dims[1:])
 
+    @staticmethod
+    def _conv_params(p: Dict):
+        """Shared convolution_param extraction for Convolution and
+        Deconvolution (kernel/stride/pad h-w, group, dilation, bias)."""
+        return dict(
+            num_out=int(_first(p, "num_output")),
+            kh=int(_first(p, "kernel_h", _first(p, "kernel_size", 1))),
+            kw=int(_first(p, "kernel_w", _first(p, "kernel_size", 1))),
+            sh=int(_first(p, "stride_h", _first(p, "stride", 1))),
+            sw=int(_first(p, "stride_w", _first(p, "stride", 1))),
+            ph=int(_first(p, "pad_h", _first(p, "pad", 0))),
+            pw=int(_first(p, "pad_w", _first(p, "pad", 0))),
+            group=int(_first(p, "group", 1)),
+            dilation=int(_first(p, "dilation", 1)),
+            bias_term=str(_first(p, "bias_term",
+                                 "true")).lower() != "false")
+
     def _conv(self, layer: Dict, name: str):
         p = (layer.get("convolution_param") or [{}])[0]
-        num_out = int(_first(p, "num_output"))
-        kh = int(_first(p, "kernel_h", _first(p, "kernel_size", 1)))
-        kw = int(_first(p, "kernel_w", _first(p, "kernel_size", 1)))
-        sh = int(_first(p, "stride_h", _first(p, "stride", 1)))
-        sw = int(_first(p, "stride_w", _first(p, "stride", 1)))
-        ph = int(_first(p, "pad_h", _first(p, "pad", 0)))
-        pw = int(_first(p, "pad_w", _first(p, "pad", 0)))
-        group = int(_first(p, "group", 1))
-        dilation = int(_first(p, "dilation", 1))
-        bias_term = str(_first(p, "bias_term", "true")).lower() != "false"
+        cp = self._conv_params(p)
+        num_out, kh, kw = cp["num_out"], cp["kh"], cp["kw"]
+        sh, sw, ph, pw = cp["sh"], cp["sw"], cp["ph"], cp["pw"]
+        group, dilation = cp["group"], cp["dilation"]
+        bias_term = cp["bias_term"]
         x = self._in(layer)
         if ph or pw:
             x = L.ZeroPadding2D((ph, pw), dim_ordering="th")(x)
@@ -251,18 +263,14 @@ class _CaffeGraphBuilder:
 
     def _deconv(self, layer: Dict, name: str):
         p = (layer.get("convolution_param") or [{}])[0]
-        num_out = int(_first(p, "num_output"))
-        kh = int(_first(p, "kernel_h", _first(p, "kernel_size", 1)))
-        kw = int(_first(p, "kernel_w", _first(p, "kernel_size", 1)))
-        sh = int(_first(p, "stride_h", _first(p, "stride", 1)))
-        sw = int(_first(p, "stride_w", _first(p, "stride", 1)))
-        ph = int(_first(p, "pad_h", _first(p, "pad", 0)))
-        pw = int(_first(p, "pad_w", _first(p, "pad", 0)))
-        if int(_first(p, "group", 1)) != 1:
+        cp = self._conv_params(p)
+        num_out, kh, kw = cp["num_out"], cp["kh"], cp["kw"]
+        sh, sw, ph, pw = cp["sh"], cp["sw"], cp["ph"], cp["pw"]
+        if cp["group"] != 1:
             raise NotImplementedError("Grouped Deconvolution")
-        if int(_first(p, "dilation", 1)) != 1:
+        if cp["dilation"] != 1:
             raise NotImplementedError("Dilated Deconvolution")
-        bias_term = str(_first(p, "bias_term", "true")).lower() != "false"
+        bias_term = cp["bias_term"]
         blobs = self.weights.get(name, [])
         if not blobs:
             raise ValueError(f"No weights for Deconvolution {name!r}")
@@ -481,6 +489,10 @@ class _CaffeGraphBuilder:
                 jnp.log(sh + sc * x) / d)(self._in(layer))
         elif ltype == "Reshape":
             p = (layer.get("reshape_param", [{}]) or [{}])[0]
+            if int(_first(p, "axis", 0)) != 0 \
+                    or int(_first(p, "num_axes", -1)) != -1:
+                raise NotImplementedError(
+                    "Reshape with axis/num_axes is not supported")
             shape_blk = (p.get("shape") or [{}])[0]
             dims = [int(d) for d in shape_blk.get("dim", [])]
             # caffe: 0 copies the input dim, -1 infers; dim[0] is batch

@@ -1,0 +1,139 @@
+"""Image-classification tooling: named model configs + label maps around
+`ImageClassifier` (the reference's
+`models/image/imageclassification/ImageClassificationConfig.scala` +
+`LabelReader.scala` role).
+
+As with detection, this environment has no egress: named configs resolve
+architecture + preprocess + label map, weights come from local files
+(`model.save_weights`) or initialize randomly for fine-tuning.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.models.image import ImageClassifier
+
+CIFAR10_CLASSES: Tuple[str, ...] = (
+    "airplane", "automobile", "bird", "cat", "deer", "dog", "frog",
+    "horse", "ship", "truck")
+
+MNIST_CLASSES: Tuple[str, ...] = tuple(str(d) for d in range(10))
+
+
+def classification_label_reader(dataset: str,
+                                path: Optional[str] = None
+                                ) -> Dict[int, str]:
+    """`LabelReader.readImagenetlLabelMap` shape: {index: name}. Built-ins
+    cover cifar10/mnist; "imagenet" (1000 names) and custom maps load from
+    a one-name-per-line file, the reference's resource-file format."""
+    key = dataset.lower()
+    if key == "cifar10":
+        return dict(enumerate(CIFAR10_CLASSES))
+    if key == "mnist":
+        return dict(enumerate(MNIST_CLASSES))
+    if key in ("imagenet", "file"):
+        if not path:
+            raise ValueError(
+                f"label dataset {dataset!r} needs a names file (one class "
+                "name per line, line order = class index)")
+        with open(path) as fh:
+            return dict(enumerate(ln.strip() for ln in fh if ln.strip()))
+    raise ValueError(
+        f"Unknown label dataset {dataset!r}: cifar10, mnist, imagenet "
+        "(with path), or file (with path)")
+
+
+@dataclass
+class ClassificationConfig:
+    depth: int
+    input_size: int
+    class_num: int
+    dataset: str
+    # ImageNet-style preprocess: resize shorter side, center crop,
+    # per-channel mean/std (RGB, 0-255 domain)
+    resize: int = 256
+    mean_rgb: Tuple[float, float, float] = (123.68, 116.78, 103.94)
+    std_rgb: Tuple[float, float, float] = (58.4, 57.12, 57.38)
+
+
+CLASSIFICATION_MODELS: Dict[str, ClassificationConfig] = {
+    "resnet-18-imagenet": ClassificationConfig(18, 224, 1000, "imagenet"),
+    "resnet-50-imagenet": ClassificationConfig(50, 224, 1000, "imagenet"),
+    "resnet-18-cifar10": ClassificationConfig(
+        18, 32, 10, "cifar10", resize=32,
+        mean_rgb=(125.3, 123.0, 113.9), std_rgb=(63.0, 62.1, 66.7)),
+}
+
+
+class ConfiguredClassifier:
+    """Classifier bound to its config: preprocess → predict → top-N with
+    names (the `ImageConfigure` composition for classification)."""
+
+    def __init__(self, classifier: ImageClassifier,
+                 config: ClassificationConfig, name: str):
+        self.classifier = classifier
+        self.config = config
+        self.name = name
+
+    def preprocess(self, images) -> np.ndarray:
+        import cv2
+        cfg = self.config
+        if isinstance(images, np.ndarray) and images.ndim == 3:
+            images = [images]
+        out = []
+        for img in images:
+            img = np.asarray(img).astype(np.float32)
+            h, w = img.shape[:2]
+            # resize shorter side to cfg.resize, then center-crop square
+            if min(h, w) != cfg.resize:
+                scale = cfg.resize / min(h, w)
+                img = cv2.resize(img, (max(cfg.input_size,
+                                           int(round(w * scale))),
+                                       max(cfg.input_size,
+                                           int(round(h * scale)))))
+            h, w = img.shape[:2]
+            y0 = (h - cfg.input_size) // 2
+            x0 = (w - cfg.input_size) // 2
+            img = img[y0:y0 + cfg.input_size, x0:x0 + cfg.input_size]
+            img = (img - np.asarray(cfg.mean_rgb, np.float32)) \
+                / np.asarray(cfg.std_rgb, np.float32)
+            out.append(img)
+        return np.stack(out)
+
+    def predict_top_n(self, images, top_n: int = 5,
+                      batch_per_thread: int = 8):
+        probs = self.classifier.predict(self.preprocess(images),
+                                        batch_per_thread=batch_per_thread)
+        return self.classifier.top_n(probs, top_n)
+
+
+def load_image_classifier(model_name: str,
+                          weights_path: Optional[str] = None,
+                          label_path: Optional[str] = None
+                          ) -> ConfiguredClassifier:
+    """`ImageClassifier.loadModel(name)` shape: named config → architecture
+    + label map (+ local weights when given)."""
+    if model_name not in CLASSIFICATION_MODELS:
+        raise ValueError(
+            f"Unknown classification model {model_name!r}; available: "
+            f"{sorted(CLASSIFICATION_MODELS)}")
+    cfg = CLASSIFICATION_MODELS[model_name]
+    label_map = (classification_label_reader(cfg.dataset, label_path)
+                 if (cfg.dataset not in ("imagenet",) or label_path)
+                 else {})
+    clf = ImageClassifier(
+        depth=cfg.depth, class_num=cfg.class_num,
+        input_shape=(cfg.input_size, cfg.input_size, 3),
+        label_map=label_map)
+    if weights_path:
+        clf.model.load_weights(weights_path)
+    else:
+        import jax
+        clf.model.ensure_built(
+            np.zeros((1, cfg.input_size, cfg.input_size, 3), np.float32),
+            jax.random.PRNGKey(0))
+    return ConfiguredClassifier(clf, cfg, model_name)

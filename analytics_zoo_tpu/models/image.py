@@ -100,14 +100,19 @@ class ImageClassifier(ZooModel):
                                        for k, v in self.label_map.items()})
         self.model = resnet(depth, class_num, input_shape)
 
+    def top_n(self, probs, top_n: int = 5) -> List[List]:
+        """Per-row top-N (label, prob) via the label map — shared by
+        predict_image_set and the classification_zoo config path."""
+        out = []
+        for p in np.asarray(probs):
+            top = np.argsort(-p)[:top_n]
+            out.append([(self.label_map.get(int(i), int(i)), float(p[i]))
+                        for i in top])
+        return out
+
     def predict_image_set(self, image_set, top_n: int = 5,
                           batch_per_thread: int = 8) -> List[List]:
         """Classify an ImageSet; returns per-image top-N (label, prob)."""
         x = np.stack(image_set.images).astype(np.float32)
         probs = self.predict(x, batch_per_thread=batch_per_thread)
-        out = []
-        for p in probs:
-            top = np.argsort(-p)[:top_n]
-            out.append([(self.label_map.get(int(i), int(i)), float(p[i]))
-                        for i in top])
-        return out
+        return self.top_n(probs, top_n)

@@ -123,7 +123,7 @@ class _OnnxGraphBuilder:
         k = attrs.get("kernel_shape", [2, 2])
         strides = attrs.get("strides", [1] * len(k))  # ONNX default is 1
         pads = attrs.get("pads", [0] * 4)
-        x = self.nodes[node["input"][0]]
+        x = self._node(node["input"][0], "Pool")
         if any(pads):
             (pt, pb), (pl, pr) = _sym_pads(pads, 2)
             pad_cfg = ((0, 0), (0, 0), (pt, pb), (pl, pr))
@@ -162,25 +162,33 @@ class _OnnxGraphBuilder:
                  "LogSoftmax": lambda: L.Activation("log_softmax"),
                  "LeakyRelu": lambda: L.LeakyReLU(kw.get("alpha", 0.01)),
                  "Elu": lambda: L.ELU(kw.get("alpha", 1.0))}[fn_name]()
-        return layer(self.nodes[node["input"][0]])
+        return layer(self._node(node["input"][0], fn_name))
 
     def _binop(self, node, op):
         a_name, b_name = node["input"][:2]
+        if a_name in self.consts and b_name in self.consts:
+            # fold (weight-prep chains, e.g. decomposed-BatchNorm
+            # Add(var, eps) → Sqrt → Div)
+            fns = {"Add": np.add, "Sub": np.subtract,
+                   "Mul": np.multiply, "Div": np.divide}
+            self.consts[node["output"][0]] = fns[op](
+                self.consts[a_name], self.consts[b_name])
+            return None
         if b_name in self.consts and a_name in self.nodes:
             c = self.consts[b_name].astype(np.float32)
             fns = {"Add": lambda x: x + c, "Sub": lambda x: x - c,
                    "Mul": lambda x: x * c, "Div": lambda x: x / c}
-            return LambdaLayer(fns[op])(self.nodes[a_name])
+            return LambdaLayer(fns[op])(self._node(a_name, op))
         if a_name in self.consts and b_name in self.nodes:
             c = self.consts[a_name].astype(np.float32)
             fns = {"Add": lambda x: c + x, "Sub": lambda x: c - x,
                    "Mul": lambda x: c * x, "Div": lambda x: c / x}
-            return LambdaLayer(fns[op])(self.nodes[b_name])
+            return LambdaLayer(fns[op])(self._node(b_name, op))
         # tensor-tensor with numpy broadcasting semantics
         fns = {"Add": lambda a, b: a + b, "Sub": lambda a, b: a - b,
                "Mul": lambda a, b: a * b, "Div": lambda a, b: a / b}
-        return LambdaLayer(fns[op])([self.nodes[a_name],
-                                     self.nodes[b_name]])
+        return LambdaLayer(fns[op])([self._node(a_name, op),
+                                     self._node(b_name, op)])
 
     # -- op dispatch -------------------------------------------------------
     def handle(self, node: Dict):
@@ -206,7 +214,9 @@ class _OnnxGraphBuilder:
         elif op == "MatMul":
             self.nodes[out_name] = self._matmul(node)
         elif op in ("Add", "Sub", "Mul", "Div"):
-            self.nodes[out_name] = self._binop(node, op)
+            combined = self._binop(node, op)
+            if combined is not None:       # None → constant-folded
+                self.nodes[out_name] = combined
         elif op in ("Relu", "Sigmoid", "Tanh", "Softmax", "LogSoftmax"):
             self.nodes[out_name] = self._act(node, op)
         elif op in ("LeakyRelu", "Elu"):
@@ -220,33 +230,33 @@ class _OnnxGraphBuilder:
         elif op == "GlobalAveragePool":
             self.nodes[out_name] = LambdaLayer(
                 lambda x: x.mean(axis=(2, 3), keepdims=True))(
-                    self.nodes[node["input"][0]])
+                    self._node(node["input"][0], op))
         elif op == "GlobalMaxPool":
             self.nodes[out_name] = LambdaLayer(
                 lambda x: x.max(axis=(2, 3), keepdims=True))(
-                    self.nodes[node["input"][0]])
+                    self._node(node["input"][0], op))
         elif op == "BatchNormalization":
             self.nodes[out_name] = self._batchnorm(node, attrs)
         elif op == "Flatten":
             self.nodes[out_name] = L.Flatten()(
-                self.nodes[node["input"][0]])
+                self._node(node["input"][0], op))
         elif op == "Reshape":
             self.nodes[out_name] = self._reshape(node, attrs)
         elif op == "Concat":
             axis = int(attrs.get("axis", 1))
             self.nodes[out_name] = L.Merge(mode="concat", concat_axis=axis)(
-                [self.nodes[i] for i in node["input"]])
+                [self._node(i, op) for i in node["input"]])
         elif op == "Unsqueeze":
             axes = attrs.get("axes") or \
                 self.consts[node["input"][1]].reshape(-1).tolist()
-            node_out = self.nodes[node["input"][0]]
+            node_out = self._node(node["input"][0], op)
             for ax in sorted(int(a) for a in axes):   # ascending keeps
                 node_out = L.ExpandDim(ax)(node_out)  # later axes valid
             self.nodes[out_name] = node_out
         elif op == "Squeeze":
             axes = attrs.get("axes") or \
                 self.consts[node["input"][1]].reshape(-1).tolist()
-            node_out = self.nodes[node["input"][0]]
+            node_out = self._node(node["input"][0], op)
             for ax in sorted((int(a) for a in axes), reverse=True):
                 node_out = L.Squeeze(ax)(node_out)
             self.nodes[out_name] = node_out
@@ -451,7 +461,7 @@ class _OnnxGraphBuilder:
         strides = attrs.get("strides", [1, 1])
         dilations = attrs.get("dilations", [1, 1])
         pads = attrs.get("pads", [0, 0, 0, 0])
-        x = self.nodes[node["input"][0]]
+        x = self._node(node["input"][0], "Pool")
         if any(pads):
             (pt, pb), (pl, pr) = _sym_pads(pads, 2)
             x = _pad_lambda(((0, 0), (0, 0), (pt, pb), (pl, pr)))(x)

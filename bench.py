@@ -74,9 +74,11 @@ def main():
     init_orca_context(cluster_mode="local")
     dev = jax.devices()[0]
 
+    use_flash = os.environ.get("BENCH_FLASH") == "1"
     model = BERTClassifier(
         num_classes=2, vocab=vocab, hidden_size=hidden, n_block=n_block,
-        n_head=n_head, seq_len=seq_len, intermediate_size=inter)
+        n_head=n_head, seq_len=seq_len, intermediate_size=inter,
+        use_flash=use_flash)
     est = Estimator.from_keras(
         model, optimizer=optax.adamw(1e-4),
         loss=objectives.get("sparse_categorical_crossentropy",

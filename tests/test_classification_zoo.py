@@ -1,0 +1,68 @@
+"""Image-classification tooling tests (reference
+`ImageClassificationConfig.scala` / `LabelReader.scala`): named configs,
+label maps, preprocess geometry, save/load round-trip."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models import classification_zoo as cz
+
+
+class TestLabelReader:
+    def test_builtin_maps(self):
+        m = cz.classification_label_reader("cifar10")
+        assert len(m) == 10 and m[3] == "cat"
+        assert cz.classification_label_reader("mnist")[7] == "7"
+
+    def test_imagenet_needs_file(self, tmp_path):
+        with pytest.raises(ValueError, match="names file"):
+            cz.classification_label_reader("imagenet")
+        p = tmp_path / "names.txt"
+        p.write_text("tench\ngoldfish\n")
+        m = cz.classification_label_reader("imagenet", str(p))
+        assert m == {0: "tench", 1: "goldfish"}
+
+    def test_unknown(self):
+        with pytest.raises(ValueError, match="Unknown label dataset"):
+            cz.classification_label_reader("openimages")
+
+
+class TestConfiguredClassifier:
+    def test_load_cifar_config(self):
+        clf = cz.load_image_classifier("resnet-18-cifar10")
+        assert clf.config.input_size == 32
+        assert clf.classifier.label_map[0] == "airplane"
+
+    def test_unknown_model(self):
+        with pytest.raises(ValueError, match="Unknown classification"):
+            cz.load_image_classifier("vgg-19")
+
+    def test_preprocess_resize_center_crop(self):
+        clf = cz.load_image_classifier("resnet-18-cifar10")
+        img = np.random.RandomState(0).randint(
+            0, 255, size=(48, 64, 3)).astype(np.uint8)
+        batch = clf.preprocess(img)
+        assert batch.shape == (1, 32, 32, 3)
+        assert abs(float(batch.mean())) < 1.5  # normalized domain
+
+    def test_predict_top_n_names(self):
+        clf = cz.load_image_classifier("resnet-18-cifar10")
+        imgs = np.random.RandomState(1).randint(
+            0, 255, size=(2, 32, 32, 3)).astype(np.uint8)
+        tops = clf.predict_top_n(imgs, top_n=3, batch_per_thread=2)
+        assert len(tops) == 2 and len(tops[0]) == 3
+        for name, prob in tops[0]:
+            assert isinstance(name, str) and 0.0 <= prob <= 1.0
+
+    def test_weights_round_trip(self, tmp_path):
+        clf1 = cz.load_image_classifier("resnet-18-cifar10")
+        w = str(tmp_path / "w.npz")
+        clf1.classifier.model.save_weights(w)
+        clf2 = cz.load_image_classifier("resnet-18-cifar10",
+                                        weights_path=w)
+        img = np.random.RandomState(2).randint(
+            0, 255, size=(32, 32, 3)).astype(np.uint8)
+        x = clf1.preprocess(img)
+        p1 = np.asarray(clf1.classifier.predict(x, batch_per_thread=1))
+        p2 = np.asarray(clf2.classifier.predict(x, batch_per_thread=1))
+        np.testing.assert_allclose(p1, p2, rtol=1e-5)

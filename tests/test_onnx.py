@@ -605,6 +605,19 @@ class TestOnnxOpTail:
         got = np.asarray(model.apply(model.params, x))
         np.testing.assert_array_equal(got, [2, 3, 4])
 
+    def test_binop_const_fold_chain(self):
+        # decomposed-BatchNorm weight prep: Add(var, eps) → Sqrt → Div
+        var = np.asarray([4.0, 16.0], np.float32)
+        eps = np.asarray(0.0, np.float32)
+        got = self._run([
+            {"op_type": ["Add"], "input": ["var", "eps"], "output": ["ve"]},
+            {"op_type": ["Sqrt"], "input": ["ve"], "output": ["std"]},
+            {"op_type": ["Div"], "input": ["x", "std"], "output": ["y"]},
+        ], np.ones((2, 2), np.float32), [2], [2],
+            inits=[_tensor("var", var), _tensor("eps", eps)])
+        np.testing.assert_allclose(got, np.tile(1.0 / np.sqrt(var), (2, 1)),
+                                   rtol=1e-6)
+
     def test_gather_const_fold(self):
         table = np.arange(4, dtype=np.float32) * 10          # (4,)
         idx = np.asarray([1, 3], np.int64)
