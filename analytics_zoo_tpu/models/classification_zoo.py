@@ -80,28 +80,30 @@ class ConfiguredClassifier:
         self.name = name
 
     def preprocess(self, images) -> np.ndarray:
+        """Shorter-side resize + the shared ImageProcessing crop/normalize
+        transforms from `data/image.py` (one implementation of the
+        crop/normalize math across the pipeline and the zoo)."""
         import cv2
+
+        from analytics_zoo_tpu.data.image import (ImageCenterCrop,
+                                                  ImageChannelNormalize)
         cfg = self.config
+        crop = ImageCenterCrop(cfg.input_size, cfg.input_size)
+        norm = ImageChannelNormalize(*cfg.mean_rgb, *cfg.std_rgb)
         if isinstance(images, np.ndarray) and images.ndim == 3:
             images = [images]
         out = []
         for img in images:
             img = np.asarray(img).astype(np.float32)
             h, w = img.shape[:2]
-            # resize shorter side to cfg.resize, then center-crop square
+            # resize shorter side to cfg.resize (ImageResize is fixed WxH)
             if min(h, w) != cfg.resize:
                 scale = cfg.resize / min(h, w)
                 img = cv2.resize(img, (max(cfg.input_size,
                                            int(round(w * scale))),
                                        max(cfg.input_size,
                                            int(round(h * scale)))))
-            h, w = img.shape[:2]
-            y0 = (h - cfg.input_size) // 2
-            x0 = (w - cfg.input_size) // 2
-            img = img[y0:y0 + cfg.input_size, x0:x0 + cfg.input_size]
-            img = (img - np.asarray(cfg.mean_rgb, np.float32)) \
-                / np.asarray(cfg.std_rgb, np.float32)
-            out.append(img)
+            out.append(norm(crop(img)))
         return np.stack(out)
 
     def predict_top_n(self, images, top_n: int = 5,
@@ -113,18 +115,28 @@ class ConfiguredClassifier:
 
 def load_image_classifier(model_name: str,
                           weights_path: Optional[str] = None,
-                          label_path: Optional[str] = None
+                          label_path: Optional[str] = None,
+                          allow_missing_labels: bool = False
                           ) -> ConfiguredClassifier:
     """`ImageClassifier.loadModel(name)` shape: named config → architecture
-    + label map (+ local weights when given)."""
+    + label map (+ local weights when given). ImageNet-dataset configs
+    need a `label_path` names file (no egress to fetch one); pass
+    `allow_missing_labels=True` to skip the map (predictions then carry
+    integer class indices), e.g. for fine-tuning workflows."""
     if model_name not in CLASSIFICATION_MODELS:
         raise ValueError(
             f"Unknown classification model {model_name!r}; available: "
             f"{sorted(CLASSIFICATION_MODELS)}")
     cfg = CLASSIFICATION_MODELS[model_name]
-    label_map = (classification_label_reader(cfg.dataset, label_path)
-                 if (cfg.dataset not in ("imagenet",) or label_path)
-                 else {})
+    if cfg.dataset == "imagenet" and not label_path:
+        if not allow_missing_labels:
+            raise ValueError(
+                f"{model_name} needs a label_path names file (one class "
+                "name per line) — or pass allow_missing_labels=True to "
+                "predict integer class indices")
+        label_map: Dict[int, str] = {}
+    else:
+        label_map = classification_label_reader(cfg.dataset, label_path)
     clf = ImageClassifier(
         depth=cfg.depth, class_num=cfg.class_num,
         input_shape=(cfg.input_size, cfg.input_size, 3),

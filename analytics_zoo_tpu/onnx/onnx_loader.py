@@ -461,7 +461,7 @@ class _OnnxGraphBuilder:
         strides = attrs.get("strides", [1, 1])
         dilations = attrs.get("dilations", [1, 1])
         pads = attrs.get("pads", [0, 0, 0, 0])
-        x = self._node(node["input"][0], "Pool")
+        x = self._node(node["input"][0], "Conv")
         if any(pads):
             (pt, pb), (pl, pr) = _sym_pads(pads, 2)
             x = _pad_lambda(((0, 0), (0, 0), (pt, pb), (pl, pr)))(x)

@@ -1,0 +1,78 @@
+"""NCF (MovieLens-scale) training throughput through `Estimator.fit` — the
+other BASELINE workload (`BASELINE.json` configs[0]; reference
+`pyzoo/zoo/models/recommendation/neuralcf.py:30`, `apps/recommendation-ncf`).
+
+NCF is embedding-gather bound, so MFU is the wrong lens; the reference
+community metric is samples/sec. Prints ONE JSON line. `vs_baseline`
+compares against a 100k samples/sec/chip yardstick (no absolute CPU
+number exists in the reference tree — BASELINE.md; its MovieLens-100k
+KerasModel run processes ~10-40k samples/sec on the era's Xeon nodes).
+
+    python bench_ncf.py            # real chip
+    BENCH_TINY=1 python bench_ncf.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+
+if ("JAX_DEFAULT_PRNG_IMPL" not in os.environ
+        and jax.default_backend() == "tpu"):
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+import numpy as np
+
+
+def main():
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    if tiny:
+        users, items, n, batch, spr = 200, 100, 4096, 512, 4
+    else:
+        # MovieLens-20M scale: 138k users, 27k items
+        users, items = 138_000, 27_000
+        n = int(os.environ.get("BENCH_N", 1 << 20))
+        batch = int(os.environ.get("BENCH_BATCH", 8192))
+        spr = int(os.environ.get("BENCH_SPR", 16))
+
+    init_orca_context(cluster_mode="local")
+    ncf = NeuralCF(user_count=users, item_count=items, class_num=2,
+                   mf_embed=64, user_embed=64, item_embed=64,
+                   hidden_layers=(128, 64, 32))
+    est = Estimator.from_keras(ncf.model, optimizer="adam",
+                               loss="sparse_categorical_crossentropy")
+
+    rs = np.random.RandomState(0)
+    x = np.stack([rs.randint(1, users, n), rs.randint(1, items, n)],
+                 axis=1).astype(np.int32)
+    y = rs.randint(0, 2, n).astype(np.int32)
+    fit_kw = dict(epochs=1, batch_size=batch, steps_per_run=spr)
+
+    est.fit((x, y), **fit_kw)          # warmup: compile + first epoch
+    t0 = time.perf_counter()
+    hist = est.fit((x, y), **fit_kw)
+    dt = time.perf_counter() - t0
+    steps = n // batch
+    samples_s = steps * batch / dt
+
+    print(json.dumps({
+        "metric": "ncf_train_samples_per_sec_via_estimator_fit",
+        "value": round(samples_s, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_s / 100_000.0, 4),
+        "step_ms": round(dt / steps * 1e3, 3),
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "final_loss": float(hist["loss"][-1]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

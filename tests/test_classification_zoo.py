@@ -37,6 +37,13 @@ class TestConfiguredClassifier:
         with pytest.raises(ValueError, match="Unknown classification"):
             cz.load_image_classifier("vgg-19")
 
+    def test_imagenet_config_requires_labels_or_optout(self):
+        with pytest.raises(ValueError, match="label_path"):
+            cz.load_image_classifier("resnet-18-imagenet")
+        clf = cz.load_image_classifier("resnet-18-imagenet",
+                                       allow_missing_labels=True)
+        assert clf.classifier.label_map == {}
+
     def test_preprocess_resize_center_crop(self):
         clf = cz.load_image_classifier("resnet-18-cifar10")
         img = np.random.RandomState(0).randint(
