@@ -221,3 +221,68 @@ def maximum(inputs, name=None):
 
 def concatenate(inputs, axis=-1, name=None):
     return Concatenate(axis=axis, name=name)(inputs)
+
+
+# ---------------------------------------------------------------------------
+# Remaining keras2 inventory (the reference's full keras2 layer set is now
+# covered: Activation/Dropout/Flatten/Softmax, Cropping1D,
+# LocallyConnected1D, and the 1D/3D global pools)
+# ---------------------------------------------------------------------------
+class Activation(k1.Activation):
+    pass
+
+
+class Dropout(k1.Dropout):
+    def __init__(self, rate: float, **kw):
+        super().__init__(rate, **kw)
+
+
+class Flatten(k1.Flatten):
+    def __init__(self, data_format: Optional[str] = None, **kw):
+        if data_format not in (None, "channels_last", "channels_first"):
+            raise ValueError(f"Unsupported data_format: {data_format}")
+        # flatten output ordering is layout-dependent only through the
+        # producing layer's dim_ordering; the keras2 flag is accepted for
+        # signature parity
+        super().__init__(**kw)
+
+
+class Softmax(k1.Softmax):
+    pass
+
+
+class Cropping1D(k1.Cropping1D):
+    pass
+
+
+class LocallyConnected1D(k1.LocallyConnected1D):
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 padding: str = "valid", activation=None,
+                 use_bias: bool = True,
+                 kernel_initializer="glorot_uniform", **kw):
+        if padding != "valid":
+            raise ValueError(
+                "LocallyConnected1D only supports padding='valid'")
+        super().__init__(filters, kernel_size, activation=activation,
+                         subsample_length=strides, use_bias=use_bias,
+                         init=kernel_initializer, **kw)
+
+
+class GlobalMaxPooling1D(k1.GlobalMaxPooling1D):
+    pass
+
+
+class GlobalAveragePooling1D(k1.GlobalAveragePooling1D):
+    pass
+
+
+class GlobalMaxPooling3D(k1.GlobalMaxPooling3D):
+    def __init__(self, data_format: Optional[str] = None, **kw):
+        super().__init__(
+            dim_ordering=_data_format_to_ordering(data_format), **kw)
+
+
+class GlobalAveragePooling3D(k1.GlobalAveragePooling3D):
+    def __init__(self, data_format: Optional[str] = None, **kw):
+        super().__init__(
+            dim_ordering=_data_format_to_ordering(data_format), **kw)
