@@ -1065,10 +1065,14 @@ class Threshold(Layer):
 # Long-tail parity layers (`keras/layers/*.scala` remaining inventory)
 # ---------------------------------------------------------------------------
 class Softmax(Layer):
-    """Softmax as a layer (`Softmax.scala`), last axis."""
+    """Softmax as a layer (`Softmax.scala`); axis defaults to last."""
+
+    def __init__(self, axis: int = -1, **kw):
+        super().__init__(**kw)
+        self.axis = int(axis)
 
     def call(self, params, x, *, training=False, rng=None):
-        return jax.nn.softmax(x, axis=-1)
+        return jax.nn.softmax(x, axis=self.axis)
 
 
 class BinaryThreshold(Layer):

@@ -268,12 +268,23 @@ class LocallyConnected1D(k1.LocallyConnected1D):
                          init=kernel_initializer, **kw)
 
 
+def _check_1d_format(data_format: Optional[str]) -> None:
+    if data_format not in (None, "channels_last"):
+        raise ValueError(
+            "1D global pools are channels_last only "
+            f"(got data_format={data_format!r})")
+
+
 class GlobalMaxPooling1D(k1.GlobalMaxPooling1D):
-    pass
+    def __init__(self, data_format: Optional[str] = None, **kw):
+        _check_1d_format(data_format)
+        super().__init__(**kw)
 
 
 class GlobalAveragePooling1D(k1.GlobalAveragePooling1D):
-    pass
+    def __init__(self, data_format: Optional[str] = None, **kw):
+        _check_1d_format(data_format)
+        super().__init__(**kw)
 
 
 class GlobalMaxPooling3D(k1.GlobalMaxPooling3D):

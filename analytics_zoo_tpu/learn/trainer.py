@@ -85,12 +85,27 @@ def check_global_batch(batch_size: int, dp: int) -> None:
 
 def _put_batch(tree, mesh, stacked: bool = False):
     """mesh=None → single default device (non-distributed escape hatch).
-    stacked=True for (steps, batch, ...) multi-step stacks."""
+    stacked=True for (steps, batch, ...) multi-step stacks.
+
+    Multi-process (`jax.distributed`): each process passes its LOCAL batch
+    shard (the per-executor-partition contract of the reference) and the
+    global array is assembled across hosts — device_put cannot target
+    non-addressable devices."""
     if mesh is None:
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(jnp.asarray(a)), tree)
     sharding = mesh.stacked_batch_sharding() if stacked \
         else mesh.batch_sharding()
+    if jax.process_count() > 1:
+        batch_dim = 1 if stacked else 0
+
+        def put(a):
+            a = np.asarray(a)
+            gshape = list(a.shape)
+            gshape[batch_dim] *= jax.process_count()
+            return jax.make_array_from_process_local_data(
+                sharding, a, tuple(gshape))
+        return jax.tree_util.tree_map(put, tree)
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(jnp.asarray(a), sharding), tree)
 
@@ -199,6 +214,12 @@ def _put_replicated(tree, mesh):
     if mesh is None:
         return jax.tree_util.tree_map(lambda a: jax.device_put(a), tree)
     sharding = mesh.replicated()
+    if jax.process_count() > 1:
+        # every process holds the full value (same seed) → its local
+        # shard of a replicated array IS the full array
+        return jax.tree_util.tree_map(
+            lambda a: jax.make_array_from_process_local_data(
+                sharding, np.asarray(a), np.shape(a)), tree)
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(a, sharding), tree)
 
@@ -338,16 +359,46 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if steps_per_run < 1:
         raise ValueError(f"steps_per_run must be >=1, got {steps_per_run}")
 
+    # Multi-process: `batch_size` stays GLOBAL (the reference's total-core
+    # contract); each process feeds its LOCAL data shard, sliced at
+    # global/process_count per step and assembled across hosts by
+    # _put_batch.
+    n_proc = jax.process_count()
+    local_batch = batch_size
+    if n_proc > 1:
+        if batch_size % n_proc:
+            raise ValueError(
+                f"global batch_size ({batch_size}) must divide by the "
+                f"process count ({n_proc})")
+        if mesh is None or dp != jax.device_count():
+            # _put_batch's cross-host assembly assumes the batch (data ×
+            # fsdp) axes span every device; model axes crossing process
+            # boundaries would mis-assemble the global shape
+            raise NotImplementedError(
+                "Multi-process fit currently supports pure data-parallel "
+                "meshes (data×fsdp covering all devices); got "
+                f"dp={dp} of {jax.device_count()} devices")
+        if batch_iter_factory is not None:
+            # lazy/streaming datasets batch at the GLOBAL size per process
+            # and (worse) every process would stream the same records —
+            # silent sample duplication; shard files per host instead
+            raise NotImplementedError(
+                "Multi-process fit over streaming datasets "
+                "(TFRecord/FeatureSet) is not supported yet: every "
+                "process would feed the same records. Materialize a "
+                "per-host shard and pass arrays instead")
+        local_batch = batch_size // n_proc
+
     if batch_iter_factory is None:
         n = _tree_len(x)
-        if n < batch_size:
+        if n < local_batch:
             raise ValueError(
-                f"Dataset has {n} samples but global batch_size is "
-                f"{batch_size}; training batches are whole-batch only "
+                f"Dataset has {n} samples but the per-process batch is "
+                f"{local_batch}; training batches are whole-batch only "
                 "(static shapes). Lower batch_size or add data.")
 
         def batch_iter_factory(epoch):  # noqa: F811 — default factory
-            return iter_batches(x, y, batch_size, shuffle=shuffle,
+            return iter_batches(x, y, local_batch, shuffle=shuffle,
                                 seed=seed + epoch)
 
     rng = jax.random.PRNGKey(seed)
@@ -431,7 +482,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                   params, opt_state, loss = train_step(params, opt_state,
                                                        xb, yb, step_rng)
               iteration += k
-              n_seen += real
+              n_seen += real * n_proc       # local count × processes
               losses_dev.append(loss)
               # loss stays a device scalar: triggers that read .loss (Min/
               # MaxLoss) force their own sync; counter triggers stay async
@@ -508,8 +559,14 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
 def evaluate_keras(model, x, y=None, batch_per_thread: int = 32,
                    metrics=None) -> Dict[str, float]:
     ctx = get_context()
-    mesh = ctx.mesh
-    batch = batch_per_thread * mesh.data_parallel_size
+    # Multi-process: each rank evaluates ITS OWN data locally (the
+    # per-partition evaluation contract) — a cross-host eval batch would
+    # both duplicate every sample per rank and produce outputs on
+    # non-addressable devices.
+    mesh = ctx.mesh if jax.process_count() == 1 else None
+    dp_local = mesh.data_parallel_size if mesh \
+        else jax.local_device_count()
+    batch = batch_per_thread * dp_local
     model.ensure_built(next(iter_batches(x, y, batch,
                                          drop_remainder=False,
                                          pad_to_batch=True))[0])
@@ -567,8 +624,11 @@ def _forward_jit(model):
 
 def predict_keras(model, x, batch_per_thread: int = 32) -> np.ndarray:
     ctx = get_context()
-    mesh = ctx.mesh
-    batch = batch_per_thread * mesh.data_parallel_size
+    # see evaluate_keras: per-rank local prediction under multi-process
+    mesh = ctx.mesh if jax.process_count() == 1 else None
+    dp_local = mesh.data_parallel_size if mesh \
+        else jax.local_device_count()
+    batch = batch_per_thread * dp_local
     model.ensure_built(next(iter_batches(x, None, batch,
                                          drop_remainder=False,
                                          pad_to_batch=True))[0])

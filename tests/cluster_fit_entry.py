@@ -1,0 +1,44 @@
+"""Worker entry for the multi-process Estimator.fit test: every process
+holds its LOCAL data shard (the per-executor-partition contract), fit runs
+over the global 2-process × 2-device mesh, and each rank writes its loss
+history so the test can assert the ranks agree and match the
+single-process result."""
+
+import json
+import os
+
+import numpy as np
+
+
+def make_shard(rank: int, n_local: int = 64, dim: int = 4):
+    """Deterministic per-rank data: rank r holds rows seeded by r."""
+    rs = np.random.RandomState(100 + rank)
+    x = rs.randn(n_local, dim).astype(np.float32)
+    y = x.sum(1, keepdims=True).astype(np.float32)
+    return x, y
+
+
+def main(out_dir):
+    import jax
+
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.keras import Sequential
+    from analytics_zoo_tpu.keras import layers as L
+    from analytics_zoo_tpu.learn.estimator import Estimator
+
+    rank = jax.process_index()
+    zoo.init_orca_context(cluster_mode="local")
+
+    x, y = make_shard(rank)
+    model = Sequential([L.Dense(8, input_shape=(4,), activation="relu"),
+                        L.Dense(1)])
+    model.ensure_built(np.zeros((1, 4), np.float32),
+                       jax.random.PRNGKey(7))   # same init on every rank
+    from analytics_zoo_tpu.data.dataset import TPUDataset
+    est = Estimator.from_keras(model, optimizer="sgd", loss="mse")
+    ds = TPUDataset.from_ndarrays((x, y), batch_size=32, shuffle=False)
+    hist = est.fit(ds, epochs=3, seed=0, prefetch=False)
+
+    with open(os.path.join(out_dir, f"fit_rank{rank}.json"), "w") as fh:
+        json.dump({"loss": hist["loss"]}, fh)
+    return 0

@@ -594,6 +594,18 @@ class TestKeras2Complete:
         with pytest.raises(ValueError, match="valid"):
             K2.LocallyConnected1D(4, 3, padding="same")
 
+    def test_keras2_kwargs_accepted(self):
+        # standard keras2 kwargs must not TypeError
+        K2.GlobalMaxPooling1D(data_format="channels_last")
+        K2.Softmax(axis=1)
+        K2.LocallyConnected1D(4, 3, kernel_initializer="he_normal")
+        with pytest.raises(ValueError, match="channels_last"):
+            K2.GlobalAveragePooling1D(data_format="channels_first")
+        # Softmax axis actually honored
+        x = np.random.RandomState(0).rand(2, 3, 4).astype(np.float32)
+        y = np.asarray(K2.Softmax(axis=1).call({}, x))
+        np.testing.assert_allclose(y.sum(axis=1), 1.0, rtol=1e-5)
+
     def test_global_pool_3d_data_format(self):
         m = Sequential([K2.GlobalMaxPooling3D(
             data_format="channels_first", input_shape=(2, 4, 4, 4))])
