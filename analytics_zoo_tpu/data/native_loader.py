@@ -34,17 +34,45 @@ _lib_lock = threading.Lock()
 _build_failed = False
 
 
-def _build() -> Optional[str]:
-    if os.path.exists(_LIB_PATH) and \
-            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
-        return _LIB_PATH
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _LIB_PATH]
+def build_native_lib(src: str, lib_path: str) -> Optional[ctypes.CDLL]:
+    """Shared native-build contract for every on-demand C++ helper:
+    honors ZOO_DISABLE_NATIVE=1, rebuilds when the source is newer, and
+    recovers once from a stale/truncated .so (a killed build). Returns a
+    loaded CDLL or None (caller falls back to the python path)."""
+    if os.environ.get("ZOO_DISABLE_NATIVE") == "1":
+        return None
+
+    def compile_() -> Optional[str]:
+        if os.path.exists(lib_path) and \
+                os.path.getmtime(lib_path) >= os.path.getmtime(src):
+            return lib_path
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               src, "-o", lib_path]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            return lib_path
+        except (OSError, subprocess.SubprocessError) as e:
+            log.warning("native build of %s failed (%s); using python "
+                        "path", os.path.basename(src), e)
+            return None
+
+    path = compile_()
+    if path is None:
+        return None
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _LIB_PATH
-    except (OSError, subprocess.SubprocessError) as e:
-        log.warning("native loader build failed (%s); using python path", e)
+        return ctypes.CDLL(path)
+    except OSError:
+        # stale/truncated artifact (e.g. a killed build): rebuild once
+        try:
+            os.unlink(path)
+            path = compile_()
+            if path:
+                return ctypes.CDLL(path)
+        except OSError:
+            pass
+        log.warning("native .so %s unloadable; using python path",
+                    os.path.basename(lib_path))
         return None
 
 
@@ -53,28 +81,10 @@ def _get_lib():
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
-        if os.environ.get("ZOO_DISABLE_NATIVE") == "1":
+        lib = build_native_lib(_SRC, _LIB_PATH)
+        if lib is None:
             _build_failed = True
             return None
-        path = _build()
-        if path is None:
-            _build_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError:
-            # stale/truncated artifact (e.g. a killed build): rebuild once
-            try:
-                os.unlink(path)
-                path = _build()
-                lib = ctypes.CDLL(path) if path else None
-            except OSError:
-                lib = None
-            if lib is None:
-                log.warning("native loader .so unloadable; using python "
-                            "path")
-                _build_failed = True
-                return None
         lib.zoo_loader_create.restype = ctypes.c_void_p
         lib.zoo_loader_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,

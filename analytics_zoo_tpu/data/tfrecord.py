@@ -33,6 +33,85 @@ from analytics_zoo_tpu.onnx import wire
 
 from analytics_zoo_tpu.utils.crc import crc32c, masked_crc32c  # noqa: F401
 
+# ---------------------------------------------------------------------------
+# Native fast path (`native/tfrecord_scanner.cpp`): frame walk + CRC32C at
+# memory bandwidth; built on demand like the zoo_loader, python fallback
+# when no compiler is present.
+# ---------------------------------------------------------------------------
+import ctypes as _ctypes
+import logging as _logging
+import threading as _threading
+
+_log = _logging.getLogger("analytics_zoo_tpu.tfrecord")
+_NATIVE_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native",
+    "tfrecord_scanner.cpp")
+_NATIVE_LIB = os.path.join(os.path.dirname(_NATIVE_SRC),
+                           "_tfrecord_scanner.so")
+_native = None
+_native_lock = _threading.Lock()
+_native_failed = False
+
+
+def _native_lib():
+    """Build (once) and load the scanner via the shared native-build
+    contract (ZOO_DISABLE_NATIVE, stale-.so recovery); None → python
+    fallback."""
+    global _native, _native_failed
+    if _native is not None or _native_failed:
+        return _native
+    with _native_lock:
+        if _native is not None or _native_failed:
+            return _native
+        from analytics_zoo_tpu.data.native_loader import build_native_lib
+        lib = build_native_lib(_NATIVE_SRC, _NATIVE_LIB)
+        if lib is None:
+            _native_failed = True
+            return None
+        lib.tfr_scan.restype = _ctypes.c_long
+        lib.tfr_scan.argtypes = [
+            _ctypes.c_char_p, _ctypes.c_int,
+            _ctypes.POINTER(_ctypes.c_int64),
+            _ctypes.POINTER(_ctypes.c_int64), _ctypes.c_long]
+        lib.tfr_count.restype = _ctypes.c_long
+        lib.tfr_count.argtypes = [_ctypes.c_char_p]
+        _native = lib
+    return _native
+
+
+_NATIVE_ERRORS = {
+    -1: "cannot open/read",
+    -2: "truncated record",
+    -3: "corrupt record length CRC",
+    -5: "corrupt record payload CRC",
+}
+
+
+def _native_scan(path: str, verify_payload: bool):
+    """Native frame walk → (offsets, lengths) numpy arrays, or None when
+    the native path is unavailable."""
+    lib = _native_lib()
+    if lib is None:
+        return None
+    # count first (one header-only pass at memory bandwidth) so the
+    # offset/length arrays are exact — sizing by file_size/16 would
+    # allocate ~file-size bytes up front on multi-GB shards
+    count = lib.tfr_count(path.encode())
+    if count < 0:
+        raise ValueError(
+            f"{path}: {_NATIVE_ERRORS.get(count, f'scan error {count}')}")
+    cap = max(1, int(count))
+    offsets = np.empty(cap, np.int64)
+    lengths = np.empty(cap, np.int64)
+    n = lib.tfr_scan(
+        path.encode(), int(verify_payload),
+        offsets.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int64)),
+        lengths.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int64)), cap)
+    if n < 0:
+        raise ValueError(
+            f"{path}: {_NATIVE_ERRORS.get(n, f'scan error {n}')}")
+    return offsets[:n], lengths[:n]
+
 
 # ---------------------------------------------------------------------------
 # Record framing
@@ -74,7 +153,17 @@ def read_records(path: str, verify_payload: bool = False
                  ) -> Iterator[bytes]:
     """Yield raw record payloads from one TFRecord file. The 12-byte frame
     header CRC is always verified (cheap, catches corruption/misalignment
-    immediately); payload CRC only under `verify_payload`."""
+    immediately); payload CRC only under `verify_payload`. Uses the native
+    C++ scanner when buildable (frame walk + CRC at memory bandwidth),
+    python frame walk otherwise."""
+    scanned = _native_scan(path, verify_payload)
+    if scanned is not None:
+        offsets, lengths = scanned
+        with open(path, "rb") as fh:
+            for off, ln in zip(offsets, lengths):
+                fh.seek(int(off))
+                yield fh.read(int(ln))
+        return
     with open(path, "rb") as fh:
         while True:
             header = fh.read(8)
@@ -104,6 +193,13 @@ def count_records(path: str) -> int:
     """Count records by walking frame headers only (no payload decode).
     Header CRCs are verified and truncation detected, so a corrupt or
     non-TFRecord file raises here the same way `read_records` would."""
+    lib = _native_lib()
+    if lib is not None:
+        n = lib.tfr_count(path.encode())
+        if n < 0:
+            raise ValueError(
+                f"{path}: {_NATIVE_ERRORS.get(n, f'scan error {n}')}")
+        return int(n)
     n = 0
     size = os.path.getsize(path)
     with open(path, "rb") as fh:

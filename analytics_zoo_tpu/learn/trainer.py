@@ -396,6 +396,16 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 f"Dataset has {n} samples but the per-process batch is "
                 f"{local_batch}; training batches are whole-batch only "
                 "(static shapes). Lower batch_size or add data.")
+        if n_proc > 1:
+            # unequal shards would desync the per-step collectives and
+            # deadlock mid-epoch; fail fast with the actual counts
+            from jax.experimental import multihost_utils
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.asarray(n, np.int64)))
+            if not (counts == counts[0]).all():
+                raise ValueError(
+                    "Every process must hold the same number of local "
+                    f"samples; got {counts.tolist()} across ranks")
 
         def batch_iter_factory(epoch):  # noqa: F811 — default factory
             return iter_batches(x, y, local_batch, shuffle=shuffle,
@@ -556,6 +566,22 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     return history
 
 
+def _localize_params(model):
+    """Multi-process eval/predict run per-rank on local devices; params
+    left on the global mesh by fit must be pulled to host first (every
+    rank holds the full value when replicated; FSDP-sharded params would
+    need collectives → clear error instead)."""
+    def pull(a):
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            if a.is_fully_replicated:
+                return np.asarray(a.addressable_data(0))
+            raise NotImplementedError(
+                "Multi-process evaluate/predict needs replicated "
+                "parameters; params are sharded across hosts")
+        return a
+    model.params = jax.tree_util.tree_map(pull, model.params)
+
+
 def evaluate_keras(model, x, y=None, batch_per_thread: int = 32,
                    metrics=None) -> Dict[str, float]:
     ctx = get_context()
@@ -564,6 +590,8 @@ def evaluate_keras(model, x, y=None, batch_per_thread: int = 32,
     # both duplicate every sample per rank and produce outputs on
     # non-addressable devices.
     mesh = ctx.mesh if jax.process_count() == 1 else None
+    if jax.process_count() > 1:
+        _localize_params(model)
     dp_local = mesh.data_parallel_size if mesh \
         else jax.local_device_count()
     batch = batch_per_thread * dp_local
@@ -626,6 +654,8 @@ def predict_keras(model, x, batch_per_thread: int = 32) -> np.ndarray:
     ctx = get_context()
     # see evaluate_keras: per-rank local prediction under multi-process
     mesh = ctx.mesh if jax.process_count() == 1 else None
+    if jax.process_count() > 1:
+        _localize_params(model)
     dp_local = mesh.data_parallel_size if mesh \
         else jax.local_device_count()
     batch = batch_per_thread * dp_local

@@ -1,0 +1,167 @@
+// TFRecord frame scanner — the native fast path for the TFRecord data
+// layer (analytics_zoo_tpu/data/tfrecord.py). The pure-Python CRC32C walk
+// costs ~1 MB/s; this scans at memory bandwidth with a slice-by-8 CRC32C,
+// verifying frame-header CRCs (and optionally payload CRCs) and returning
+// record offsets/lengths for Python to mmap-slice.
+//
+// Exposed (C ABI, driven via ctypes from data/tfrecord.py):
+//   tfr_scan(path, verify_payload, out_offsets, out_lengths, capacity)
+//     -> record count (>=0), or -errno-style codes:
+//        -1 open/read failure, -2 truncated, -3 corrupt length CRC,
+//        -4 capacity too small, -5 corrupt payload CRC
+//   tfr_count(path) -> record count with header verification (payloads
+//     skipped), same error codes.
+//   tfr_crc32c(buf, len) -> masked crc32c (for cross-checking with the
+//     python implementation in tests)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+uint32_t table[8][256];
+
+// ctypes releases the GIL, so scans can race from multiple threads:
+// build the tables eagerly in a static initializer, not lazily behind a
+// non-atomic flag.
+struct TableInit {
+  TableInit() {
+    const uint32_t poly = 0x82F63B78u;
+    for (uint32_t n = 0; n < 256; ++n) {
+      uint32_t c = n;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+      table[0][n] = c;
+    }
+    for (uint32_t n = 0; n < 256; ++n)
+      for (int k = 1; k < 8; ++k)
+        table[k][n] =
+            table[k - 1][n] >> 8 ^ table[0][table[k - 1][n] & 0xFF];
+  }
+};
+const TableInit table_init;
+
+uint32_t crc32c(const uint8_t* p, size_t len, uint32_t crc = 0) {
+  crc ^= 0xFFFFFFFFu;
+  // slice-by-8
+  while (len >= 8) {
+    uint32_t lo;
+    uint32_t hi;
+    memcpy(&lo, p, 4);
+    memcpy(&hi, p + 4, 4);
+    lo ^= crc;
+    crc = table[7][lo & 0xFF] ^ table[6][(lo >> 8) & 0xFF] ^
+          table[5][(lo >> 16) & 0xFF] ^ table[4][lo >> 24] ^
+          table[3][hi & 0xFF] ^ table[2][(hi >> 8) & 0xFF] ^
+          table[1][(hi >> 16) & 0xFF] ^ table[0][hi >> 24];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t masked(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+uint32_t rd32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+uint64_t rd64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+
+// Shared frame walk. When offsets/lengths are null, only counts.
+long scan_impl(const char* path, int verify_payload, int64_t* offsets,
+               int64_t* lengths, long capacity) {
+  FILE* fh = fopen(path, "rb");
+  if (!fh) return -1;
+  fseek(fh, 0, SEEK_END);
+  long size = ftell(fh);
+  fseek(fh, 0, SEEK_SET);
+
+  long count = 0;
+  long pos = 0;
+  uint8_t header[12];
+  // payload staging buffer (grown on demand) only when verifying payloads
+  uint8_t* buf = nullptr;
+  size_t buf_cap = 0;
+
+  while (pos < size) {
+    if (size - pos < 12 || fread(header, 1, 12, fh) != 12) {
+      fclose(fh);
+      delete[] buf;
+      return -2;  // truncated header
+    }
+    uint64_t len = rd64(header);
+    if (rd32(header + 8) != masked(crc32c(header, 8))) {
+      fclose(fh);
+      delete[] buf;
+      return -3;  // corrupt length CRC
+    }
+    if ((uint64_t)(size - pos - 12) < len + 4) {
+      fclose(fh);
+      delete[] buf;
+      return -2;  // truncated payload/CRC
+    }
+    if (offsets) {
+      if (count >= capacity) {
+        fclose(fh);
+        delete[] buf;
+        return -4;
+      }
+      offsets[count] = pos + 12;
+      lengths[count] = (int64_t)len;
+    }
+    if (verify_payload) {
+      if (len > buf_cap) {
+        delete[] buf;
+        buf_cap = (size_t)len;
+        buf = new uint8_t[buf_cap];
+      }
+      uint8_t tail[4];
+      if (fread(buf, 1, len, fh) != len || fread(tail, 1, 4, fh) != 4) {
+        fclose(fh);
+        delete[] buf;
+        return -2;
+      }
+      if (rd32(tail) != masked(crc32c(buf, len))) {
+        fclose(fh);
+        delete[] buf;
+        return -5;  // corrupt payload CRC
+      }
+    } else {
+      fseek(fh, (long)len + 4, SEEK_CUR);
+    }
+    pos += 12 + (long)len + 4;
+    ++count;
+  }
+  fclose(fh);
+  delete[] buf;
+  return count;
+}
+
+}  // namespace
+
+extern "C" {
+
+long tfr_scan(const char* path, int verify_payload, int64_t* offsets,
+              int64_t* lengths, long capacity) {
+  return scan_impl(path, verify_payload, offsets, lengths, capacity);
+}
+
+long tfr_count(const char* path) {
+  return scan_impl(path, 0, nullptr, nullptr, 0);
+}
+
+uint32_t tfr_crc32c(const uint8_t* buf, long len) {
+  return masked(crc32c(buf, (size_t)len));
+}
+
+}  // extern "C"
