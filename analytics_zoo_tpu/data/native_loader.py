@@ -46,15 +46,24 @@ def build_native_lib(src: str, lib_path: str) -> Optional[ctypes.CDLL]:
         if os.path.exists(lib_path) and \
                 os.path.getmtime(lib_path) >= os.path.getmtime(src):
             return lib_path
+        # compile to a private temp file and rename: concurrent processes
+        # (multi-process fit on one host) must never dlopen a half-written
+        # .so or unlink each other's output
+        tmp = f"{lib_path}.tmp.{os.getpid()}"
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               src, "-o", lib_path]
+               src, "-o", tmp]
         try:
             subprocess.run(cmd, check=True, capture_output=True,
                            timeout=120)
+            os.replace(tmp, lib_path)       # atomic publication
             return lib_path
         except (OSError, subprocess.SubprocessError) as e:
             log.warning("native build of %s failed (%s); using python "
                         "path", os.path.basename(src), e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return None
 
     path = compile_()

@@ -83,8 +83,13 @@ _NATIVE_ERRORS = {
     -1: "cannot open/read",
     -2: "truncated record",
     -3: "corrupt record length CRC",
+    -4: "record count grew during scan",
     -5: "corrupt record payload CRC",
 }
+
+# one pass covers files with up to 4M records (2 × 32 MB index arrays);
+# only bigger corpora pay an extra exact-count pass
+_SCAN_CAP = 1 << 22
 
 
 def _native_scan(path: str, verify_payload: bool):
@@ -93,20 +98,27 @@ def _native_scan(path: str, verify_payload: bool):
     lib = _native_lib()
     if lib is None:
         return None
-    # count first (one header-only pass at memory bandwidth) so the
-    # offset/length arrays are exact — sizing by file_size/16 would
-    # allocate ~file-size bytes up front on multi-GB shards
-    count = lib.tfr_count(path.encode())
-    if count < 0:
-        raise ValueError(
-            f"{path}: {_NATIVE_ERRORS.get(count, f'scan error {count}')}")
-    cap = max(1, int(count))
-    offsets = np.empty(cap, np.int64)
-    lengths = np.empty(cap, np.int64)
-    n = lib.tfr_scan(
-        path.encode(), int(verify_payload),
-        offsets.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int64)),
-        lengths.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int64)), cap)
+
+    def scan(cap):
+        offsets = np.empty(cap, np.int64)
+        lengths = np.empty(cap, np.int64)
+        n = lib.tfr_scan(
+            path.encode(), int(verify_payload),
+            offsets.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int64)),
+            lengths.ctypes.data_as(_ctypes.POINTER(_ctypes.c_int64)), cap)
+        return n, offsets, lengths
+
+    # bounded first pass; on overflow (huge corpus or a writer appending
+    # between passes) retry once with the exact count
+    cap = max(1, min(os.path.getsize(path) // 16, _SCAN_CAP))
+    n, offsets, lengths = scan(cap)
+    if n == -4:
+        count = lib.tfr_count(path.encode())
+        if count < 0:
+            raise ValueError(
+                f"{path}: "
+                f"{_NATIVE_ERRORS.get(count, f'scan error {count}')}")
+        n, offsets, lengths = scan(max(1, int(count)))
     if n < 0:
         raise ValueError(
             f"{path}: {_NATIVE_ERRORS.get(n, f'scan error {n}')}")

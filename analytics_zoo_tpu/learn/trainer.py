@@ -391,14 +391,11 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
 
     if batch_iter_factory is None:
         n = _tree_len(x)
-        if n < local_batch:
-            raise ValueError(
-                f"Dataset has {n} samples but the per-process batch is "
-                f"{local_batch}; training batches are whole-batch only "
-                "(static shapes). Lower batch_size or add data.")
         if n_proc > 1:
             # unequal shards would desync the per-step collectives and
-            # deadlock mid-epoch; fail fast with the actual counts
+            # deadlock mid-epoch; gather counts BEFORE any local raise
+            # (a rank bailing early would strand the others inside this
+            # very collective)
             from jax.experimental import multihost_utils
             counts = np.asarray(multihost_utils.process_allgather(
                 np.asarray(n, np.int64)))
@@ -406,6 +403,11 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 raise ValueError(
                     "Every process must hold the same number of local "
                     f"samples; got {counts.tolist()} across ranks")
+        if n < local_batch:
+            raise ValueError(
+                f"Dataset has {n} samples but the per-process batch is "
+                f"{local_batch}; training batches are whole-batch only "
+                "(static shapes). Lower batch_size or add data.")
 
         def batch_iter_factory(epoch):  # noqa: F811 — default factory
             return iter_batches(x, y, local_batch, shuffle=shuffle,

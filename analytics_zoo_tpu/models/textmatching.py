@@ -18,11 +18,11 @@ import numpy as np
 
 from analytics_zoo_tpu.keras import Input, Model
 from analytics_zoo_tpu.keras import layers as L
-from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.models.common import Ranker, ZooModel
 from analytics_zoo_tpu.ops.autograd import Lambda
 
 
-class KNRM(ZooModel):
+class KNRM(ZooModel, Ranker):
     def __init__(self, text1_length: int, text2_length: int,
                  vocab_size: Optional[int] = None,
                  embed_size: int = 300,

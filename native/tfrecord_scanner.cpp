@@ -105,7 +105,9 @@ long scan_impl(const char* path, int verify_payload, int64_t* offsets,
       delete[] buf;
       return -3;  // corrupt length CRC
     }
-    if ((uint64_t)(size - pos - 12) < len + 4) {
+    uint64_t remaining = (uint64_t)(size - pos) - 12;
+    // overflow-safe: len + 4 would wrap for crafted lengths near 2^64
+    if (remaining < 4 || len > remaining - 4) {
       fclose(fh);
       delete[] buf;
       return -2;  // truncated payload/CRC

@@ -176,3 +176,49 @@ class TestIntentEntity:
         model.compile("adam", ["sparse_categorical_crossentropy",
                                "sparse_categorical_crossentropy"])
         model.fit([words, chars], [iy, ty], batch_size=8, nb_epoch=1)
+
+
+class TestRanker:
+    """`models/common/Ranker.scala` NDCG@k / MAP semantics."""
+
+    def test_ndcg_hand_example(self):
+        from analytics_zoo_tpu.models.common import Ranker
+        # perfect ranking → 1.0
+        assert Ranker.ndcg_score([2, 1, 0], [0.9, 0.5, 0.1], k=3) \
+            == pytest.approx(1.0)
+        # worst ranking of one relevant item at k=1 → 0
+        assert Ranker.ndcg_score([1, 0], [0.1, 0.9], k=1) == 0.0
+        # no relevant items → 0 by convention
+        assert Ranker.ndcg_score([0, 0], [0.5, 0.4], k=2) == 0.0
+        with pytest.raises(ValueError):
+            Ranker.ndcg_score([1], [1.0], k=0)
+
+    def test_ndcg_partial(self):
+        from analytics_zoo_tpu.models.common import Ranker
+        # relevant item ranked second of two, k=2:
+        # dcg = (2^1)/ln(3), idcg = (2^1)/ln(2) → ln(2)/ln(3)
+        got = Ranker.ndcg_score([1, 0], [0.1, 0.9], k=2)
+        assert got == pytest.approx(np.log(2) / np.log(3))
+
+    def test_map_hand_example(self):
+        from analytics_zoo_tpu.models.common import Ranker
+        # relevant at positions 1 and 3 of the score-sorted list:
+        # AP = (1/1 + 2/3) / 2
+        got = Ranker.map_score([1, 0, 1], [0.9, 0.5, 0.2])
+        assert got == pytest.approx((1.0 + 2.0 / 3.0) / 2)
+        assert Ranker.map_score([0, 0], [0.9, 0.1]) == 0.0
+
+    def test_knrm_evaluate_ndcg_map(self):
+        from analytics_zoo_tpu.models.textmatching import KNRM
+        knrm = KNRM(text1_length=4, text2_length=6, vocab_size=50,
+                    embed_size=8, target_mode="ranking")
+        knrm.model.ensure_built(np.zeros((1, 10), np.int32))
+        rs = np.random.RandomState(0)
+        queries = []
+        for _ in range(3):
+            x = rs.randint(1, 50, size=(5, 10)).astype(np.int32)
+            y = (rs.rand(5) > 0.5).astype(np.float32)
+            queries.append((x, y))
+        ndcg = knrm.evaluate_ndcg(queries, k=3)
+        mapv = knrm.evaluate_map(queries)
+        assert 0.0 <= ndcg <= 1.0 and 0.0 <= mapv <= 1.0
