@@ -239,11 +239,14 @@ class Dropout(k1.Dropout):
 
 class Flatten(k1.Flatten):
     def __init__(self, data_format: Optional[str] = None, **kw):
-        if data_format not in (None, "channels_last", "channels_first"):
+        if data_format == "channels_first":
+            # tf.keras transposes channels_first input to channels_last
+            # ordering before flattening; silently accepting the flag would
+            # permute the feature order fed to downstream Dense weights
+            raise NotImplementedError(
+                "Flatten(data_format='channels_first') is not supported")
+        if data_format not in (None, "channels_last"):
             raise ValueError(f"Unsupported data_format: {data_format}")
-        # flatten output ordering is layout-dependent only through the
-        # producing layer's dim_ordering; the keras2 flag is accepted for
-        # signature parity
         super().__init__(**kw)
 
 

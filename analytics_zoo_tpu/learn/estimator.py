@@ -175,7 +175,12 @@ class Estimator:
             from analytics_zoo_tpu.learn.torch_bridge import \
                 convert_torch_optimizer
             topt, tsched = self._torch_optim_spec
-            step_batch = ds.global_batch(dp) if lazy else batch_size
+            # multi-process fit_keras steps each process through its LOCAL
+            # shard at batch_size/process_count per step, so steps/epoch is
+            # n_local // per_process_batch — using the global batch here
+            # would make the rebuilt schedule decay process_count× early.
+            step_batch = (ds.global_batch(dp) if lazy
+                          else max(1, batch_size // jax.process_count()))
             spe = max(1, ds.n_samples() // step_batch)
             self.model.optimizer = convert_torch_optimizer(
                 topt, tsched, steps_per_epoch=spe)

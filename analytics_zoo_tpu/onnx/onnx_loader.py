@@ -283,7 +283,9 @@ class _OnnxGraphBuilder:
         elif op == "Clip":
             self.nodes[out_name] = self._clip(node, attrs)
         elif op == "Pow":
-            self.nodes[out_name] = self._pow(node)
+            powed = self._pow(node)
+            if powed is not None:         # None → constant-folded
+                self.nodes[out_name] = powed
         elif op == "Cast":
             src = node["input"][0]
             dtype = self._CAST_DTYPES.get(int(attrs.get("to", 1)))
@@ -294,13 +296,16 @@ class _OnnxGraphBuilder:
                 self.consts[out_name] = self.consts[src].astype(dtype)
             else:
                 self.nodes[out_name] = LambdaLayer(
-                    lambda x, d=dtype: x.astype(d))(self.nodes[src])
+                    lambda x, d=dtype: x.astype(d))(
+                    self._node(src, "Cast"))
         elif op == "Gather":
             gathered = self._gather(node, attrs)
             if gathered is not None:      # None → constant-folded
                 self.nodes[out_name] = gathered
         elif op == "Greater":
-            self.nodes[out_name] = self._greater(node)
+            gt = self._greater(node)
+            if gt is not None:            # None → constant-folded
+                self.nodes[out_name] = gt
         elif op == "LRN":
             self.nodes[out_name] = L.LRN2D(
                 alpha=float(attrs.get("alpha", 1e-4)),
@@ -359,11 +364,22 @@ class _OnnxGraphBuilder:
 
     def _pow(self, node):
         a, b = node["input"][:2]
+        if a in self.consts and b in self.consts:
+            # promote like the runtime branches do — int**-1 would raise
+            self.consts[node["output"][0]] = np.power(
+                self.consts[a].astype(np.float32),
+                self.consts[b].astype(np.float32))
+            return None
         if b in self.consts:
             c = self.consts[b].astype(np.float32)
-            return LambdaLayer(lambda x, c=c: x ** c)(self.nodes[a])
-        return LambdaLayer(lambda x, y: x ** y)([self.nodes[a],
-                                                 self.nodes[b]])
+            return LambdaLayer(lambda x, c=c: x ** c)(
+                self._node(a, "Pow"))
+        if a in self.consts:
+            c = self.consts[a].astype(np.float32)
+            return LambdaLayer(lambda x, c=c: c ** x)(
+                self._node(b, "Pow"))
+        return LambdaLayer(lambda x, y: x ** y)([self._node(a, "Pow"),
+                                                 self._node(b, "Pow")])
 
     _CAST_DTYPES = {1: np.float32, 6: np.int32, 7: np.int64,
                     9: np.bool_, 10: np.float16, 11: np.float64}
@@ -399,11 +415,20 @@ class _OnnxGraphBuilder:
 
     def _greater(self, node):
         a, b = node["input"][:2]
+        if a in self.consts and b in self.consts:
+            self.consts[node["output"][0]] = np.greater(
+                self.consts[a], self.consts[b])
+            return None
         if b in self.consts:
             c = self.consts[b].astype(np.float32)
-            return LambdaLayer(lambda x, c=c: x > c)(self.nodes[a])
-        return LambdaLayer(lambda x, y: x > y)([self.nodes[a],
-                                                self.nodes[b]])
+            return LambdaLayer(lambda x, c=c: x > c)(
+                self._node(a, "Greater"))
+        if a in self.consts:
+            c = self.consts[a].astype(np.float32)
+            return LambdaLayer(lambda x, c=c: c > x)(
+                self._node(b, "Greater"))
+        return LambdaLayer(lambda x, y: x > y)([self._node(a, "Greater"),
+                                                self._node(b, "Greater")])
 
     def _reduce(self, node, attrs, op):
         axes = attrs.get("axes")

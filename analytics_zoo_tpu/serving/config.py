@@ -81,6 +81,7 @@ class ServingConfig:
     # gates the wait-for-secret/salt flow before weights load)
     model_encrypted: bool = False
     secret_timeout_s: float = 60.0
+    scrub_secret: bool = False              # delete secret after first read
     # frontend hardening (`FrontEndApp.scala` tokenBucket/https arguments)
     tokens_per_second: Optional[float] = None
     token_acquire_timeout_ms: float = 100.0
@@ -117,6 +118,7 @@ class ServingConfig:
         cfg.model_encrypted = bool(secure.get("model_encrypted", False))
         if secure.get("secret_timeout_s") is not None:
             cfg.secret_timeout_s = float(secure["secret_timeout_s"])
+        cfg.scrub_secret = bool(secure.get("scrub_secret", False))
         frontend = raw.get("frontend", {}) or {}
         if frontend.get("tokens_per_second") is not None:
             cfg.tokens_per_second = float(frontend["tokens_per_second"])
@@ -147,7 +149,15 @@ class ServingConfig:
             if broker is None:
                 from analytics_zoo_tpu.serving.broker import connect_broker
                 broker = connect_broker(self.broker_url)
-            secret, salt = wait_model_secret(broker, self.secret_timeout_s)
+            if not self.scrub_secret:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "serving an encrypted model with secure.scrub_secret "
+                    "off: the secret/salt stay readable on the broker for "
+                    "restarts/replicas — any broker client can read them. "
+                    "Set secure.scrub_secret: true for one-shot delivery.")
+            secret, salt = wait_model_secret(broker, self.secret_timeout_s,
+                                             scrub=self.scrub_secret)
 
         cfg_json = os.path.join(self.model_path, "config.json")
         if os.path.exists(cfg_json):
@@ -179,9 +189,15 @@ class ServingConfig:
 
 
 def wait_model_secret(broker, timeout_s: float = 60.0,
-                      poll_s: float = 0.2):
+                      poll_s: float = 0.2, scrub: bool = False):
     """Block until the frontend posts the model secret/salt to the broker
-    (`ClusterServingHelper.scala:302-310` jedis.hget polling loop)."""
+    (`ClusterServingHelper.scala:302-310` jedis.hget polling loop).
+
+    The reference leaves the secret readable on the broker so serving
+    restarts and extra replicas can pick it up without a fresh
+    POST /model-secure; that is the default here too. Pass ``scrub=True``
+    (config: ``secure.scrub_secret``) to delete it after the first read —
+    then every serving (re)start needs the operator to re-POST."""
     import time as _time
     from analytics_zoo_tpu.serving.http_frontend import (
         MODEL_SECURED_KEY, MODEL_SECURED_SALT, MODEL_SECURED_SECRET)
@@ -190,11 +206,9 @@ def wait_model_secret(broker, timeout_s: float = 60.0,
         secret = broker.hget(MODEL_SECURED_KEY, MODEL_SECURED_SECRET)
         salt = broker.hget(MODEL_SECURED_KEY, MODEL_SECURED_SALT)
         if secret and salt:
-            # one-shot: scrub the secret from the broker immediately —
-            # leaving it readable would let any broker client decrypt the
-            # model long after startup
-            broker.hdel(MODEL_SECURED_KEY, MODEL_SECURED_SECRET)
-            broker.hdel(MODEL_SECURED_KEY, MODEL_SECURED_SALT)
+            if scrub:
+                broker.hdel(MODEL_SECURED_KEY, MODEL_SECURED_SECRET)
+                broker.hdel(MODEL_SECURED_KEY, MODEL_SECURED_SALT)
             return secret, salt
         _time.sleep(poll_s)
     raise TimeoutError(

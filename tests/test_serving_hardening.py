@@ -213,12 +213,21 @@ class TestModelSecure:
         with pytest.raises(TimeoutError):
             wait_model_secret(MemoryBroker(), timeout_s=0.5)
 
-    def test_secret_scrubbed_from_broker_after_read(self):
+    def test_secret_left_readable_by_default(self):
+        # reference semantics: restarts / extra replicas re-read the secret
         from analytics_zoo_tpu.serving.config import wait_model_secret
         br = MemoryBroker()
         br.hset(MODEL_SECURED_KEY, "secret", "s")
         br.hset(MODEL_SECURED_KEY, "salt", "t")
         assert wait_model_secret(br, timeout_s=5) == ("s", "t")
+        assert wait_model_secret(br, timeout_s=5) == ("s", "t")
+
+    def test_secret_scrubbed_when_opted_in(self):
+        from analytics_zoo_tpu.serving.config import wait_model_secret
+        br = MemoryBroker()
+        br.hset(MODEL_SECURED_KEY, "secret", "s")
+        br.hset(MODEL_SECURED_KEY, "salt", "t")
+        assert wait_model_secret(br, timeout_s=5, scrub=True) == ("s", "t")
         # one-shot: nothing left for a later broker client to steal
         assert br.hget(MODEL_SECURED_KEY, "secret") is None
         assert br.hget(MODEL_SECURED_KEY, "salt") is None
