@@ -5,3 +5,6 @@ from analytics_zoo_tpu.data import readers  # noqa: F401
 from analytics_zoo_tpu.data import tfrecord  # noqa: F401
 from analytics_zoo_tpu.data.readers import (  # noqa: F401
     read_csv, read_json, read_parquet)
+from analytics_zoo_tpu.data.roi import RoiLabel  # noqa: F401
+from analytics_zoo_tpu.data.detection import (  # noqa: F401
+    Coco, Imdb, PascalVoc)

@@ -1,0 +1,311 @@
+"""Detection dataset readers (Pascal VOC / COCO) + SSD batching.
+
+Reference: `models/image/objectdetection/common/dataset/PascalVoc.scala`
+(VOCdevkit layout — `ImageSets/Main/<set>.txt`, `Annotations/<id>.xml`,
+`JPEGImages/<id>.jpg` — and the 20-class table), `Coco.scala` (per-image
+JSON annotations listed by an `ImageSets/<set>.txt` of
+"<image> <annotation>" pairs, COCO category-id remap), `Imdb.scala`
+(`getImdb("voc_2007_train", path)` factory), and `ssd/SSDMiniBatch.scala`
+(batched images + gt rows `(imgId, label, diff, x1, y1, x2, y2)`).
+
+TPU-first deltas from the reference: class indices are **0-based with 0 =
+background** (the convention `models/objectdetection.py` trains with;
+the reference stores 1-based-with-background-at-1) and batches are
+fixed-shape — per-image gts pad to `max_gt` so the whole train step jits
+(the reference's variable-length gt tensor would retrace per batch).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import xml.etree.ElementTree as ET
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.data.roi import (RoiChain, RoiLabel,
+                                        ssd_train_transforms,
+                                        ssd_val_transforms)
+
+# `PascalVoc.scala` classes table (background first)
+VOC_CLASSES: Tuple[str, ...] = (
+    "__background__",
+    "aeroplane", "bicycle", "bird", "boat",
+    "bottle", "bus", "car", "cat", "chair",
+    "cow", "diningtable", "dog", "horse",
+    "motorbike", "person", "pottedplant",
+    "sheep", "sofa", "train", "tvmonitor",
+)
+VOC_CLASS_TO_IND: Dict[str, int] = {c: i for i, c in enumerate(VOC_CLASSES)}
+
+# `Coco.scala` category-id ↔ name table (ids are sparse: 80 classes over
+# id range 1..90; background id 0 first)
+COCO_CAT_ID_AND_CLASS: Tuple[Tuple[int, str], ...] = (
+    (0, "__background__"),
+    (1, "person"), (2, "bicycle"), (3, "car"), (4, "motorcycle"),
+    (5, "airplane"), (6, "bus"), (7, "train"), (8, "truck"), (9, "boat"),
+    (10, "traffic light"), (11, "fire hydrant"), (13, "stop sign"),
+    (14, "parking meter"), (15, "bench"), (16, "bird"), (17, "cat"),
+    (18, "dog"), (19, "horse"), (20, "sheep"), (21, "cow"),
+    (22, "elephant"), (23, "bear"), (24, "zebra"), (25, "giraffe"),
+    (27, "backpack"), (28, "umbrella"), (31, "handbag"), (32, "tie"),
+    (33, "suitcase"), (34, "frisbee"), (35, "skis"), (36, "snowboard"),
+    (37, "sports ball"), (38, "kite"), (39, "baseball bat"),
+    (40, "baseball glove"), (41, "skateboard"), (42, "surfboard"),
+    (43, "tennis racket"), (44, "bottle"), (46, "wine glass"), (47, "cup"),
+    (48, "fork"), (49, "knife"), (50, "spoon"), (51, "bowl"),
+    (52, "banana"), (53, "apple"), (54, "sandwich"), (55, "orange"),
+    (56, "broccoli"), (57, "carrot"), (58, "hot dog"), (59, "pizza"),
+    (60, "donut"), (61, "cake"), (62, "chair"), (63, "couch"),
+    (64, "potted plant"), (65, "bed"), (67, "dining table"), (70, "toilet"),
+    (72, "tv"), (73, "laptop"), (74, "mouse"), (75, "remote"),
+    (76, "keyboard"), (77, "cell phone"), (78, "microwave"), (79, "oven"),
+    (80, "toaster"), (81, "sink"), (82, "refrigerator"), (84, "book"),
+    (85, "clock"), (86, "vase"), (87, "scissors"), (88, "teddy bear"),
+    (89, "hair drier"), (90, "toothbrush"),
+)
+COCO_CLASSES: Tuple[str, ...] = tuple(c for _, c in COCO_CAT_ID_AND_CLASS)
+COCO_CAT_ID_TO_IND: Dict[int, int] = {
+    cid: i for i, (cid, _) in enumerate(COCO_CAT_ID_AND_CLASS)}
+
+
+class DetectionFeature:
+    """One roidb entry: decoded RGB image (or None), RoiLabel, source path
+    (the reference's `ImageFeature(image, label, path)`)."""
+
+    __slots__ = ("image", "roi", "path")
+
+    def __init__(self, image: Optional[np.ndarray], roi: RoiLabel,
+                 path: str):
+        self.image = image
+        self.roi = roi
+        self.path = path
+
+
+def load_voc_annotation(xml_path: str,
+                        class_to_ind: Dict[str, int] = VOC_CLASS_TO_IND
+                        ) -> RoiLabel:
+    """Parse one `Annotations/<id>.xml` (`PascalVoc.loadAnnotation`):
+    bndbox corners in pixel coords, class name, difficult flag."""
+    root = ET.parse(xml_path).getroot()
+    objs = root.findall("object")
+    boxes = np.zeros((len(objs), 4), np.float32)
+    classes = np.zeros((len(objs),), np.int32)
+    difficult = np.zeros((len(objs),), np.float32)
+    for i, obj in enumerate(objs):
+        bb = obj.find("bndbox")
+        boxes[i] = [float(bb.find(t).text)
+                    for t in ("xmin", "ymin", "xmax", "ymax")]
+        classes[i] = class_to_ind[obj.find("name").text.strip()]
+        diff = obj.find("difficult")
+        difficult[i] = float(diff.text) if diff is not None else 0.0
+    return RoiLabel(classes, boxes, difficult)
+
+
+def load_coco_annotation(json_path: str) -> RoiLabel:
+    """Parse one per-image COCO-style JSON (`Coco.loadAnnotation`):
+    `{"image": {width, height}, "annotation": [{area, bbox[x,y,w,h],
+    category_id}, ...]}` — xywh → clipped corners, zero-area dropped,
+    difficult always 0."""
+    with open(json_path) as fh:
+        blob = json.load(fh)
+    width = float(blob["image"]["width"])
+    height = float(blob["image"]["height"])
+    boxes, classes = [], []
+    for ann in blob.get("annotation", []):
+        x, y, w, h = [float(v) for v in ann["bbox"]]
+        x1, y1 = max(0.0, x), max(0.0, y)
+        x2 = min(width - 1.0, x1 + max(0.0, w - 1.0))
+        y2 = min(height - 1.0, y1 + max(0.0, h - 1.0))
+        if float(ann.get("area", w * h)) > 0 and x2 >= x1 and y2 >= y1:
+            boxes.append([x1, y1, x2, y2])
+            classes.append(COCO_CAT_ID_TO_IND[int(ann["category_id"])])
+    return RoiLabel(np.asarray(classes, np.int32),
+                    np.asarray(boxes, np.float32).reshape(-1, 4))
+
+
+class Imdb:
+    """Image database: `get_roidb()` -> list of DetectionFeature
+    (`Imdb.scala` trait + `getImdb` name factory)."""
+
+    classes: Tuple[str, ...] = ()
+
+    def get_roidb(self, read_image: bool = True) -> List[DetectionFeature]:
+        raise NotImplementedError
+
+    @staticmethod
+    def get_imdb(name: str, devkit_path: str) -> "Imdb":
+        parts = name.split("_")
+        if parts[0] == "voc":
+            return PascalVoc(image_set=parts[2], devkit_path=devkit_path,
+                             year=parts[1])
+        if parts[0] == "coco":
+            return Coco(image_set=parts[1], devkit_path=devkit_path)
+        raise ValueError(f"Unknown imdb name {name!r} "
+                         "(expected voc_<year>_<set> or coco_<set>)")
+
+    @staticmethod
+    def _read_image(path: str) -> np.ndarray:
+        from analytics_zoo_tpu.data.image import load_image
+        return load_image(path)
+
+
+class PascalVoc(Imdb):
+    """VOCdevkit reader (`PascalVoc.scala`): year "0712" merges 2007+2012
+    the way the reference trains SSD."""
+
+    classes = VOC_CLASSES
+
+    def __init__(self, image_set: str, devkit_path: str,
+                 year: str = "2007"):
+        if not os.path.isdir(devkit_path):
+            raise FileNotFoundError(
+                f"VOCdevkit path does not exist: {devkit_path}")
+        self.image_set = image_set
+        self.devkit_path = devkit_path
+        self.year = year
+        self.name = f"voc_{year}_{image_set}"
+
+    def _index_paths(self) -> List[Tuple[str, str]]:
+        years = ("2007", "2012") if self.year == "0712" else (self.year,)
+        pairs = []
+        for y in years:
+            data = os.path.join(self.devkit_path, f"VOC{y}")
+            if not os.path.isdir(data):
+                raise FileNotFoundError(
+                    f"cannot find data folder {data} for {self.name}")
+            lst = os.path.join(data, "ImageSets", "Main",
+                               f"{self.image_set}.txt")
+            if not os.path.exists(lst):
+                raise FileNotFoundError(f"Path does not exist {lst}")
+            with open(lst) as fh:
+                for line in fh:
+                    idx = line.strip()
+                    if idx:
+                        pairs.append(
+                            (os.path.join(data, "JPEGImages",
+                                          f"{idx}.jpg"),
+                             os.path.join(data, "Annotations",
+                                          f"{idx}.xml")))
+        return pairs
+
+    def get_roidb(self, read_image: bool = True) -> List[DetectionFeature]:
+        out = []
+        for img_path, ann_path in self._index_paths():
+            img = self._read_image(img_path) if read_image else None
+            out.append(DetectionFeature(
+                img, load_voc_annotation(ann_path), img_path))
+        return out
+
+
+class Coco(Imdb):
+    """Reference COCO layout (`Coco.scala`): `ImageSets/<set>.txt` lines
+    of "<image-relpath> <annotation-relpath>", per-image JSON files."""
+
+    classes = COCO_CLASSES
+
+    def __init__(self, image_set: str, devkit_path: str):
+        self.image_set = image_set
+        self.devkit_path = devkit_path
+        self.name = f"coco_{image_set}"
+
+    def get_roidb(self, read_image: bool = True) -> List[DetectionFeature]:
+        lst = os.path.join(self.devkit_path, "ImageSets",
+                           f"{self.image_set}.txt")
+        if not os.path.exists(lst):
+            raise FileNotFoundError(f"Path does not exist {lst}")
+        out = []
+        with open(lst) as fh:
+            for line in fh:
+                parts = line.split()
+                if not parts:
+                    continue
+                img_path = os.path.join(self.devkit_path, parts[0])
+                ann_path = os.path.join(self.devkit_path, parts[1])
+                img = self._read_image(img_path) if read_image else None
+                out.append(DetectionFeature(
+                    img, load_coco_annotation(ann_path), img_path))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# SSD batching (`ssd/SSDMiniBatch.scala` / `RoiImageToSSDBatch.scala`)
+# ---------------------------------------------------------------------------
+def features_to_ssd_arrays(features: Sequence[DetectionFeature],
+                           transforms: Optional[RoiChain],
+                           max_gt: int,
+                           normalize=None
+                           ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+    """Run the roi chain per feature and assemble the fixed-shape arrays
+    the jitted SSD step consumes: images [N,H,W,3] float32 and padded
+    `{"gt_boxes": [N,G,4] normalized corners, "gt_labels": [N,G] int32
+    (0 pad), "difficult": [N,G]}`. `normalize` is an optional image-only
+    op applied last (channel normalize / dtype), shared with eval."""
+    imgs, boxes, labels, diffs = [], [], [], []
+    for feat in features:
+        img, roi = feat.image, feat.roi
+        if transforms is not None:
+            img, roi = transforms.apply(img, roi)
+        if normalize is not None:
+            img = normalize(img)
+        g = min(len(roi), max_gt)
+        if len(roi) > max_gt:
+            import logging
+            logging.getLogger(__name__).warning(
+                "%s: %d ground truths truncated to max_gt=%d — evaluation "
+                "on these arrays will under-count npos; raise max_gt",
+                feat.path, len(roi), max_gt)
+        b = np.zeros((max_gt, 4), np.float32)
+        c = np.zeros((max_gt,), np.int32)
+        d = np.zeros((max_gt,), np.float32)
+        b[:g] = roi.boxes[:g]
+        c[:g] = roi.classes[:g]
+        d[:g] = roi.difficult[:g]
+        imgs.append(np.asarray(img, np.float32))
+        boxes.append(b)
+        labels.append(c)
+        diffs.append(d)
+    return (np.stack(imgs),
+            {"gt_boxes": np.stack(boxes), "gt_labels": np.stack(labels),
+             "difficult": np.stack(diffs)})
+
+
+def gt_arrays_to_rows(gt: Dict[str, np.ndarray]) -> np.ndarray:
+    """Padded gt arrays -> the evaluator's flat row form
+    `[M, 7] = (img_id, label, difficult, x1, y1, x2, y2)`
+    (`SSDMiniBatch` target layout; pad rows dropped)."""
+    rows = []
+    n = gt["gt_labels"].shape[0]
+    for i in range(n):
+        keep = gt["gt_labels"][i] > 0
+        for lab, diff, box in zip(gt["gt_labels"][i][keep],
+                                  gt["difficult"][i][keep],
+                                  gt["gt_boxes"][i][keep]):
+            rows.append([i, lab, diff, *box])
+    return np.asarray(rows, np.float32).reshape(-1, 7)
+
+
+def load_ssd_train_set(imdb_or_name, devkit_path: Optional[str] = None,
+                       resolution: int = 300, max_gt: int = 32,
+                       means: Sequence[float] = (123.0, 117.0, 104.0),
+                       seed: Optional[int] = 0, normalize=None):
+    """`SSDDataSet.loadSSDTrainSet`: read roidb, apply the augmenting
+    chain, return (images, gt-dict) ready for `TPUDataset`/`fit`."""
+    imdb = (Imdb.get_imdb(imdb_or_name, devkit_path)
+            if isinstance(imdb_or_name, str) else imdb_or_name)
+    chain = ssd_train_transforms(resolution, means=means, seed=seed)
+    return features_to_ssd_arrays(imdb.get_roidb(), chain, max_gt,
+                                  normalize=normalize)
+
+
+def load_ssd_val_set(imdb_or_name, devkit_path: Optional[str] = None,
+                     resolution: int = 300, max_gt: int = 32,
+                     normalize=None):
+    """`SSDDataSet.loadSSDValSet`: no augmentation, same batch contract."""
+    imdb = (Imdb.get_imdb(imdb_or_name, devkit_path)
+            if isinstance(imdb_or_name, str) else imdb_or_name)
+    chain = ssd_val_transforms(resolution)
+    return features_to_ssd_arrays(imdb.get_roidb(), chain, max_gt,
+                                  normalize=normalize)

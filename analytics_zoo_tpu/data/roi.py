@@ -1,0 +1,304 @@
+"""Bbox-aware (roi) image augmentation for detection training.
+
+Reference: the SSD training pipeline chains roi transforms that keep the
+ground-truth boxes consistent with every geometric image op
+(`feature/image/roi/RoiRecordToFeature.scala`, `ssd/SSDDataSet.scala`:
+``ImageRoiNormalize -> ImageExpand -> ImageRoiProject ->
+ImageRandomSampler -> ImageResize -> ImageHFlip -> ImageRoiHFlip``; the
+box-projection math lives in BigDL's roi label transformers and
+`common/BboxUtil.scala`). Here each transform owns both the pixel op and
+the box remap in one step — there is no separate "project" pass to forget.
+
+All transforms are host-side numpy (augmentation is input-pipeline work;
+the TPU sees only the final fixed-shape batch). Boxes are corner-form
+``[x1, y1, x2, y2]``; after `RoiNormalize` they are normalized to [0, 1]
+which is what the samplers/flip/resize below expect (matching the
+reference pipeline order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class RoiLabel:
+    """Ground-truth for one image: integer class per box (0 = background is
+    never a gt class), VOC `difficult` flags, corner boxes. The reference's
+    `RoiLabel(classes, bboxes)` with the (class, difficult) rows folded into
+    typed fields."""
+
+    classes: np.ndarray                       # [G] int32
+    boxes: np.ndarray                         # [G, 4] float32 corner
+    difficult: np.ndarray = field(default=None)  # [G] float32 0/1
+
+    def __post_init__(self):
+        self.classes = np.asarray(self.classes, np.int32).reshape(-1)
+        self.boxes = np.asarray(self.boxes, np.float32).reshape(-1, 4)
+        if self.difficult is None:
+            self.difficult = np.zeros(len(self.classes), np.float32)
+        else:
+            self.difficult = np.asarray(
+                self.difficult, np.float32).reshape(-1)
+        if not (len(self.classes) == len(self.boxes)
+                == len(self.difficult)):
+            raise ValueError("classes/boxes/difficult length mismatch")
+
+    def __len__(self):
+        return len(self.classes)
+
+    def select(self, mask: np.ndarray) -> "RoiLabel":
+        return RoiLabel(self.classes[mask], self.boxes[mask],
+                        self.difficult[mask])
+
+
+class RoiImageProcessing:
+    """Composable transform over an ``(image, RoiLabel)`` pair; `>>`
+    chains (the reference's `->` operator over roi pipelines)."""
+
+    def apply(self, img: np.ndarray, roi: RoiLabel
+              ) -> Tuple[np.ndarray, RoiLabel]:
+        raise NotImplementedError
+
+    def __call__(self, feature):
+        img, roi = feature
+        return self.apply(img, roi)
+
+    def __rshift__(self, other: "RoiImageProcessing") -> "RoiChain":
+        return RoiChain([self, other])
+
+
+class RoiChain(RoiImageProcessing):
+    def __init__(self, transforms: Sequence[RoiImageProcessing]):
+        self.transforms = list(transforms)
+
+    def apply(self, img, roi):
+        for t in self.transforms:
+            img, roi = t.apply(img, roi)
+        return img, roi
+
+    def __rshift__(self, other):
+        return RoiChain(self.transforms + [other])
+
+
+class RoiLift(RoiImageProcessing):
+    """Lift a geometry-preserving image-only op (color jitter, normalize,
+    dtype) into a roi chain. Using this with a geometric op would silently
+    desync the boxes — that is exactly the bug class the roi transforms
+    exist to prevent, so only lift photometric ops."""
+
+    def __init__(self, image_op):
+        self.image_op = image_op
+
+    def apply(self, img, roi):
+        return self.image_op(img), roi
+
+
+class RoiRandomPreprocessing(RoiImageProcessing):
+    """Apply the wrapped roi transform with probability p
+    (`ImageRandomPreprocessing` around Expand/HFlip in the SSD chain)."""
+
+    def __init__(self, transform: RoiImageProcessing, p: float = 0.5,
+                 seed: Optional[int] = None):
+        self.transform = transform
+        self.p = p
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, img, roi):
+        if self.rng.rand() < self.p:
+            return self.transform.apply(img, roi)
+        return img, roi
+
+
+class RoiNormalize(RoiImageProcessing):
+    """Pixel-coordinate boxes -> [0, 1] normalized (`ImageRoiNormalize`).
+    Every transform below this point works in normalized space."""
+
+    def apply(self, img, roi):
+        H, W = img.shape[:2]
+        scale = np.array([W, H, W, H], np.float32)
+        return img, RoiLabel(roi.classes, roi.boxes / scale, roi.difficult)
+
+
+class RoiHFlip(RoiImageProcessing):
+    """Mirror image + boxes: x1' = 1-x2, x2' = 1-x1 (`ImageHFlip` +
+    `ImageRoiHFlip`). Boxes must be normalized."""
+
+    def apply(self, img, roi):
+        flipped = img[:, ::-1].copy()
+        b = roi.boxes
+        nb = np.stack([1.0 - b[:, 2], b[:, 1], 1.0 - b[:, 0], b[:, 3]],
+                      axis=1) if len(roi) else b
+        return flipped, RoiLabel(roi.classes, nb, roi.difficult)
+
+
+class RoiResize(RoiImageProcessing):
+    """Resize the pixels; normalized boxes are scale-invariant so they pass
+    through untouched (`ImageResize` inside the roi chain)."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = resize_h, resize_w
+
+    def apply(self, img, roi):
+        import cv2
+        img = cv2.resize(img, (self.w, self.h),
+                         interpolation=cv2.INTER_LINEAR)
+        return img, roi
+
+
+class RoiExpand(RoiImageProcessing):
+    """SSD "zoom-out": paste the image at a random offset inside a canvas
+    of ratio r ∈ [1, max_expand_ratio] filled with the channel means, then
+    shrink the normalized boxes into the canvas frame (`ImageExpand` +
+    `ImageRoiProject`). Trains small-object detection."""
+
+    def __init__(self, max_expand_ratio: float = 4.0,
+                 means: Sequence[float] = (123.0, 117.0, 104.0),
+                 seed: Optional[int] = None):
+        self.max_ratio = max_expand_ratio
+        self.means = np.asarray(means, np.float32)
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, img, roi):
+        H, W = img.shape[:2]
+        r = self.rng.uniform(1.0, self.max_ratio)
+        nH, nW = int(round(H * r)), int(round(W * r))
+        y0 = int(self.rng.uniform(0, nH - H + 1))
+        x0 = int(self.rng.uniform(0, nW - W + 1))
+        canvas = np.empty((nH, nW, img.shape[2]), img.dtype)
+        canvas[...] = self.means.astype(img.dtype)
+        canvas[y0:y0 + H, x0:x0 + W] = img
+        if len(roi):
+            sx, sy = W / nW, H / nH
+            ox, oy = x0 / nW, y0 / nH
+            b = roi.boxes * np.array([sx, sy, sx, sy], np.float32) \
+                + np.array([ox, oy, ox, oy], np.float32)
+            roi = RoiLabel(roi.classes, b, roi.difficult)
+        return canvas, roi
+
+
+def _crop_iou(crop: np.ndarray, boxes: np.ndarray) -> np.ndarray:
+    """Jaccard of one normalized crop rect vs [G,4] boxes."""
+    ix1 = np.maximum(crop[0], boxes[:, 0])
+    iy1 = np.maximum(crop[1], boxes[:, 1])
+    ix2 = np.minimum(crop[2], boxes[:, 2])
+    iy2 = np.minimum(crop[3], boxes[:, 3])
+    inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0, None)
+    area_c = (crop[2] - crop[0]) * (crop[3] - crop[1])
+    area_b = np.clip(boxes[:, 2] - boxes[:, 0], 0, None) \
+        * np.clip(boxes[:, 3] - boxes[:, 1], 0, None)
+    return inter / np.maximum(area_c + area_b - inter, 1e-8)
+
+
+def project_boxes(roi: RoiLabel, crop: np.ndarray) -> RoiLabel:
+    """Remap normalized boxes into a normalized crop rect: keep gts whose
+    CENTER falls inside the crop, translate + rescale, clip to [0, 1]
+    (the reference sampler's `ImageRoiProject` center rule)."""
+    if not len(roi):
+        return roi
+    b = roi.boxes
+    cx = (b[:, 0] + b[:, 2]) / 2
+    cy = (b[:, 1] + b[:, 3]) / 2
+    keep = ((cx > crop[0]) & (cx < crop[2])
+            & (cy > crop[1]) & (cy < crop[3]))
+    kept = roi.select(keep)
+    if not len(kept):
+        return kept
+    cw, ch = crop[2] - crop[0], crop[3] - crop[1]
+    nb = (kept.boxes - np.array([crop[0], crop[1], crop[0], crop[1]],
+                                np.float32)) \
+        / np.array([cw, ch, cw, ch], np.float32)
+    return RoiLabel(kept.classes, np.clip(nb, 0.0, 1.0), kept.difficult)
+
+
+class RoiRandomSampler(RoiImageProcessing):
+    """The SSD batch sampler (`ImageRandomSampler`): alongside the whole
+    image, try up to `max_trials` random crops per min-IoU constraint in
+    `min_overlaps` (scale ∈ [min_scale, 1], aspect ∈ [min/max_aspect],
+    accepted when some gt box reaches the IoU floor); pick one of the
+    accepted crops uniformly and project the boxes into it."""
+
+    def __init__(self,
+                 min_overlaps: Sequence[float] = (0.1, 0.3, 0.5, 0.7, 0.9),
+                 min_scale: float = 0.3,
+                 min_aspect: float = 0.5, max_aspect: float = 2.0,
+                 max_trials: int = 50, max_sample: int = 1,
+                 seed: Optional[int] = None):
+        self.min_overlaps = tuple(min_overlaps)
+        self.min_scale = min_scale
+        self.min_aspect, self.max_aspect = min_aspect, max_aspect
+        self.max_trials = max_trials
+        self.max_sample = max_sample
+        self.rng = np.random.RandomState(seed)
+
+    def _sample_crop(self) -> np.ndarray:
+        scale = self.rng.uniform(self.min_scale, 1.0)
+        # keep the crop inside the unit square: ar bounded by scale²
+        lo = max(self.min_aspect, scale * scale)
+        hi = min(self.max_aspect, 1.0 / (scale * scale))
+        ar = self.rng.uniform(lo, hi)
+        w = scale * np.sqrt(ar)
+        h = scale / np.sqrt(ar)
+        x0 = self.rng.uniform(0.0, 1.0 - w)
+        y0 = self.rng.uniform(0.0, 1.0 - h)
+        return np.array([x0, y0, x0 + w, y0 + h], np.float32)
+
+    def apply(self, img, roi):
+        crops = [np.array([0.0, 0.0, 1.0, 1.0], np.float32)]
+        for min_iou in self.min_overlaps:
+            found = 0
+            for _ in range(self.max_trials):
+                if found >= self.max_sample:
+                    break
+                crop = self._sample_crop()
+                if len(roi) == 0:
+                    continue
+                if _crop_iou(crop, roi.boxes).max() >= min_iou:
+                    # only crops that keep at least one gt center are
+                    # usable for training
+                    if len(project_boxes(roi, crop)):
+                        crops.append(crop)
+                        found += 1
+        crop = crops[self.rng.randint(len(crops))]
+        if np.allclose(crop, [0.0, 0.0, 1.0, 1.0]):
+            return img, roi
+        H, W = img.shape[:2]
+        x0, y0 = int(crop[0] * W), int(crop[1] * H)
+        x1, y1 = max(x0 + 1, int(crop[2] * W)), max(y0 + 1, int(crop[3] * H))
+        return img[y0:y1, x0:x1].copy(), project_boxes(roi, crop)
+
+
+def ssd_train_transforms(resolution: int,
+                         means: Sequence[float] = (123.0, 117.0, 104.0),
+                         expand_p: float = 0.5, flip_p: float = 0.5,
+                         seed: Optional[int] = None,
+                         color_jitter=None) -> RoiChain:
+    """The reference SSD training chain (`SSDDataSet.loadSSDTrainSet`):
+    normalize rois -> [color jitter] -> random expand -> random IoU crop ->
+    resize -> random hflip. Channel normalization/dtype is left to the
+    caller's lifted photometric ops so eval/train share it."""
+    rng = np.random.RandomState(seed)
+
+    def sub():          # independent child streams, one seeded source
+        return int(rng.randint(0, 2 ** 31 - 1))
+
+    chain: List[RoiImageProcessing] = [RoiNormalize()]
+    if color_jitter is not None:
+        chain.append(RoiLift(color_jitter))
+    chain += [
+        RoiRandomPreprocessing(RoiExpand(means=means, seed=sub()),
+                               p=expand_p, seed=sub()),
+        RoiRandomSampler(seed=sub()),
+        RoiResize(resolution, resolution),
+    ]
+    flip = RoiRandomPreprocessing(RoiHFlip(), p=flip_p, seed=sub())
+    chain.append(flip)
+    return RoiChain(chain)
+
+
+def ssd_val_transforms(resolution: int) -> RoiChain:
+    """Eval chain: normalize + resize only (`loadSSDValSet`)."""
+    return RoiChain([RoiNormalize(), RoiResize(resolution, resolution)])

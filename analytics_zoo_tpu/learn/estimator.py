@@ -281,6 +281,32 @@ class Estimator:
                  feature_cols=None, label_cols=None) -> Dict[str, float]:
         ds = to_dataset(data, batch_per_thread=batch_per_thread,
                         feature_cols=feature_cols, label_cols=label_cols)
+        if metrics:
+            # detection mAP is corpus-level (per-class global score sort) —
+            # it cannot stream through the jitted metric accumulators, so
+            # it takes the predict-then-evaluate path
+            from analytics_zoo_tpu.models.detection_eval import DetectionMAP
+            mlist = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+            det = [m for m in mlist if isinstance(m, DetectionMAP)]
+            if det:
+                if len(det) != len(mlist):
+                    raise ValueError(
+                        "DetectionMAP cannot be mixed with streaming "
+                        "metrics in one evaluate() call")
+                x, y = ds.materialize()
+                flat = self.model.predict(
+                    x, batch_per_thread=batch_per_thread)
+                out: Dict[str, float] = {}
+                for i, m in enumerate(det):
+                    # disambiguate repeated evaluators (e.g. VOC07 + area)
+                    tag = m.name if len(det) == 1 else f"{m.name}_{i}"
+                    res = m.evaluate_flat(flat, y)
+                    out[tag] = res.result()[0]
+                    out.update({f"AP_{n}" if len(det) == 1
+                                else f"AP_{n}_{i}": ap
+                                for n, ap in res.ap_by_class()})
+                return out
         from analytics_zoo_tpu.ops import metrics as zmetrics
         ms = zmetrics.resolve(metrics) if metrics else None
         x, y = ds.materialize()

@@ -1,0 +1,218 @@
+"""Detection evaluation: VOC mean-average-precision.
+
+Reference semantics: `common/evaluation/MeanAveragePrecision.scala` +
+`EvalUtil.scala` (per-class tp/fp marking against greedily-claimed gts,
+difficult gts excluded from both npos and fp, VOC07 11-point vs
+area-under-envelope AP) and `PascalVocEvaluator.meanAveragePrecision`
+(background excluded, mAP = unweighted class mean). Results are batch-
+mergeable the way the reference's `DetectionResult.+` accumulates over a
+validation epoch.
+
+Class indices here are 0-based with 0 = background (the convention the
+rest of `models/objectdetection.py` uses); gt rows use the
+`SSDMiniBatch` layout `(img_id, label, difficult, x1, y1, x2, y2)`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def voc_ap(recall: np.ndarray, precision: np.ndarray,
+           use_07_metric: bool = False) -> float:
+    """AP from a PR curve: VOC07 11-point interpolation, or the corrected
+    area under the monotone precision envelope (`EvalUtil.vocAp`)."""
+    if use_07_metric:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            mask = recall >= t
+            p = float(precision[mask].max()) if mask.any() else 0.0
+            ap += p / 11.0
+        return ap
+    # sentinel-pad, build the envelope, integrate where recall steps
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    mpre = np.maximum.accumulate(mpre[::-1])[::-1]
+    steps = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[steps + 1] - mrec[steps]) * mpre[steps + 1]))
+
+
+def compute_ap(records: Sequence[Tuple[float, int, int]], npos: int,
+               use_07_metric: bool = False) -> float:
+    """(score, tp, fp) records -> AP (`EvalUtil.computeAP`): global sort by
+    descending score, cumulate, precision/recall, `voc_ap`."""
+    if npos == 0 or not len(records):
+        return 0.0
+    arr = np.asarray(records, np.float32)
+    order = np.argsort(-arr[:, 0], kind="stable")
+    tp = np.cumsum(arr[order, 1])
+    fp = np.cumsum(arr[order, 2])
+    recall = tp / float(npos)
+    precision = tp / np.maximum(tp + fp, 1e-12)
+    return voc_ap(recall, precision, use_07_metric)
+
+
+def _iou_one_to_many(box: np.ndarray, gts: np.ndarray,
+                     normalized: bool = True) -> np.ndarray:
+    """One detection box vs [G,4] gt boxes (`BboxUtil.getMaxOverlaps`):
+    un-normalized coords use the VOC +1 pixel-extent convention."""
+    off = 0.0 if normalized else 1.0
+    ix1 = np.maximum(gts[:, 0], box[0])
+    iy1 = np.maximum(gts[:, 1], box[1])
+    ix2 = np.minimum(gts[:, 2], box[2])
+    iy2 = np.minimum(gts[:, 3], box[3])
+    inter = np.clip(ix2 - ix1 + off, 0, None) \
+        * np.clip(iy2 - iy1 + off, 0, None)
+    area_d = (box[2] - box[0] + off) * (box[3] - box[1] + off)
+    area_g = (gts[:, 2] - gts[:, 0] + off) * (gts[:, 3] - gts[:, 1] + off)
+    return inter / np.maximum(area_d + area_g - inter, 1e-12)
+
+
+def evaluate_class(detections: Dict[int, Tuple[np.ndarray, np.ndarray]],
+                   gt_rows: np.ndarray, cls: int,
+                   iou_threshold: float = 0.5, normalized: bool = True
+                   ) -> Tuple[int, List[Tuple[float, int, int]]]:
+    """Score one class over a batch (`EvalUtil.evaluateBatch`).
+
+    detections: {img_id: (scores [K], boxes [K,4])} for THIS class, each
+    image's detections in descending-score order (NMS output order).
+    gt_rows: [M, 7] rows for all classes of the batch. Returns
+    (npos, [(score, tp, fp)]): difficult gts count in neither npos nor
+    fp; a gt already claimed by a higher-scoring detection turns later
+    hits into fps (greedy claiming)."""
+    npos = 0
+    by_img: Dict[int, Dict[str, np.ndarray]] = {}
+    if gt_rows.size:
+        sel = gt_rows[gt_rows[:, 1].astype(np.int32) == cls]
+        for img_id in np.unique(sel[:, 0].astype(np.int32)):
+            rows = sel[sel[:, 0].astype(np.int32) == img_id]
+            by_img[int(img_id)] = {
+                "boxes": rows[:, 3:7],
+                "difficult": rows[:, 2],
+                "claimed": np.zeros(len(rows), bool)}
+        npos = int(np.sum(sel[:, 2] == 0))
+    records: List[Tuple[float, int, int]] = []
+    for img_id, (scores, boxes) in detections.items():
+        gts = by_img.get(int(img_id))
+        for score, box in zip(np.asarray(scores), np.asarray(boxes)):
+            if gts is None or not len(gts["boxes"]):
+                records.append((float(score), 0, 1))
+                continue
+            ious = _iou_one_to_many(box, gts["boxes"], normalized)
+            j = int(np.argmax(ious))
+            if ious[j] > iou_threshold:
+                if gts["difficult"][j] != 0:
+                    continue                      # difficult: ignored
+                if not gts["claimed"][j]:
+                    gts["claimed"][j] = True
+                    records.append((float(score), 1, 0))
+                else:
+                    records.append((float(score), 0, 1))
+            else:
+                records.append((float(score), 0, 1))
+    return npos, records
+
+
+class DetectionResult:
+    """Per-class (npos, records) accumulator; `+` merges batches
+    (`DetectionResult` in `MeanAveragePrecision.scala`)."""
+
+    def __init__(self, results: List[Tuple[int, List[Tuple[float, int,
+                                                           int]]]],
+                 classes: Sequence[str], use_07_metric: bool):
+        self.results = results
+        self.classes = list(classes)
+        self.use_07_metric = use_07_metric
+
+    def __add__(self, other: "DetectionResult") -> "DetectionResult":
+        merged = [(a[0] + b[0], list(a[1]) + list(b[1]))
+                  for a, b in zip(self.results, other.results)]
+        return DetectionResult(merged, self.classes, self.use_07_metric)
+
+    def ap_by_class(self) -> List[Tuple[str, float]]:
+        out = []
+        for cls_name, (npos, records) in zip(self.classes, self.results):
+            if cls_name != "__background__":
+                out.append((cls_name,
+                            compute_ap(records, npos, self.use_07_metric)))
+        return out
+
+    def result(self) -> Tuple[float, int]:
+        aps = self.ap_by_class()
+        mean = sum(ap for _, ap in aps) / max(len(aps), 1)
+        return mean, 1
+
+    def __str__(self):
+        aps = self.ap_by_class()
+        mean = sum(ap for _, ap in aps) / max(len(aps), 1)
+        lines = ["~~~~~~~~", "Results:"]
+        lines += [f"AP for {name} = {ap:.4f}" for name, ap in aps]
+        lines += [f"Mean AP = {mean:.4f}", "~~~~~~~~"]
+        return "\n".join(lines)
+
+
+class MeanAveragePrecision:
+    """`MeanAveragePrecision(use07metric, normalized, classes)` — call on
+    (per-image per-class detections, gt rows) to get a mergeable
+    DetectionResult."""
+
+    name = "PascalMeanAveragePrecision"
+
+    def __init__(self, classes: Sequence[str],
+                 use_07_metric: bool = False, normalized: bool = True,
+                 iou_threshold: float = 0.5):
+        self.classes = list(classes)
+        self.use_07_metric = use_07_metric
+        self.normalized = normalized
+        self.iou_threshold = iou_threshold
+
+    def __call__(self,
+                 detections: List[Dict[int, Tuple[np.ndarray, np.ndarray]]],
+                 gt_rows: np.ndarray) -> DetectionResult:
+        """detections: list over images; each entry maps class index ->
+        (scores, boxes) in descending-score order. gt_rows: [M, 7]."""
+        results = []
+        for c, cls_name in enumerate(self.classes):
+            if cls_name == "__background__":
+                results.append((0, []))
+                continue
+            per_img = {i: d[c] for i, d in enumerate(detections) if c in d}
+            results.append(evaluate_class(
+                per_img, gt_rows, c, self.iou_threshold, self.normalized))
+        return DetectionResult(results, self.classes, self.use_07_metric)
+
+
+class DetectionMAP(MeanAveragePrecision):
+    """`Estimator.evaluate(metrics=[DetectionMAP(...)])`-pluggable form:
+    carries the SSD postprocess spec so it can decode the model's raw flat
+    output itself (the reference passes `MeanAveragePrecision` as a BigDL
+    ValidationMethod into `Estimator.evaluate`; here the decode that its
+    `decodeBatchOutput` did lives in the metric)."""
+
+    def __init__(self, anchors, n_anchors_per_map: Sequence[int],
+                 n_classes: int, classes: Optional[Sequence[str]] = None,
+                 score_threshold: float = 0.01, nms_iou: float = 0.45,
+                 max_out: int = 100, **kw):
+        if classes is None:
+            classes = ["__background__"] + [str(i)
+                                            for i in range(1, n_classes)]
+        super().__init__(classes, **kw)
+        self.anchors = np.asarray(anchors, np.float32)
+        self.n_anchors_per_map = list(n_anchors_per_map)
+        self.n_classes = n_classes
+        self.score_threshold = score_threshold
+        self.nms_iou = nms_iou
+        self.max_out = max_out
+
+    def evaluate_flat(self, flat_outputs, gt) -> DetectionResult:
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.models.objectdetection import (
+            _gt_to_rows, decode_detections)
+        dets = decode_detections(
+            flat_outputs, jnp.asarray(self.anchors),
+            self.n_anchors_per_map, self.n_classes,
+            self.score_threshold, self.nms_iou, self.max_out)
+        return self(dets, _gt_to_rows(gt))

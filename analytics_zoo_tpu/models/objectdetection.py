@@ -291,6 +291,40 @@ def split_ssd_output(flat, n_anchors_per_map: Sequence[int], n_classes: int):
     return jnp.concatenate(locs, axis=1), jnp.concatenate(confs, axis=1)
 
 
+def decode_detections(flat, anchors, n_anchors_per_map: Sequence[int],
+                      n_classes: int, score_threshold: float = 0.01,
+                      iou_threshold: float = 0.45, max_out: int = 100
+                      ) -> List[Dict[int, Tuple[np.ndarray, np.ndarray]]]:
+    """Flat SSD output -> per-image {class: (scores desc, boxes [K,4])}
+    (`BboxUtil.decodeBatchOutput` shape: per-image per-class RoiLabels).
+    The decode + per-class NMS runs batched under jit; only the final
+    ragged filtering is host-side."""
+    loc, conf = split_ssd_output(jnp.asarray(flat), n_anchors_per_map,
+                                 n_classes)
+    boxes = decode_boxes(loc, anchors[None])                   # [B, A, 4]
+    probs = jax.nn.softmax(conf, axis=-1)
+    idx, valid = jax.vmap(
+        lambda bx, pr: nms_multiclass(bx, pr.T[1:], iou_threshold,
+                                      max_out))(boxes, probs)
+    idx, valid = np.asarray(idx), np.asarray(valid)
+    boxes_np, probs_np = np.asarray(boxes), np.asarray(probs)
+    out: List[Dict[int, Tuple[np.ndarray, np.ndarray]]] = []
+    for b in range(boxes_np.shape[0]):
+        per_cls: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for c in range(1, n_classes):                          # skip bg
+            ids = idx[b, c - 1][valid[b, c - 1]]
+            if not len(ids):
+                continue
+            scores = probs_np[b, ids, c]
+            keep = scores >= score_threshold
+            if not keep.any():
+                continue
+            order = np.argsort(-scores[keep], kind="stable")
+            per_cls[c] = (scores[keep][order], boxes_np[b, ids][keep][order])
+        out.append(per_cls)
+    return out
+
+
 class ObjectDetector:
     """`ObjectDetector` surface: model + anchors + label map, with the
     `ScaleDetection`-style postprocess (decode, per-class NMS, score
@@ -305,32 +339,62 @@ class ObjectDetector:
         self.n_classes = n_classes
         self.label_map = label_map or {}
 
+    def detect_raw(self, images: np.ndarray,
+                   score_threshold: float = 0.01,
+                   iou_threshold: float = 0.45, max_out: int = 100
+                   ) -> List[Dict[int, Tuple[np.ndarray, np.ndarray]]]:
+        """Per-image {class: (scores, boxes)} with a low score floor —
+        the evaluator's input form (decoded batch output)."""
+        flat = self.model.predict(np.asarray(images, np.float32),
+                                  batch_per_thread=8)
+        return decode_detections(flat, self.anchors,
+                                 self.n_anchors_per_map, self.n_classes,
+                                 score_threshold, iou_threshold, max_out)
+
     def predict(self, images: np.ndarray, score_threshold: float = 0.5,
                 iou_threshold: float = 0.45, max_out: int = 20
                 ) -> List[List[Tuple]]:
-        flat = self.model.predict(np.asarray(images, np.float32),
-                                  batch_per_thread=8)
-        loc, conf = split_ssd_output(jnp.asarray(flat),
-                                     self.n_anchors_per_map, self.n_classes)
-        boxes = decode_boxes(loc, self.anchors[None])           # [B, A, 4]
-        probs = jax.nn.softmax(conf, axis=-1)
-        # one IoU matrix per image, classes vmapped over it; batch vmapped
-        idx, valid = jax.vmap(
-            lambda bx, pr: nms_multiclass(
-                bx, pr.T[1:], iou_threshold, max_out))(boxes, probs)
-        idx, valid = np.asarray(idx), np.asarray(valid)
-        boxes_np, probs_np = np.asarray(boxes), np.asarray(probs)
+        dets = self.detect_raw(images, score_threshold, iou_threshold,
+                               max_out)
         out = []
-        for b in range(boxes_np.shape[0]):
+        for per_cls in dets:
             rows = []
-            for c in range(1, self.n_classes):                  # skip bg
-                for i, v in zip(idx[b, c - 1], valid[b, c - 1]):
-                    score = float(probs_np[b, i, c])
-                    if v and score >= score_threshold:
-                        x1, y1, x2, y2 = boxes_np[b, i]
-                        rows.append((self.label_map.get(c, c), score,
-                                     float(x1), float(y1), float(x2),
-                                     float(y2)))
+            for c, (scores, boxes) in per_cls.items():
+                for score, (x1, y1, x2, y2) in zip(scores, boxes):
+                    rows.append((self.label_map.get(c, c), float(score),
+                                 float(x1), float(y1), float(x2),
+                                 float(y2)))
             rows.sort(key=lambda r: -r[1])
             out.append(rows)
         return out
+
+    def evaluate(self, images: np.ndarray, gt,
+                 classes: Optional[Sequence[str]] = None,
+                 use_07_metric: bool = False, iou_threshold: float = 0.5,
+                 nms_iou: float = 0.45, score_threshold: float = 0.01,
+                 max_out: int = 100):
+        """mAP over a batch (`MeanAveragePrecision` wired the way the
+        reference's `ObjectDetector` evaluates with a ValidationMethod).
+        `gt` is either flat [M,7] rows or the padded gt dict from
+        `data/detection.py`. Returns a DetectionResult (print it for the
+        per-class table; `.result()[0]` is the mAP)."""
+        from analytics_zoo_tpu.models.detection_eval import \
+            MeanAveragePrecision
+        gt_rows = _gt_to_rows(gt)
+        if classes is None:
+            classes = ["__background__"] + [
+                str(self.label_map.get(c, c))
+                for c in range(1, self.n_classes)]
+        evaluator = MeanAveragePrecision(
+            classes, use_07_metric=use_07_metric,
+            iou_threshold=iou_threshold)
+        dets = self.detect_raw(images, score_threshold, nms_iou, max_out)
+        return evaluator(dets, gt_rows)
+
+
+def _gt_to_rows(gt) -> np.ndarray:
+    if isinstance(gt, dict):
+        from analytics_zoo_tpu.data.detection import gt_arrays_to_rows
+        return gt_arrays_to_rows(
+            {k: np.asarray(v) for k, v in gt.items()})
+    return np.asarray(gt, np.float32).reshape(-1, 7)

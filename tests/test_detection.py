@@ -1,0 +1,448 @@
+"""Detection vertical: VOC/COCO readers, roi-aware augmentation, VOC mAP.
+
+Reference test strategy mirrored: tiny in-repo fixtures + numeric pinning
+(`PascalVocSpec.scala`, `DataAugmentationSpec.scala`,
+`MeanAveragePrecision`/`EvalUtil` semantics hand-computed)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data import detection as dd
+from analytics_zoo_tpu.data import roi as R
+from analytics_zoo_tpu.models import detection_eval as de
+from analytics_zoo_tpu.models import objectdetection as od
+
+cv2 = pytest.importorskip("cv2")
+
+
+# ---------------------------------------------------------------------------
+# fixtures: synthetic VOC devkit / COCO dir
+# ---------------------------------------------------------------------------
+def _write_voc_xml(path, objs, size=(64, 64)):
+    items = []
+    for name, (x1, y1, x2, y2), diff in objs:
+        items.append(
+            f"<object><name>{name}</name><difficult>{diff}</difficult>"
+            f"<bndbox><xmin>{x1}</xmin><ymin>{y1}</ymin>"
+            f"<xmax>{x2}</xmax><ymax>{y2}</ymax></bndbox></object>")
+    xml = (f"<annotation><size><width>{size[1]}</width>"
+           f"<height>{size[0]}</height></size>{''.join(items)}"
+           "</annotation>")
+    with open(path, "w") as fh:
+        fh.write(xml)
+
+
+def _rect_image(boxes, size=64, color=(255, 255, 255)):
+    """Black image with filled rectangles at pixel boxes."""
+    img = np.zeros((size, size, 3), np.uint8)
+    for x1, y1, x2, y2 in boxes:
+        img[int(y1):int(y2), int(x1):int(x2)] = color
+    return img
+
+
+def make_voc_devkit(root, n_images=12, seed=0, image_set="train",
+                    size=64):
+    """VOCdevkit/VOC2007 layout with one 'car' rectangle per image (plus
+    one two-object image and one difficult object)."""
+    rng = np.random.RandomState(seed)
+    base = os.path.join(root, "VOC2007")
+    for sub in ("ImageSets/Main", "Annotations", "JPEGImages"):
+        os.makedirs(os.path.join(base, sub), exist_ok=True)
+    ids = []
+    for i in range(n_images):
+        idx = f"{i:06d}"
+        ids.append(idx)
+        w = rng.randint(18, 34)
+        h = rng.randint(18, 34)
+        x1 = rng.randint(2, size - w - 2)
+        y1 = rng.randint(2, size - h - 2)
+        box = (x1, y1, x1 + w, y1 + h)
+        objs = [("car", box, 0)]
+        img = _rect_image([box], size)
+        if i == 1:  # second class on one image
+            b2 = (2, 2, 14, 14)
+            objs.append(("person", b2, 0))
+            img[2:14, 2:14] = (128, 32, 32)
+        if i == 2:  # difficult flag
+            objs[0] = ("car", box, 1)
+        cv2.imwrite(os.path.join(base, "JPEGImages", f"{idx}.jpg"),
+                    cv2.cvtColor(img, cv2.COLOR_RGB2BGR))
+        _write_voc_xml(os.path.join(base, "Annotations", f"{idx}.xml"),
+                       objs, (size, size))
+    with open(os.path.join(base, "ImageSets", "Main",
+                           f"{image_set}.txt"), "w") as fh:
+        fh.write("\n".join(ids) + "\n")
+    return root
+
+
+# ---------------------------------------------------------------------------
+# readers
+# ---------------------------------------------------------------------------
+class TestVocReader:
+    def test_roidb_contents(self, tmp_path):
+        make_voc_devkit(str(tmp_path), n_images=4)
+        imdb = dd.PascalVoc("train", str(tmp_path))
+        roidb = imdb.get_roidb()
+        assert len(roidb) == 4
+        f0 = roidb[0]
+        assert f0.image.shape == (64, 64, 3)
+        assert f0.roi.classes[0] == dd.VOC_CLASS_TO_IND["car"] == 7
+        assert f0.roi.boxes.shape == (1, 4)
+        # the white rectangle is where the annotation says
+        x1, y1, x2, y2 = f0.roi.boxes[0].astype(int)
+        inside = f0.image[y1 + 2:y2 - 2, x1 + 2:x2 - 2]
+        assert inside.mean() > 180
+        # two-object image carries both classes
+        f1 = roidb[1]
+        assert set(f1.roi.classes) == {7, dd.VOC_CLASS_TO_IND["person"]}
+        # difficult flag parsed
+        assert roidb[2].roi.difficult[0] == 1.0
+
+    def test_skip_image_read(self, tmp_path):
+        make_voc_devkit(str(tmp_path), n_images=2)
+        roidb = dd.PascalVoc("train", str(tmp_path)).get_roidb(
+            read_image=False)
+        assert roidb[0].image is None and len(roidb[0].roi) == 1
+
+    def test_imdb_factory(self, tmp_path):
+        make_voc_devkit(str(tmp_path), n_images=2)
+        imdb = dd.Imdb.get_imdb("voc_2007_train", str(tmp_path))
+        assert isinstance(imdb, dd.PascalVoc)
+        assert len(imdb.get_roidb(read_image=False)) == 2
+        with pytest.raises(ValueError):
+            dd.Imdb.get_imdb("imagenet_train", str(tmp_path))
+
+    def test_missing_devkit_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            dd.PascalVoc("train", str(tmp_path / "nope"))
+
+
+class TestCocoReader:
+    def _make(self, tmp_path):
+        os.makedirs(tmp_path / "ImageSets", exist_ok=True)
+        os.makedirs(tmp_path / "imgs", exist_ok=True)
+        os.makedirs(tmp_path / "anns", exist_ok=True)
+        img = _rect_image([(10, 10, 40, 40)], 64)
+        cv2.imwrite(str(tmp_path / "imgs" / "a.jpg"),
+                    cv2.cvtColor(img, cv2.COLOR_RGB2BGR))
+        ann = {"image": {"width": 64, "height": 64},
+               "annotation": [
+                   # xywh, cat 17 = "cat" -> class index 16 (sparse remap)
+                   {"bbox": [10, 10, 30, 30], "area": 900,
+                    "category_id": 17},
+                   # zero-area must be dropped
+                   {"bbox": [5, 5, 0, 10], "area": 0, "category_id": 1},
+                   # clipped to image bounds
+                   {"bbox": [50, 50, 30, 30], "area": 900,
+                    "category_id": 1}]}
+        with open(tmp_path / "anns" / "a.json", "w") as fh:
+            json.dump(ann, fh)
+        with open(tmp_path / "ImageSets" / "val.txt", "w") as fh:
+            fh.write("imgs/a.jpg anns/a.json\n")
+
+    def test_roidb(self, tmp_path):
+        self._make(tmp_path)
+        roidb = dd.Coco("val", str(tmp_path)).get_roidb()
+        assert len(roidb) == 1
+        roi = roidb[0].roi
+        assert len(roi) == 2                      # zero-area dropped
+        assert roi.classes[0] == 16               # cat id 17 remapped
+        np.testing.assert_allclose(roi.boxes[0], [10, 10, 39, 39])
+        np.testing.assert_allclose(roi.boxes[1], [50, 50, 63, 63])
+        assert dd.COCO_CLASSES[16] == "cat"
+
+
+# ---------------------------------------------------------------------------
+# roi transforms
+# ---------------------------------------------------------------------------
+class TestRoiTransforms:
+    def test_normalize(self):
+        img = np.zeros((100, 200, 3), np.uint8)
+        roi = R.RoiLabel([1], [[20, 10, 60, 50]])
+        _, out = R.RoiNormalize().apply(img, roi)
+        np.testing.assert_allclose(out.boxes[0], [0.1, 0.1, 0.3, 0.5])
+
+    def test_hflip(self):
+        img = np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3)
+        roi = R.RoiLabel([1], [[0.1, 0.2, 0.4, 0.6]])
+        fimg, out = R.RoiHFlip().apply(img, roi)
+        np.testing.assert_allclose(out.boxes[0], [0.6, 0.2, 0.9, 0.6],
+                                   atol=1e-6)
+        np.testing.assert_array_equal(fimg, img[:, ::-1])
+
+    def test_expand_preserves_content_and_boxes(self):
+        rng = np.random.RandomState(0)
+        img = rng.randint(0, 255, (40, 60, 3)).astype(np.uint8)
+        roi = R.RoiLabel([1], [[0.25, 0.25, 0.75, 0.75]])
+        canvas, out = R.RoiExpand(seed=7).apply(img, roi)
+        nH, nW = canvas.shape[:2]
+        assert nH >= 40 and nW >= 60
+        # locate the pasted image by its top-left pixel run
+        pos = np.argwhere((canvas == img[0, 0]).all(-1))
+        found = False
+        for y0, x0 in pos:
+            if y0 + 40 <= nH and x0 + 60 <= nW and \
+                    np.array_equal(canvas[y0:y0 + 40, x0:x0 + 60], img):
+                found = True
+                break
+        assert found, "original image not found inside canvas"
+        # box remap: normalized box over canvas == original box in pixels
+        b = out.boxes[0] * np.array([nW, nH, nW, nH], np.float32)
+        expect = np.array([x0 + 0.25 * 60, y0 + 0.25 * 40,
+                           x0 + 0.75 * 60, y0 + 0.75 * 40])
+        np.testing.assert_allclose(b, expect, atol=1.0)
+
+    def test_project_boxes_center_rule(self):
+        roi = R.RoiLabel([1, 2], [[0.3, 0.3, 0.6, 0.6],     # center inside
+                                  [0.0, 0.0, 0.2, 0.2]])    # center outside
+        crop = np.array([0.25, 0.25, 0.75, 0.75], np.float32)
+        out = R.project_boxes(roi, crop)
+        assert len(out) == 1 and out.classes[0] == 1
+        np.testing.assert_allclose(out.boxes[0], [0.1, 0.1, 0.7, 0.7],
+                                   atol=1e-6)
+
+    def test_random_sampler_invariants(self):
+        img = _rect_image([(16, 16, 48, 48)], 64)
+        base = R.RoiLabel([1], [[0.25, 0.25, 0.75, 0.75]])
+        sampler = R.RoiRandomSampler(seed=11)
+        kept_any = False
+        changed = False
+        for _ in range(30):
+            out_img, out = sampler.apply(img, base)
+            assert out_img.size > 0
+            if len(out):
+                kept_any = True
+                assert np.all(out.boxes >= -1e-6)
+                assert np.all(out.boxes <= 1 + 1e-6)
+                assert set(out.classes).issubset({1})
+            if out_img.shape != img.shape:
+                changed = True
+        assert kept_any and changed
+
+    def test_random_preprocessing_probability(self):
+        img = np.zeros((8, 8, 3), np.uint8)
+        roi = R.RoiLabel([1], [[0.1, 0.1, 0.5, 0.5]])
+        always = R.RoiRandomPreprocessing(R.RoiHFlip(), p=1.0, seed=0)
+        never = R.RoiRandomPreprocessing(R.RoiHFlip(), p=0.0, seed=0)
+        _, r1 = always.apply(img, roi)
+        _, r2 = never.apply(img, roi)
+        np.testing.assert_allclose(r1.boxes[0], [0.5, 0.1, 0.9, 0.5],
+                                   atol=1e-6)
+        np.testing.assert_allclose(r2.boxes[0], roi.boxes[0])
+
+    def test_train_chain_output_contract(self, tmp_path):
+        make_voc_devkit(str(tmp_path), n_images=3)
+        x, gt = dd.load_ssd_train_set(
+            "voc_2007_train", str(tmp_path), resolution=32, max_gt=4,
+            seed=0, normalize=lambda im: im.astype(np.float32) / 255.0)
+        assert x.shape == (3, 32, 32, 3) and x.dtype == np.float32
+        assert gt["gt_boxes"].shape == (3, 4, 4)
+        assert gt["gt_labels"].shape == (3, 4)
+        live = gt["gt_labels"] > 0
+        assert live.any()
+        assert np.all(gt["gt_boxes"][live] >= -1e-6)
+        assert np.all(gt["gt_boxes"][live] <= 1 + 1e-6)
+
+    def test_gt_rows_roundtrip(self):
+        gt = {"gt_boxes": np.array([[[0.1, 0.1, 0.5, 0.5],
+                                     [0, 0, 0, 0]],
+                                    [[0.2, 0.2, 0.6, 0.6],
+                                     [0.3, 0.3, 0.4, 0.4]]], np.float32),
+              "gt_labels": np.array([[7, 0], [1, 2]], np.int32),
+              "difficult": np.array([[1, 0], [0, 0]], np.float32)}
+        rows = dd.gt_arrays_to_rows(gt)
+        assert rows.shape == (3, 7)
+        np.testing.assert_allclose(
+            rows[0], [0, 7, 1, 0.1, 0.1, 0.5, 0.5], atol=1e-6)
+        assert rows[1][0] == 1 and rows[2][1] == 2
+
+
+# ---------------------------------------------------------------------------
+# mAP numerics (hand-computed; `EvalUtil`/`vocAp` semantics)
+# ---------------------------------------------------------------------------
+def _det(scores, boxes):
+    return (np.asarray(scores, np.float32),
+            np.asarray(boxes, np.float32).reshape(-1, 4))
+
+
+class TestVocAp:
+    def test_perfect_single(self):
+        rec = np.array([1.0])
+        prec = np.array([1.0])
+        assert de.voc_ap(rec, prec) == pytest.approx(1.0)
+        assert de.voc_ap(rec, prec, True) == pytest.approx(1.0)
+
+    def test_area_metric_hand_computed(self):
+        # records: tp@.9, fp@.8, tp@.7 with npos=2
+        ap = de.compute_ap([(0.9, 1, 0), (0.8, 0, 1), (0.7, 1, 0)], 2)
+        assert ap == pytest.approx(0.5 + 0.5 * (2.0 / 3.0), abs=1e-6)
+
+    def test_07_metric_hand_computed(self):
+        ap = de.compute_ap([(0.9, 1, 0), (0.8, 0, 1), (0.7, 1, 0)], 2,
+                           use_07_metric=True)
+        assert ap == pytest.approx((6 * 1.0 + 5 * (2.0 / 3.0)) / 11,
+                                   abs=1e-6)
+
+    def test_no_positives(self):
+        assert de.compute_ap([(0.9, 0, 1)], 0) == 0.0
+        assert de.compute_ap([], 5) == 0.0
+
+
+class TestEvaluateClass:
+    GT = np.array([  # (img, label, diff, x1, y1, x2, y2)
+        [0, 1, 0, 0.1, 0.1, 0.5, 0.5],
+        [1, 1, 0, 0.2, 0.2, 0.6, 0.6],
+    ], np.float32)
+
+    def test_tp_fp_marking(self):
+        dets = {0: _det([0.9], [[0.1, 0.1, 0.5, 0.5]]),
+                1: _det([0.8, 0.7],
+                        [[0.8, 0.8, 0.9, 0.9],       # misses
+                         [0.2, 0.2, 0.6, 0.6]])}     # hits
+        npos, recs = de.evaluate_class(dets, self.GT, cls=1)
+        assert npos == 2
+        assert sorted(recs, key=lambda r: -r[0]) == [
+            (pytest.approx(0.9), 1, 0), (pytest.approx(0.8), 0, 1),
+            (pytest.approx(0.7), 1, 0)]
+
+    def test_duplicate_detection_is_fp(self):
+        gt = self.GT[:1]
+        dets = {0: _det([0.9, 0.8], [[0.1, 0.1, 0.5, 0.5],
+                                     [0.12, 0.1, 0.5, 0.5]])}
+        npos, recs = de.evaluate_class(dets, gt, cls=1)
+        assert npos == 1
+        assert recs == [(pytest.approx(0.9), 1, 0),
+                        (pytest.approx(0.8), 0, 1)]
+
+    def test_difficult_ignored(self):
+        gt = np.array([[0, 1, 1, 0.1, 0.1, 0.5, 0.5]], np.float32)
+        dets = {0: _det([0.9], [[0.1, 0.1, 0.5, 0.5]])}
+        npos, recs = de.evaluate_class(dets, gt, cls=1)
+        assert npos == 0 and recs == []     # neither tp nor fp
+
+    def test_detection_on_empty_image_is_fp(self):
+        dets = {5: _det([0.9], [[0.1, 0.1, 0.5, 0.5]])}
+        npos, recs = de.evaluate_class(dets, self.GT, cls=1)
+        assert recs == [(pytest.approx(0.9), 0, 1)]
+
+    def test_unnormalized_plus_one_convention(self):
+        # 10x10 pixel boxes, exact overlap: normalized=False uses the VOC
+        # +1 extent so IoU is exactly 1
+        gt = np.array([[0, 1, 0, 10, 10, 19, 19]], np.float32)
+        dets = {0: _det([0.9], [[10, 10, 19, 19]])}
+        npos, recs = de.evaluate_class(dets, gt, cls=1, normalized=False)
+        assert recs == [(pytest.approx(0.9), 1, 0)]
+
+
+class TestMeanAveragePrecision:
+    CLASSES = ["__background__", "car", "person"]
+
+    def test_multiclass_map(self):
+        gt = np.array([
+            [0, 1, 0, 0.1, 0.1, 0.5, 0.5],     # car img0
+            [0, 2, 0, 0.6, 0.6, 0.9, 0.9],     # person img0
+            [1, 2, 0, 0.2, 0.2, 0.6, 0.6],     # person img1
+        ], np.float32)
+        dets = [
+            {1: _det([0.9], [[0.1, 0.1, 0.5, 0.5]]),       # car tp
+             2: _det([0.8], [[0.6, 0.6, 0.9, 0.9]])},      # person tp
+            {},                                             # img1: miss
+        ]
+        ev = de.MeanAveragePrecision(self.CLASSES)
+        res = ev(dets, gt)
+        aps = dict(res.ap_by_class())
+        assert aps["car"] == pytest.approx(1.0)
+        assert aps["person"] == pytest.approx(0.5)
+        assert res.result()[0] == pytest.approx(0.75)
+        assert "AP for car = 1.0000" in str(res)
+
+    def test_batch_merge(self):
+        gt0 = np.array([[0, 1, 0, 0.1, 0.1, 0.5, 0.5]], np.float32)
+        gt1 = np.array([[0, 1, 0, 0.2, 0.2, 0.6, 0.6]], np.float32)
+        ev = de.MeanAveragePrecision(self.CLASSES)
+        r0 = ev([{1: _det([0.9], [[0.1, 0.1, 0.5, 0.5]])}], gt0)
+        r1 = ev([{1: _det([0.8], [[0.8, 0.8, 0.9, 0.9]])}], gt1)  # fp
+        merged = r0 + r1
+        aps = dict(merged.ap_by_class())
+        # 2 gts, one tp@.9 one fp@.8 -> rec [.5,.5] prec [1,.5] -> AP .5
+        assert aps["car"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: SSD trains on the synthetic VOC fixture with augmentation
+# and the mAP improves
+# ---------------------------------------------------------------------------
+class TestSSDEndToEnd:
+    def test_train_improves_map(self, tmp_path):
+        make_voc_devkit(str(tmp_path), n_images=12, seed=3)
+        norm = lambda im: im.astype(np.float32) / 255.0   # noqa: E731
+        # two augmentation passes over the set = more crop/flip diversity
+        x1, g1 = dd.load_ssd_train_set("voc_2007_train", str(tmp_path),
+                                       resolution=64, max_gt=4, seed=0,
+                                       normalize=norm)
+        x2, g2 = dd.load_ssd_train_set("voc_2007_train", str(tmp_path),
+                                       resolution=64, max_gt=4, seed=1,
+                                       normalize=norm)
+        x = np.concatenate([x1, x2])
+        gt = {k: np.concatenate([g1[k], g2[k]]) for k in g1}
+        xv, gv = dd.load_ssd_val_set("voc_2007_train", str(tmp_path),
+                                     resolution=64, max_gt=4,
+                                     normalize=norm)
+
+        n_classes = len(dd.VOC_CLASSES)
+        model, anchors = od.build_ssd(n_classes=n_classes, image_size=64)
+        n_per_map = [8 * 8 * 3, 4 * 4 * 3]
+        params = model.build(jax.random.PRNGKey(0))
+
+        labels, loc_t, matched = jax.vmap(
+            lambda b, l: od.match_anchors(b, l, jnp.asarray(anchors)))(
+                jnp.asarray(gt["gt_boxes"]),
+                jnp.asarray(gt["gt_labels"]))
+
+        import optax
+        opt = optax.adam(3e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                flat = model.apply(p, jnp.asarray(x))
+                loc, conf = od.split_ssd_output(flat, n_per_map,
+                                                n_classes)
+                return od.multibox_loss(conf, loc, labels, loc_t,
+                                        matched)
+            l, g = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(params, updates), opt_state, l
+
+        def car_ap(det):
+            res = det.evaluate(xv, gv, classes=list(dd.VOC_CLASSES))
+            return dict(res.ap_by_class())["car"], res
+
+        model.params = jax.device_get(params)
+        det = od.ObjectDetector(model, anchors, n_per_map, n_classes)
+        ap_before, _ = car_ap(det)
+
+        losses = []
+        for _ in range(150):
+            params, opt_state, l = step(params, opt_state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] and np.isfinite(losses).all()
+
+        model.params = jax.device_get(params)
+        ap_after, res = car_ap(det)
+        assert ap_after > ap_before
+        assert ap_after > 0.5, str(res)
+        # the estimator-pluggable metric path agrees
+        from analytics_zoo_tpu.models.detection_eval import DetectionMAP
+        m = DetectionMAP(anchors, n_per_map, n_classes,
+                         classes=list(dd.VOC_CLASSES))
+        flat = model.predict(xv, batch_per_thread=8)
+        res2 = m.evaluate_flat(flat, gv)
+        assert dict(res2.ap_by_class())["car"] == pytest.approx(
+            ap_after, abs=1e-6)
