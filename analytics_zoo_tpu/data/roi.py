@@ -275,17 +275,21 @@ def ssd_train_transforms(resolution: int,
                          means: Sequence[float] = (123.0, 117.0, 104.0),
                          expand_p: float = 0.5, flip_p: float = 0.5,
                          seed: Optional[int] = None,
-                         color_jitter=None) -> RoiChain:
+                         color_jitter="default") -> RoiChain:
     """The reference SSD training chain (`SSDDataSet.loadSSDTrainSet`):
-    normalize rois -> [color jitter] -> random expand -> random IoU crop ->
-    resize -> random hflip. Channel normalization/dtype is left to the
-    caller's lifted photometric ops so eval/train share it."""
+    normalize rois -> color jitter -> random expand -> random IoU crop ->
+    resize -> random hflip. `color_jitter=None` disables the photometric
+    leg; channel normalization/dtype is left to the caller's lifted ops so
+    eval/train share it."""
     rng = np.random.RandomState(seed)
 
     def sub():          # independent child streams, one seeded source
         return int(rng.randint(0, 2 ** 31 - 1))
 
     chain: List[RoiImageProcessing] = [RoiNormalize()]
+    if color_jitter == "default":
+        from analytics_zoo_tpu.data.image import ImageColorJitter
+        color_jitter = ImageColorJitter(seed=sub())
     if color_jitter is not None:
         chain.append(RoiLift(color_jitter))
     chain += [

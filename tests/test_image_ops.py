@@ -1,0 +1,257 @@
+"""Image augmentation op set + threaded decode pipeline.
+
+Per-op numerical tests (reference semantics: `feature/image/*.scala`
+wrappers over the BigDL/Caffe-SSD photometric + geometric augmentation
+set) and the image-folder prefetch dataset."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.data import image as I
+
+cv2 = pytest.importorskip("cv2")
+
+
+def checker(size=32):
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 255, (size, size, 3)).astype(np.uint8)
+
+
+class TestPhotometric:
+    def test_hue_shifts_hsv_channel(self):
+        # pure red: H=0; +60 of OpenCV hue (=120 real degrees) lands on
+        # pure green's H=60
+        img = np.zeros((4, 4, 3), np.uint8)
+        img[..., 0] = 255
+        out = I.ImageHue(60, 60, seed=0).apply(img)
+        np.testing.assert_array_equal(out[0, 0], [0, 255, 0])
+        hsv = cv2.cvtColor(out, cv2.COLOR_RGB2HSV)
+        assert np.all(hsv[..., 0] == 60)
+        # wrap-around stays in [0, 180)
+        out2 = I.ImageHue(170, 170, seed=0).apply(img)
+        assert cv2.cvtColor(out2, cv2.COLOR_RGB2HSV)[..., 0].max() < 180
+
+    def test_saturation_gray_fixed_point(self):
+        gray = np.full((8, 8, 3), 128, np.uint8)
+        out = I.ImageSaturation(0.5, 0.5, seed=0).apply(gray)
+        np.testing.assert_array_equal(out, gray)
+
+    def test_saturation_scales(self):
+        img = np.zeros((4, 4, 3), np.uint8)
+        img[...] = (200, 100, 100)                  # saturated-ish red
+        half = I.ImageSaturation(0.5, 0.5, seed=0).apply(img)
+        s_in = cv2.cvtColor(img, cv2.COLOR_RGB2HSV)[..., 1]
+        s_out = cv2.cvtColor(half, cv2.COLOR_RGB2HSV)[..., 1]
+        np.testing.assert_allclose(s_out, s_in // 2, atol=2)
+
+    def test_contrast_multiplies(self):
+        img = np.full((4, 4, 3), 100, np.uint8)
+        out = I.ImageContrast(1.5, 1.5, seed=0).apply(img)
+        assert np.all(out == 150)
+        out = I.ImageContrast(3.0, 3.0, seed=0).apply(img)
+        assert np.all(out == 255)                   # clipped
+
+    def test_channel_order_permutes(self):
+        img = np.zeros((2, 2, 3), np.uint8)
+        img[..., 0], img[..., 1], img[..., 2] = 10, 20, 30
+        out = I.ImageChannelOrder(seed=1).apply(img)
+        assert sorted(out[0, 0].tolist()) == [10, 20, 30]
+
+    def test_color_jitter_runs_and_is_seeded(self):
+        img = checker()
+        a = I.ImageColorJitter(seed=7).apply(img)
+        b = I.ImageColorJitter(seed=7).apply(img)
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == img.shape and a.dtype == np.uint8
+        # with all probs 1 something definitely changes
+        c = I.ImageColorJitter(brightness_prob=1.0, contrast_prob=1.0,
+                               hue_prob=1.0, saturation_prob=1.0,
+                               seed=3).apply(img)
+        assert not np.array_equal(c, img)
+
+    def test_color_jitter_shuffle_mode(self):
+        img = checker()
+        out = I.ImageColorJitter(shuffle=True, seed=5).apply(img)
+        assert out.shape == img.shape
+
+
+class TestGeometric:
+    def test_expand_ratio_and_content(self):
+        img = checker(20)
+        out = I.ImageExpand(min_expand_ratio=2.0, max_expand_ratio=2.0,
+                            seed=0).apply(img)
+        assert out.shape == (40, 40, 3)
+        pos = np.argwhere((out == img[0, 0]).all(-1))
+        assert any(np.array_equal(out[y:y + 20, x:x + 20], img)
+                   for y, x in pos if y + 20 <= 40 and x + 20 <= 40)
+
+    def test_filler_fills_region(self):
+        img = np.zeros((10, 10, 3), np.uint8)
+        out = I.ImageFiller(0.2, 0.2, 0.5, 0.5, value=255).apply(img)
+        assert np.all(out[2:5, 2:5] == 255)
+        assert out[0, 0, 0] == 0 and out[6, 6, 0] == 0
+        with pytest.raises(ValueError):
+            I.ImageFiller(0.5, 0.2, 0.3, 0.5)
+
+    def test_fixed_crop_normalized_and_pixel(self):
+        img = checker(20)
+        out = I.ImageFixedCrop(0.25, 0.25, 0.75, 0.75).apply(img)
+        np.testing.assert_array_equal(out, img[5:15, 5:15])
+        out = I.ImageFixedCrop(5, 5, 15, 15, normalized=False).apply(img)
+        np.testing.assert_array_equal(out, img[5:15, 5:15])
+
+    def test_fixed_crop_clip(self):
+        img = checker(20)
+        out = I.ImageFixedCrop(-0.5, 0.0, 1.5, 1.0).apply(img)
+        np.testing.assert_array_equal(out, img)
+        with pytest.raises(ValueError):
+            I.ImageFixedCrop(-0.5, 0.0, 1.5, 1.0, is_clip=False).apply(img)
+
+    def test_mirror_flips_both_axes(self):
+        img = checker(8)
+        out = I.ImageMirror().apply(img)
+        np.testing.assert_array_equal(out, img[::-1, ::-1])
+
+    def test_random_resize_bounds(self):
+        img = checker(16)
+        for _ in range(10):
+            out = I.ImageRandomResize(8, 12, seed=None).apply(img)
+            assert 8 <= out.shape[0] < 12 and out.shape[0] == out.shape[1]
+
+    def test_aspect_scale_short_edge(self):
+        img = np.zeros((50, 100, 3), np.uint8)
+        out = I.ImageAspectScale(min_size=25).apply(img)
+        assert out.shape[:2] == (25, 50)
+        # long-edge cap wins: 100*0.5 = 50 > 40 -> scale becomes 0.4
+        out = I.ImageAspectScale(min_size=25, max_size=40).apply(img)
+        assert out.shape[:2] == (20, 40)
+        # multiple-of rounding
+        out = I.ImageAspectScale(min_size=25, scale_multiple_of=8).apply(
+            img)
+        assert out.shape[0] % 8 == 0 and out.shape[1] % 8 == 0
+
+    def test_random_aspect_scale_choices(self):
+        img = np.zeros((50, 100, 3), np.uint8)
+        seen = set()
+        op = I.ImageRandomAspectScale([20, 40], seed=0)
+        for _ in range(10):
+            seen.add(op.apply(img).shape[0])
+        assert seen == {20, 40}
+
+    def test_random_cropper(self):
+        img = checker(20)
+        out = I.ImageRandomCropper(8, 6, cropper_method="center").apply(
+            img)
+        np.testing.assert_array_equal(out, img[7:13, 6:14])
+        out = I.ImageRandomCropper(8, 6, seed=0).apply(img)
+        assert out.shape == (6, 8, 3)
+        with pytest.raises(ValueError):
+            I.ImageRandomCropper(8, 6, cropper_method="diagonal")
+
+
+class TestNormalizers:
+    def test_channel_scaled_normalizer(self):
+        img = np.full((2, 2, 3), 100, np.float32)
+        out = I.ImageChannelScaledNormalizer(10, 20, 30, 0.5).apply(img)
+        np.testing.assert_allclose(out[0, 0], [45.0, 40.0, 35.0])
+
+    def test_pixel_normalize(self):
+        img = np.full((2, 2, 3), 5, np.float32)
+        means = np.ones((2, 2, 3), np.float32)
+        np.testing.assert_allclose(
+            I.ImagePixelNormalize(means).apply(img), img - 1)
+        with pytest.raises(ValueError):
+            I.ImagePixelNormalize(np.ones((3, 3, 3))).apply(img)
+
+    def test_per_image_normalize_minmax(self):
+        img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+        out = I.PerImageNormalize(0, 1).apply(img)
+        assert out.min() == 0.0 and out.max() == 1.0
+        np.testing.assert_allclose(out, img / 11.0)
+
+    def test_per_image_normalize_l2(self):
+        img = np.ones((2, 2, 1), np.float32)
+        out = I.PerImageNormalize(1, 0, norm_type=I.NORM_L2).apply(img)
+        np.testing.assert_allclose(np.sqrt((out ** 2).sum()), 1.0,
+                                   rtol=1e-6)
+
+    def test_random_preprocessing_prob(self):
+        img = checker(8)
+        out = I.ImageRandomPreprocessing(I.ImageMirror(), p=0.0,
+                                         seed=0).apply(img)
+        np.testing.assert_array_equal(out, img)
+        out = I.ImageRandomPreprocessing(I.ImageMirror(), p=1.0,
+                                         seed=0).apply(img)
+        np.testing.assert_array_equal(out, img[::-1, ::-1])
+
+
+class TestParallelPipeline:
+    def _folder(self, tmp_path, n_per_class=6, size=16):
+        for cls in ("cats", "dogs"):
+            os.makedirs(tmp_path / cls, exist_ok=True)
+            for i in range(n_per_class):
+                img = np.full((size, size, 3),
+                              40 if cls == "cats" else 200, np.uint8)
+                cv2.imwrite(str(tmp_path / cls / f"{i}.png"), img)
+        return str(tmp_path)
+
+    def test_parallel_map_ordered_preserves_order(self):
+        out = list(I.parallel_map_ordered(lambda x: x * x, range(100), 4))
+        assert out == [i * i for i in range(100)]
+
+    def test_parallel_read_matches_serial(self, tmp_path):
+        path = self._folder(tmp_path)
+        a = I.ImageSet.read(path, with_label=True, num_workers=1)
+        b = I.ImageSet.read(path, with_label=True, num_workers=4)
+        assert a.paths == b.paths
+        np.testing.assert_array_equal(a.labels, b.labels)
+        for x, y in zip(a.images, b.images):
+            np.testing.assert_array_equal(x, y)
+
+    def test_folder_dataset_stream(self, tmp_path):
+        path = self._folder(tmp_path)
+        ds = I.image_folder_dataset(
+            path, transform=I.ImageResize(8, 8)
+            >> I.ImageChannelNormalize(0, 0, 0, 255, 255, 255),
+            batch_size=4, num_workers=3)
+        assert ds.n_samples() == 12
+        sx, sy = ds.first_sample()
+        assert sx.shape == (8, 8, 3) and sy in (0, 1)
+        batches = list(ds.iter_train(data_parallel=1, seed=0))
+        assert len(batches) == 3
+        for xb, yb, bsz in batches:
+            assert xb.shape == (4, 8, 8, 3) and bsz == 4
+            assert xb.dtype == np.float32
+            # labels track their images through the shuffle: cats are
+            # dark (0), dogs bright (1)
+            bright = xb.mean(axis=(1, 2, 3)) > 0.4
+            np.testing.assert_array_equal(bright.astype(np.int32), yb)
+
+    def test_folder_dataset_materialize(self, tmp_path):
+        path = self._folder(tmp_path)
+        ds = I.image_folder_dataset(path, transform=I.ImageResize(8, 8),
+                                    batch_size=4, num_workers=3)
+        x, y = ds.materialize()
+        assert x.shape == (12, 8, 8, 3)
+        assert sorted(np.unique(y).tolist()) == [0, 1]
+
+    def test_folder_dataset_fits_estimator(self, tmp_path):
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        path = self._folder(tmp_path)
+        ds = I.image_folder_dataset(
+            path, transform=I.ImageResize(8, 8)
+            >> I.ImageChannelNormalize(127, 127, 127, 255, 255, 255),
+            batch_size=8, num_workers=2)   # 8 = dp size of the test mesh
+        model = Sequential([L.Flatten(input_shape=(8, 8, 3)),
+                            L.Dense(2, activation="softmax")])
+        model.compile(optimizer="adam",
+                      loss="sparse_categorical_crossentropy")
+        est = Estimator.from_keras(model)
+        est.fit(ds, epochs=6)
+        x, y = ds.materialize()
+        acc = (np.argmax(model.predict(x), -1) == y).mean()
+        assert acc == 1.0
