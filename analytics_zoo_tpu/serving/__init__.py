@@ -21,6 +21,8 @@ _EXPORTS = {
     "InputQueue": "analytics_zoo_tpu.serving.client",
     "OutputQueue": "analytics_zoo_tpu.serving.client",
     "ClusterServing": "analytics_zoo_tpu.serving.server",
+    "RedisBroker": "analytics_zoo_tpu.serving.broker",
+    "MiniRedisServer": "analytics_zoo_tpu.serving.redis_server",
     "Timer": "analytics_zoo_tpu.serving.timer",
     "FrontEnd": "analytics_zoo_tpu.serving.http_frontend",
     "ServingConfig": "analytics_zoo_tpu.serving.config",

@@ -50,17 +50,22 @@ def cmd_start(args) -> int:
         frontend._srv.serving = serving
     print("cluster serving started", flush=True)
 
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
-    try:
-        while not stop:
-            time.sleep(0.5)
-    finally:
+    def shutdown():
         if frontend:
             frontend.stop()
         serving.stop()
         print(json.dumps(serving.metrics()), flush=True)
+
+    return _run_until_signal(shutdown)
+
+
+def _run_until_signal(stop_fn) -> int:
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.5)
+    stop_fn()
     return 0
 
 
@@ -68,13 +73,16 @@ def cmd_broker(args) -> int:
     from analytics_zoo_tpu.serving.broker import TCPBrokerServer
     srv = TCPBrokerServer(host=args.host, port=args.port).start()
     print(f"broker listening on {srv.host}:{srv.port}", flush=True)
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
-    while not stop:
-        time.sleep(0.5)
-    srv.stop()
-    return 0
+    return _run_until_signal(srv.stop)
+
+
+def cmd_redis(args) -> int:
+    """Standalone RESP2 stream/hash server (`redis://` brokers connect to
+    it with the real wire protocol; swap in a production Redis freely)."""
+    from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+    srv = MiniRedisServer(host=args.host, port=args.port).start()
+    print(f"mini-redis listening on {srv.url}", flush=True)
+    return _run_until_signal(srv.stop)
 
 
 def cmd_metrics(args) -> int:
@@ -100,6 +108,10 @@ def main(argv=None) -> int:
     pb.add_argument("--host", default="0.0.0.0")
     pb.add_argument("--port", type=int, default=6379)
     pb.set_defaults(fn=cmd_broker)
+    pr = sub.add_parser("redis", help="run the in-package RESP2 server")
+    pr.add_argument("--host", default="0.0.0.0")
+    pr.add_argument("--port", type=int, default=6379)
+    pr.set_defaults(fn=cmd_redis)
     pm = sub.add_parser("metrics", help="fetch frontend metrics")
     pm.add_argument("--url", required=True)
     pm.set_defaults(fn=cmd_metrics)

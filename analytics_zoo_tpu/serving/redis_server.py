@@ -1,0 +1,240 @@
+"""Self-contained RESP2 stream/hash server ("mini redis").
+
+The serving data plane is reference-faithful Redis streams
+(`FlinkRedisSource.scala:66-87`), but the deploy image carries no redis
+binary — so the framework ships its own small RESP2 server implementing
+exactly the command subset the stack uses: XADD / XGROUP CREATE
+(MKSTREAM) / XREADGROUP (COUNT, BLOCK, ">") / XACK / XDEL and
+HSET/HGET/HGETALL/HDEL. `RedisBroker` (`serving/broker.py`) talks to it
+over the real wire protocol, so serving latency can be measured across a
+genuine socket hop, and a production Redis can be swapped in with no code
+change (same commands, same framing).
+
+Blocking XREADGROUP is implemented with a condition variable: a BLOCK
+window parks the reader until XADD signals, instead of busy-polling."""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from analytics_zoo_tpu.serving.broker import RESPError
+
+
+class MiniRedisStore:
+    """In-memory streams + hashes with consumer-group semantics: per-group
+    last-delivered cursor and pending-entries list (PEL)."""
+
+    def __init__(self):
+        self.streams: Dict[str, List[Tuple[str, List[str]]]] = {}
+        self.groups: Dict[Tuple[str, str], Dict] = {}
+        self.hashes: Dict[str, Dict[str, str]] = {}
+        self.seq = 0
+        self.lock = threading.Lock()
+        self.data_ready = threading.Condition(self.lock)
+
+    # -- command dispatch --------------------------------------------------
+    def execute(self, args: List[str]):
+        cmd = args[0].upper()
+        handler = getattr(self, "cmd_" + cmd.lower(), None)
+        if handler is None:
+            raise RESPError(f"ERR unknown command '{cmd}'")
+        if cmd == "XREADGROUP":
+            # manages its own locking (may park on the condition)
+            return handler(args[1:])
+        with self.lock:
+            return handler(args[1:])
+
+    def cmd_xadd(self, a):
+        stream, rid = a[0], a[1]
+        if rid != "*":
+            raise RESPError("ERR only auto-generated ids are supported")
+        self.seq += 1
+        rid = f"{self.seq}-0"
+        self.streams.setdefault(stream, []).append((rid, list(a[2:])))
+        self.data_ready.notify_all()
+        return rid
+
+    def cmd_xgroup(self, a):
+        if a[0].upper() != "CREATE":
+            raise RESPError("ERR only XGROUP CREATE is supported")
+        stream, group = a[1], a[2]
+        mkstream = any(str(x).upper() == "MKSTREAM" for x in a[4:])
+        if stream not in self.streams:
+            if not mkstream:
+                raise RESPError("ERR The XGROUP subcommand requires the "
+                                "key to exist")
+            self.streams[stream] = []
+        if (stream, group) in self.groups:
+            raise RESPError("BUSYGROUP Consumer Group name already exists")
+        self.groups[(stream, group)] = {"cursor": 0, "pel": set()}
+        return "OK"
+
+    def _pop_new(self, stream: str, group: str, count: int):
+        g = self.groups.get((stream, group))
+        if g is None:
+            raise RESPError("NOGROUP No such consumer group")
+        entries = self.streams.get(stream, [])
+        new = entries[g["cursor"]:g["cursor"] + count]
+        g["cursor"] += len(new)
+        g["pel"].update(rid for rid, _ in new)
+        return new
+
+    def cmd_xreadgroup(self, a):
+        if a[0].upper() != "GROUP":
+            raise RESPError("ERR XREADGROUP must start with GROUP")
+        group = a[1]
+        opts = [str(x).upper() for x in a[3:]]
+        count = int(a[3 + opts.index("COUNT") + 1]) \
+            if "COUNT" in opts else 10
+        block_ms: Optional[int] = None
+        if "BLOCK" in opts:
+            block_ms = int(a[3 + opts.index("BLOCK") + 1])
+        si = opts.index("STREAMS")
+        stream, cursor_id = a[3 + si + 1], a[3 + si + 2]
+        if cursor_id != ">":
+            raise RESPError("ERR only the new-messages cursor '>' is "
+                            "supported")
+        deadline = None if block_ms is None else (
+            None if block_ms == 0 else time.monotonic() + block_ms / 1e3)
+        with self.lock:
+            while True:
+                new = self._pop_new(stream, group, count)
+                if new:
+                    return [[stream,
+                             [[rid, fields] for rid, fields in new]]]
+                if block_ms is None:
+                    return None
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                if not self.data_ready.wait(remaining):
+                    return None
+
+    def cmd_xack(self, a):
+        stream, group, ids = a[0], a[1], a[2:]
+        g = self.groups.get((stream, group))
+        n = 0
+        for rid in ids:
+            if g and rid in g["pel"]:
+                g["pel"].discard(rid)
+                n += 1
+        return n
+
+    def cmd_xdel(self, a):
+        stream, ids = a[0], set(a[1:])
+        entries = self.streams.get(stream, [])
+        removed = sum(1 for r, _ in entries if r in ids)
+        # group cursors are list positions: removing delivered entries in
+        # front of a cursor must pull the cursor back with them
+        for (s, _), g in self.groups.items():
+            if s == stream:
+                g["cursor"] -= sum(1 for r, _ in entries[:g["cursor"]]
+                                   if r in ids)
+        self.streams[stream] = [(r, f) for r, f in entries if r not in ids]
+        return removed
+
+    def cmd_hset(self, a):
+        self.hashes.setdefault(a[0], {})[a[1]] = a[2]
+        return 1
+
+    def cmd_hget(self, a):
+        return self.hashes.get(a[0], {}).get(a[1])
+
+    def cmd_hgetall(self, a):
+        out: List[str] = []
+        for k, v in self.hashes.get(a[0], {}).items():
+            out.extend([k, v])
+        return out
+
+    def cmd_hdel(self, a):
+        h = self.hashes.get(a[0], {})
+        return 1 if h.pop(a[1], None) is not None else 0
+
+    def cmd_ping(self, a):
+        return "PONG" if not a else a[0]
+
+
+class _RESPHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                args = self._read_command()
+            except (ConnectionError, ValueError):
+                return
+            if args is None:
+                return
+            try:
+                reply = self.server.store.execute(args)
+                self.wfile.write(_encode_reply(reply))
+            except RESPError as e:
+                self.wfile.write(b"-%s\r\n" % str(e).encode())
+            except Exception as e:  # noqa: BLE001 — protocol error reply
+                self.wfile.write(b"-ERR %s\r\n" % str(e).encode())
+
+    def _read_command(self):
+        line = self.rfile.readline()
+        if not line:
+            return None
+        if line[:1] != b"*":
+            raise ValueError(f"expected RESP array, got {line!r}")
+        n = int(line[1:-2])
+        args = []
+        for _ in range(n):
+            hdr = self.rfile.readline()
+            if hdr[:1] != b"$":
+                raise ValueError(f"expected bulk string, got {hdr!r}")
+            ln = int(hdr[1:-2])
+            args.append(self.rfile.read(ln + 2)[:-2].decode())
+        return args
+
+
+def _encode_reply(v) -> bytes:
+    if v is None:
+        return b"*-1\r\n"
+    if isinstance(v, int):
+        return b":%d\r\n" % v
+    if isinstance(v, str):
+        if v in ("OK", "PONG"):
+            return b"+%s\r\n" % v.encode()
+        data = v.encode()
+        return b"$%d\r\n%s\r\n" % (len(data), data)
+    if isinstance(v, list):
+        return b"*%d\r\n" % len(v) + b"".join(
+            _encode_reply(x) for x in v)
+    raise TypeError(f"cannot encode {type(v)} as RESP")
+
+
+class MiniRedisServer:
+    """Threaded RESP2 server over a MiniRedisStore.
+
+    >>> srv = MiniRedisServer().start()
+    >>> broker = connect_broker(srv.url)     # real socket + wire protocol
+    >>> srv.stop()
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 store: Optional[MiniRedisStore] = None):
+        self.store = store or MiniRedisStore()
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _RESPHandler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.store = self.store
+        self.host, self.port = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"redis://{self.host}:{self.port}"
+
+    def start(self) -> "MiniRedisServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -1,16 +1,20 @@
 """Serving latency benchmark — p50/p99 end-to-end through the broker.
 
 BASELINE.md target: p50 < 50 ms for the batched TPU InferenceModel behind
-the stream queue. Runs the full client → broker → serve loop → client
-round trip in-process (the reference measures the same path through Redis,
-`docker/cluster-serving/perf/offline-benchmark`). Prints ONE JSON line.
+the Redis queue. The same workload runs through THREE broker paths and
+reports each (the reference measures through Redis,
+`docker/cluster-serving/perf/offline-benchmark:1-25`):
+
+- memory: in-process MemoryBroker (stack floor: encode/batch/jit/decode)
+- tcp:    TCPBrokerServer over a localhost socket
+- redis:  RedisBroker speaking real RESP2 to the in-package
+          MiniRedisServer over a localhost socket — the wire path a
+          production Redis would serve; the headline number.
 
 Note on dev rigs with a remote-tunneled TPU (axon): every device call pays
-the tunnel's HTTP round trip (~100 ms), which dominates the measurement.
-The serving stack itself — client encode, broker, dynamic batching,
-bucketed jit dispatch, decode — measures p50 ≈ 0.7 ms with an in-process
-backend (`JAX_PLATFORMS=cpu`), far inside the 50 ms target; a real v5e
-host runs the model in-process the same way.
+the tunnel's HTTP round trip (~100 ms), which dominates. A real v5e host
+runs the model in-process; set JAX_PLATFORMS=cpu to measure the serving
+stack itself.
 
     python bench_serving.py
 """
@@ -19,20 +23,67 @@ from __future__ import annotations
 
 import json
 import sys
-import threading
 import time
 
 import numpy as np
+
+
+N_REQUESTS = 200
+
+
+def _measure(infer, broker_kind: str, n: int = N_REQUESTS):
+    from analytics_zoo_tpu.serving.broker import (MemoryBroker, TCPBroker,
+                                                  TCPBrokerServer)
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    server = None
+    if broker_kind == "memory":
+        serve_broker = client_broker = MemoryBroker()
+    elif broker_kind == "tcp":
+        server = TCPBrokerServer().start()
+        serve_broker = TCPBroker(server.host, server.port)
+        client_broker = TCPBroker(server.host, server.port)
+    elif broker_kind == "redis":
+        from analytics_zoo_tpu.serving.broker import RedisBroker
+        server = MiniRedisServer().start()
+        serve_broker = RedisBroker(server.host, server.port)
+        client_broker = RedisBroker(server.host, server.port)
+    else:
+        raise ValueError(broker_kind)
+
+    serving = ClusterServing(infer, broker=serve_broker, batch_size=32,
+                             batch_timeout_ms=2).start()
+    inq = InputQueue(client_broker)
+    outq = OutputQueue(client_broker)
+
+    img = np.random.rand(32, 32, 3).astype(np.float32)
+    lat = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        uri = inq.enqueue(t=img)
+        while True:
+            res = outq.query(uri, delete=True)
+            if res is not None:
+                break
+            time.sleep(0.0005)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    serving.stop()
+    for br in (serve_broker, client_broker):
+        if hasattr(br, "close"):
+            br.close()
+    if server is not None:
+        server.stop()
+    lat = np.asarray(sorted(lat))
+    return (float(np.percentile(lat, 50)), float(np.percentile(lat, 99)))
 
 
 def main():
     from analytics_zoo_tpu import init_orca_context, stop_orca_context
     from analytics_zoo_tpu.keras import Sequential
     from analytics_zoo_tpu.keras import layers as L
-    from analytics_zoo_tpu.serving.broker import MemoryBroker
-    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
     from analytics_zoo_tpu.serving.inference_model import InferenceModel
-    from analytics_zoo_tpu.serving.server import ClusterServing
 
     init_orca_context(cluster_mode="local")
     model = Sequential([
@@ -49,37 +100,23 @@ def main():
     for b in (1, 2, 4, 8, 16, 32):
         infer.predict(np.zeros((b, 32, 32, 3), np.float32))
 
-    broker = MemoryBroker()
-    serving = ClusterServing(infer, broker=broker, batch_size=32,
-                             batch_timeout_ms=2).start()
-    inq = InputQueue(broker)
-    outq = OutputQueue(broker)
-
-    n = 200
-    lat = []
-    img = np.random.rand(32, 32, 3).astype(np.float32)
-    for i in range(n):
-        t0 = time.perf_counter()
-        uri = inq.enqueue(t=img)
-        while True:
-            res = outq.query(uri, delete=True)
-            if res is not None:
-                break
-            time.sleep(0.0005)
-        lat.append((time.perf_counter() - t0) * 1e3)
-    serving.stop()
+    results = {}
+    for kind in ("memory", "tcp", "redis"):
+        p50, p99 = _measure(infer, kind)
+        results[kind] = {"p50_ms": round(p50, 2), "p99_ms": round(p99, 2)}
     stop_orca_context()
 
-    lat = np.asarray(sorted(lat))
-    p50 = float(np.percentile(lat, 50))
-    p99 = float(np.percentile(lat, 99))
+    # headline: the Redis-wire path (what BASELINE.md names)
+    p50 = results["redis"]["p50_ms"]
     print(json.dumps({
         "metric": "serving_p50_latency",
-        "value": round(p50, 2),
+        "value": p50,
         "unit": "ms",
-        "vs_baseline": round(50.0 / p50, 3),   # >1 = better than target
-        "p99_ms": round(p99, 2),
-        "n_requests": n,
+        "vs_baseline": round(50.0 / max(p50, 1e-6), 3),  # >1 beats target
+        "broker": "redis",
+        "p99_ms": results["redis"]["p99_ms"],
+        "by_broker": results,
+        "n_requests": N_REQUESTS,
     }))
 
 

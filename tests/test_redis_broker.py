@@ -1,4 +1,4 @@
-"""RedisBroker protocol tests against an in-process RESP2 server double.
+"""RedisBroker protocol tests against the in-package RESP2 server.
 
 The image carries no redis server or client library, so the broker speaks
 RESP itself (`serving/broker.py _RESPClient`); this server double decodes
@@ -7,11 +7,6 @@ reference uses (`FlinkRedisSource.scala:66-87`), so a typo in command
 names, argument order, or reply parsing fails here instead of against a
 production Redis."""
 
-import json
-import socket
-import socketserver
-import threading
-
 import numpy as np
 import pytest
 
@@ -19,175 +14,19 @@ from analytics_zoo_tpu.serving.broker import (RESPError, RedisBroker,
                                               encode_ndarray)
 
 
-class _MiniRedis:
-    """Tiny RESP2 redis: XADD/XGROUP CREATE/XREADGROUP/XACK/XDEL +
-    HSET/HGET/HGETALL/HDEL. Enough semantics for the broker contract:
-    per-group last-delivered cursor, pending-entries list, MKSTREAM."""
-
-    def __init__(self):
-        self.streams = {}     # name -> list[(id, [field, value, ...])]
-        self.groups = {}      # (stream, group) -> {"cursor": int, "pel": set}
-        self.hashes = {}      # key -> dict
-        self.seq = 0
-        self.lock = threading.Lock()
-
-    # -- command dispatch --------------------------------------------------
-    def execute(self, args):
-        cmd = args[0].upper()
-        with self.lock:
-            return getattr(self, "cmd_" + cmd.lower(),
-                           self._unknown)(args[1:])
-
-    def _unknown(self, args):
-        raise RESPError("ERR unknown command")
-
-    def cmd_xadd(self, a):
-        stream, rid = a[0], a[1]
-        assert rid == "*", "only auto ids supported"
-        self.seq += 1
-        rid = f"{self.seq}-0"
-        self.streams.setdefault(stream, []).append((rid, list(a[2:])))
-        return rid
-
-    def cmd_xgroup(self, a):
-        assert a[0].upper() == "CREATE"
-        stream, group = a[1], a[2]
-        mkstream = any(x.upper() == "MKSTREAM" for x in a[4:])
-        if stream not in self.streams:
-            if not mkstream:
-                raise RESPError("ERR The XGROUP subcommand requires the "
-                                "key to exist")
-            self.streams[stream] = []
-        if (stream, group) in self.groups:
-            raise RESPError("BUSYGROUP Consumer Group name already exists")
-        self.groups[(stream, group)] = {"cursor": 0, "pel": set()}
-        return "OK"
-
-    def cmd_xreadgroup(self, a):
-        assert a[0].upper() == "GROUP"
-        group, consumer = a[1], a[2]
-        opts = [x.upper() if isinstance(x, str) else x for x in a[3:]]
-        count = int(a[3 + opts.index("COUNT") + 1]) \
-            if "COUNT" in opts else 10
-        si = opts.index("STREAMS")
-        stream, cursor_id = a[3 + si + 1], a[3 + si + 2]
-        assert cursor_id == ">", "only new-messages cursor supported"
-        g = self.groups.get((stream, group))
-        if g is None:
-            raise RESPError("NOGROUP No such consumer group")
-        entries = self.streams.get(stream, [])
-        new = entries[g["cursor"]:g["cursor"] + count]
-        g["cursor"] += len(new)
-        g["pel"].update(rid for rid, _ in new)
-        if not new:
-            return None
-        return [[stream, [[rid, fields] for rid, fields in new]]]
-
-    def cmd_xack(self, a):
-        stream, group, ids = a[0], a[1], a[2:]
-        g = self.groups.get((stream, group))
-        n = 0
-        for rid in ids:
-            if g and rid in g["pel"]:
-                g["pel"].discard(rid)
-                n += 1
-        return n
-
-    def cmd_xdel(self, a):
-        stream, ids = a[0], set(a[1:])
-        before = len(self.streams.get(stream, []))
-        kept = [(r, f) for r, f in self.streams.get(stream, [])
-                if r not in ids]
-        removed = before - len(kept)
-        # keep cursor consistent with list-position semantics
-        for key, g in self.groups.items():
-            if key[0] == stream:
-                g["cursor"] -= sum(
-                    1 for r, _ in self.streams.get(stream, [])[:g["cursor"]]
-                    if r in ids)
-        self.streams[stream] = kept
-        return removed
-
-    def cmd_hset(self, a):
-        self.hashes.setdefault(a[0], {})[a[1]] = a[2]
-        return 1
-
-    def cmd_hget(self, a):
-        return self.hashes.get(a[0], {}).get(a[1])
-
-    def cmd_hgetall(self, a):
-        out = []
-        for k, v in self.hashes.get(a[0], {}).items():
-            out.extend([k, v])
-        return out
-
-    def cmd_hdel(self, a):
-        h = self.hashes.get(a[0], {})
-        return 1 if h.pop(a[1], None) is not None else 0
-
-
-class _Handler(socketserver.StreamRequestHandler):
-    def handle(self):
-        while True:
-            try:
-                args = self._read_command()
-            except (ConnectionError, ValueError):
-                return
-            if args is None:
-                return
-            try:
-                reply = self.server.store.execute(args)
-                self.wfile.write(self._encode(reply))
-            except RESPError as e:
-                self.wfile.write(b"-%s\r\n" % str(e).encode())
-            except Exception as e:  # noqa: BLE001
-                self.wfile.write(b"-ERR %s\r\n" % str(e).encode())
-
-    def _read_command(self):
-        line = self.rfile.readline()
-        if not line:
-            return None
-        assert line[:1] == b"*", f"expected array, got {line!r}"
-        n = int(line[1:-2])
-        args = []
-        for _ in range(n):
-            hdr = self.rfile.readline()
-            assert hdr[:1] == b"$"
-            ln = int(hdr[1:-2])
-            args.append(self.rfile.read(ln + 2)[:-2].decode())
-        return args
-
-    def _encode(self, v) -> bytes:
-        if v is None:
-            return b"*-1\r\n"
-        if isinstance(v, int):
-            return b":%d\r\n" % v
-        if isinstance(v, str):
-            if v == "OK":
-                return b"+OK\r\n"
-            data = v.encode()
-            return b"$%d\r\n%s\r\n" % (len(data), data)
-        if isinstance(v, list):
-            return b"*%d\r\n" % len(v) + b"".join(
-                self._encode(x) for x in v)
-        raise TypeError(type(v))
+from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
 
 
 @pytest.fixture()
 def redis_server():
-    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Handler)
-    srv.daemon_threads = True
-    srv.store = _MiniRedis()
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
-    t.start()
+    srv = MiniRedisServer().start()
     yield srv
-    srv.shutdown()
-    srv.server_close()
+    srv.stop()
 
 
 class TestRedisBrokerProtocol:
     def test_stream_group_ack_cycle(self, redis_server):
-        br = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br = RedisBroker("127.0.0.1", redis_server.port)
         rid = br.xadd("serving_stream", {"uri": "a", "data": {"v": 1}})
         assert rid == "1-0"
         got = br.read_group("serving_stream", "serving", "c1", count=8)
@@ -201,14 +40,14 @@ class TestRedisBrokerProtocol:
         assert redis_server.store.streams["serving_stream"] == []
 
     def test_group_create_idempotent(self, redis_server):
-        br = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br = RedisBroker("127.0.0.1", redis_server.port)
         br.read_group("s", "g", "c", count=1, block_ms=1)
-        br2 = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br2 = RedisBroker("127.0.0.1", redis_server.port)
         # second client hits BUSYGROUP internally and proceeds
         assert br2.read_group("s", "g", "c2", count=1, block_ms=1) == []
 
     def test_hash_ops(self, redis_server):
-        br = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br = RedisBroker("127.0.0.1", redis_server.port)
         br.hset("result:serving_stream", "uri1", "[1.0, 2.0]")
         br.hset("result:serving_stream", "uri2", "NaN")
         assert br.hget("result:serving_stream", "uri1") == "[1.0, 2.0]"
@@ -219,7 +58,7 @@ class TestRedisBrokerProtocol:
 
     def test_record_payload_round_trip(self, redis_server):
         # the actual serving record shape (b64 ndarray) survives the wire
-        br = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br = RedisBroker("127.0.0.1", redis_server.port)
         arr = np.arange(6, dtype=np.float32).reshape(2, 3)
         br.xadd("serving_stream", {"uri": "u",
                                    "data": {"t": encode_ndarray(arr)}})
@@ -231,7 +70,7 @@ class TestRedisBrokerProtocol:
     def test_long_block_survives_client_socket_timeout(self, redis_server):
         # BLOCK windows past the connection default (10s) must not kill
         # the socket: the per-command deadline stretches past block_ms
-        br = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br = RedisBroker("127.0.0.1", redis_server.port)
         br._r._timeout_s = 0.2  # shrink default to make the bug cheap
         br._r._sock.settimeout(0.2)
         t0 = __import__("time").time()
@@ -244,7 +83,7 @@ class TestRedisBrokerProtocol:
     def test_reconnects_after_connection_loss(self, redis_server):
         # a timed-out/killed connection must not permanently dead-end the
         # broker: the next command reconnects (serving loops run for days)
-        br = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br = RedisBroker("127.0.0.1", redis_server.port)
         br.hset("k", "f", "1")
         br._r.close()  # simulate the close-on-timeout path
         assert br.hget("k", "f") == "1"  # transparently reconnected
@@ -260,7 +99,7 @@ class TestRedisBrokerProtocol:
         m.ensure_built(np.zeros((1, 3), np.float32))
         im = InferenceModel()
         im.load_keras(m)
-        port = redis_server.server_address[1]
+        port = redis_server.port
         broker = RedisBroker("127.0.0.1", port)
         serving = ClusterServing(im, broker, batch_timeout_ms=20).start()
         try:
@@ -276,7 +115,7 @@ class TestRedisBrokerProtocol:
             serving.stop()
 
     def test_error_reply_raises(self, redis_server):
-        br = RedisBroker("127.0.0.1", redis_server.server_address[1])
+        br = RedisBroker("127.0.0.1", redis_server.port)
         with pytest.raises(RESPError):
             br._r.command("NOSUCHCOMMAND")
 
@@ -291,7 +130,7 @@ class TestRedisBrokerProtocol:
         m.ensure_built(np.zeros((1, 4), np.float32))
         im = InferenceModel()
         im.load_keras(m)
-        port = redis_server.server_address[1]
+        port = redis_server.port
         serving = ClusterServing(
             im, RedisBroker("127.0.0.1", port)).start()
         try:
@@ -300,3 +139,34 @@ class TestRedisBrokerProtocol:
             assert np.asarray(out).shape == (3,)
         finally:
             serving.stop()
+
+
+class TestBlockingRead:
+    def test_block_parks_until_xadd(self, redis_server):
+        """BLOCK must wake on XADD (condition variable), not poll-timeout:
+        the read returns well before the 5s block window elapses."""
+        import threading
+        import time
+        br = RedisBroker("127.0.0.1", redis_server.port)
+        got = {}
+
+        def reader():
+            t0 = time.time()
+            got["res"] = br.read_group("bs", "g", "c", count=1,
+                                       block_ms=5000)
+            got["dt"] = time.time() - t0
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.2)
+        RedisBroker("127.0.0.1", redis_server.port).xadd("bs", {"v": 1})
+        t.join(timeout=10)
+        assert got["res"] and got["res"][0][1] == {"v": 1}
+        assert 0.1 < got["dt"] < 3.0
+
+    def test_block_times_out_empty(self, redis_server):
+        import time
+        br = RedisBroker("127.0.0.1", redis_server.port)
+        t0 = time.time()
+        assert br.read_group("bs2", "g", "c", count=1, block_ms=200) == []
+        assert 0.15 < time.time() - t0 < 2.0
