@@ -234,6 +234,106 @@ class _TPE:
         return cfg
 
 
+# ---------------------------------------------------------------------------
+# GP/EI sampler (the reference's SkOpt SearchAlg role,
+# ray_tune_search_engine.py:244-282 — Bayesian optimization proper)
+# ---------------------------------------------------------------------------
+class _GPBayes:
+    """Gaussian-process Bayesian optimization with expected improvement.
+
+    Configs encode to a unit-cube vector (numeric dims min-max scaled,
+    loguniform in log space; choice/grid dims one-hot like skopt's
+    categorical encoding). The surrogate is an RBF-kernel GP with a
+    median-distance length scale and a small noise floor, fit by one
+    Cholesky solve per suggestion (numpy only — no skopt dependency);
+    suggestions maximize EI over random candidates. Same `suggest`
+    surface as `_TPE` so the engine's model-based wave loop is shared."""
+
+    def __init__(self, space: Dict[str, Any], mode: str,
+                 n_candidates: int = 256, xi: float = 0.01, seed: int = 0):
+        self.space = space
+        self.mode = mode
+        self.n_candidates = n_candidates
+        self.xi = xi
+        self.rng = random.Random(seed)
+
+    # -- encoding ---------------------------------------------------------
+    def _dims(self):
+        for k, v in self.space.items():
+            if isinstance(v, (_Choice, _Grid)):
+                yield k, v, len(v.options)
+            elif isinstance(v, _Sampler):
+                yield k, v, 1
+
+    def _encode_cfg(self, cfg: Dict[str, Any]) -> List[float]:
+        vec: List[float] = []
+        for k, v, width in self._dims():
+            val = cfg.get(k)
+            if isinstance(v, (_Choice, _Grid)):
+                onehot = [0.0] * width
+                reprs = [repr(o) for o in v.options]
+                if repr(val) in reprs:
+                    onehot[reprs.index(repr(val))] = 1.0
+                vec.extend(onehot)
+            else:
+                lo, hi = v.lo, v.hi
+                x = float(val)
+                if isinstance(v, _LogUniform):
+                    x = math.log(max(x, 1e-300))
+                vec.append((x - lo) / ((hi - lo) or 1.0))
+        return vec
+
+    def _random_cfg(self) -> Dict[str, Any]:
+        out = {}
+        for k, v in self.space.items():
+            if isinstance(v, _Grid):
+                out[k] = self.rng.choice(v.options)
+            elif isinstance(v, _Sampler):
+                out[k] = v.sample(self.rng)
+            else:
+                out[k] = v
+        return out
+
+    def suggest(self, observed: List["Trial"]) -> Dict[str, Any]:
+        import numpy as np
+        ok = [t for t in observed if t.ok]
+        if len(ok) < 4:
+            return self._random_cfg()
+        X = np.asarray([self._encode_cfg(t.config) for t in ok])
+        y = np.asarray([t.metric for t in ok], float)
+        if self.mode == "max":
+            y = -y                                  # GP minimizes
+        y_mu, y_sd = y.mean(), y.std() or 1.0
+        yn = (y - y_mu) / y_sd
+
+        # median-heuristic length scale over observed pairs
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        tri = d2[np.triu_indices(len(X), 1)]
+        ls2 = float(np.median(tri[tri > 0])) if (tri > 0).any() else 1.0
+
+        K = np.exp(-d2 / (2 * ls2)) + 1e-6 * np.eye(len(X))
+        K += 1e-3 * np.eye(len(X))                  # observation noise
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+
+        cands = [self._random_cfg() for _ in range(self.n_candidates)]
+        Xc = np.asarray([self._encode_cfg(c) for c in cands])
+        d2c = ((Xc[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        Ks = np.exp(-d2c / (2 * ls2))               # [n_cand, n_obs]
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.clip(1.0 + 1e-3 - (v ** 2).sum(0), 1e-12, None)
+        sd = np.sqrt(var)
+
+        best = yn.min()
+        imp = best - mu - self.xi
+        z = imp / sd
+        cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        pdf = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+        ei = imp * cdf + sd * pdf
+        return cands[int(np.argmax(ei))]
+
+
 # module-level so the spawn-based process pool can pickle it
 def _run_trial_payload(payload):
     train_fn, data, config, budget, metric = payload
@@ -279,14 +379,14 @@ class SearchEngine:
                  search_alg: Optional[str] = None):
         if mode not in ("min", "max"):
             raise ValueError("mode must be min|max")
-        if search_alg not in (None, "random", "tpe"):
-            raise ValueError("search_alg must be random|tpe")
-        if scheduler == "asha" and search_alg == "tpe":
+        if search_alg not in (None, "random", "tpe", "bayes"):
+            raise ValueError("search_alg must be random|tpe|bayes")
+        if scheduler == "asha" and search_alg in ("tpe", "bayes"):
             raise ValueError(
-                "search_alg='tpe' and scheduler='asha' are mutually "
-                "exclusive in this engine: ASHA rungs re-evaluate a fixed "
-                "population while TPE grows one. Drop the scheduler to use "
-                "TPE at full budget.")
+                f"search_alg={search_alg!r} and scheduler='asha' are "
+                "mutually exclusive in this engine: ASHA rungs re-evaluate "
+                "a fixed population while model-based search grows one. "
+                "Drop the scheduler to search at full budget.")
         if backend == "ray":
             try:
                 import ray  # noqa: F401
@@ -334,7 +434,11 @@ class SearchEngine:
         if self.scheduler == "asha":
             self.trials = self._run_asha()
         elif self.search_alg == "tpe":
-            self.trials = self._run_tpe()
+            self.trials = self._run_model_based(
+                _TPE(self._space, self.mode, seed=self.seed))
+        elif self.search_alg == "bayes":
+            self.trials = self._run_model_based(
+                _GPBayes(self._space, self.mode, seed=self.seed))
         else:
             self.trials = self._map_trials(self._configs, self.max_budget)
         return self.trials
@@ -391,11 +495,11 @@ class SearchEngine:
     def _run_one(self, config: Dict, budget: int) -> Trial:
         return self._map_trials([config], budget)[0]
 
-    def _run_tpe(self) -> List[Trial]:
-        """Model-based sequential optimization in n_workers-sized waves:
-        total trials = len(expanded configs) (recipe num_samples)."""
+    def _run_model_based(self, sampler) -> List[Trial]:
+        """Model-based sequential optimization (TPE or GP/EI) in
+        n_workers-sized waves: total trials = len(expanded configs)
+        (recipe num_samples)."""
         total = len(self._configs)
-        tpe = _TPE(self._space, self.mode, seed=self.seed)
         done: List[Trial] = []
         # startup wave: first configs from the random expansion
         startup = min(max(4, self.n_workers), total)
@@ -403,7 +507,7 @@ class SearchEngine:
                                      self.max_budget))
         while len(done) < total:
             wave = min(self.n_workers, total - len(done))
-            configs = [tpe.suggest(done) for _ in range(wave)]
+            configs = [sampler.suggest(done) for _ in range(wave)]
             done.extend(self._map_trials(configs, self.max_budget))
         return done
 

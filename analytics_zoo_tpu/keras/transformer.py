@@ -239,12 +239,13 @@ class BERT(Layer):
                  seq_len: int = 512, intermediate_size: int = 3072,
                  type_vocab: int = 2, hidden_drop: float = 0.1,
                  attn_drop: float = 0.1, pooled_only: bool = False,
-                 use_flash: bool = False, **kw):
+                 use_flash: bool = False, remat: bool = False, **kw):
         super().__init__(**kw)
         self.vocab, self.hidden_size = vocab, hidden_size
         self.seq_len, self.type_vocab = seq_len, type_vocab
         self.hidden_drop = hidden_drop
         self.pooled_only = pooled_only
+        self.remat = remat
         self.blocks = [
             TransformerEncoderBlock(hidden_size, n_head, intermediate_size,
                                     hidden_dropout=hidden_drop,
@@ -309,8 +310,22 @@ class BERT(Layer):
             sub = None
             if rng is not None:
                 rng, sub = jax.random.split(rng)
-            h = blk.call(params[blk.name], [h, mask], training=training,
-                         rng=sub)
+            if self.remat:
+                # activation rematerialization per block: save only the
+                # matmul outputs with no batch dims (i.e. nothing — all
+                # block dots carry the batch), recompute the rest in the
+                # backward pass. Trades ~1/3 more FLOPs on the block for
+                # O(1) blocks of live activations, unlocking batch sizes
+                # (and seq lengths) the non-remat program cannot fit.
+                h = jax.checkpoint(
+                    lambda p, hh, mm, rr, _blk=blk: _blk.call(
+                        p, [hh, mm], training=training, rng=rr),
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)(
+                        params[blk.name], h, mask, sub)
+            else:
+                h = blk.call(params[blk.name], [h, mask],
+                             training=training, rng=sub)
         pooled = jnp.tanh(h[:, 0] @ params["pooler_kernel"]
                           + params["pooler_bias"])
         if self.pooled_only:

@@ -64,16 +64,37 @@ def _flash_supported(mask) -> bool:
     return True
 
 
+def _auto_block(T: int) -> int:
+    """Largest multiple of 128 that divides T, capped at 1024 — big tiles
+    amortize DMA/softmax-state overhead (see the v5e table in
+    docs/ROOFLINE.md) without padding sequence lengths like 1152 that a
+    1024 block would round up to 2048 (~3× wasted attention work).
+    Lengths with no 128-multiple divisor fall back to 128 + the pad
+    path."""
+    for b in (1024, 512, 256, 128):
+        if T % b == 0:
+            return b
+    return 128
+
+
 def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
                     dropout_rate: float = 0.0,
                     dropout_seed: Optional[jax.Array] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """q,k,v: [B, H, T, Dh]. mask: additive [B,1,1,T] (padding) or
     [B,1,T,T] (full; reference path only). `dropout_rate` > 0 needs
     `dropout_seed` (scalar int32). Differentiable (custom VJP); the mask
     receives a zero cotangent (padding masks are data, not parameters).
-    Returns [B, H, T, Dh]."""
+    Returns [B, H, T, Dh].
+
+    Block sizes default to the largest 128-multiple divisor of T up to
+    1024: per-tile work must amortize the DMA + softmax-state overhead —
+    measured on v5e at T=2048, 1024×1024 blocks run the fwd+bwd 4.4×
+    faster than 128×128 and beat the XLA reference attention (~12 vs
+    ~19 ms fwd). VMEM stays O(block_q·block_k) f32 (~4 MB at 1024²) plus
+    the K/V double buffers."""
     if dropout_rate > 0.0 and dropout_seed is None:
         raise ValueError("flash_attention: dropout_rate > 0 needs a "
                          "dropout_seed (deterministic in-kernel masks)")
@@ -95,6 +116,10 @@ def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
                                     dropout_rate if use_dropout else 0.0,
                                     key)
     B, H, T, D = q.shape
+    if block_q is None:
+        block_q = _auto_block(T)
+    if block_k is None:
+        block_k = _auto_block(T)
     if mask is None:
         mask = jnp.zeros((B, 1, 1, T), jnp.float32)
     block = math.lcm(block_q, block_k)

@@ -1,0 +1,45 @@
+"""Per-chip peak numbers for roofline/MFU accounting (docs/ROOFLINE.md).
+
+Single source of truth for the benches (`bench.py`, `bench_ncf.py`) and
+any profiling hook that wants achieved-vs-peak ratios. Values are the
+published per-chip peaks; lookup is by `device_kind` substring."""
+
+from __future__ import annotations
+
+PEAK_BF16_FLOPS = [  # device_kind substring -> peak bf16 FLOP/s per chip
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+PEAK_HBM_BYTES = [  # device_kind substring -> peak HBM bytes/s per chip
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5 lite", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+
+
+def _lookup(device, table, default: float) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for sub, peak in table:
+        if sub in kind:
+            return peak
+    return default
+
+
+def peak_flops(device) -> float:
+    """Peak bf16 matmul FLOP/s; unknown TPUs assume v5e."""
+    return _lookup(device, PEAK_BF16_FLOPS, 197e12)
+
+
+def peak_hbm(device) -> float:
+    """Peak HBM bytes/s; unknown TPUs assume v5e."""
+    return _lookup(device, PEAK_HBM_BYTES, 819e9)

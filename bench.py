@@ -33,52 +33,25 @@ if ("JAX_DEFAULT_PRNG_IMPL" not in os.environ
 import numpy as np
 import optax
 
-_PEAK_BF16 = [  # device_kind substring -> peak bf16 FLOP/s per chip
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-]
+from analytics_zoo_tpu.utils.roofline import peak_flops
 
 
-def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for sub, peak in _PEAK_BF16:
-        if sub in kind:
-            return peak
-    return 197e12  # unknown TPU: assume v5e
-
-
-def main():
-    from analytics_zoo_tpu import init_orca_context
+def _measure_bert(dev, *, vocab, hidden, n_block, n_head, seq_len, inter,
+                  batch, steps, steps_per_run, use_flash=False,
+                  remat=False):
+    """One BERT-classifier training measurement THROUGH Estimator.fit.
+    Returns (mfu, tokens/s, step_ms, final_loss)."""
     from analytics_zoo_tpu.learn.estimator import Estimator
     from analytics_zoo_tpu.models.bert import BERTClassifier
     from analytics_zoo_tpu.ops import objectives
 
-    tiny = os.environ.get("BENCH_TINY") == "1"
-    if tiny:
-        vocab, hidden, n_block, n_head, seq_len, inter = 512, 128, 2, 2, 64, 256
-        batch, steps, steps_per_run = 8, 6, 3
-    else:
-        vocab, hidden, n_block, n_head, seq_len, inter = (
-            30522, 768, 12, 12, 128, 3072)
-        # batch 256 measures ~2-4 MFU points above 128 on v5e (more work
-        # per dispatch amortizes the per-run host turnaround)
-        batch = int(os.environ.get("BENCH_BATCH", 256))
-        steps = int(os.environ.get("BENCH_STEPS", 48))
-        steps_per_run = int(os.environ.get("BENCH_SPR", 24))
-
-    init_orca_context(cluster_mode="local")
-    dev = jax.devices()[0]
-
-    use_flash = os.environ.get("BENCH_FLASH") == "1"
+    drop_kw = {}
+    if os.environ.get("BENCH_NODROP") == "1":   # roofline experiments
+        drop_kw = dict(hidden_drop=0.0, attn_drop=0.0, dropout=0.0)
     model = BERTClassifier(
         num_classes=2, vocab=vocab, hidden_size=hidden, n_block=n_block,
         n_head=n_head, seq_len=seq_len, intermediate_size=inter,
-        use_flash=use_flash)
+        use_flash=use_flash, remat=remat, **drop_kw)
     est = Estimator.from_keras(
         model, optimizer=optax.adamw(1e-4),
         loss=objectives.get("sparse_categorical_crossentropy",
@@ -96,7 +69,6 @@ def main():
     t0 = time.perf_counter()
     hist = est.fit(data, **fit_kw)          # timed: cached program, real loop
     dt = time.perf_counter() - t0
-    loss = hist["loss"][-1]
 
     # Matmul params only (embeddings are gathers, not FLOPs).
     n_params = sum(int(np.prod(np.shape(p))) for p in
@@ -106,21 +78,64 @@ def main():
     tokens = batch * seq_len
     # fwd+bwd = 6 FLOPs/param/token; attention scores+context add
     # 12 * L * T^2 * D per batch element (fwd 4*T^2*D, x3 with bwd).
-    flops_step = 6 * n_matmul * tokens + 12 * n_block * seq_len**2 * hidden * batch
-    flops_s = flops_step * steps / dt
-    mfu = flops_s / peak_flops(dev)
-    tokens_s = tokens * steps / dt
+    # Remat recomputation is NOT counted as useful work (honest MFU).
+    flops_step = (6 * n_matmul * tokens
+                  + 12 * n_block * seq_len**2 * hidden * batch)
+    mfu = flops_step * steps / dt / peak_flops(dev)
+    return (mfu, tokens * steps / dt, dt / steps * 1e3,
+            float(hist["loss"][-1]))
 
-    print(json.dumps({
+
+def main():
+    from analytics_zoo_tpu import init_orca_context
+
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    if tiny:
+        cfg = dict(vocab=512, hidden=128, n_block=2, n_head=2, seq_len=64,
+                   inter=256, batch=8, steps=6, steps_per_run=3)
+    else:
+        cfg = dict(
+            vocab=30522, hidden=768, n_block=12, n_head=12, seq_len=128,
+            inter=3072,
+            # batch 256 measures ~2-4 MFU points above 128 on v5e (more
+            # work per dispatch amortizes the per-run host turnaround)
+            batch=int(os.environ.get("BENCH_BATCH", 256)),
+            steps=int(os.environ.get("BENCH_STEPS", 48)),
+            steps_per_run=int(os.environ.get("BENCH_SPR", 24)))
+
+    init_orca_context(cluster_mode="local")
+    dev = jax.devices()[0]
+
+    mfu, tokens_s, step_ms, loss = _measure_bert(
+        dev, use_flash=os.environ.get("BENCH_FLASH") == "1",
+        remat=os.environ.get("BENCH_REMAT") == "1", **cfg)
+
+    out = {
         "metric": "bert_base_train_mfu_via_estimator_fit",
         "value": round(mfu * 100, 2),
         "unit": "%",
         "vs_baseline": round(mfu / 0.35, 4),
         "tokens_per_sec": round(tokens_s, 1),
-        "step_ms": round(dt / steps * 1e3, 2),
+        "step_ms": round(step_ms, 2),
         "device": getattr(dev, "device_kind", str(dev)),
         "final_loss": float(loss),
-    }))
+    }
+
+    # Long-sequence headline: flash attention + per-block remat at seq
+    # 2048 — the regime the Pallas kernels exist for (full-attention
+    # activations would not fit; O(T) memory keeps the MXU busy).
+    if not tiny and os.environ.get("BENCH_LONGSEQ", "1") == "1":
+        m2k, t2k, ms2k, _ = _measure_bert(
+            dev, vocab=30522, hidden=768, n_block=12, n_head=12,
+            seq_len=2048, inter=3072,
+            batch=int(os.environ.get("BENCH_LONGSEQ_BATCH", 16)),
+            steps=12, steps_per_run=6, use_flash=True,
+            remat=os.environ.get("BENCH_LONGSEQ_REMAT", "0") == "1")
+        out["bert_seq2048_flash_mfu_pct"] = round(m2k * 100, 2)
+        out["bert_seq2048_tokens_per_sec"] = round(t2k, 1)
+        out["bert_seq2048_step_ms"] = round(ms2k, 2)
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

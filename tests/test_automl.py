@@ -164,6 +164,45 @@ class TestTPESearch:
         with pytest.raises(ValueError, match="mutually exclusive"):
             SearchEngine(metric="mse", scheduler="asha", search_alg="tpe")
 
+    def test_bayes_beats_random_on_fixed_budget(self):
+        space = {"x": hp.uniform(-2.0, 2.0), "y": hp.uniform(-1.0, 3.0)}
+        budget = 48
+        rand = SearchEngine(metric="mse", num_samples=budget, seed=5,
+                            backend="serial")
+        rand.compile(None, _rosenbrock_fn, search_space=space).run()
+        gp = SearchEngine(metric="mse", num_samples=budget, seed=5,
+                          backend="serial", search_alg="bayes")
+        gp.compile(None, _rosenbrock_fn, search_space=space).run()
+        best_r = rand.get_best_trials(1)[0].metric
+        best_g = gp.get_best_trials(1)[0].metric
+        assert len(gp.trials) == budget
+        assert best_g <= best_r, (best_g, best_r)
+
+    def test_bayes_handles_mixed_space(self):
+        # categoricals one-hot encode; loguniform encodes in log space
+        space = {"cell": hp.grid_search(["a", "b"]),
+                 "lr": hp.loguniform(1e-5, 1e-1),
+                 "n": hp.randint(1, 8)}
+
+        def fn(config, data, budget):
+            import math
+            return {"mse": (0.0 if config["cell"] == "b" else 1.0)
+                    + abs(math.log10(config["lr"]) + 3) + config["n"] * 0.1}
+
+        eng = SearchEngine(metric="mse", num_samples=24, seed=2,
+                           backend="serial", search_alg="bayes")
+        eng.compile(None, fn, search_space=space).run()
+        assert all(t.ok for t in eng.trials), \
+            [t.error for t in eng.trials if not t.ok]
+        best = eng.get_best_config()
+        assert best["cell"] == "b"
+        assert 1e-5 <= best["lr"] <= 1e-1
+
+    def test_bayes_with_asha_rejected(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            SearchEngine(metric="mse", scheduler="asha",
+                         search_alg="bayes")
+
     def test_process_backend_rejects_closures(self):
         captured = []
 
