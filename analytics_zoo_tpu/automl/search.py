@@ -507,7 +507,22 @@ class SearchEngine:
                                      self.max_budget))
         while len(done) < total:
             wave = min(self.n_workers, total - len(done))
-            configs = [sampler.suggest(done) for _ in range(wave)]
+            # constant-liar batching: pretend each in-wave suggestion
+            # already scored at the incumbent best, so a deterministic
+            # acquisition (GP-EI) doesn't hand the whole wave the same
+            # config (TPE is stochastic but also benefits)
+            ok = [t for t in done if t.ok]
+            lie = None
+            if ok:
+                vals = [t.metric for t in ok]
+                lie = min(vals) if self.mode == "min" else max(vals)
+            configs = []
+            fantasies = list(done)
+            for _ in range(wave):
+                cfg = sampler.suggest(fantasies)
+                configs.append(cfg)
+                if lie is not None:
+                    fantasies.append(Trial(config=cfg, metric=lie))
             done.extend(self._map_trials(configs, self.max_budget))
         return done
 

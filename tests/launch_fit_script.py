@@ -1,0 +1,48 @@
+"""Standalone training script run through `zoo-launch` in the tests:
+every process calls `init_orca_context(cluster_mode="multi-host")` with
+ONLY the env the launcher set (COORDINATOR_ADDRESS / ZOO_NUM_PROCESSES /
+ZOO_PROCESS_ID), fits over the global mesh on its local shard, and
+writes its loss history + world view for the test to assert on."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main(out_dir: str) -> int:
+    import jax
+
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.data.dataset import TPUDataset
+    from analytics_zoo_tpu.keras import Sequential
+    from analytics_zoo_tpu.keras import layers as L
+    from analytics_zoo_tpu.learn.estimator import Estimator
+
+    zoo.init_orca_context(cluster_mode="multi-host")
+    rank = jax.process_index()
+
+    rs = np.random.RandomState(100 + rank)
+    x = rs.randn(64, 4).astype(np.float32)
+    y = x.sum(1, keepdims=True).astype(np.float32)
+
+    model = Sequential([L.Dense(8, input_shape=(4,), activation="relu"),
+                        L.Dense(1)])
+    model.ensure_built(np.zeros((1, 4), np.float32),
+                       jax.random.PRNGKey(7))   # same init on every rank
+    est = Estimator.from_keras(model, optimizer="sgd", loss="mse")
+    ds = TPUDataset.from_ndarrays((x, y), batch_size=32, shuffle=False)
+    hist = est.fit(ds, epochs=2, seed=0, prefetch=False)
+
+    with open(os.path.join(out_dir, f"launch_rank{rank}.json"), "w") as fh:
+        json.dump({"loss": hist["loss"],
+                   "process_count": jax.process_count(),
+                   "local_devices": jax.local_device_count(),
+                   "coordinator": os.environ.get("COORDINATOR_ADDRESS")},
+                  fh)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))
