@@ -3,8 +3,11 @@
 Uniform surface: `fit(x, y, epochs, batch_size)` on unrolled windows
 (x: [B, past_len, F], y: [B, horizon]), `predict(x)`, `evaluate(x, y)` —
 matching `LSTMForecaster` (`lstm_forecaster.py:21`), `MTNetForecaster`,
-`TCNForecaster`, and the factorization-based `TCMFForecaster` (distributed
-via Orca in the reference; single-host jit here)."""
+`TCNForecaster`, and the many-series `TCMFForecaster` — DeepGLO-hybrid
+by default (`automl/tcmf.py`: global factorization + temporal nets, as
+`tcmf/DeepGLO.py`), with a plain-factorization backend and
+`distributed=True` sharded local-stage training over XShards (the
+reference's Orca-trained mode)."""
 
 from __future__ import annotations
 
@@ -103,19 +106,66 @@ class MTNetForecaster(_KerasForecaster):
 
 
 class TCMFForecaster:
-    """`tcmf_forecaster.py`: global matrix factorization over a panel of
-    series. fit on {"id": [n], "y": [n, T]}, predict(horizon)."""
+    """`tcmf_forecaster.py`: the many-series forecaster. Default backend
+    is the DeepGLO hybrid (`automl/tcmf.py` — global factorization +
+    temporal nets, matching `tcmf/DeepGLO.py`); `model="factorization"`
+    keeps the plain `Y≈FX` + AR baseline. fit on {"id": [n],
+    "y": [n, T]} or (distributed=True) an XShards of such panels;
+    predict(horizon)."""
 
-    def __init__(self, rank: int = 8, ar_lags: int = 8, steps: int = 300,
-                 lr: float = 0.05, seed: int = 0):
-        self._tcmf = TCMF(rank=rank, ar_lags=ar_lags, steps=steps, lr=lr,
-                          seed=seed)
+    def __init__(self, rank: int = 8, ar_lags: Optional[int] = None,
+                 steps: int = 300, lr: float = 0.05, seed: int = 0,
+                 model: str = "deepglo", distributed: bool = False,
+                 **deepglo_kw):
+        if model not in ("deepglo", "factorization"):
+            raise ValueError("model must be deepglo|factorization")
+        if distributed and model == "factorization":
+            raise ValueError("distributed=True needs the deepglo backend "
+                             "(the factorization baseline is single-host)")
+        if model == "factorization":
+            if deepglo_kw:
+                raise TypeError(
+                    f"{sorted(deepglo_kw)} only apply to the deepglo "
+                    "backend")
+            self._tcmf = TCMF(rank=rank, ar_lags=ar_lags or 8,
+                              steps=steps, lr=lr, seed=seed)
+        else:
+            if ar_lags is not None:
+                raise TypeError(
+                    "ar_lags only applies to model='factorization' "
+                    "(deepglo forecasts X with its temporal network)")
+            from analytics_zoo_tpu.automl.tcmf import DeepGLO
+            self._tcmf = DeepGLO(rank=rank, fact_steps=steps, lr=lr,
+                                 seed=seed, **deepglo_kw)
+        self.distributed = distributed
         self._ids: Optional[np.ndarray] = None
 
-    def fit(self, x: Dict):
-        y = np.asarray(x["y"], np.float32)
-        self._ids = np.asarray(x.get("id", np.arange(len(y))))
-        self._tcmf.fit(y)
+    def fit(self, x):
+        from analytics_zoo_tpu.data.shards import XShards
+        shards = None
+        if isinstance(x, XShards):
+            panels = x.collect()
+            y = np.concatenate(
+                [np.asarray(p["y"], np.float32) for p in panels])
+            ids, offset = [], 0
+            for p in panels:
+                m = len(p["y"])
+                # default ids number GLOBALLY across shards (per-shard
+                # arange would alias series between shards)
+                ids.append(np.asarray(
+                    p.get("id", np.arange(offset, offset + m))))
+                offset += m
+            self._ids = np.concatenate(ids)
+            shards = x if self.distributed else None
+        else:
+            y = np.asarray(x["y"], np.float32)
+            self._ids = np.asarray(x.get("id", np.arange(len(y))))
+            if self.distributed:
+                shards = XShards.partition({"y": y})
+        if shards is not None:
+            self._tcmf.fit(y, shards=shards)
+        else:
+            self._tcmf.fit(y)
         return self
 
     def predict(self, horizon: int = 24) -> Dict:
