@@ -1,0 +1,77 @@
+"""Model inference over plain HTTP (the reference's
+`apps/model-inference-examples` family: non-Python callers reach the
+serving stack through the HTTP frontend, as the SpringBoot/Flink
+examples do through `AbstractInferenceModel`).
+
+Flow: start the in-package RESP2 stream server → the serving loop with a
+batched InferenceModel → the HTTP frontend — then act as a FOREIGN
+client: plain `urllib` POST /predict with a JSON tensor (no framework
+imports on the client side), read predictions and the /metrics
+percentiles back.
+
+    python apps/model_inference_http.py
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.serving import (ClusterServing, FrontEnd,
+                                       InferenceModel, MiniRedisServer,
+                                       RedisBroker)
+
+DIM, CLASSES = 8, 3
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    model = Sequential([
+        L.Dense(16, input_shape=(DIM,), activation="relu"),
+        L.Dense(CLASSES, activation="softmax"),
+    ])
+    model.ensure_built(np.zeros((1, DIM), np.float32))
+    infer = InferenceModel(concurrent_num=2).load_keras(model)
+
+    redis = MiniRedisServer().start()
+    broker = RedisBroker(redis.host, redis.port)
+    serving = ClusterServing(infer, broker=broker, batch_size=16,
+                             batch_timeout_ms=5).start()
+    frontend = FrontEnd(RedisBroker(redis.host, redis.port),
+                        serving=serving, port=0).start()
+    base = f"http://127.0.0.1:{frontend.port}"
+    print(f"stack up: redis={redis.url} frontend={base}")
+
+    try:
+        # a foreign client: nothing but stdlib HTTP + JSON
+        payload = json.dumps({
+            "instances": np.random.rand(4, DIM).round(4).tolist()
+        }).encode()
+        t0 = time.perf_counter()
+        req = urllib.request.Request(
+            base + "/predict", data=payload,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        dt = (time.perf_counter() - t0) * 1e3
+        preds = np.asarray(out["predictions"])
+        print(f"4 predictions in {dt:.1f} ms, shape {preds.shape}")
+        assert preds.shape == (4, CLASSES)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)
+
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            metrics = json.loads(r.read())
+        print("serving metrics:", json.dumps(metrics)[:160], "...")
+    finally:
+        frontend.stop()
+        serving.stop()
+        redis.stop()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

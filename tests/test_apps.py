@@ -15,6 +15,12 @@ APPS = [
     "image_similarity.py",
     "image_augmentation.py",
     "sentiment_analysis.py",
+    "dogs_vs_cats.py",
+    "recommendation_wide_n_deep.py",
+    "anomaly_detection_hd.py",
+    "image_augmentation_3d.py",
+    "model_inference_http.py",
+    "object_detection_voc.py",
 ]
 
 
