@@ -1,0 +1,6 @@
+#!/bin/sh
+# Start the in-container RESP2 server, then the serving loop + frontend.
+set -e
+python -m analytics_zoo_tpu.serving.cli redis --host 0.0.0.0 --port 6379 &
+sleep 1
+exec python -m analytics_zoo_tpu.serving.cli start --config /opt/zoo/config.yaml

@@ -1,0 +1,146 @@
+"""Dataset fetchers (the reference's `scripts/data/*/get_*.sh` role).
+
+Each dataset downloads from its canonical public source into
+`<dir>/<name>/` — or, with `--synthetic`, generates a small same-format
+stand-in locally (for air-gapped dev rigs and CI: every reader in the
+framework can be exercised without network).
+
+    python scripts/data/fetch.py movielens-1m ./data
+    python scripts/data/fetch.py news20 ./data --synthetic
+    python scripts/data/fetch.py all ./data --synthetic
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+URLS = {
+    "movielens-1m":
+        "https://files.grouplens.org/datasets/movielens/ml-1m.zip",
+    "news20":
+        "http://qwone.com/~jason/20Newsgroups/20news-18828.tar.gz",
+    "glove":
+        "https://nlp.stanford.edu/data/glove.6B.zip",
+    "nyc-taxi":
+        "https://raw.githubusercontent.com/numenta/NAB/master/data/"
+        "realKnownCause/nyc_taxi.csv",
+}
+
+
+def _download(url: str, dest: str):
+    import urllib.request
+    print(f"downloading {url} -> {dest}")
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    urllib.request.urlretrieve(url, dest)
+    if dest.endswith(".zip"):
+        import zipfile
+        with zipfile.ZipFile(dest) as z:
+            z.extractall(os.path.dirname(dest))
+    elif dest.endswith((".tar.gz", ".tgz")):
+        import tarfile
+        with tarfile.open(dest) as t:
+            # 'data' filter blocks tar-slip path traversal from a
+            # tampered archive
+            t.extractall(os.path.dirname(dest), filter="data")
+
+
+# -- synthetic same-format generators ---------------------------------------
+def _synth_movielens(out: str, n_users=200, n_items=120, n=5000, seed=0):
+    """ml-1m layout: ratings.dat with ``user::item::rating::ts`` rows."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    os.makedirs(out, exist_ok=True)
+    with open(os.path.join(out, "ratings.dat"), "w") as fh:
+        for _ in range(n):
+            fh.write(f"{rs.randint(1, n_users)}::"
+                     f"{rs.randint(1, n_items)}::"
+                     f"{rs.randint(1, 6)}::{978300000 + rs.randint(1e6)}\n")
+    with open(os.path.join(out, "movies.dat"), "w",
+              encoding="latin-1") as fh:
+        for i in range(1, n_items):
+            fh.write(f"{i}::Movie {i} (2000)::Drama\n")
+
+
+def _synth_news20(out: str, n_per_group=20, seed=0):
+    """20news layout: ``<group>/<doc-id>`` text files."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    words = ["tpu", "mesh", "kernel", "market", "game", "engine",
+             "stream", "model", "trade", "score"]
+    for g, group in enumerate(("comp.graphics", "rec.sport.hockey",
+                               "sci.space")):
+        gdir = os.path.join(out, group)
+        os.makedirs(gdir, exist_ok=True)
+        for i in range(n_per_group):
+            body = " ".join(rs.choice(words, 40 + g * 5))
+            with open(os.path.join(gdir, str(10000 + i)), "w") as fh:
+                fh.write(f"Subject: sample {i}\n\n{body}\n")
+
+
+def _synth_glove(out: str, dim=50, vocab=200, seed=0):
+    """glove.6B layout: ``word v1 v2 ...`` text lines."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    os.makedirs(out, exist_ok=True)
+    with open(os.path.join(out, f"glove.6B.{dim}d.txt"), "w") as fh:
+        for i in range(vocab):
+            vec = " ".join(f"{v:.4f}" for v in rs.randn(dim) * 0.3)
+            fh.write(f"word{i} {vec}\n")
+
+
+def _synth_nyc_taxi(out: str, n=2000, seed=0):
+    """NAB layout: ``timestamp,value`` csv — strictly increasing
+    30-minute intervals with daily seasonality plus a few injected
+    anomalies."""
+    import datetime
+
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    os.makedirs(out, exist_ok=True)
+    t = np.arange(n)
+    base = 15000 + 6000 * np.sin(2 * np.pi * t / 48.0)
+    vals = base + 800 * rs.randn(n)
+    for idx in rs.choice(n, 5, replace=False):
+        vals[idx] *= 2.2
+    start = datetime.datetime(2014, 7, 1)
+    with open(os.path.join(out, "nyc_taxi.csv"), "w") as fh:
+        fh.write("timestamp,value\n")
+        for i, v in enumerate(vals):
+            ts = start + datetime.timedelta(minutes=30 * i)
+            fh.write(f"{ts:%Y-%m-%d %H:%M:%S},{v:.0f}\n")
+
+
+SYNTH = {"movielens-1m": _synth_movielens, "news20": _synth_news20,
+         "glove": _synth_glove, "nyc-taxi": _synth_nyc_taxi}
+
+
+def fetch(name: str, base_dir: str, synthetic: bool = False):
+    out = os.path.join(base_dir, name)
+    if synthetic:
+        SYNTH[name](out)
+        print(f"synthetic {name} written to {out}")
+    else:
+        url = URLS[name]
+        _download(url, os.path.join(out, url.rsplit("/", 1)[-1]))
+        print(f"{name} downloaded to {out}")
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("dataset", choices=sorted(URLS) + ["all"])
+    p.add_argument("dir", nargs="?", default="./data")
+    p.add_argument("--synthetic", action="store_true",
+                   help="generate a small same-format local stand-in "
+                        "instead of downloading")
+    args = p.parse_args(argv)
+    names = sorted(URLS) if args.dataset == "all" else [args.dataset]
+    for name in names:
+        fetch(name, args.dir, synthetic=args.synthetic)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""Offline serving throughput benchmark.
+
+The reference's `docker/cluster-serving/perf/offline-benchmark` +
+`cluster-serving-enqueue-test` recipe: enqueue 10k images, read
+throughput from the serving log. Here the whole harness is one script:
+stand up the RESP2 stream server + batched serving loop, enqueue N
+images through the client API, wait for drain, print ONE JSON line with
+end-to-end throughput and the serving-side timer stats.
+
+    python scripts/perf/offline_benchmark.py                # 10k images
+    python scripts/perf/offline_benchmark.py --n 500 --broker memory
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=10_000,
+                   help="images to enqueue (reference uses 10000)")
+    p.add_argument("--broker", choices=("redis", "memory"),
+                   default="redis")
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--timeout-s", type=float, default=600.0)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.keras import Sequential
+    from analytics_zoo_tpu.keras import layers as L
+    from analytics_zoo_tpu.serving import (ClusterServing, InferenceModel,
+                                           InputQueue, MemoryBroker,
+                                           OutputQueue)
+
+    init_orca_context(cluster_mode="local")
+    S = args.image_size
+    model = Sequential([
+        L.Convolution2D(16, 3, 3, input_shape=(S, S, 3),
+                        border_mode="same", activation="relu"),
+        L.GlobalAveragePooling2D(),
+        L.Dense(10, activation="softmax"),
+    ])
+    model.ensure_built(np.zeros((1, S, S, 3), np.float32))
+    infer = InferenceModel(concurrent_num=2).load_keras(model)
+    for b in (1, args.batch_size):
+        infer.predict(np.zeros((b, S, S, 3), np.float32))  # warm buckets
+
+    server = None
+    if args.broker == "redis":
+        from analytics_zoo_tpu.serving import MiniRedisServer, RedisBroker
+        server = MiniRedisServer().start()
+        serve_broker = RedisBroker(server.host, server.port)
+        client_broker = RedisBroker(server.host, server.port)
+    else:
+        serve_broker = client_broker = MemoryBroker()
+
+    serving = ClusterServing(infer, broker=serve_broker,
+                             batch_size=args.batch_size,
+                             batch_timeout_ms=5).start()
+    inq = InputQueue(client_broker)
+    outq = OutputQueue(client_broker)
+
+    img = np.random.rand(S, S, 3).astype(np.float32)
+    t0 = time.perf_counter()
+    uris = [inq.enqueue(t=img) for _ in range(args.n)]
+    t_enq = time.perf_counter() - t0
+    print(f"{args.n} images enqueued in {t_enq:.1f}s", file=sys.stderr)
+
+    # drain: wait until the LAST uri has a result, then count them all
+    deadline = time.time() + args.timeout_s
+    while time.time() < deadline:
+        if outq.query(uris[-1]) is not None:
+            break
+        time.sleep(0.05)
+    else:
+        raise TimeoutError("serving did not drain the queue in time")
+    t_total = time.perf_counter() - t0
+    served = sum(1 for u in uris if outq.query(u) is not None)
+
+    metrics = serving.metrics()
+    serving.stop()
+    if server is not None:
+        server.stop()
+
+    print(json.dumps({
+        "metric": "serving_offline_throughput",
+        "value": round(served / t_total, 1),
+        "unit": "images/s",
+        "broker": args.broker,
+        "n_enqueued": args.n,
+        "n_served": served,
+        "wall_s": round(t_total, 2),
+        "enqueue_s": round(t_enq, 2),
+        "serving_metrics": metrics,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
