@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.keras.engine import Layer, Params, Shape
+from analytics_zoo_tpu.pallas.dropout import fused_dropout
 
 # ---------------------------------------------------------------------------
 # Initializers & activations
@@ -135,9 +136,7 @@ class Dropout(Layer):
             return x
         if rng is None:
             raise ValueError(f"{self.name}: dropout in training needs an rng")
-        keep = 1.0 - self.rate
-        mask = jax.random.bernoulli(rng, keep, jnp.shape(x))
-        return jnp.where(mask, x / keep, 0.0)
+        return fused_dropout(x, self.rate, rng=rng)
 
 
 class Flatten(Layer):

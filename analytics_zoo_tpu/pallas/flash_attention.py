@@ -35,6 +35,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from analytics_zoo_tpu.pallas.dropout import _dropout_threshold
+
 
 def _reference_attention(q, k, v, mask=None, dropout_rate: float = 0.0,
                          dropout_key=None):
@@ -148,11 +150,6 @@ def _flash(q, k, v, mask, seed, rate, block_q, block_k, interpret):
     out, _ = _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k,
                         interpret)
     return out
-
-
-def _dropout_threshold(rate: float) -> int:
-    # keep iff bits >= threshold; uint32 compare
-    return min(int(rate * 2 ** 32), 2 ** 32 - 1)
 
 
 def _keep_scale(s_ref, rate, n_qb, n_kb, qi, ki, shape):

@@ -1,0 +1,103 @@
+"""Dropout implementation tests (`pallas/dropout.py`).
+
+The u8/u32 paths run on CPU; the Pallas in-kernel-RNG path needs the TPU
+PRNG (no interpret-mode support) and is covered by
+`tests/tpu/test_tpu_kernels.py::TestFusedDropout` on a real chip.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pallas.dropout import (_tile_rows, _u8_dropout,
+                                              _view_2d, fused_dropout)
+
+
+class TestU8Dropout:
+    def test_keep_fraction_and_scale(self):
+        x = jnp.ones((512, 256), jnp.float32)
+        out = np.asarray(_u8_dropout(jax.random.PRNGKey(0), 0.1, x))
+        t = round(0.9 * 256)                       # 230
+        keep_eff = t / 256.0
+        frac = (out != 0).mean()
+        assert abs(frac - keep_eff) < 0.01
+        np.testing.assert_allclose(out[out != 0], 1.0 / keep_eff, rtol=1e-6)
+
+    def test_unbiased_estimator(self):
+        # E[dropout(x)] == x exactly because scaling uses t/256, the true
+        # keep probability of the byte compare — not the nominal rate.
+        x = jnp.full((2048, 512), 3.0, jnp.float32)
+        out = np.asarray(_u8_dropout(jax.random.PRNGKey(1), 0.3, x))
+        assert abs(out.mean() - 3.0) < 0.02
+
+    def test_gradient_is_mask_times_scale(self):
+        x = jnp.ones((64, 128), jnp.float32)
+        f = lambda x: jnp.sum(_u8_dropout(jax.random.PRNGKey(2), 0.2, x))
+        g = np.asarray(jax.grad(f)(x))
+        out = np.asarray(_u8_dropout(jax.random.PRNGKey(2), 0.2, x))
+        np.testing.assert_array_equal(g != 0, out != 0)
+
+    def test_bf16(self):
+        x = jnp.ones((64, 128), jnp.bfloat16)
+        out = _u8_dropout(jax.random.PRNGKey(3), 0.1, x)
+        assert out.dtype == jnp.bfloat16
+
+
+class TestDispatch:
+    def test_rate_zero_identity(self):
+        x = jnp.ones((4, 4))
+        assert fused_dropout(x, 0.0, seed=jnp.int32(0)) is x
+
+    def test_rate_one_zeroes(self):
+        # bernoulli keep=0 degenerate case (Dropout.scala semantics)
+        out = fused_dropout(jnp.ones((4, 4)), 1.0, seed=jnp.int32(0))
+        np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+    def test_needs_rng_or_seed(self):
+        with pytest.raises(ValueError):
+            fused_dropout(jnp.ones((4, 4)), 0.1)
+
+    def test_impl_env_honored(self, monkeypatch):
+        x = jnp.ones((64, 128), jnp.float32)
+        monkeypatch.setenv("ZOO_DROPOUT_IMPL", "u8")
+        o8 = np.asarray(fused_dropout(x, 0.1, rng=jax.random.PRNGKey(0)))
+        t = round(0.9 * 256)
+        np.testing.assert_allclose(o8[o8 != 0], 256.0 / t, rtol=1e-6)
+        monkeypatch.setenv("ZOO_DROPOUT_IMPL", "u32")
+        o32 = np.asarray(fused_dropout(x, 0.1, rng=jax.random.PRNGKey(0)))
+        np.testing.assert_allclose(o32[o32 != 0], 1.0 / 0.9, rtol=1e-6)
+
+    def test_bad_impl_raises(self, monkeypatch):
+        monkeypatch.setenv("ZOO_DROPOUT_IMPL", "bogus")
+        with pytest.raises(ValueError):
+            fused_dropout(jnp.ones((4, 4)), 0.1, seed=jnp.int32(0))
+
+    def test_cpu_default_is_exact_bernoulli(self):
+        # off-TPU the default keeps the exact rate (u32 bernoulli)
+        assert os.environ.get("ZOO_DROPOUT_IMPL") is None
+        x = jnp.ones((256, 128), jnp.float32)
+        out = np.asarray(fused_dropout(x, 0.25, rng=jax.random.PRNGKey(4)))
+        np.testing.assert_allclose(out[out != 0], 1.0 / 0.75, rtol=1e-6)
+
+
+class TestTiling:
+    def test_view_2d_lane_aligned_last_dim(self):
+        assert _view_2d(jnp.zeros((4, 6, 256))) == (24, 256)
+
+    def test_view_2d_flattens_odd_trailing(self):
+        # 4*6*96 = 2304 = 18*128: flat view with a 128-multiple column
+        shape = _view_2d(jnp.zeros((4, 6, 96)))
+        assert shape is not None and shape[0] * shape[1] == 2304
+        assert shape[1] % 128 == 0
+
+    def test_view_2d_none_for_unaligned(self):
+        assert _view_2d(jnp.zeros((3, 5, 7))) is None
+
+    def test_tile_rows_divides(self):
+        for m, c in [(32768, 768), (393216, 128), (100, 768), (7, 128)]:
+            bm = _tile_rows(m, c)
+            assert m % bm == 0
+            assert bm * c <= 512 * 1024 or bm == 1

@@ -204,3 +204,39 @@ class TestLargeBlocks:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-2, atol=5e-3)
+
+
+class TestFusedDropout:
+    """Pallas in-kernel-RNG dropout (`pallas/dropout.py`): determinism,
+    mask/grad bit-identity (the VJP regenerates, never stores), and the
+    unbiased u8 default."""
+
+    def test_pallas_deterministic_and_scaled(self, monkeypatch):
+        from analytics_zoo_tpu.pallas.dropout import fused_dropout
+        monkeypatch.setenv("ZOO_DROPOUT_IMPL", "pallas")
+        x = jnp.ones((256, 384), jnp.float32)
+        a = np.asarray(fused_dropout(x, 0.1, seed=jnp.int32(11)))
+        b = np.asarray(fused_dropout(x, 0.1, seed=jnp.int32(11)))
+        np.testing.assert_array_equal(a, b)
+        assert abs((a != 0).mean() - 0.9) < 0.02
+        np.testing.assert_allclose(a[a != 0], 1.0 / 0.9, rtol=1e-6)
+
+    def test_pallas_grad_regenerates_same_mask(self, monkeypatch):
+        from analytics_zoo_tpu.pallas.dropout import fused_dropout
+        monkeypatch.setenv("ZOO_DROPOUT_IMPL", "pallas")
+        x = jnp.ones((128, 256), jnp.float32)
+        seed = jnp.int32(5)
+        out = np.asarray(fused_dropout(x, 0.2, seed=seed))
+        g = np.asarray(jax.grad(
+            lambda x: jnp.sum(fused_dropout(x, 0.2, seed=seed)))(x))
+        np.testing.assert_array_equal(g != 0, out != 0)
+
+    def test_u8_default_on_tpu(self):
+        import os
+        from analytics_zoo_tpu.pallas.dropout import fused_dropout
+        assert os.environ.get("ZOO_DROPOUT_IMPL") is None
+        x = jnp.ones((128, 256), jnp.bfloat16)
+        out = np.asarray(fused_dropout(x, 0.1, rng=jax.random.PRNGKey(0)),
+                         np.float32)
+        t = round(0.9 * 256)
+        np.testing.assert_allclose(out[out != 0], 256.0 / t, rtol=1e-2)
