@@ -45,10 +45,18 @@ import jax.numpy as jnp
 
 
 def _dropout_threshold(rate: float) -> int:
-    """keep iff bits >= threshold (uint32 compare) — the shared keep-rule;
-    `flash_attention._keep_scale` imports this so in-kernel masks never
-    diverge between the two modules."""
+    """keep iff bits >= threshold (uint32 compare) — the keep-rule of the
+    full-width Pallas kernel below (`impl=pallas`)."""
     return min(int(rate * 2 ** 32), 2 ** 32 - 1)
+
+
+def _byte_threshold(rate: float) -> int:
+    """keep iff byte < t — the shared uint8 keep-rule: t = round(keep*256),
+    clamped to [1, 255]. Scale by the EXACT keep probability t/256 for an
+    unbiased estimator (the rate is quantized to 1/256). Used by
+    `_u8_dropout` here and `flash_attention._keep_scale` (imported) so the
+    byte rule never diverges between the two modules."""
+    return max(1, min(255, int(round((1.0 - rate) * 256))))
 
 
 def _plain_dropout(rng, rate: float, x):
@@ -66,7 +74,7 @@ def _u8_dropout(rng, rate: float, x):
     RNG output per element to HBM (XLA cannot fuse RngBitGenerator into
     consumers); bytes cut that traffic 4x and the compare+select still
     fuses into the surrounding chain."""
-    t = max(1, min(255, int(round((1.0 - rate) * 256))))
+    t = _byte_threshold(rate)
     bits = jax.random.bits(rng, jnp.shape(x), jnp.uint8)
     keep_eff = t / 256.0
     return jnp.where(bits < t, x / jnp.asarray(keep_eff, x.dtype),

@@ -30,12 +30,13 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-from analytics_zoo_tpu.pallas.dropout import _dropout_threshold
+from analytics_zoo_tpu.pallas.dropout import _byte_threshold
 
 
 def _reference_attention(q, k, v, mask=None, dropout_rate: float = 0.0,
@@ -84,6 +85,8 @@ def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
                     dropout_seed: Optional[jax.Array] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    bwd_block_q: Optional[int] = None,
+                    bwd_block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """q,k,v: [B, H, T, Dh]. mask: additive [B,1,1,T] (padding) or
     [B,1,T,T] (full; reference path only). `dropout_rate` > 0 needs
@@ -122,9 +125,34 @@ def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
         block_q = _auto_block(T)
     if block_k is None:
         block_k = _auto_block(T)
+    # Backward kernels hold more VMEM live per tile (pnorm, dw, plus the
+    # dq/dk/dv accumulators) than the forward, so their sweet spot can be
+    # smaller; default to the forward blocks.
+    env_bwd = os.environ.get("ZOO_FLASH_BWD_BLOCK")
+    if env_bwd:
+        try:
+            env_val = int(env_bwd)
+        except ValueError:
+            raise ValueError(f"ZOO_FLASH_BWD_BLOCK={env_bwd!r}: not an int")
+        if env_val <= 0 or env_val % 128 or T % env_val:
+            raise ValueError(
+                f"ZOO_FLASH_BWD_BLOCK={env_val}: must be a positive "
+                f"multiple of 128 dividing the sequence length {T}")
+    else:
+        env_val = None
+    if bwd_block_q is None:
+        bwd_block_q = env_val or block_q
+    if bwd_block_k is None:
+        bwd_block_k = env_val or block_k
+    if use_dropout and (bwd_block_q != block_q or bwd_block_k != block_k):
+        # the per-tile PRNG reseeding indexes (qi, ki) tiles — backward
+        # masks only regenerate bit-identically on the SAME tiling
+        raise ValueError("flash_attention: in-kernel dropout requires "
+                         "bwd blocks == fwd blocks (mask regeneration is "
+                         "tile-indexed)")
     if mask is None:
         mask = jnp.zeros((B, 1, 1, T), jnp.float32)
-    block = math.lcm(block_q, block_k)
+    block = math.lcm(block_q, block_k, bwd_block_q, bwd_block_k)
     if T % block:
         pad = (-T) % block
         qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
@@ -133,20 +161,23 @@ def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
         maskp = jnp.pad(mask, ((0, 0), (0, 0), (0, 0), (0, pad)),
                         constant_values=-1e9)
         out = flash_attention(qp, kp, vp, maskp, dropout_rate, dropout_seed,
-                              block_q, block_k, interpret)
+                              block_q, block_k, bwd_block_q, bwd_block_k,
+                              interpret)
         return out[:, :, :T]
     seed = jnp.asarray(dropout_seed if use_dropout else 0,
                        jnp.int32).reshape(1, 1)
     rate = float(dropout_rate) if use_dropout else 0.0
     return _flash(q, k, v, mask, seed, rate, block_q, block_k,
+                  bwd_block_q, bwd_block_k,
                   bool(interpret) if interpret is not None else False)
 
 
 # ---------------------------------------------------------------------------
 # custom-VJP core (assumes T % lcm(block_q, block_k) == 0, mask [B,1,1,T])
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, mask, seed, rate, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, mask, seed, rate, block_q, block_k, bwd_block_q,
+           bwd_block_k, interpret):
     out, _ = _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k,
                         interpret)
     return out
@@ -155,16 +186,29 @@ def _flash(q, k, v, mask, seed, rate, block_q, block_k, interpret):
 def _keep_scale(s_ref, rate, n_qb, n_kb, qi, ki, shape):
     """Deterministic per-tile dropout scale: 1/keep where kept, 0 where
     dropped. Identical bits in forward and both backward kernels (the tile
-    index folds (bh, qi, ki); prng_seed on this mosaic takes 2 scalars)."""
+    index folds (bh, qi, ki); prng_seed on this mosaic takes 2 scalars).
+
+    The PRNG is the expensive part (~20 cycles/word on v5e — measured
+    45 ms/step across the three kernels at seq 2048 when drawing one
+    uint32 per element), so draw one word per FOUR elements and use each
+    byte as an independent keep-draw: keep iff byte < t, t =
+    round(keep*256), scaled by the exact keep probability t/256 (unbiased;
+    rate quantized to 1/256 like `pallas/dropout._u8_dropout`). Which
+    byte lands on which column is an arbitrary fixed bijection — the mask
+    stays iid Bernoulli and regenerates bit-identically in the backward
+    kernels."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh = pl.program_id(0)
     tile = (bh * n_qb + qi) * n_kb + ki
     pltpu.prng_seed(s_ref[0, 0], tile)
-    bits = pltpu.prng_random_bits(shape)
-    keep = bits.astype(jnp.uint32) >= jnp.uint32(_dropout_threshold(rate))
-    return jnp.where(keep, 1.0 / (1.0 - rate), 0.0)
+    words = pltpu.prng_random_bits((shape[0], shape[1] // 4))
+    words = words.astype(jnp.uint32)
+    t = _byte_threshold(rate)
+    bytes_ = jnp.concatenate(
+        [(words >> (8 * j)) & jnp.uint32(0xFF) for j in range(4)], axis=1)
+    return jnp.where(bytes_ < jnp.uint32(t), 256.0 / t, 0.0)
 
 
 def _fwd_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
@@ -331,7 +375,11 @@ def _dkv_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(rate, block_q, block_k, interpret, res, dout):
+def _flash_bwd(rate, _fwd_block_q, _fwd_block_k, block_q, block_k, interpret,
+               res, dout):
+    # _fwd_block_* are unused: mask regeneration derives its tile indices
+    # from the bwd blocks, which flash_attention() forces equal to the fwd
+    # blocks whenever dropout is active.
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -406,7 +454,8 @@ def _flash_bwd(rate, block_q, block_k, interpret, res, dout):
             jnp.zeros_like(mask), jnp.zeros_like(seed))
 
 
-def _flash_fwd_rule(q, k, v, mask, seed, rate, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, mask, seed, rate, block_q, block_k,
+                    bwd_block_q, bwd_block_k, interpret):
     return _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k,
                       interpret)
 
