@@ -125,13 +125,24 @@ def launch(hosts: Sequence[str], nproc: int, script: str,
            simulate_devices: int = 0,
            extra_env: Optional[Dict[str, str]] = None) -> ProcessMonitor:
     """Start the full host×nproc process group and return its monitor
-    (fail-fast `.wait()`, group `.terminate()`)."""
+    (fail-fast `.wait()`, group `.terminate()`).
+
+    Remote coordinators default to port 29400 (the conventional
+    rendezvous port — a locally-probed free port says nothing about the
+    remote head). Concurrent launches sharing a head host must pass
+    distinct ``port``s."""
     hosts = list(hosts)
     if coordinator is None:
         head = hosts[0].split("@")[-1]
         if _is_local(hosts[0]):
+            # loopback: probe a genuinely free local port
             head = "127.0.0.1"
-        coordinator = f"{head}:{port or _free_port()}"
+            coordinator = f"{head}:{port or _free_port()}"
+        else:
+            # remote coordinator: a port probed by binding LOCALLY says
+            # nothing about the remote host — use the conventional
+            # rendezvous port unless the caller picked one
+            coordinator = f"{head}:{port or 29400}"
     cmds = build_commands(hosts, nproc, coordinator, script, script_args,
                           python=python, ssh_cmd=ssh_cmd,
                           extra_env=extra_env,

@@ -129,24 +129,27 @@ def flash_attention(q, k, v, mask: Optional[jax.Array] = None,
     # dq/dk/dv accumulators) than the forward, so their sweet spot can be
     # smaller; default to the forward blocks.
     env_bwd = os.environ.get("ZOO_FLASH_BWD_BLOCK")
-    if env_bwd:
+    if env_bwd and bwd_block_q is None and bwd_block_k is None:
+        # tuning HINT, not a contract: applied only where it is legal for
+        # THIS call — a process can hold models with several seq lengths
         try:
             env_val = int(env_bwd)
         except ValueError:
             raise ValueError(f"ZOO_FLASH_BWD_BLOCK={env_bwd!r}: not an int")
-        if env_val <= 0 or env_val % 128 or T % env_val:
-            raise ValueError(
-                f"ZOO_FLASH_BWD_BLOCK={env_val}: must be a positive "
-                f"multiple of 128 dividing the sequence length {T}")
-    else:
-        env_val = None
+        applicable = (env_val > 0 and env_val % 128 == 0
+                      and T % env_val == 0
+                      # dropout masks regenerate per (qi, ki) tile — the
+                      # backward must match the forward tiling exactly
+                      and (not use_dropout
+                           or (env_val == block_q and env_val == block_k)))
+        if applicable:
+            bwd_block_q = bwd_block_k = env_val
     if bwd_block_q is None:
-        bwd_block_q = env_val or block_q
+        bwd_block_q = block_q
     if bwd_block_k is None:
-        bwd_block_k = env_val or block_k
+        bwd_block_k = block_k
     if use_dropout and (bwd_block_q != block_q or bwd_block_k != block_k):
-        # the per-tile PRNG reseeding indexes (qi, ki) tiles — backward
-        # masks only regenerate bit-identically on the SAME tiling
+        # explicit caller-passed mismatch is a programming error
         raise ValueError("flash_attention: in-kernel dropout requires "
                          "bwd blocks == fwd blocks (mask regeneration is "
                          "tile-indexed)")

@@ -23,6 +23,12 @@ from typing import Dict, List, Optional, Tuple
 from analytics_zoo_tpu.serving.broker import RESPError
 
 
+class Simple(str):
+    """Marker for RESP simple-string replies (+OK). Only command handlers
+    construct it — a hash VALUE that happens to equal "OK" stays a plain
+    str and is encoded as a bulk string, the type real Redis sends."""
+
+
 class MiniRedisStore:
     """In-memory streams + hashes with consumer-group semantics: per-group
     last-delivered cursor and pending-entries list (PEL)."""
@@ -70,7 +76,7 @@ class MiniRedisStore:
         if (stream, group) in self.groups:
             raise RESPError("BUSYGROUP Consumer Group name already exists")
         self.groups[(stream, group)] = {"cursor": 0, "pel": set()}
-        return "OK"
+        return Simple("OK")
 
     def _pop_new(self, stream: str, group: str, count: int):
         g = self.groups.get((stream, group))
@@ -155,7 +161,8 @@ class MiniRedisStore:
         return 1 if h.pop(a[1], None) is not None else 0
 
     def cmd_ping(self, a):
-        return "PONG" if not a else a[0]
+        # bare PING -> +PONG simple string; PING msg echoes a bulk string
+        return Simple("PONG") if not a else a[0]
 
 
 class _RESPHandler(socketserver.StreamRequestHandler):
@@ -197,9 +204,9 @@ def _encode_reply(v) -> bytes:
         return b"*-1\r\n"
     if isinstance(v, int):
         return b":%d\r\n" % v
+    if isinstance(v, Simple):
+        return b"+%s\r\n" % v.encode()
     if isinstance(v, str):
-        if v in ("OK", "PONG"):
-            return b"+%s\r\n" % v.encode()
         data = v.encode()
         return b"$%d\r\n%s\r\n" % (len(data), data)
     if isinstance(v, list):

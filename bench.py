@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -92,6 +93,18 @@ def _measure_bert(dev, *, vocab, hidden, n_block, n_head, seq_len, inter,
             float(hist["loss"][-1]))
 
 
+def _run_sub(cmd, timeout, env=None):
+    """Run a sibling benchmark; return its last-line JSON or None."""
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout, env=env)
+        last = [ln for ln in res.stdout.strip().splitlines()
+                if ln.startswith("{")]
+        return json.loads(last[-1]) if last else None
+    except (subprocess.TimeoutExpired, OSError, json.JSONDecodeError):
+        return None
+
+
 def main():
     from analytics_zoo_tpu import init_orca_context
 
@@ -143,6 +156,35 @@ def main():
         out["bert_seq2048_flash_mfu_pct"] = round(m2k * 100, 2)
         out["bert_seq2048_tokens_per_sec"] = round(t2k, 1)
         out["bert_seq2048_step_ms"] = round(ms2k, 2)
+
+    # The other two BASELINE targets, as guarded subprocesses so a hang or
+    # crash in either can never lose the BERT headline (VERDICT r3 #3):
+    # NCF throughput/HBM-utilization and serving p50/p99 over the RESP2
+    # redis wire.
+    here = os.path.dirname(os.path.abspath(__file__))
+    if not tiny and os.environ.get("BENCH_NCF", "1") == "1":
+        r = _run_sub([sys.executable, os.path.join(here, "bench_ncf.py")],
+                     timeout=900)
+        if r:
+            out["ncf_samples_per_sec"] = r.get("value")
+            out["ncf_hbm_utilization_pct"] = r.get("hbm_utilization_pct")
+            out["ncf_step_ms"] = r.get("step_ms")
+        else:
+            out["ncf_samples_per_sec"] = None
+    if not tiny and os.environ.get("BENCH_SERVING", "1") == "1":
+        # CPU backend for the serving stack: on dev rigs the TPU sits
+        # behind an HTTP tunnel whose ~100 ms round trip per dispatch
+        # would swamp the wire-path latency being measured (a production
+        # v5e host runs the model in-process; bench_serving.py docstring)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = _run_sub([sys.executable, os.path.join(here, "bench_serving.py")],
+                     timeout=900, env=env)
+        if r:
+            out["serving_p50_ms"] = r.get("value")
+            out["serving_p99_ms"] = r.get("p99_ms")
+            out["serving_broker"] = r.get("broker")
+        else:
+            out["serving_p50_ms"] = None
 
     print(json.dumps(out))
 

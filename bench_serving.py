@@ -22,8 +22,17 @@ stack itself.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+import jax
+
+# honor JAX_PLATFORMS=cpu even though the machine's sitecustomize
+# preimports jax with the TPU plugin pinned (backends init lazily, so the
+# live-config update still takes effect — see .claude/skills/verify)
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 

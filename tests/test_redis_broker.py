@@ -170,3 +170,50 @@ class TestBlockingRead:
         t0 = time.time()
         assert br.read_group("bs2", "g", "c", count=1, block_ms=200) == []
         assert 0.15 < time.time() - t0 < 2.0
+
+
+class TestRESPTypes:
+    """Protocol-type correctness: simple strings come only from command
+    handlers; data values equal to 'OK'/'PONG' stay bulk strings
+    (ADVICE r3: other RESP clients type-check replies)."""
+
+    def test_hash_value_literally_ok_is_bulk(self):
+        import socket
+        from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+        srv = MiniRedisServer().start()
+        try:
+            s = socket.create_connection((srv.host, srv.port))
+            f = s.makefile("rb")
+
+            def read_reply():
+                # minimal RESP reader: deterministic, no recv timing
+                line = f.readline()
+                kind = line[:1]
+                if kind in (b"+", b"-", b":"):
+                    return line
+                if kind == b"$":
+                    n = int(line[1:-2])
+                    return line + (f.read(n + 2) if n >= 0 else b"")
+                if kind == b"*":
+                    n = int(line[1:-2])
+                    return line + b"".join(read_reply() for _ in range(n))
+                raise AssertionError(f"unexpected reply {line!r}")
+
+            def send(*args):
+                out = b"*%d\r\n" % len(args)
+                for a in args:
+                    b = a.encode()
+                    out += b"$%d\r\n%s\r\n" % (len(b), b)
+                s.sendall(out)
+                return read_reply()
+            assert send("HSET", "h", "f", "OK") == b":1\r\n"
+            # the stored value must come back as a BULK string, not +OK
+            assert send("HGET", "h", "f") == b"$2\r\nOK\r\n"
+            # while XGROUP CREATE's status reply is a simple string
+            assert send("XADD", "st", "*", "k", "v").startswith(b"$")
+            assert send("XGROUP", "CREATE", "st", "g", "$") == b"+OK\r\n"
+            assert send("PING") == b"+PONG\r\n"
+            assert send("PING", "hello") == b"$5\r\nhello\r\n"
+            s.close()
+        finally:
+            srv.stop()
