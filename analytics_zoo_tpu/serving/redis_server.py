@@ -29,6 +29,12 @@ class Simple(str):
     str and is encoded as a bulk string, the type real Redis sends."""
 
 
+# Marker for the *-1 nil-ARRAY reply (timed-out XREADGROUP). A bare None
+# encodes as $-1 nil BULK — what real Redis sends for a missing HGET
+# (divergence caught by tests/test_resp2_conformance.py).
+NIL_ARRAY = object()
+
+
 class MiniRedisStore:
     """In-memory streams + hashes with consumer-group semantics: per-group
     last-delivered cursor and pending-entries list (PEL)."""
@@ -112,13 +118,13 @@ class MiniRedisStore:
                     return [[stream,
                              [[rid, fields] for rid, fields in new]]]
                 if block_ms is None:
-                    return None
+                    return NIL_ARRAY
                 remaining = None if deadline is None \
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
-                    return None
+                    return NIL_ARRAY
                 if not self.data_ready.wait(remaining):
-                    return None
+                    return NIL_ARRAY
 
     def cmd_xack(self, a):
         stream, group, ids = a[0], a[1], a[2:]
@@ -144,8 +150,11 @@ class MiniRedisStore:
         return removed
 
     def cmd_hset(self, a):
-        self.hashes.setdefault(a[0], {})[a[1]] = a[2]
-        return 1
+        h = self.hashes.setdefault(a[0], {})
+        is_new = a[1] not in h
+        h[a[1]] = a[2]
+        # real Redis replies with the number of NEW fields added
+        return 1 if is_new else 0
 
     def cmd_hget(self, a):
         return self.hashes.get(a[0], {}).get(a[1])
@@ -200,8 +209,10 @@ class _RESPHandler(socketserver.StreamRequestHandler):
 
 
 def _encode_reply(v) -> bytes:
-    if v is None:
+    if v is NIL_ARRAY:
         return b"*-1\r\n"
+    if v is None:
+        return b"$-1\r\n"
     if isinstance(v, int):
         return b":%d\r\n" % v
     if isinstance(v, Simple):

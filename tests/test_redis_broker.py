@@ -178,42 +178,21 @@ class TestRESPTypes:
     (ADVICE r3: other RESP clients type-check replies)."""
 
     def test_hash_value_literally_ok_is_bulk(self):
-        import socket
         from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+        from tests.test_resp2_conformance import SpecClient
         srv = MiniRedisServer().start()
         try:
-            s = socket.create_connection((srv.host, srv.port))
-            f = s.makefile("rb")
-
-            def read_reply():
-                # minimal RESP reader: deterministic, no recv timing
-                line = f.readline()
-                kind = line[:1]
-                if kind in (b"+", b"-", b":"):
-                    return line
-                if kind == b"$":
-                    n = int(line[1:-2])
-                    return line + (f.read(n + 2) if n >= 0 else b"")
-                if kind == b"*":
-                    n = int(line[1:-2])
-                    return line + b"".join(read_reply() for _ in range(n))
-                raise AssertionError(f"unexpected reply {line!r}")
-
-            def send(*args):
-                out = b"*%d\r\n" % len(args)
-                for a in args:
-                    b = a.encode()
-                    out += b"$%d\r\n%s\r\n" % (len(b), b)
-                s.sendall(out)
-                return read_reply()
-            assert send("HSET", "h", "f", "OK") == b":1\r\n"
+            c = SpecClient(srv.host, srv.port)
+            assert c.call("HSET", "h", "f", "OK") == ("int", 1)
             # the stored value must come back as a BULK string, not +OK
-            assert send("HGET", "h", "f") == b"$2\r\nOK\r\n"
+            assert c.call("HGET", "h", "f") == ("bulk", "OK")
+            kind, _ = c.call("XADD", "st", "*", "k", "v")
+            assert kind == "bulk"
             # while XGROUP CREATE's status reply is a simple string
-            assert send("XADD", "st", "*", "k", "v").startswith(b"$")
-            assert send("XGROUP", "CREATE", "st", "g", "$") == b"+OK\r\n"
-            assert send("PING") == b"+PONG\r\n"
-            assert send("PING", "hello") == b"$5\r\nhello\r\n"
-            s.close()
+            assert c.call("XGROUP", "CREATE", "st", "g", "$") == \
+                ("simple", "OK")
+            assert c.call("PING") == ("simple", "PONG")
+            assert c.call("PING", "hello") == ("bulk", "hello")
+            c.close()
         finally:
             srv.stop()
