@@ -1,4 +1,4 @@
-"""NNEstimator/NNModel/NNClassifier over pandas DataFrames.
+"""NNEstimator/NNModel/NNClassifier over pandas DataFrames and XShards.
 
 Behavioral contract from `nnframes/NNEstimator.scala:197` + python mirror
 (`nn_classifier.py`): builder-style setters (setBatchSize/setMaxEpoch/
@@ -6,27 +6,45 @@ setLearningRate/setFeaturesCol/setLabelCol/setCachingSample →
 snake_case), `fit(df) -> NNModel`, `NNModel.transform(df)` appends a
 `prediction` column, `NNClassifier` trains on integer labels with
 (sparse) cross-entropy and its model predicts the argmax class
-(1-based by default, like BigDL's ClassNLL convention)."""
+(1-based by default, like BigDL's ClassNLL convention).
+
+Scale path (the reference trains over a cluster-wide Spark DataFrame):
+`fit`/`transform` also accept an `XShards` of pandas DataFrames — each
+shard assembles independently (no single concatenated frame), training
+delegates to the sharded `learn.Estimator` machinery, and `transform`
+maps per shard like the reference's `mapPartitions`
+(`NNEstimator.scala:641`). `set_sample_preprocessing` mirrors
+`setSamplePreprocessing`: a per-row callable (e.g. a chained
+ImageProcessing) applied at assembly time."""
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Union
+from typing import Any, Callable, List, Optional, Sequence, Union
 
 import numpy as np
 import pandas as pd
 
+from analytics_zoo_tpu.data.shards import XShards
 from analytics_zoo_tpu.keras.engine import KerasNet
 
 
-def _assemble(df: pd.DataFrame, cols: Sequence[str]) -> np.ndarray:
+def _assemble(df: pd.DataFrame, cols: Sequence[str],
+              preprocess: Optional[Callable] = None) -> np.ndarray:
     """Feature assembly: one array-valued column passes through (stacked);
     several scalar columns concatenate — the NNEstimator featureSize
     flattening (`NNEstimator.scala` supports both)."""
+    if len(cols) == 1 and len(df) and \
+            isinstance(df[cols[0]].iloc[0], (list, tuple, np.ndarray)):
+        rows = (np.asarray(v, np.float32) for v in df[cols[0]])
+        if preprocess is not None:
+            rows = (np.asarray(preprocess(r), np.float32) for r in rows)
+        return np.stack(list(rows))
+    if preprocess is not None:
+        # per-row transforms are defined on array-valued features only —
+        # silently skipping them would train on untransformed data
+        raise ValueError("sample_preprocessing needs a single array-valued "
+                         f"feature column; got scalar columns {list(cols)}")
     if len(cols) == 1:
-        first = df[cols[0]].iloc[0]
-        if isinstance(first, (list, tuple, np.ndarray)):
-            return np.stack([np.asarray(v, np.float32)
-                             for v in df[cols[0]]])
         return df[cols[0]].to_numpy(np.float32)[:, None]
     return np.stack([df[c].to_numpy(np.float32) for c in cols], axis=1)
 
@@ -44,6 +62,7 @@ class NNEstimator:
         self.caching_sample = True
         self._lr: Optional[float] = None
         self._validation = None
+        self._preprocessing: Optional[Callable] = None
 
     # -- builder setters (`NNEstimator.scala` setters) ---------------------
     def set_batch_size(self, v: int) -> "NNEstimator":
@@ -75,6 +94,12 @@ class NNEstimator:
         self._validation = df
         return self
 
+    def set_sample_preprocessing(self, fn: Callable) -> "NNEstimator":
+        """Per-row feature transform applied at assembly time — the
+        `setSamplePreprocessing` role (chained ImageProcessing etc.)."""
+        self._preprocessing = fn
+        return self
+
     # -- fit ---------------------------------------------------------------
     def _label_array(self, df: pd.DataFrame) -> np.ndarray:
         y = np.asarray(list(df[self.label_col]), np.float32)
@@ -91,20 +116,66 @@ class NNEstimator:
             opt = self.optimizer
         self.model.compile(opt, self.criterion)
 
-    def fit(self, df: pd.DataFrame) -> "NNModel":
-        x = _assemble(df, self.features_col)
+    def fit(self, df: Union[pd.DataFrame, XShards]) -> "NNModel":
+        if isinstance(df, XShards):
+            return self._fit_shards(df)
+        x = _assemble(df, self.features_col, self._preprocessing)
         y = self._label_array(df)
         self._compile()
         val = None
         if self._validation is not None:
-            val = (_assemble(self._validation, self.features_col),
+            val = (_assemble(self._validation, self.features_col,
+                             self._preprocessing),
                    self._label_array(self._validation))
         self.model.fit(x, y, batch_size=min(self.batch_size, len(x)),
                        nb_epoch=self.max_epoch, validation_data=val)
         return self._make_model()
 
+    def _fit_shards(self, shards: XShards) -> "NNModel":
+        """XShards of DataFrames: assemble per shard (no concatenated
+        frame) and train through the sharded Estimator path — the
+        `NNEstimator.scala:197` cluster-wide fit.
+
+        With a sample preprocessing the assembly re-runs EVERY epoch
+        (stochastic augmentations draw fresh each pass, matching the
+        reference's per-pass Spark preprocessing) and runs serially —
+        ImageProcessing chains carry a non-thread-safe RandomState."""
+        from analytics_zoo_tpu.learn.estimator import Estimator
+
+        live = [s for s in shards.collect() if len(s)]
+        if not live:
+            raise ValueError("NNEstimator.fit: all shards are empty")
+        shards = XShards(live)
+        # whole-batch-only training: clamp like the pandas path does
+        batch = min(self.batch_size, sum(len(s) for s in live))
+        self._compile()
+        val = None
+        if self._validation is not None:
+            val = (_assemble(self._validation, self.features_col,
+                             self._preprocessing),
+                   self._label_array(self._validation))
+        est = Estimator(self.model)
+
+        def assemble():
+            return shards.transform_shard(
+                lambda d: {"x": _assemble(d, self.features_col,
+                                          self._preprocessing),
+                           "y": self._label_array(d)},
+                parallel=self._preprocessing is None)
+
+        if self._preprocessing is None:
+            est.fit(assemble(), epochs=self.max_epoch,
+                    batch_size=batch, validation_data=val)
+        else:
+            for _ in range(self.max_epoch):
+                est.fit(assemble(), epochs=1, batch_size=batch,
+                        validation_data=val)
+        return self._make_model()
+
     def _make_model(self) -> "NNModel":
-        return NNModel(self.model, self.features_col)
+        model = NNModel(self.model, self.features_col)
+        model._preprocessing = self._preprocessing
+        return model
 
 
 class NNModel:
@@ -116,6 +187,7 @@ class NNModel:
         self.features_col = [features_col] if isinstance(features_col, str) \
             else list(features_col)
         self.batch_size = 32
+        self._preprocessing: Optional[Callable] = None
 
     def set_batch_size(self, v: int) -> "NNModel":
         self.batch_size = v
@@ -125,14 +197,28 @@ class NNModel:
         self.features_col = [v] if isinstance(v, str) else list(v)
         return self
 
+    def set_sample_preprocessing(self, fn: Callable) -> "NNModel":
+        self._preprocessing = fn
+        return self
+
     def _predict(self, df: pd.DataFrame) -> np.ndarray:
-        x = _assemble(df, self.features_col)
+        x = _assemble(df, self.features_col, self._preprocessing)
         return np.asarray(self.model.predict(
             x, batch_per_thread=self.batch_size))
 
-    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
-        preds = self._predict(df)
+    def transform(self, df: Union[pd.DataFrame, XShards]
+                  ) -> Union[pd.DataFrame, XShards]:
+        """Appends `prediction`. XShards map per shard — the
+        `mapPartitions` shape of `NNEstimator.scala:641`. Serial when a
+        preprocessing is set (RandomState is not thread-safe)."""
+        if isinstance(df, XShards):
+            return df.transform_shard(
+                self.transform, parallel=self._preprocessing is None)
         out = df.copy()
+        if not len(df):
+            out["prediction"] = []
+            return out
+        preds = self._predict(df)
         out["prediction"] = [p if np.ndim(p) else float(p) for p in preds]
         return out
 
@@ -162,8 +248,10 @@ class NNClassifier(NNEstimator):
         return y
 
     def _make_model(self) -> "NNClassifierModel":
-        return NNClassifierModel(self.model, self.features_col,
-                                 zero_based_label=self.zero_based_label)
+        model = NNClassifierModel(self.model, self.features_col,
+                                  zero_based_label=self.zero_based_label)
+        model._preprocessing = self._preprocessing
+        return model
 
 
 class NNClassifierModel(NNModel):
@@ -175,12 +263,19 @@ class NNClassifierModel(NNModel):
         super().__init__(model, features_col)
         self.zero_based_label = zero_based_label
 
-    def transform(self, df: pd.DataFrame) -> pd.DataFrame:
+    def transform(self, df: Union[pd.DataFrame, XShards]
+                  ) -> Union[pd.DataFrame, XShards]:
+        if isinstance(df, XShards):
+            return df.transform_shard(
+                self.transform, parallel=self._preprocessing is None)
+        out = df.copy()
+        if not len(df):
+            out["prediction"] = np.zeros((0,), np.int64)
+            return out
         probs = self._predict(df)
         cls = np.argmax(probs, axis=-1)
         if not self.zero_based_label:
             cls = cls + 1
-        out = df.copy()
         out["prediction"] = cls.astype(np.int64)
         return out
 
@@ -192,7 +287,11 @@ class NNImageReader:
     @staticmethod
     def read_images(path: str, with_label: bool = False,
                     resize: Optional[int] = None,
-                    one_based_label: bool = True) -> pd.DataFrame:
+                    one_based_label: bool = True,
+                    num_shards: Optional[int] = None
+                    ) -> Union[pd.DataFrame, XShards]:
+        """Directory → DataFrame, or (num_shards given) an XShards of
+        row-range DataFrame shards for the distributed NNFrames path."""
         from analytics_zoo_tpu.data.image import ImageResize, ImageSet
         iset = ImageSet.read(path, with_label=with_label,
                              one_based_label=one_based_label)
@@ -202,4 +301,9 @@ class NNImageReader:
                 "path": iset.paths}
         if iset.labels is not None:
             data["label"] = iset.labels
-        return pd.DataFrame(data)
+        df = pd.DataFrame(data)
+        if num_shards is None:
+            return df
+        parts = np.array_split(np.arange(len(df)), num_shards)
+        return XShards([df.iloc[idx].reset_index(drop=True)
+                        for idx in parts if len(idx)])

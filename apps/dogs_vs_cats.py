@@ -1,11 +1,14 @@
 """Dogs-vs-cats transfer learning (the reference's `apps/dogs-vs-cats/
-transfer-learning.ipynb` scenario, BASELINE config 3).
+transfer-learning.ipynb` scenario, BASELINE config 3) — through the
+NNFrames pipeline like the reference notebook: `NNImageReader.read_images`
+→ XShards of DataFrames → `NNClassifier` with a chained-ImageProcessing
+sample preprocessing → `NNClassifierModel.transform` adds `prediction`
+per shard.
 
-Flow: an image folder on disk → the threaded decode+augment pipeline →
-a "pretrained" conv trunk FROZEN by graph surgery (`net.freeze`) → only
-the new classifier head trains through `Estimator.fit` → save, reload,
-and batch-predict. Synthetic pet photos stand in for the Kaggle
-download (texture + hue separate the classes).
+A "pretrained" conv trunk is FROZEN by graph surgery (`net.freeze`) so
+only the new classifier head trains; then save, reload, and
+batch-predict. Synthetic pet photos stand in for the Kaggle download
+(texture + hue separate the classes).
 
     python apps/dogs_vs_cats.py
 """
@@ -14,6 +17,7 @@ import os
 import tempfile
 
 import numpy as np
+import pandas as pd
 
 from analytics_zoo_tpu import init_orca_context
 from analytics_zoo_tpu import net as znet
@@ -21,6 +25,8 @@ from analytics_zoo_tpu.data import image as I
 from analytics_zoo_tpu.keras import Input, Model
 from analytics_zoo_tpu.keras import layers as L
 from analytics_zoo_tpu.learn.estimator import Estimator
+from analytics_zoo_tpu.nnframes import (NNClassifier, NNClassifierModel,
+                                        NNImageReader)
 
 SIZE = 32
 TRUNK = ("conv1", "conv2")
@@ -61,34 +67,43 @@ def main():
     init_orca_context(cluster_mode="local")
     data_dir = make_pet_folder(tempfile.mkdtemp(prefix="pets_"))
 
-    aug = (I.ImageColorJitter(brightness_prob=0.3, hue_prob=0.0,
-                              saturation_prob=0.3, contrast_prob=0.3,
-                              seed=1)
-           >> I.ImageRandomCropper(56, 56, mirror=True, seed=2)
-           >> I.ImageResize(SIZE, SIZE)
-           >> I.ImageChannelNormalize(127, 127, 127, 255, 255, 255))
-    ds = I.image_folder_dataset(data_dir, transform=aug, batch_size=8,
-                                num_workers=4)
-    print(f"{ds.n_samples()} images, threaded decode+augment")
+    # NNImageReader → XShards of DataFrames (the cluster-wide reference
+    # flow; labels are 1-based from the folder layout)
+    shards = NNImageReader.read_images(data_dir, with_label=True,
+                                       num_shards=4)
+    n = sum(len(s) for s in shards.collect())
+    print(f"{n} images in {shards.num_partitions()} shards")
+
+    train_aug = (I.ImageColorJitter(brightness_prob=0.3, hue_prob=0.0,
+                                    saturation_prob=0.3, contrast_prob=0.3,
+                                    seed=1)
+                 >> I.ImageRandomCropper(56, 56, mirror=True, seed=2)
+                 >> I.ImageResize(SIZE, SIZE)
+                 >> I.ImageChannelNormalize(127, 127, 127, 255, 255, 255))
+    eval_pre = (I.ImageResize(SIZE, SIZE)
+                >> I.ImageChannelNormalize(127, 127, 127, 255, 255, 255))
 
     import jax
     model = build_model()
     model.ensure_built(np.zeros((1, SIZE, SIZE, 3), np.float32),
                        jax.random.PRNGKey(42))  # "downloaded" weights
     tuned = znet.freeze(model, TRUNK)           # trunk out of grad path
-    tuned.compile(optimizer="adam",
-                  loss="sparse_categorical_crossentropy")
-    est = Estimator.from_keras(tuned)
-    est.fit(ds, epochs=25)
+    clf = (NNClassifier(tuned)
+           .set_features_col("image").set_label_col("label")
+           .set_batch_size(8).set_max_epoch(25)
+           .set_sample_preprocessing(train_aug))
+    nn_model = clf.fit(shards)                  # sharded Estimator path
     assert not set(tuned.params) & set(TRUNK), "trunk must stay frozen"
 
-    x, y = ds.materialize()
-    acc = float((np.argmax(tuned.predict(x), -1) == y).mean())
+    nn_model.set_sample_preprocessing(eval_pre)  # deterministic eval
+    scored = nn_model.transform(shards)          # XShards + prediction col
+    df = pd.concat(scored.collect(), ignore_index=True)
+    acc = float((df["prediction"] == df["label"]).mean())
     print(f"train accuracy {acc:.3f} (only the head trained)")
     assert acc > 0.85, "transfer learning failed to separate the classes"
 
     path = os.path.join(tempfile.mkdtemp(), "pets_model")
-    est.save(path)
+    Estimator.from_keras(tuned).save(path)
     # rebuild with the same "pretrained" trunk, then load the tuned head
     base2 = build_model()
     base2.ensure_built(np.zeros((1, SIZE, SIZE, 3), np.float32),
@@ -97,7 +112,15 @@ def main():
     reloaded.compile(optimizer="adam",
                      loss="sparse_categorical_crossentropy")
     Estimator.from_keras(reloaded).load(path)
-    agree = np.allclose(reloaded.predict(x[:8]), tuned.predict(x[:8]),
+    re_scored = (NNClassifierModel(reloaded, "image",
+                                   zero_based_label=False)
+                 .set_sample_preprocessing(eval_pre).transform(shards))
+    re_df = pd.concat(re_scored.collect(), ignore_index=True)
+    assert bool((re_df["prediction"] == df["prediction"]).all())
+    # weight-level check, not just argmax: logits must match numerically
+    x_eval = np.stack([np.asarray(eval_pre(im), np.float32)
+                       for im in pd.concat(shards.collect())["image"][:8]])
+    agree = np.allclose(reloaded.predict(x_eval), tuned.predict(x_eval),
                         atol=1e-5)
     print(f"reloaded model agrees: {agree}")
     assert agree

@@ -200,3 +200,102 @@ class TestMatchingAndLoss:
         assert all(len(r) > 0 for r in dets)
         label, score, x1, y1, x2, y2 = dets[0][0]
         assert label in ("obj", "other") and 0.0 <= score <= 1.0
+
+
+class TestNNFramesXShards:
+    """XShards-of-DataFrames path (`NNEstimator.scala:197` cluster-wide
+    fit / :641 mapPartitions transform, VERDICT r3 #6)."""
+
+    def _shards(self, n=96, parts=4):
+        from analytics_zoo_tpu.data.shards import XShards
+        df = scalar_df(n)
+        idx = np.array_split(np.arange(n), parts)
+        return df, XShards([df.iloc[i].reset_index(drop=True)
+                            for i in idx])
+
+    def test_multi_shard_fit_and_transform(self):
+        from analytics_zoo_tpu.data.shards import XShards
+        df, shards = self._shards()
+        model = Sequential([L.Dense(8, activation="relu",
+                                    input_shape=(2,)), L.Dense(1)])
+        est = (NNEstimator(model, "mse")
+               .set_features_col(["a", "b"]).set_label_col("target")
+               .set_batch_size(32).set_max_epoch(30)
+               .set_learning_rate(1e-2))
+        nn_model = est.fit(shards)                  # sharded path
+        scored = nn_model.transform(shards)
+        assert isinstance(scored, XShards)
+        assert scored.num_partitions() == 4
+        out = pd.concat(scored.collect(), ignore_index=True)
+        assert "prediction" in out.columns and len(out) == len(df)
+        preds = np.asarray([np.squeeze(p) for p in out["prediction"]])
+        resid = preds - df["target"].to_numpy()
+        assert float(np.mean(resid ** 2)) < 0.3
+
+    def test_classifier_shards_match_pandas_path(self):
+        # same data, same seed model: the sharded fit must train (loss
+        # down, accuracy up) and transform must keep per-shard row order
+        from analytics_zoo_tpu.data.shards import XShards
+        df, shards = self._shards()
+        df = df.copy()
+        df["label"] = df["label"] + 1               # 1-based labels
+        shards = XShards([s.assign(label=s["label"] + 1)
+                          for s in shards.collect()])
+        model = Sequential([L.Dense(8, activation="relu",
+                                    input_shape=(2,)),
+                            L.Dense(2, activation="softmax")])
+        clf = (NNClassifier(model)
+               .set_features_col(["a", "b"]).set_label_col("label")
+               .set_batch_size(32).set_max_epoch(40)
+               .set_learning_rate(5e-2))
+        nn_model = clf.fit(shards)
+        scored = pd.concat(nn_model.transform(shards).collect(),
+                           ignore_index=True)
+        acc = float((scored["prediction"] == df["label"].to_numpy()).mean())
+        assert acc > 0.85
+        assert set(scored["prediction"]) <= {1, 2}   # stays 1-based
+
+    def test_sample_preprocessing_applied(self):
+        # per-row preprocessing is defined on ARRAY-valued features: it
+        # must change predictions there, and raise (not silently no-op)
+        # for scalar columns
+        from analytics_zoo_tpu.data.shards import XShards
+        _, shards = self._shards(n=32, parts=2)
+        model = Sequential([L.Dense(1, input_shape=(2,))])
+        est = (NNEstimator(model, "mse")
+               .set_features_col(["a", "b"]).set_label_col("target")
+               .set_max_epoch(1))
+        nn_model = est.fit(shards)
+        arr_shards = XShards([
+            pd.DataFrame({"features": [np.asarray([a, b], np.float32)
+                                       for a, b in zip(s["a"], s["b"])],
+                          "target": s["target"]})
+            for s in shards.collect()])
+        m2 = NNModel(nn_model.model, "features")
+        plain = pd.concat(m2.transform(arr_shards).collect(),
+                          ignore_index=True)
+        m2.set_sample_preprocessing(lambda r: r * 2)
+        doubled = pd.concat(m2.transform(arr_shards).collect(),
+                            ignore_index=True)
+        p0 = np.asarray([np.squeeze(p) for p in plain["prediction"]])
+        p1 = np.asarray([np.squeeze(p) for p in doubled["prediction"]])
+        assert not np.allclose(p0, p1)
+        # scalar columns + preprocessing is a contract violation
+        with pytest.raises(ValueError, match="array-valued"):
+            nn_model.set_sample_preprocessing(lambda r: r) \
+                .transform(shards.collect()[0])
+
+    def test_empty_shard_handling(self):
+        from analytics_zoo_tpu.data.shards import XShards
+        df, _ = self._shards(n=8, parts=1)
+        model = Sequential([L.Dense(1, input_shape=(2,))])
+        est = (NNEstimator(model, "mse")
+               .set_features_col(["a", "b"]).set_label_col("target")
+               .set_max_epoch(1))
+        # empty shards are filtered out of fit...
+        shards = XShards([df, df.iloc[:0]])
+        nn_model = est.fit(shards)
+        # ...and transform yields an empty frame WITH the prediction col
+        out = nn_model.transform(shards)
+        empty = out.collect()[1]
+        assert "prediction" in empty.columns and len(empty) == 0
