@@ -117,6 +117,7 @@ class DeepGLO:
         self._x_params = self._y_params = None
         self._x_apply = self._y_apply = None
         self._y_mu = self._y_sd = None
+        self._yn_parts = None
 
     # -- global stage ------------------------------------------------------
     def _fact_run(self, x_apply):
@@ -167,12 +168,158 @@ class DeepGLO:
         self.F = np.asarray(params["F"])
         self.X = np.asarray(params["X"])
 
+    def _fact_sharded_fns(self, x_apply):
+        """jit-cached per-fit program pieces for the sharded global stage
+        (same role as `_fact_run` for the in-memory stage): one trace per
+        fit, reused across the 1 + refine_rounds factorization rounds."""
+        if getattr(self, "_fact_sharded_cached", None) is not None:
+            return self._fact_sharded_cached
+        opt = optax.adam(self.lr)
+
+        @jax.jit
+        def shard_grad(f_i, x, yn_i, w):
+            def li(f_i, x):
+                recon = jnp.mean((f_i @ x - yn_i) ** 2)
+                return w * (recon + 1e-4 * jnp.mean(f_i ** 2))
+            return jax.grad(li, argnums=(0, 1))(f_i, x)
+
+        @jax.jit
+        def central_grad(x, x_params, alpha):
+            def lc(x):
+                xrows = x[:, :, None]
+                pred = x_apply(x_params, xrows)
+                tmp = jnp.mean((pred[:, :-1] - x[:, 1:]) ** 2)
+                return 1e-4 * jnp.mean(x ** 2) + alpha * tmp
+            return jax.grad(lc)(x)
+
+        @jax.jit
+        def apply_updates(params, opt_state, grads):
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._fact_sharded_cached = (opt, shard_grad, central_grad,
+                                     apply_updates)
+        return self._fact_sharded_cached
+
+    def _factorize_sharded(self, yns, sizes, x_params, x_apply, rng,
+                           temporal: bool):
+        """Distributed global stage: {F, X} Adam with F sharded by series
+        panel. The full loss decomposes exactly — recon and the F-reg are
+        size-weighted per-shard sums (mean over n·T rows = Σ (m_i/n)·
+        mean_i), the X-reg and X_seq temporal term are central — so the
+        assembled gradient equals the in-memory `_factorize` gradient and
+        the Adam trajectories match (`tests/test_tcmf.py`). The [n, T]
+        panel is never concatenated; only [m_i, rank] F parts and the
+        [rank, T] X are updated."""
+        n = sum(sizes)
+        t = yns[0].shape[1]
+        if self.F is None:
+            kf, kx = jax.random.split(rng)
+            f_full = jax.random.normal(kf, (n, self.rank)) * 0.1
+            bounds = np.cumsum([0] + sizes)
+            f_parts = tuple(f_full[lo:hi]
+                            for lo, hi in zip(bounds[:-1], bounds[1:]))
+            x = jax.random.normal(kx, (self.rank, t)) * 0.1
+        else:
+            bounds = np.cumsum([0] + sizes)
+            f_parts = tuple(jnp.asarray(self.F[lo:hi])
+                            for lo, hi in zip(bounds[:-1], bounds[1:]))
+            x = jnp.asarray(self.X)
+        alpha = jnp.float32(self.alpha if temporal else 0.0)
+        opt, shard_grad, central_grad, apply_updates = \
+            self._fact_sharded_fns(x_apply)
+        params = {"F": f_parts, "X": x}
+        opt_state = opt.init(params)
+        ws = [jnp.float32(m / n) for m in sizes]
+        for _ in range(self.fact_steps):
+            g_x = central_grad(params["X"], x_params, alpha)
+            g_f = []
+            for f_i, yn_i, w in zip(params["F"], yns, ws):
+                gf_i, gx_i = shard_grad(f_i, params["X"], yn_i, w)
+                g_f.append(gf_i)
+                g_x = g_x + gx_i
+            params, opt_state = apply_updates(
+                params, opt_state, {"F": tuple(g_f), "X": g_x})
+        self.F = np.concatenate([np.asarray(f) for f in params["F"]])
+        self.X = np.asarray(params["X"])
+
+    def _run_global_stage(self, factorize, x_init, x_apply, r_x):
+        """The alternating schedule shared by the in-memory and sharded
+        paths: plain factorization (alpha=0, untrained X_seq), then
+        refine_rounds of (train X_seq on X, re-factorize with the
+        temporal term), then a final X_seq fit for prediction."""
+        x_train = _make_net_trainer(x_init, x_apply, self.seq_steps,
+                                    self.net_lr)
+        self._x_params = x_init(r_x)
+        factorize(False)
+        for _ in range(self.refine_rounds):
+            xrows = jnp.asarray(self.X)[:, :, None]
+            self._x_params = x_train(xrows, jnp.asarray(self.X), r_x)
+            factorize(True)
+        xrows = jnp.asarray(self.X)[:, :, None]
+        self._x_params = x_train(xrows, jnp.asarray(self.X), r_x)
+
+    def _panels_from_parts(self, yns, sizes):
+        """[yn, global-recon] input panels for the local stage, one global
+        block per shard (never the full [n, T] reconstruction)."""
+        panels, off = [], 0
+        for yn, m in zip(yns, sizes):
+            g = jnp.asarray(self.F[off:off + m] @ self.X)
+            panels.append((jnp.stack([yn, g], axis=-1), yn, m))
+            off += m
+        return panels
+
+    def _fit_sharded(self, shards) -> "DeepGLO":
+        """Whole-pipeline distributed fit over an XShards of {"y": [m, T]}
+        panels (VERDICT r3 #8): per-shard normalization, sharded global
+        factorization, central X_seq refinement (X is [rank, T] — small),
+        and the per-shard-gradient local stage. The full [n_series, T]
+        panel is never materialized; per-series stats ([n, 1]) and the
+        factor F ([n, rank]) are the only full-length arrays kept."""
+        raws = [np.asarray(sh["y"], np.float32) for sh in shards.collect()]
+        sizes = [p.shape[0] for p in raws]
+        mus = [p.mean(axis=1, keepdims=True) for p in raws]
+        sds = [p.std(axis=1, keepdims=True) + 1e-6 for p in raws]
+        yns = [jnp.asarray((p - m) / s) for p, m, s in zip(raws, mus, sds)]
+        self.F = self.X = None
+        self._fact_cached = None
+        self._fact_sharded_cached = None
+        self._y_mu = np.concatenate(mus)
+        self._y_sd = np.concatenate(sds)
+        self._yn_parts = yns
+        self._yn_hist = None
+        rng = jax.random.PRNGKey(self.seed)
+        r_fact, r_x, r_y = jax.random.split(rng, 3)
+
+        x_init, x_apply = _make_tcn(1, self.hidden, self.levels,
+                                    self.kernel)
+        self._x_apply = x_apply
+        self._run_global_stage(
+            lambda temporal: self._factorize_sharded(
+                yns, sizes, self._x_params, x_apply, r_fact,
+                temporal=temporal),
+            x_init, x_apply, r_x)
+
+        y_init, y_apply = _make_tcn(2, self.hidden, self.levels,
+                                    self.kernel)
+        self._y_apply = y_apply
+        self._y_params = self._train_local_panels(
+            y_init, y_apply, self._panels_from_parts(yns, sizes), r_y)
+        return self
+
     # -- fit ---------------------------------------------------------------
-    def fit(self, y: np.ndarray, shards=None) -> "DeepGLO":
+    def fit(self, y: Optional[np.ndarray] = None, shards=None) -> "DeepGLO":
         """y: [n_series, T]. `shards`: optional XShards of {"y": [m, T]}
-        panels — the local stage then trains by per-shard gradient
-        averaging (distributed mode)."""
+        panels. With BOTH, the global stage runs in-memory and only the
+        local stage trains by per-shard gradient averaging; with shards
+        ONLY (y=None), the whole pipeline runs sharded
+        (`_fit_sharded`)."""
+        if y is None:
+            if shards is None:
+                raise ValueError("fit needs y or shards")
+            return self._fit_sharded(shards)
         y = np.asarray(y, np.float32)
+        self._yn_parts = None
         # every fit is fresh — a warm start from a previous panel would
         # silently bias (or shape-crash) the factorization
         self.F = self.X = None
@@ -188,21 +335,10 @@ class DeepGLO:
         x_init, x_apply = _make_tcn(1, self.hidden, self.levels,
                                     self.kernel)
         self._x_apply = x_apply
-        x_train = _make_net_trainer(x_init, x_apply, self.seq_steps,
-                                    self.net_lr)
-
-        # round 0: plain factorization (alpha=0; the untrained X_seq
-        # params are present but weightless), then alternate
-        self._x_params = x_init(r_x)
-        self._factorize(yj, self._x_params, x_apply, r_fact,
-                        temporal=False)
-        for _ in range(self.refine_rounds):
-            xrows = jnp.asarray(self.X)[:, :, None]
-            self._x_params = x_train(xrows, jnp.asarray(self.X), r_x)
-            self._factorize(yj, self._x_params, x_apply, r_fact,
-                            temporal=True)
-        xrows = jnp.asarray(self.X)[:, :, None]
-        self._x_params = x_train(xrows, jnp.asarray(self.X), r_x)
+        self._run_global_stage(
+            lambda temporal: self._factorize(
+                yj, self._x_params, x_apply, r_fact, temporal=temporal),
+            x_init, x_apply, r_x)
 
         # local stage: per-series net over [y, global] channels
         y_init, y_apply = _make_tcn(2, self.hidden, self.levels,
@@ -236,7 +372,12 @@ class DeepGLO:
             g = jnp.asarray(self.F[offset:offset + m] @ self.X)
             panels.append((jnp.stack([yn, g], axis=-1), yn, m))
             offset += m
-        n_total = offset
+        return self._train_local_panels(y_init, y_apply, panels, rng)
+
+    def _train_local_panels(self, y_init, y_apply, panels, rng):
+        """Core of the sharded local stage over prepared
+        ([m, T, 2] input, [m, T] target, m) panels."""
+        n_total = sum(m for _, _, m in panels)
         params = y_init(rng)
         opt = optax.adam(self.net_lr)
         opt_state = opt.init(params)
@@ -285,11 +426,22 @@ class DeepGLO:
         xf = self._roll(self._x_apply, self._x_params,
                         jnp.asarray(self.X), horizon)
         x_full = jnp.concatenate([jnp.asarray(self.X), xf], axis=1)
-        g_full = jnp.asarray(self.F) @ x_full        # [n, T+h] global
-        # local refinement over [y, global]
-        out = self._roll(self._y_apply, self._y_params,
-                         jnp.asarray(self._yn_hist), horizon,
-                         covariate=g_full)
+        if self._yn_parts is not None:
+            # sharded fit: roll per panel, full history never concatenated
+            outs, off = [], 0
+            for yn in self._yn_parts:
+                m = yn.shape[0]
+                g = jnp.asarray(self.F[off:off + m]) @ x_full
+                outs.append(self._roll(self._y_apply, self._y_params, yn,
+                                       horizon, covariate=g))
+                off += m
+            out = jnp.concatenate(outs, axis=0)
+        else:
+            g_full = jnp.asarray(self.F) @ x_full    # [n, T+h] global
+            # local refinement over [y, global]
+            out = self._roll(self._y_apply, self._y_params,
+                             jnp.asarray(self._yn_hist), horizon,
+                             covariate=g_full)
         return np.asarray(out) * self._y_sd + self._y_mu
 
     def rolling_validation(self, y: np.ndarray, tau: int = 8,

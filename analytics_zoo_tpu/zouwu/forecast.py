@@ -145,8 +145,6 @@ class TCMFForecaster:
         shards = None
         if isinstance(x, XShards):
             panels = x.collect()
-            y = np.concatenate(
-                [np.asarray(p["y"], np.float32) for p in panels])
             ids, offset = [], 0
             for p in panels:
                 m = len(p["y"])
@@ -156,7 +154,13 @@ class TCMFForecaster:
                     p.get("id", np.arange(offset, offset + m))))
                 offset += m
             self._ids = np.concatenate(ids)
-            shards = x if self.distributed else None
+            if self.distributed:
+                # fully sharded DeepGLO fit: the [n, T] panel is never
+                # concatenated (global stage runs per shard too)
+                self._tcmf.fit(shards=x)
+                return self
+            y = np.concatenate(
+                [np.asarray(p["y"], np.float32) for p in panels])
         else:
             y = np.asarray(x["y"], np.float32)
             self._ids = np.asarray(x.get("id", np.arange(len(y))))

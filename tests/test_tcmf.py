@@ -125,3 +125,46 @@ class TestForecasterSurface:
     def test_distributed_needs_deepglo(self):
         with pytest.raises(ValueError, match="deepglo"):
             TCMFForecaster(model="factorization", distributed=True)
+
+
+class TestShardedGlobalStage:
+    """Whole-pipeline sharded fit (VERDICT r3 #8): the global
+    factorization runs per-shard with exact size-weighted gradient
+    assembly — same init, same Adam trajectory as in-memory."""
+
+    def test_sharded_fit_equals_in_memory(self):
+        y = panel(n=10, t=120)
+        kw = dict(rank=3, fact_steps=60, seq_steps=40, refine_rounds=1,
+                  hidden=16, levels=2, seed=0)
+        mem = DeepGLO(**kw).fit(y)
+        parts = [y[:4], y[4:7], y[7:]]            # uneven shards
+        sh = DeepGLO(**kw).fit(
+            shards=XShards([{"y": p} for p in parts]))
+        np.testing.assert_allclose(sh.F, mem.F, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(sh.X, mem.X, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(sh.predict(6), mem.predict(6),
+                                   rtol=1e-2, atol=1e-3)
+
+    def test_sharded_fit_never_concats_panel(self, monkeypatch):
+        # the [n, T] panel must not be materialized by the sharded path
+        import jax.numpy as jnp
+        y = panel(n=8, t=96)
+        parts = [{"y": y[:3]}, {"y": y[3:]}]
+        n, t = y.shape
+        orig = jnp.concatenate
+
+        def guard(arrays, axis=0, **kw):
+            out = orig(arrays, axis=axis, **kw)
+            assert out.shape != (n, t), "full panel concatenated"
+            return out
+
+        monkeypatch.setattr(jnp, "concatenate", guard)
+        m = DeepGLO(rank=2, fact_steps=30, seq_steps=20, refine_rounds=1,
+                    hidden=8, levels=2, seed=0)
+        m.fit(shards=XShards(parts))
+        pred = m.predict(4)
+        assert pred.shape == (n, 4)
+
+    def test_fit_requires_data(self):
+        with pytest.raises(ValueError):
+            DeepGLO().fit()
