@@ -99,7 +99,8 @@ class TPUDataset:
     def from_tfrecord(paths, parse_fn: Callable[[Dict[str, Any]], Tuple],
                       batch_size: int = -1, batch_per_thread: int = -1,
                       shuffle: bool = True, shuffle_buffer: int = 8192,
-                      verify_payload: bool = False) -> "TPUDataset":
+                      verify_payload: bool = False,
+                      num_workers: int = 1) -> "TPUDataset":
         """Stream a TFRecord corpus into training (the reference's
         `TFDataset.from_tf_data_dataset`/`TFBytesDataset` role,
         `tf_dataset.py:593,911`, minus the tf.data graph shuttling).
@@ -109,12 +110,17 @@ class TPUDataset:
         to an (x, y) sample of fixed-shape arrays. Records stream through a
         `shuffle_buffer`-sized shuffle window per epoch (file order is also
         reshuffled per epoch); batches are stacked to static shapes and the
-        tail remainder is dropped, per the training batch contract."""
+        tail remainder is dropped, per the training batch contract.
+
+        `num_workers` > 1 runs decode+parse through the threaded
+        order-preserving map (`image.parallel_map_ordered`) — JPEG decode
+        and cv2 augmentation release the GIL, so an ImageNet-style
+        pipeline keeps the chip fed."""
         from analytics_zoo_tpu.data import tfrecord as tfr
         files = tfr.expand_files(paths)
         return _TFRecordDataset(files, parse_fn, batch_size,
                                 batch_per_thread, shuffle, shuffle_buffer,
-                                verify_payload)
+                                verify_payload, num_workers)
 
     # -- consumption -------------------------------------------------------
     def n_samples(self) -> int:
@@ -185,7 +191,7 @@ class _TFRecordDataset(TPUDataset):
 
     def __init__(self, files: List[str], parse_fn, batch_size: int,
                  batch_per_thread: int, shuffle: bool, shuffle_buffer: int,
-                 verify_payload: bool):
+                 verify_payload: bool, num_workers: int = 1):
         super().__init__(x=None, y=None, batch_size=batch_size,
                          batch_per_thread=batch_per_thread, shuffle=shuffle)
         if parse_fn is None:
@@ -196,6 +202,7 @@ class _TFRecordDataset(TPUDataset):
         self._parse_fn = parse_fn
         self._shuffle_buffer = max(1, shuffle_buffer)
         self._verify_payload = verify_payload
+        self._num_workers = max(1, num_workers)
         self._n: Optional[int] = None
 
     def n_samples(self) -> int:
@@ -232,13 +239,19 @@ class _TFRecordDataset(TPUDataset):
     def _iter_samples(self, rng: np.random.RandomState,
                       ordered: bool = False):
         from analytics_zoo_tpu.data import tfrecord as tfr
+        from analytics_zoo_tpu.data.image import parallel_map_ordered
         files = list(self._files)
         if self.shuffle and not ordered:
             rng.shuffle(files)
-        for path in files:
-            for payload in tfr.read_records(
-                    path, verify_payload=self._verify_payload):
-                yield self._parse_fn(tfr.decode_example(payload))
+
+        def payloads():
+            for path in files:
+                yield from tfr.read_records(
+                    path, verify_payload=self._verify_payload)
+
+        yield from parallel_map_ordered(
+            lambda p: self._parse_fn(tfr.decode_example(p)),
+            payloads(), self._num_workers)
 
     def iter_train(self, data_parallel: int, seed: int = 0):
         import jax

@@ -510,6 +510,10 @@ class _ConvND(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         x = _to_channels_last(x, self.dim_ordering, self.spatial_rank)
+        # conv requires matching operand dtypes; follow the kernel (under
+        # mixed precision the params are bf16 while e.g. an on-device
+        # normalization Lambda may produce f32)
+        x = x.astype(params["kernel"].dtype)
         y = jax.lax.conv_general_dilated(
             x, params["kernel"], window_strides=self.strides,
             padding=self.padding, dimension_numbers=self.dn,

@@ -84,6 +84,61 @@ def resnet(depth: int = 50, class_num: int = 1000,
     return Model(inp, x)
 
 
+def _inception_block(x, c1, c3r, c3, c5r, c5, pp):
+    """One GoogLeNet inception module: 1x1 / 1x1→3x3 / 1x1→5x5 /
+    pool→1x1 branches concatenated on channels."""
+    b1 = _conv_bn(x, c1, 1)
+    b3 = _conv_bn(_conv_bn(x, c3r, 1), c3, 3)
+    b5 = _conv_bn(_conv_bn(x, c5r, 1), c5, 5)
+    bp = L.MaxPooling2D(pool_size=(3, 3), strides=(1, 1),
+                        border_mode="same")(x)
+    bp = _conv_bn(bp, pp, 1)
+    return L.merge([b1, b3, b5, bp], mode="concat", concat_axis=-1)
+
+
+# (branch filter tables of GoogLeNet/Inception-v1, stage 3a..5b)
+_INCEPTION_V1 = [
+    ("3a", 64, 96, 128, 16, 32, 32), ("3b", 128, 128, 192, 32, 96, 64),
+    ("pool", ),
+    ("4a", 192, 96, 208, 16, 48, 64), ("4b", 160, 112, 224, 24, 64, 64),
+    ("4c", 128, 128, 256, 24, 64, 64), ("4d", 112, 144, 288, 32, 64, 64),
+    ("4e", 256, 160, 320, 32, 128, 128),
+    ("pool", ),
+    ("5a", 256, 160, 320, 32, 128, 128),
+    ("5b", 384, 192, 384, 48, 128, 128),
+]
+
+
+def inception_v1(class_num: int = 1000,
+                 input_shape: Sequence[int] = (224, 224, 3),
+                 dropout: float = 0.4) -> Model:
+    """GoogLeNet/Inception-v1 — the reference's headline ImageNet training
+    model (`zoo/examples/inception/ImageNet2012.scala`, Train.scala;
+    BigDL `Inception_v1_NoAuxClassifier`). NHWC with BatchNorm after every
+    conv (the bn variant — plain v1 needs LRN, which buys nothing on TPU);
+    no auxiliary heads (they exist to aid very deep pre-BN training)."""
+    inp = Input(shape=tuple(input_shape))
+    x = _conv_bn(inp, 64, 7, stride=2)
+    x = L.MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                       border_mode="same")(x)
+    x = _conv_bn(x, 64, 1)
+    x = _conv_bn(x, 192, 3)
+    x = L.MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                       border_mode="same")(x)
+    for row in _INCEPTION_V1:
+        if row[0] == "pool":
+            x = L.MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                               border_mode="same")(x)
+        else:
+            _, c1, c3r, c3, c5r, c5, pp = row
+            x = _inception_block(x, c1, c3r, c3, c5r, c5, pp)
+    x = L.GlobalAveragePooling2D()(x)
+    if dropout > 0:
+        x = L.Dropout(dropout)(x)
+    x = L.Dense(class_num, activation="softmax")(x)
+    return Model(inp, x)
+
+
 class ImageClassifier(ZooModel):
     """Model + preprocessing + label map (`models/image/imageclassification/
     ImageClassifier.scala` surface)."""

@@ -32,6 +32,10 @@ EXAMPLES = [
     ("session_recommender.py", []),
     ("long_context_attention.py", []),
     ("tfrecord_training.py", []),
+    ("inception_imagenet.py", ["--image-size", "32", "--batch", "8",
+                               "--fixture-shards", "2",
+                               "--fixture-per-shard", "16",
+                               "--workers", "2", "--steps-per-run", "2"]),
 ]
 
 

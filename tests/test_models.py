@@ -276,3 +276,25 @@ class TestResNet:
     def test_bad_depth(self):
         with pytest.raises(ValueError, match="Unsupported depth"):
             resnet(depth=99)
+
+
+class TestInceptionV1:
+    def test_builds_and_classifies(self):
+        import jax
+        import numpy as np
+        from analytics_zoo_tpu.models.image import inception_v1
+        m = inception_v1(class_num=5, input_shape=(64, 64, 3))
+        m.ensure_built(np.zeros((1, 64, 64, 3), np.float32),
+                       jax.random.PRNGKey(0))
+        out = np.asarray(m.predict(np.random.rand(2, 64, 64, 3)
+                                   .astype(np.float32)))
+        assert out.shape == (2, 5)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+    def test_channel_widths_follow_googlenet(self):
+        # inception output channels = c1+c3+c5+pp per block; 5b ends 1024
+        from analytics_zoo_tpu.models.image import _INCEPTION_V1
+        widths = {r[0]: r[1] + r[3] + r[5] + r[6]
+                  for r in _INCEPTION_V1 if r[0] != "pool"}
+        assert widths["3a"] == 256 and widths["4a"] == 512
+        assert widths["5b"] == 1024

@@ -317,3 +317,25 @@ class TestTFRecordDataset:
             assert preds.shape == (96, 1)
         finally:
             zoo.stop_orca_context()
+
+
+class TestThreadedParse:
+    def test_num_workers_same_samples(self, tmp_path):
+        import numpy as np
+        from analytics_zoo_tpu.data import tfrecord as tfr
+        from analytics_zoo_tpu.data.dataset import TPUDataset
+        path = str(tmp_path / "t.tfrecord")
+        tfr.write_tfrecord(path, [
+            tfr.encode_example({"v": np.asarray([i], np.int64)})
+            for i in range(37)])
+
+        def parse(ex):
+            return np.asarray(ex["v"], np.float32), None
+
+        serial = TPUDataset.from_tfrecord(path, parse, batch_size=5,
+                                          shuffle=False)
+        threaded = TPUDataset.from_tfrecord(path, parse, batch_size=5,
+                                            shuffle=False, num_workers=4)
+        xs, _ = serial.materialize()
+        xt, _ = threaded.materialize()
+        np.testing.assert_array_equal(np.asarray(xs), np.asarray(xt))
