@@ -510,10 +510,13 @@ class _ConvND(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         x = _to_channels_last(x, self.dim_ordering, self.spatial_rank)
-        # conv requires matching operand dtypes; follow the kernel (under
-        # mixed precision the params are bf16 while e.g. an on-device
-        # normalization Lambda may produce f32)
-        x = x.astype(params["kernel"].dtype)
+        # conv requires matching operand dtypes; float inputs follow the
+        # kernel (under mixed precision the params are bf16 while e.g. an
+        # on-device normalization Lambda produces f32). Integer inputs
+        # still error loudly — silently casting raw uint8 images would
+        # train on unscaled 0-255 values.
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(params["kernel"].dtype)
         y = jax.lax.conv_general_dilated(
             x, params["kernel"], window_strides=self.strides,
             padding=self.padding, dimension_numbers=self.dn,

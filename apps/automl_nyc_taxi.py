@@ -1,0 +1,93 @@
+"""AutoML time-series walkthrough — the reference's `apps/automl`
+notebook (`nyc_taxi_dataset.ipynb`): NYC-taxi-style demand series →
+`AutoTSTrainer` hyperparameter search → `TSPipeline` evaluate /
+incremental fit / save / load / predict. Synthetic taxi demand stands in
+for the download (daily + weekly seasonality, rush-hour bumps, noise).
+
+    python apps/automl_nyc_taxi.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.automl.recipe import LSTMGridRandomRecipe
+from analytics_zoo_tpu.zouwu.autots import AutoTSTrainer, TSPipeline
+
+
+def taxi_demand_df(n=1200, seed=0) -> pd.DataFrame:
+    """30-min interval series with daily (48) + weekly (336) rhythms —
+    the shape of the notebook's `nyc_taxi.csv`."""
+    rs = np.random.RandomState(seed)
+    ts = np.arange(n)
+    demand = (10.0
+              + 4.0 * np.sin(2 * np.pi * ts / 48.0)        # daily
+              + 2.0 * np.sin(2 * np.pi * ts / 336.0)       # weekly
+              + 1.5 * ((ts % 48 == 17) | (ts % 48 == 36))  # rush hours
+              + 0.4 * rs.randn(n))
+    return pd.DataFrame({
+        "datetime": pd.date_range("2015-01-01", periods=n, freq="30min"),
+        "value": demand.astype(np.float32),
+    })
+
+
+def sparkline(vals, width=48) -> str:
+    """The notebook's matplotlib plot, terminal edition."""
+    blocks = "▁▂▃▄▅▆▇█"
+    v = np.asarray(vals, np.float32)[:width]
+    lo, hi = float(v.min()), float(v.max())
+    span = (hi - lo) or 1.0
+    return "".join(blocks[int((x - lo) / span * (len(blocks) - 1))]
+                   for x in v)
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    df = taxi_demand_df()
+    split = int(len(df) * 0.8)
+    train_df, test_df = df.iloc[:split], df.iloc[split:]
+    print(f"{len(train_df)} train / {len(test_df)} test points")
+    print("history:", sparkline(train_df["value"].to_numpy()[-96:]))
+
+    trainer = AutoTSTrainer(dt_col="datetime", target_col="value",
+                            horizon=1)
+    pipeline = trainer.fit(train_df, validation_df=test_df,
+                           recipe=LSTMGridRandomRecipe(
+                               num_rand_samples=1, epochs=3, look_back=6),
+                           metric="mse")
+    print("best config:", {k: v for k, v in pipeline.config.items()
+                           if k in ("lstm_1_units", "lstm_2_units", "lr",
+                                    "past_seq_len")})
+
+    metrics = pipeline.evaluate(test_df, metrics=("mse", "smape"))
+    print(f"holdout: mse={metrics['mse']:.4f} smape={metrics['smape']:.2f}")
+
+    preds = np.asarray(pipeline.predict(test_df)).ravel()
+    actual = test_df["value"].to_numpy()[-len(preds):]
+    print("actual:   ", sparkline(actual))
+    print("predicted:", sparkline(preds))
+
+    # incremental fit on the fresh window (notebook: fit on new data)
+    pipeline.fit(test_df, epoch_num=2)
+    metrics2 = pipeline.evaluate(test_df, metrics=("mse",))
+    print(f"after incremental fit: mse={metrics2['mse']:.4f}")
+
+    # save / load round trip, predictions must survive
+    path = os.path.join(tempfile.mkdtemp(), "taxi_pipeline")
+    pipeline.save(path)
+    reloaded = TSPipeline.load(path)
+    np.testing.assert_allclose(
+        np.asarray(reloaded.predict(test_df)).ravel(),
+        np.asarray(pipeline.predict(test_df)).ravel(), rtol=1e-5)
+    print("save/load round trip OK")
+
+    naive_mse = float(np.mean(np.diff(actual) ** 2))  # persistence model
+    assert metrics2["mse"] < naive_mse * 1.5, (metrics2, naive_mse)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

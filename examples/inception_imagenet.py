@@ -81,15 +81,21 @@ def make_parse_fn(size: int, classes: int, seed: int = 0):
     ships 1 byte per pixel instead of 4 (the standard TPU input-pipeline
     design; 224² batches are transfer-bound otherwise)."""
     import cv2
-    aug = (I.ImageAspectScale(size + size // 8)
-           >> I.ImageRandomCropper(size, size, mirror=True, seed=seed))
+    scale = I.ImageAspectScale(size + size // 8)
+    crop = I.ImageRandomCropper(size, size, mirror=True, seed=seed)
 
     def parse(ex):
         raw = np.frombuffer(ex["image/encoded"][0], np.uint8)
         img = cv2.cvtColor(cv2.imdecode(raw, cv2.IMREAD_COLOR),
                            cv2.COLOR_BGR2RGB)
+        img = scale(img)
+        if min(img.shape[:2]) < size:
+            # extreme aspect ratios: AspectScale's long-side cap can push
+            # the short side under the crop — fall back to a square
+            # resize instead of crashing the epoch
+            img = cv2.resize(img, (size + size // 8, size + size // 8))
         label = int(ex["image/class/label"][0]) % classes
-        return aug(img).astype(np.uint8), np.int32(label)
+        return crop(img).astype(np.uint8), np.int32(label)
 
     return parse
 
@@ -140,9 +146,11 @@ def main():
         data_glob, make_parse_fn(size, classes),
         batch_size=batch, shuffle_buffer=max(batch * 4, 256),
         num_workers=args.workers)
-    n = ds.n_samples()
-    print(f"{n} records, {args.workers} decode/augment workers, "
-          f"batch {batch}, image {size}x{size}")
+    # no n_samples() here: counting records header-walks every shard
+    # (minutes over a fuse-mounted ImageNet) just for a log line
+    n_shards = len(tfr.expand_files(data_glob))
+    print(f"{n_shards} shard file(s), {args.workers} decode/augment "
+          f"workers, batch {batch}, image {size}x{size}")
 
     from analytics_zoo_tpu.keras import Input, Model
     inp = Input(shape=(size, size, 3))

@@ -1,0 +1,120 @@
+"""Streaming text classification — the reference's Spark-Streaming example
+(`pyzoo/zoo/examples/streaming/textclassification/
+streaming_text_classification.py:1`: a socket text stream à la `nc`,
+micro-batched, classified by a TextClassifier, predictions printed)
+re-hosted on the framework's own streaming runtime: a plain TCP socket
+source feeding micro-batch windows into the jitted predict path. No
+Spark — the micro-batch loop is a thread draining a socket, which is all
+`socketTextStream` + `foreachRDD` amounted to.
+
+    python examples/streaming_text_classification.py
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+
+VOCAB, SEQ_LEN, CLASSES = 400, 32, 4
+BATCH_WINDOW_S = 0.15
+
+
+def synthetic_line(rng, cls):
+    """Class-banded token text (the example's stand-in for news20 lines:
+    'label<tab>tokens')."""
+    band = VOCAB // CLASSES
+    toks = rng.randint(cls * band, (cls + 1) * band, SEQ_LEN)
+    return f"{cls}\t" + " ".join(map(str, toks))
+
+
+def producer(host, port, n_lines, seed=1):
+    """The `nc`/image_path_writer role: connect and stream lines."""
+    rng = np.random.RandomState(seed)
+    sock = socket.create_connection((host, port))
+    for i in range(n_lines):
+        line = synthetic_line(rng, int(rng.randint(CLASSES)))
+        sock.sendall((line + "\n").encode())
+        time.sleep(0.005)           # a trickle, like a live feed
+    sock.close()
+
+
+def encode(lines):
+    """text → fixed-length token ids (the reference pads/truncates to
+    sequence_length before TextClassifier.predict)."""
+    xs, ys = [], []
+    for ln in lines:
+        label, _, body = ln.partition("\t")
+        toks = [int(t) for t in body.split()][:SEQ_LEN]
+        toks += [0] * (SEQ_LEN - len(toks))
+        xs.append(toks)
+        ys.append(int(label))
+    return np.asarray(xs, np.int32), np.asarray(ys, np.int32)
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+
+    # train the classifier the stream will use (news20 stand-in corpus)
+    rng = np.random.RandomState(0)
+    lines = [synthetic_line(rng, int(rng.randint(CLASSES)))
+             for _ in range(768)]
+    x, y = encode(lines)
+    clf = TextClassifier(class_num=CLASSES, vocab_size=VOCAB,
+                         embedding_dim=32, sequence_length=SEQ_LEN,
+                         encoder="cnn", encoder_output_dim=64)
+    clf.compile("adam", "sparse_categorical_crossentropy", ["accuracy"])
+    clf.fit(x, y, batch_size=128, nb_epoch=5)
+
+    # socket text stream: listener + producer thread + micro-batch loop
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    host, port = srv.getsockname()
+    n_lines = 64
+    threading.Thread(target=producer, args=(host, port, n_lines),
+                     daemon=True).start()
+    conn, _ = srv.accept()
+    conn.settimeout(5.0)
+
+    buf = b""
+    done = False
+    seen = correct = batches = 0
+    while not done:
+        window_end = time.monotonic() + BATCH_WINDOW_S
+        while time.monotonic() < window_end:
+            try:
+                chunk = conn.recv(4096)
+            except socket.timeout:
+                chunk = b""
+            if not chunk:
+                done = True
+                break
+            buf += chunk
+        *complete, buf = buf.split(b"\n")
+        lines = [c.decode() for c in complete if c]
+        if not lines:
+            continue
+        xb, yb = encode(lines)
+        pred = np.argmax(np.asarray(clf.predict(xb, batch_per_thread=64)),
+                         axis=-1)
+        batches += 1
+        seen += len(lines)
+        correct += int((pred == yb).sum())
+        print(f"micro-batch {batches}: {len(lines)} lines, "
+              f"running accuracy {correct / seen:.2f}")
+    conn.close()
+    srv.close()
+
+    print(f"stream done: {seen} lines in {batches} micro-batches, "
+          f"accuracy {correct / seen:.2f}")
+    assert seen == n_lines, f"dropped lines: {seen}/{n_lines}"
+    assert correct / seen > 0.5
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,8 @@ APPS = [
     "image_augmentation_3d.py",
     "model_inference_http.py",
     "object_detection_voc.py",
+    "automl_nyc_taxi.py",
+    "tfnet_image_classification.py",
 ]
 
 

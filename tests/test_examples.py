@@ -32,6 +32,8 @@ EXAMPLES = [
     ("session_recommender.py", []),
     ("long_context_attention.py", []),
     ("tfrecord_training.py", []),
+    ("streaming_text_classification.py", []),
+    ("streaming_object_detection.py", []),
     ("inception_imagenet.py", ["--image-size", "32", "--batch", "8",
                                "--fixture-shards", "2",
                                "--fixture-per-shard", "16",
