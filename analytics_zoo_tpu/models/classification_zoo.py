@@ -49,10 +49,11 @@ def classification_label_reader(dataset: str,
 
 @dataclass
 class ClassificationConfig:
-    depth: int
     input_size: int
     class_num: int
     dataset: str
+    arch: str = "resnet"        # "resnet" | "inception-v1"
+    depth: int = 0              # resnet depth; unused by other archs
     # ImageNet-style preprocess: resize shorter side, center crop,
     # per-channel mean/std (RGB, 0-255 domain)
     resize: int = 256
@@ -61,11 +62,16 @@ class ClassificationConfig:
 
 
 CLASSIFICATION_MODELS: Dict[str, ClassificationConfig] = {
-    "resnet-18-imagenet": ClassificationConfig(18, 224, 1000, "imagenet"),
-    "resnet-50-imagenet": ClassificationConfig(50, 224, 1000, "imagenet"),
+    "resnet-18-imagenet": ClassificationConfig(224, 1000, "imagenet",
+                                               depth=18),
+    "resnet-50-imagenet": ClassificationConfig(224, 1000, "imagenet",
+                                               depth=50),
     "resnet-18-cifar10": ClassificationConfig(
-        18, 32, 10, "cifar10", resize=32,
+        32, 10, "cifar10", depth=18, resize=32,
         mean_rgb=(125.3, 123.0, 113.9), std_rgb=(63.0, 62.1, 66.7)),
+    # the reference's headline ImageNet trainer (examples/inception)
+    "inception-v1-imagenet": ClassificationConfig(
+        224, 1000, "imagenet", arch="inception-v1"),
 }
 
 
@@ -140,7 +146,7 @@ def load_image_classifier(model_name: str,
     clf = ImageClassifier(
         depth=cfg.depth, class_num=cfg.class_num,
         input_shape=(cfg.input_size, cfg.input_size, 3),
-        label_map=label_map)
+        label_map=label_map, arch=cfg.arch)
     if weights_path:
         clf.model.load_weights(weights_path)
     else:

@@ -145,15 +145,22 @@ class ImageClassifier(ZooModel):
 
     def __init__(self, depth: int = 50, class_num: int = 1000,
                  input_shape: Sequence[int] = (224, 224, 3),
-                 label_map: Optional[Dict[int, str]] = None):
+                 label_map: Optional[Dict[int, str]] = None,
+                 arch: str = "resnet"):
         super().__init__()
         # json keys are strings: normalize to int here, stringify in config
         self.label_map = {int(k): v for k, v in (label_map or {}).items()}
         self._config = dict(depth=depth, class_num=class_num,
                             input_shape=list(input_shape),
                             label_map={str(k): v
-                                       for k, v in self.label_map.items()})
-        self.model = resnet(depth, class_num, input_shape)
+                                       for k, v in self.label_map.items()},
+                            arch=arch)
+        if arch == "inception-v1":
+            self.model = inception_v1(class_num, input_shape)
+        elif arch == "resnet":
+            self.model = resnet(depth, class_num, input_shape)
+        else:
+            raise ValueError(f"Unknown arch {arch!r}: resnet|inception-v1")
 
     def top_n(self, probs, top_n: int = 5) -> List[List]:
         """Per-row top-N (label, prob) via the label map — shared by

@@ -73,3 +73,35 @@ class TestConfiguredClassifier:
         p1 = np.asarray(clf1.classifier.predict(x, batch_per_thread=1))
         p2 = np.asarray(clf2.classifier.predict(x, batch_per_thread=1))
         np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+class TestInceptionZooEntry:
+    def test_inception_config_loads_and_roundtrips(self, tmp_path):
+        import numpy as np
+        from analytics_zoo_tpu.models.classification_zoo import (
+            CLASSIFICATION_MODELS, )
+        from analytics_zoo_tpu.models.image import ImageClassifier
+        assert "inception-v1-imagenet" in CLASSIFICATION_MODELS
+        cfg = CLASSIFICATION_MODELS["inception-v1-imagenet"]
+        assert cfg.arch == "inception-v1"
+        # small instance of the same arch path + config round trip
+        import jax
+        clf = ImageClassifier(class_num=3, input_shape=(32, 32, 3),
+                              label_map={0: "a", 1: "b", 2: "c"},
+                              arch="inception-v1")
+        clf.model.ensure_built(np.zeros((1, 32, 32, 3), np.float32),
+                               jax.random.PRNGKey(0))
+        p = str(tmp_path / "m")
+        clf.save_model(p)
+        back = ImageClassifier.load_model(p)
+        assert back._config["arch"] == "inception-v1"
+        x = np.random.rand(2, 32, 32, 3).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(back.predict(x)),
+                                   np.asarray(clf.predict(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unknown_arch_raises(self):
+        import pytest as _pytest
+        from analytics_zoo_tpu.models.image import ImageClassifier
+        with _pytest.raises(ValueError, match="arch"):
+            ImageClassifier(arch="vgg")
