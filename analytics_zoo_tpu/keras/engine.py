@@ -185,6 +185,10 @@ class KerasNet:
         registries; `"accuracy"` dispatches on the loss string."""
         from analytics_zoo_tpu.ops import metrics as zmetrics
         from analytics_zoo_tpu.ops import objectives, optimizers
+        # remembered so features that re-derive per-parameter update rules
+        # (lazy embeddings) can check hyperparameter compatibility
+        self._optimizer_spec = optimizer if isinstance(optimizer, str) \
+            else None
         loss_str = loss if isinstance(loss, str) else None
         if isinstance(loss, (list, tuple)):
             # Keras multi-output contract: one loss per output, summed

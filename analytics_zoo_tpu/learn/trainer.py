@@ -286,29 +286,32 @@ def _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
 def build_train_step(apply_fn: Callable, loss_fn: Callable,
                      optimizer: optax.GradientTransformation,
                      apply_and_state_fn: Optional[Callable] = None,
-                     mixed_precision: bool = False
-                     ) -> Callable:
+                     mixed_precision: bool = False,
+                     lazy_specs=None) -> Callable:
     """One iteration as a pure function. jit + sharded inputs → GSPMD emits
     the gradient all-reduce; donation reuses parameter buffers in HBM.
     Stateful layers (BatchNorm moving stats) return updates through the aux
     channel and are merged outside the gradient path.
     mixed_precision=True keeps f32 master params and runs the fwd/bwd
     matmuls in bf16 (MXU-native)."""
-    one_step = _make_one_step(apply_fn, loss_fn, optimizer,
-                              apply_and_state_fn, mixed_precision)
+    one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
+                              apply_and_state_fn, mixed_precision,
+                              lazy_specs)
     return jax.jit(one_step, donate_argnums=(0, 1))
 
 
 def build_train_run(apply_fn: Callable, loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
                     apply_and_state_fn: Optional[Callable] = None,
-                    mixed_precision: bool = False) -> Callable:
+                    mixed_precision: bool = False,
+                    lazy_specs=None) -> Callable:
     """Multi-step variant: one jit'd program `lax.scan`s over a
     (k, batch, ...) stack of batches, so k steps cost ONE dispatch and ONE
     loss readback. This is the framework's hot path — the analogue of the
     reference engine owning its hot loop (`Topology.scala:1160-1337`)."""
-    one_step = _make_one_step(apply_fn, loss_fn, optimizer,
-                              apply_and_state_fn, mixed_precision)
+    one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
+                              apply_and_state_fn, mixed_precision,
+                              lazy_specs)
 
     def train_run(params, opt_state, xs, ys, rng):
         def body(carry, batch):
@@ -324,6 +327,16 @@ def build_train_run(apply_fn: Callable, loss_fn: Callable,
         return params, opt_state, rng, losses
 
     return jax.jit(train_run, donate_argnums=(0, 1))
+
+
+def _pick_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
+                   mixed_precision, lazy_specs):
+    if lazy_specs:
+        from analytics_zoo_tpu.learn.lazy_embedding import make_lazy_one_step
+        return make_lazy_one_step(apply_fn, loss_fn, optimizer, lazy_specs,
+                                  apply_and_state_fn, mixed_precision)
+    return _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
+                          mixed_precision)
 
 
 def build_eval_step(apply_fn: Callable, metrics: Sequence) -> Callable:
@@ -343,7 +356,8 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               end_trigger=None, seed: int = 0,
               batch_iter_factory: Optional[Callable] = None,
               steps_per_run: int = 1, mixed_precision: bool = False,
-              prefetch: bool = True) -> Dict[str, List[float]]:
+              prefetch: bool = True,
+              lazy_embeddings: bool = False) -> Dict[str, List[float]]:
     """`KerasNet.fit` backend. Returns a Keras-style history dict.
     `batch_iter_factory(epoch) -> iterator of (xb, yb, real)` overrides the
     default in-memory batching (lazy/disk-tier datasets).
@@ -436,13 +450,23 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         raise RuntimeError("Model must be compiled before fit "
                            "(`Topology.scala:139` contract)")
     params = _put_replicated(model.params, mesh)
-    opt_state = _put_replicated(optimizer.init(params), mesh)
+    lazy_specs = None
+    if lazy_embeddings:
+        from analytics_zoo_tpu.learn.lazy_embedding import resolve_specs
+        lazy_specs = resolve_specs(model)
+    if lazy_specs:
+        from analytics_zoo_tpu.learn.lazy_embedding import init_state
+        opt_state = _put_replicated(
+            init_state(params, lazy_specs, optimizer), mesh)
+    else:
+        opt_state = _put_replicated(optimizer.init(params), mesh)
 
     # Cache the jitted step on the model: repeated fit calls (warm restarts,
     # per-round loops) must hit the compile cache, not rebuild a fresh
     # closure every call.
     multi = steps_per_run > 1
-    cache_key = (id(optimizer), id(model.loss), multi, mixed_precision)
+    cache_key = (id(optimizer), id(model.loss), multi, mixed_precision,
+                 lazy_embeddings)
     cached = getattr(model, "_train_cache", None)
     if cached is not None and cached[0] == cache_key:
         train_step = cached[1]
@@ -451,7 +475,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         train_step = builder(
             model.apply, model.loss, optimizer,
             apply_and_state_fn=getattr(model, "apply_and_state", None),
-            mixed_precision=mixed_precision)
+            mixed_precision=mixed_precision, lazy_specs=lazy_specs)
         model._train_cache = (cache_key, train_step)
 
     ckpt_mgr = None

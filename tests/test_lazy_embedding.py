@@ -1,0 +1,166 @@
+"""Lazy row-sparse embedding updates (`learn/lazy_embedding.py`).
+
+Numeric spec: when a batch touches EVERY row, SparseAdam == dense Adam
+(the only semantic difference is skipping untouched-row decay), so the
+lazy path must match the dense path exactly in that regime; and under
+partial batches, untouched rows must be bit-identical untouched.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from analytics_zoo_tpu.learn.lazy_embedding import (LazyEmbeddingSpec,
+                                                    _dedup, init_state,
+                                                    make_lazy_one_step,
+                                                    resolve_specs)
+from analytics_zoo_tpu.learn.trainer import _make_one_step
+
+
+def _setup(vocab=8, dim=4, dense_units=3, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    params = {
+        "emb": {"embeddings": jax.random.normal(k1, (vocab, dim))},
+        "head": {"w": jax.random.normal(k2, (dim, dense_units)) * 0.3,
+                 "b": jnp.zeros((dense_units,))},
+    }
+
+    def apply_fn(p, xb, training=False, rng=None):
+        rows = jnp.take(p["emb"]["embeddings"],
+                        jnp.asarray(xb[:, 0], jnp.int32), axis=0)
+        return rows @ p["head"]["w"] + p["head"]["b"]
+
+    def loss_fn(yb, pred):
+        return jnp.mean((pred - yb) ** 2)
+
+    specs = [LazyEmbeddingSpec(
+        ("emb", "embeddings"),
+        lambda xb: jnp.asarray(xb[:, 0], jnp.int32), lr=1e-3)]
+    return params, apply_fn, loss_fn, specs
+
+
+class TestRowAdamSemantics:
+    def test_all_rows_touched_matches_dense_adam(self):
+        params, apply_fn, loss_fn, specs = _setup()
+        opt = optax.adam(1e-3)
+        dense = _make_one_step(apply_fn, loss_fn, opt, None, False)
+        lazy = make_lazy_one_step(apply_fn, loss_fn, opt, specs)
+
+        rs = np.random.RandomState(0)
+        p_d, p_l = params, params
+        s_d = opt.init(params)
+        s_l = init_state(params, specs, opt)
+        rng = jax.random.PRNGKey(1)
+        for step in range(5):
+            ids = np.concatenate([np.arange(8), rs.randint(0, 8, 8)])
+            xb = jnp.asarray(ids[:, None], jnp.float32)
+            yb = jnp.asarray(rs.randn(16, 3), jnp.float32)
+            p_d, s_d, l_d = dense(p_d, s_d, xb, yb, rng)
+            p_l, s_l, l_l = lazy(p_l, s_l, xb, yb, rng)
+        for path in (("emb", "embeddings"), ("head", "w"), ("head", "b")):
+            a, b = p_d, p_l
+            for k in path:
+                a, b = a[k], b[k]
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=str(path))
+
+    def test_untouched_rows_are_untouched_bytes(self):
+        params, apply_fn, loss_fn, specs = _setup()
+        opt = optax.adam(1e-2)
+        specs = [s._replace(lr=1e-2) for s in specs]
+        lazy = make_lazy_one_step(apply_fn, loss_fn, opt, specs)
+        s = init_state(params, specs, opt)
+        before = np.asarray(params["emb"]["embeddings"]).copy()
+        xb = jnp.asarray([[1.0], [3.0], [3.0]])     # touch rows 1 and 3
+        yb = jnp.ones((3, 3))
+        p2, s2, _ = lazy(params, s, xb, yb, jax.random.PRNGKey(0))
+        after = np.asarray(p2["emb"]["embeddings"])
+        touched = {1, 3}
+        for r in range(8):
+            if r in touched:
+                assert not np.allclose(after[r], before[r]), r
+            else:
+                np.testing.assert_array_equal(after[r], before[r])
+        # optimizer state likewise only moves for touched rows
+        mu = np.asarray(s2["tables"]["emb/embeddings"][0])
+        assert set(np.nonzero(np.abs(mu).sum(-1))[0]) == touched
+
+    def test_dedup_redirects_duplicates_oob(self):
+        safe, scat = _dedup(jnp.asarray([3, 1, 3, 3, 7]), 8)
+        assert sorted(np.asarray(scat).tolist()) == [1, 3, 7, 8, 8]
+        assert np.asarray(safe).max() < 8
+
+    def test_resolve_specs_raises_without_declaration(self):
+        class M:
+            pass
+        with pytest.raises(ValueError, match="lazy_embedding_specs"):
+            resolve_specs(M())
+
+
+class TestThroughEstimator:
+    def test_ncf_lazy_fit_trains_and_matches_dense_when_all_touched(self):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+        def make():
+            return NeuralCF(user_count=7, item_count=5, class_num=2,
+                            mf_embed=4, user_embed=4, item_embed=4,
+                            hidden_layers=(8,))
+
+        rs = np.random.RandomState(0)
+        n = 256
+        x = np.stack([rs.randint(1, 8, n), rs.randint(1, 6, n)],
+                     axis=1).astype(np.int32)
+        # guarantee every row (incl. 0-padding rows) appears per batch
+        x[:8, 0] = np.arange(8) % 8
+        x[:6, 1] = np.arange(6) % 6
+        y = ((x[:, 0] + x[:, 1]) % 2).astype(np.int32)
+
+        ncf_l = make()
+        est = Estimator.from_keras(ncf_l.model, optimizer="adam",
+                                   loss="sparse_categorical_crossentropy")
+        h = est.fit((x, y), epochs=8, batch_size=n, lazy_embeddings=True)
+        assert h["loss"][-1] < h["loss"][0]
+
+        ncf_d = make()
+        est_d = Estimator.from_keras(ncf_d.model, optimizer="adam",
+                                     loss="sparse_categorical_crossentropy")
+        hd = est_d.fit((x, y), epochs=8, batch_size=n)
+        # same seed, same data, every row touched every step -> identical
+        np.testing.assert_allclose(h["loss"], hd["loss"], rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ncf_l.model.predict(x[:16])),
+            np.asarray(ncf_d.model.predict(x[:16])), rtol=1e-4, atol=1e-5)
+
+    def test_lazy_with_steps_per_run_scan(self):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        from analytics_zoo_tpu.models.recommendation import NeuralCF
+        ncf = NeuralCF(user_count=50, item_count=30, class_num=2,
+                       mf_embed=4, user_embed=4, item_embed=4,
+                       hidden_layers=(8,))
+        rs = np.random.RandomState(1)
+        n = 512
+        x = np.stack([rs.randint(1, 51, n), rs.randint(1, 31, n)],
+                     axis=1).astype(np.int32)
+        y = rs.randint(0, 2, n).astype(np.int32)
+        est = Estimator.from_keras(ncf.model, optimizer="adam",
+                                   loss="sparse_categorical_crossentropy")
+        h = est.fit((x, y), epochs=4, batch_size=64, steps_per_run=4,
+                    lazy_embeddings=True)
+        assert np.isfinite(h["loss"]).all()
+
+    def test_non_adam_compile_raises(self):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        from analytics_zoo_tpu.models.recommendation import NeuralCF
+        ncf = NeuralCF(user_count=7, item_count=5, class_num=2,
+                       mf_embed=4, user_embed=4, item_embed=4,
+                       hidden_layers=(8,))
+        est = Estimator.from_keras(ncf.model, optimizer="sgd",
+                                   loss="sparse_categorical_crossentropy")
+        x = np.zeros((8, 2), np.int32)
+        y = np.zeros((8,), np.int32)
+        with pytest.raises(ValueError, match="compiled"):
+            est.fit((x, y), epochs=1, batch_size=8, lazy_embeddings=True)
