@@ -412,3 +412,33 @@ class TestTensorBoard:
         model.fit(x, y, batch_size=8, nb_epoch=2)
         back = tb.read_scalars(str(tmp_path / "app" / "train"))
         assert "Loss" in back and "Throughput" in back
+
+
+class TestConvDtypeGuard:
+    def test_float_input_follows_kernel_dtype(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        m = Sequential([L.Convolution2D(4, 3, 3, input_shape=(8, 8, 3),
+                                        border_mode="same")])
+        m.ensure_built(np.zeros((1, 8, 8, 3), np.float32))
+        # f32 input with bf16 kernel: silently follows the kernel
+        p16 = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), m.params)
+        out = m.apply(p16, jnp.zeros((2, 8, 8, 3), jnp.float32))
+        assert out.dtype == jnp.bfloat16
+
+    def test_integer_input_still_errors(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import pytest as _pytest
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        m = Sequential([L.Convolution2D(4, 3, 3, input_shape=(8, 8, 3),
+                                        border_mode="same")])
+        m.ensure_built(np.zeros((1, 8, 8, 3), np.float32))
+        with _pytest.raises(TypeError):
+            # raw uint8 images into a conv: loud failure, not silent
+            # training on unscaled 0-255 values
+            m.apply(m.params, jnp.zeros((2, 8, 8, 3), jnp.uint8))
