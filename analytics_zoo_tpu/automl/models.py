@@ -97,6 +97,8 @@ class CausalConv1D(Layer):
                 "bias": jnp.zeros((self.filters,), jnp.float32)}
 
     def call(self, params, x, *, training=False, rng=None):
+        from analytics_zoo_tpu.keras.layers import _match_param_dtype
+        x = _match_param_dtype(x, params["kernel"])
         pad = (self.k - 1) * self.d
         y = jax.lax.conv_general_dilated(
             x, params["kernel"], window_strides=(1,),
@@ -202,6 +204,8 @@ class _MTNetCore(Layer):
 
     def _encode(self, params, wins):
         """wins: [B, n, T, F] -> [B, n, H] via causal conv + max pool."""
+        from analytics_zoo_tpu.keras.layers import _match_param_dtype
+        wins = _match_param_dtype(wins, params["conv"])
         B, n, T, F = wins.shape
         x = wins.reshape(B * n, T, F)
         y = jax.lax.conv_general_dilated(

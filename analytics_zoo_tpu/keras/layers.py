@@ -81,6 +81,15 @@ def get_activation(name_or_fn) -> Callable:
     return _ACTIVATIONS[key]
 
 
+def _match_param_dtype(x, ref):
+    """Float operands follow the parameter dtype so mixed-precision (bf16)
+    params see matching MXU operands. Integer inputs pass through untouched
+    — casting float-encoded ids to bf16 silently corrupts values > 256."""
+    if jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != ref.dtype:
+        return x.astype(ref.dtype)
+    return x
+
+
 # ---------------------------------------------------------------------------
 # Core layers
 # ---------------------------------------------------------------------------
@@ -105,6 +114,7 @@ class Dense(Layer):
         return p
 
     def call(self, params, x, *, training=False, rng=None):
+        x = _match_param_dtype(x, params["kernel"])
         y = x @ params["kernel"]
         if self.use_bias:
             y = y + params["bias"]
@@ -515,8 +525,7 @@ class _ConvND(Layer):
         # on-device normalization Lambda produces f32). Integer inputs
         # still error loudly — silently casting raw uint8 images would
         # train on unscaled 0-255 values.
-        if jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(params["kernel"].dtype)
+        x = _match_param_dtype(x, params["kernel"])
         y = jax.lax.conv_general_dilated(
             x, params["kernel"], window_strides=self.strides,
             padding=self.padding, dimension_numbers=self.dn,
@@ -762,6 +771,7 @@ class _Recurrent(Layer):
     def call(self, params, x, *, training=False, rng=None):
         if self.go_backwards:
             x = jnp.flip(x, axis=1)
+        x = _match_param_dtype(x, params["kernel"])
         batch = x.shape[0]
         xs = jnp.swapaxes(x, 0, 1)  # [T, B, F] for scan
 
@@ -770,6 +780,10 @@ class _Recurrent(Layer):
             return carry, out
 
         carry0 = self.initial_state(batch)
+        # carry must match the step output dtype for scan (bf16 params →
+        # bf16 hidden state)
+        carry0 = jax.tree_util.tree_map(
+            lambda a: a.astype(params["kernel"].dtype), carry0)
         _, outs = jax.lax.scan(body, carry0, xs)
         if self.return_sequences:
             seq = jnp.swapaxes(outs, 0, 1)

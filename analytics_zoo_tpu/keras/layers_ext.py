@@ -34,7 +34,7 @@ import numpy as np
 from analytics_zoo_tpu.keras.engine import Layer
 from analytics_zoo_tpu.keras.layers import (
     _ConvND, _GlobalPool, _PoolND, _Recurrent, _from_channels_last,
-    _to_channels_last, get_activation, get_init)
+    _match_param_dtype, _to_channels_last, get_activation, get_init)
 
 __all__ = [
     "LeakyReLU", "ELU", "PReLU", "SReLU", "ThresholdedReLU",
@@ -229,6 +229,7 @@ class Highway(Layer):
         return p
 
     def call(self, params, x, *, training=False, rng=None):
+        x = _match_param_dtype(x, params["kernel"])
         h = x @ params["kernel"]
         t = x @ params["transform_kernel"]
         if self.use_bias:
@@ -260,6 +261,7 @@ class MaxoutDense(Layer):
         return p
 
     def call(self, params, x, *, training=False, rng=None):
+        x = _match_param_dtype(x, params["kernel"])
         y = jnp.einsum("bd,fdo->bfo", x, params["kernel"])
         if self.use_bias:
             y = y + params["bias"]
@@ -309,6 +311,7 @@ class SeparableConvolution2D(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         x = _to_channels_last(x, self.dim_ordering, 2)
+        x = _match_param_dtype(x, params["depthwise"])
         in_ch = x.shape[-1]
         y = jax.lax.conv_general_dilated(
             x, params["depthwise"], window_strides=self.strides,
@@ -372,6 +375,7 @@ class Deconvolution2D(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         x = _to_channels_last(x, self.dim_ordering, 2)
+        x = _match_param_dtype(x, params["kernel"])
         # Scatter (gradient-of-conv) semantics — matches Keras/BigDL. jax's
         # conv_transpose correlates, so flip the spatial dims.
         y = jax.lax.conv_transpose(
@@ -413,6 +417,7 @@ class AtrousConvolution2D(_ConvND):
 
     def call(self, params, x, *, training=False, rng=None):
         x = _to_channels_last(x, self.dim_ordering, self.spatial_rank)
+        x = _match_param_dtype(x, params["kernel"])
         y = jax.lax.conv_general_dilated(
             x, params["kernel"], window_strides=self.strides,
             padding=self.padding, rhs_dilation=self.atrous_rate,
@@ -484,6 +489,7 @@ class LocallyConnected1D(Layer):
         return p
 
     def call(self, params, x, *, training=False, rng=None):
+        x = _match_param_dtype(x, params["kernel"])
         # [B, L, C] → patches [B, out_len, k*C]
         k = self.kernel_size[0]
         s = self.strides[0]
@@ -535,6 +541,7 @@ class LocallyConnected2D(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         x = _to_channels_last(x, self.dim_ordering, 2)
+        x = _match_param_dtype(x, params["kernel"])
         b, h, w, c = x.shape
         kh, kw = self.kernel_size
         sh, sw = self.strides

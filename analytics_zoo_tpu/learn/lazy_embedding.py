@@ -127,15 +127,16 @@ def make_lazy_one_step(apply_fn, loss_fn,
 
     def one_step(params, opt_state, xb, yb, rng):
         def compute_loss(p):
-            x_in = xb
             if mixed_precision:
                 p = _cast_tree(p, jnp.bfloat16)
-                x_in = _cast_tree(xb, jnp.bfloat16)
+                # inputs stay uncast: ids_fn reads the same xb the model
+                # sees, and bf16 cannot represent ids > 256 exactly
+                # (see trainer.py one_step for the full rationale).
             if apply_and_state_fn is not None:
-                pred, state_upd = apply_and_state_fn(p, x_in, training=True,
+                pred, state_upd = apply_and_state_fn(p, xb, training=True,
                                                      rng=rng)
             else:
-                pred, state_upd = apply_fn(p, x_in, training=True,
+                pred, state_upd = apply_fn(p, xb, training=True,
                                            rng=rng), {}
             if mixed_precision:
                 pred = jax.tree_util.tree_map(

@@ -249,17 +249,18 @@ def _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
                    mixed_precision):
     def one_step(params, opt_state, xb, yb, rng):
         def compute_loss(p):
-            x_in = xb
             if mixed_precision:
                 p = _cast_tree(p, jnp.bfloat16)
-                # float inputs (images etc.) follow the params so convs/
-                # matmuls see matching bf16 operands; int ids untouched
-                x_in = _cast_tree(xb, jnp.bfloat16)
+                # inputs are NOT cast here: float-encoded integer id
+                # features (nnframes emits float32 ids) lose exactness
+                # above 256 in bf16 → silently wrong embedding rows.
+                # Matmul/conv layers cast their own float operands to the
+                # param dtype instead (keras/layers.py _match_param_dtype).
             if apply_and_state_fn is not None:
-                pred, state_upd = apply_and_state_fn(p, x_in, training=True,
+                pred, state_upd = apply_and_state_fn(p, xb, training=True,
                                                      rng=rng)
             else:
-                pred, state_upd = apply_fn(p, x_in, training=True,
+                pred, state_upd = apply_fn(p, xb, training=True,
                                            rng=rng), {}
             if mixed_precision:
                 pred = jax.tree_util.tree_map(

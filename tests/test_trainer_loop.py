@@ -93,6 +93,31 @@ class TestMixedPrecision:
         for leaf in jax.tree_util.tree_leaves(m.params):
             assert leaf.dtype == np.float32
 
+    def test_float_encoded_ids_not_corrupted(self):
+        # nnframes assembles id features as float32; under mixed precision
+        # the trainer must NOT cast them to bf16 (bf16 rounds 1000 → 1000±4
+        # → wrong embedding rows). Gradient of a gather at id 1000 must
+        # land on row 1000 exactly.
+        import jax.numpy as jnp
+        import optax
+
+        def apply_fn(params, xb, training=False, rng=None):
+            ids = xb.astype(jnp.int32)          # layer-level int cast
+            return params["table"][ids]
+
+        table = jnp.zeros((1200, 4), jnp.float32)
+        opt = optax.sgd(1.0)
+        step = trainer.build_train_step(
+            apply_fn, lambda y, p: jnp.sum(p), opt, mixed_precision=True)
+        params = {"table": table}
+        ids = np.full((8,), 1001.0, np.float32)   # bf16(1001) == 1000
+        y = np.zeros((8, 4), np.float32)
+        params, _, _ = step(params, opt.init(params), jnp.asarray(ids),
+                            jnp.asarray(y), jax.random.PRNGKey(0))
+        moved = np.flatnonzero(
+            np.abs(np.asarray(params["table"])).sum(axis=1))
+        assert moved.tolist() == [1001]
+
 
 class TestDeterminism:
     def test_seeded_fit_reproducible(self):
