@@ -188,9 +188,14 @@ class Estimator:
                 if hasattr(self.model, cache):
                     delattr(self.model, cache)
 
-        batch_iter_factory = (
-            (lambda epoch: ds.iter_train(dp, seed=seed + epoch))
-            if lazy else None)
+        # callers may supply their own per-epoch batch source (nnframes
+        # re-runs stochastic sample preprocessing each epoch this way
+        # WITHOUT restarting fit — optimizer state must survive epochs)
+        batch_iter_factory = fit_kwargs.pop("batch_iter_factory", None)
+        if batch_iter_factory is None:
+            batch_iter_factory = (
+                (lambda epoch: ds.iter_train(dp, seed=seed + epoch))
+                if lazy else None)
         if lazy and self.model.params is None \
                 and hasattr(ds, "first_sample"):
             # cheap shape probe: one record, not a shuffle-buffer fill

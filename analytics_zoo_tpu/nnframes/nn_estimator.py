@@ -167,9 +167,23 @@ class NNEstimator:
             est.fit(assemble(), epochs=self.max_epoch,
                     batch_size=batch, validation_data=val)
         else:
-            for _ in range(self.max_epoch):
-                est.fit(assemble(), epochs=1, batch_size=batch,
-                        validation_data=val)
+            # ONE fit over all epochs (optimizer moments/step count must
+            # survive epoch boundaries); fresh augmentation draw + fresh
+            # shuffle order per epoch via the trainer's per-epoch batch
+            # source hook.
+            from analytics_zoo_tpu.data.dataset import TPUDataset
+            from analytics_zoo_tpu.learn.trainer import iter_batches
+
+            first = TPUDataset.from_xshards(assemble(), batch_size=batch)
+
+            def epoch_batches(epoch):
+                ds = first if epoch == 0 else TPUDataset.from_xshards(
+                    assemble(), batch_size=batch)
+                return iter_batches(ds.x, ds.y, batch, shuffle=True,
+                                    seed=epoch)
+
+            est.fit(first, epochs=self.max_epoch, batch_size=batch,
+                    validation_data=val, batch_iter_factory=epoch_batches)
         return self._make_model()
 
     def _make_model(self) -> "NNModel":

@@ -285,6 +285,38 @@ class TestNNFramesXShards:
             nn_model.set_sample_preprocessing(lambda r: r) \
                 .transform(shards.collect()[0])
 
+    def test_preprocessing_fit_is_one_continuous_fit(self, monkeypatch):
+        # stochastic sample preprocessing re-draws each epoch, but the
+        # training itself must be ONE fit over all epochs: restarting fit
+        # per epoch resets Adam moments/step count and repeats the same
+        # shuffle order (round-4 advisory).
+        from analytics_zoo_tpu.data.shards import XShards
+        from analytics_zoo_tpu.learn import trainer as trainer_mod
+
+        n = 64
+        rng = np.random.RandomState(0)
+        feats = rng.randn(n, 2).astype(np.float32)
+        target = feats @ np.asarray([1.0, -2.0], np.float32)
+        df = pd.DataFrame({"features": list(feats), "target": target})
+        shards = XShards([df.iloc[:32].reset_index(drop=True),
+                          df.iloc[32:].reset_index(drop=True)])
+
+        fit_epochs = []
+        real_fit = trainer_mod.fit_keras
+
+        def spy(model, x, y=None, **kw):
+            fit_epochs.append(kw.get("epochs"))
+            return real_fit(model, x, y, **kw)
+
+        monkeypatch.setattr(trainer_mod, "fit_keras", spy)
+        model = Sequential([L.Dense(1, input_shape=(2,))])
+        est = (NNEstimator(model, "mse")
+               .set_features_col("features").set_label_col("target")
+               .set_batch_size(32).set_max_epoch(3)
+               .set_sample_preprocessing(lambda r: r * 1.0))
+        est.fit(shards)
+        assert fit_epochs == [3]
+
     def test_empty_shard_handling(self):
         from analytics_zoo_tpu.data.shards import XShards
         df, _ = self._shards(n=8, parts=1)
