@@ -11,18 +11,22 @@ import os
 # Force-override: the machine env pins JAX_PLATFORMS to the TPU plugin, and a
 # sitecustomize preimports jax — so set both the env and the live jax config
 # (backends initialize lazily, so this still takes effect).
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+# tests/tpu re-runs itself in a child pytest that needs the REAL backend;
+# the child sets ZOO_TPU_SUBPROC so this pin steps aside there.
+if os.environ.get("ZOO_TPU_SUBPROC") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8").strip()
 # Keep CPU tests deterministic and fast.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("ZOO_TPU_SUBPROC") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(scope="session")
