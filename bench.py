@@ -193,6 +193,11 @@ def main():
             out["ncf_samples_per_sec"] = r.get("value")
             out["ncf_hbm_utilization_pct"] = r.get("hbm_utilization_pct")
             out["ncf_step_ms"] = r.get("step_ms")
+            out["ncf_bound"] = r.get("bound")
+            if r.get("achieved_hbm_gbps") is not None:
+                out["ncf_achieved_hbm_gbps"] = r.get("achieved_hbm_gbps")
+                out["ncf_pct_of_achievable_bound"] = \
+                    r.get("pct_of_achievable_bound")
         else:
             out["ncf_samples_per_sec"] = None
     if not tiny and os.environ.get("BENCH_SERVING", "1") == "1":

@@ -1,0 +1,139 @@
+"""Per-op device-time attribution for the NCF training step (VERDICT r4 #1).
+
+Runs a warmed Estimator.fit under jax.profiler, parses the xplane proto
+(docs/DeveloperGuide/profiling.md recipe), and prints per-op device time
+grouped by category plus the wall/device split.
+
+    python scripts/profile_ncf.py [--lazy] [--batch 8192] [--spr 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if ("JAX_DEFAULT_PRNG_IMPL" not in os.environ
+        and jax.default_backend() == "tpu"):
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+import numpy as np
+
+
+def categorize(name: str) -> str:
+    n = name.lower()
+    if "rng-bit-generator" in n or "rng_bit" in n:
+        return "rng"
+    if "multiply_add" in n or "adam" in n:
+        return "adam-fusion"
+    if "scatter" in n:
+        return "scatter"
+    if "gather" in n:
+        return "gather"
+    if "convolution" in n or "dot" in n:
+        return "matmul"
+    if "copy" in n or "slice" in n or "transpose" in n or "reshape" in n:
+        return "data-movement"
+    if "tpu_custom_call" in n:
+        return "pallas"
+    if "fusion" in n:
+        return "other-fusion"
+    if "infeed" in n or "outfeed" in n:
+        return "infeed/outfeed"
+    return "other"
+
+
+def parse_xplane(trace_dir: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    paths = glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb"))
+    assert paths, f"no xplane under {trace_dir}"
+    xs = xplane_pb2.XSpace()
+    with open(paths[0], "rb") as f:
+        xs.ParseFromString(f.read())
+    per_op = defaultdict(float)
+    for plane in xs.planes:
+        if "/device:TPU:0" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for e in line.events:
+                name = plane.event_metadata[e.metadata_id].name
+                if name.startswith("%while"):
+                    continue  # outer scan: contains everything
+                per_op[name] += e.duration_ps / 1e12
+    return dict(per_op)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lazy", action="store_true")
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--spr", type=int, default=64)
+    ap.add_argument("--n", type=int, default=1 << 20)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    users, items = 138_000, 27_000
+    init_orca_context(cluster_mode="local")
+    ncf = NeuralCF(user_count=users, item_count=items, class_num=2,
+                   mf_embed=64, user_embed=64, item_embed=64,
+                   hidden_layers=(128, 64, 32))
+    est = Estimator.from_keras(ncf.model, optimizer="adam",
+                               loss="sparse_categorical_crossentropy")
+    rs = np.random.RandomState(0)
+    n = args.n
+    x = np.stack([rs.randint(1, users, n), rs.randint(1, items, n)],
+                 axis=1).astype(np.int32)
+    y = rs.randint(0, 2, n).astype(np.int32)
+    fit_kw = dict(epochs=1, batch_size=args.batch, steps_per_run=args.spr,
+                  lazy_embeddings=args.lazy)
+
+    est.fit((x, y), **fit_kw)          # warmup
+    steps = n // args.batch
+
+    trace_dir = tempfile.mkdtemp(prefix="ncf_prof_")
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    est.fit((x, y), **fit_kw)
+    wall = time.perf_counter() - t0
+    jax.profiler.stop_trace()
+
+    per_op = parse_xplane(trace_dir)
+    total_dev = sum(per_op.values())
+    cats = defaultdict(float)
+    for name, s in per_op.items():
+        cats[categorize(name)] += s
+
+    print(f"\nwall {wall*1e3:.1f} ms  device {total_dev*1e3:.1f} ms  "
+          f"host/transfer {max(0.0, wall-total_dev)*1e3:.1f} ms  "
+          f"steps {steps}  wall/step {wall/steps*1e3:.3f} ms  "
+          f"device/step {total_dev/steps*1e3:.3f} ms")
+    print("\nby category (device ms/step):")
+    for c, s in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"  {c:16s} {s/steps*1e3:8.3f} ms  "
+              f"({100*s/total_dev:5.1f}% of device)")
+    print("\ntop 20 ops (device ms/step):")
+    for name, s in sorted(per_op.items(), key=lambda kv: -kv[1])[:20]:
+        print(f"  {s/steps*1e3:8.3f} ms  {name[:110]}")
+    print("\ntop 12 data-movement ops (device ms/step):")
+    dm = [(n, s) for n, s in per_op.items()
+          if categorize(n) == "data-movement"]
+    for name, s in sorted(dm, key=lambda kv: -kv[1])[:12]:
+        print(f"  {s/steps*1e3:8.3f} ms  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

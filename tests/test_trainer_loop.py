@@ -119,6 +119,35 @@ class TestMixedPrecision:
         assert moved.tolist() == [1001]
 
 
+class TestDeviceCache:
+    def test_matches_streamed_path_numerics(self):
+        # shuffle off → identical batch order → identical losses between
+        # the device-resident one-dispatch epoch and the streamed path
+        x, y = _toy_data(128)
+        ma, mb = _toy_model(), _toy_model()
+        ha = ma.fit(x, y, batch_size=32, nb_epoch=3, shuffle=False, seed=3,
+                    device_cache=False)
+        hb = mb.fit(x, y, batch_size=32, nb_epoch=3, shuffle=False, seed=3,
+                    device_cache=True)
+        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=1e-5)
+
+    def test_data_transferred_once_across_fits(self):
+        x, y = _toy_data(128)
+        m = _toy_model()
+        m.fit(x, y, batch_size=32, nb_epoch=1, device_cache=True)
+        first = m._device_data
+        h = m.fit(x, y, batch_size=32, nb_epoch=2, device_cache=True)
+        assert m._device_data is first          # cache hit, no re-put
+        assert len(h["loss"]) == 2
+        assert np.isfinite(h["loss"]).all()
+
+    def test_shuffled_device_epochs_converge(self):
+        x, y = _toy_data()
+        m = _toy_model()
+        h = m.fit(x, y, batch_size=32, nb_epoch=20, device_cache=True)
+        assert h["loss"][-1] < h["loss"][0] * 0.3
+
+
 class TestDeterminism:
     def test_seeded_fit_reproducible(self):
         # SURVEY §5: end-to-end seeded reproducibility of a 2-epoch run
