@@ -114,8 +114,12 @@ class Dense(Layer):
         return p
 
     def call(self, params, x, *, training=False, rng=None):
-        x = _match_param_dtype(x, params["kernel"])
-        y = x @ params["kernel"]
+        if "kernel_q" in params:   # int8 serving path (serving/quantization)
+            from analytics_zoo_tpu.serving.quantization import int8_matmul
+            y = int8_matmul(x, params["kernel_q"], params["kernel_scale"])
+        else:
+            x = _match_param_dtype(x, params["kernel"])
+            y = x @ params["kernel"]
         if self.use_bias:
             y = y + params["bias"]
         return self.activation(y)
@@ -359,6 +363,11 @@ class Embedding(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         ids = jnp.asarray(x, jnp.int32)
+        if "embeddings_q" in params:   # int8 serving path
+            from analytics_zoo_tpu.serving.quantization import \
+                dequantize_rows
+            return dequantize_rows(params["embeddings_q"],
+                                   params["embeddings_scale"], ids)
         table = params["embeddings"]
         if not self.trainable:
             table = jax.lax.stop_gradient(table)
@@ -520,16 +529,23 @@ class _ConvND(Layer):
 
     def call(self, params, x, *, training=False, rng=None):
         x = _to_channels_last(x, self.dim_ordering, self.spatial_rank)
-        # conv requires matching operand dtypes; float inputs follow the
-        # kernel (under mixed precision the params are bf16 while e.g. an
-        # on-device normalization Lambda produces f32). Integer inputs
-        # still error loudly — silently casting raw uint8 images would
-        # train on unscaled 0-255 values.
-        x = _match_param_dtype(x, params["kernel"])
-        y = jax.lax.conv_general_dilated(
-            x, params["kernel"], window_strides=self.strides,
-            padding=self.padding, dimension_numbers=self.dn,
-            feature_group_count=self.groups)
+        if "kernel_q" in params:   # int8 serving path (serving/quantization)
+            from analytics_zoo_tpu.serving.quantization import int8_conv
+            y = int8_conv(x, params["kernel_q"], params["kernel_scale"],
+                          window_strides=self.strides,
+                          padding=self.padding, dimension_numbers=self.dn,
+                          feature_group_count=self.groups)
+        else:
+            # conv requires matching operand dtypes; float inputs follow
+            # the kernel (under mixed precision the params are bf16 while
+            # e.g. an on-device normalization Lambda produces f32).
+            # Integer inputs still error loudly — silently casting raw
+            # uint8 images would train on unscaled 0-255 values.
+            x = _match_param_dtype(x, params["kernel"])
+            y = jax.lax.conv_general_dilated(
+                x, params["kernel"], window_strides=self.strides,
+                padding=self.padding, dimension_numbers=self.dn,
+                feature_group_count=self.groups)
         if self.use_bias:
             y = y + params["bias"]
         y = self.activation(y)

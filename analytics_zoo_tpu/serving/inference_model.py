@@ -53,8 +53,15 @@ class InferenceModel:
         self.timer = Timer("predict")
 
     # -- loaders (`doLoad*`, InferenceModel.scala:76-318) ------------------
-    def load_keras(self, model, params=None) -> "InferenceModel":
-        """A native Keras-style model (Sequential/Model/ZooModel)."""
+    def load_keras(self, model, params=None,
+                   quantize: Optional[str] = None) -> "InferenceModel":
+        """A native Keras-style model (Sequential/Model/ZooModel).
+
+        `quantize="int8"` rewrites every Dense/conv/Embedding weight to
+        symmetric per-channel int8 and serves through the layers' int8
+        MXU path (`serving/quantization.py`) — the TPU counterpart of the
+        reference's OpenVINO int8 engine
+        (`OpenVinoInferenceSupportive.scala:34-57`)."""
         from analytics_zoo_tpu.models.common import ZooModel
         if isinstance(model, ZooModel):
             model = model.model
@@ -62,17 +69,28 @@ class InferenceModel:
             model.params = params
         if model.params is None:
             raise ValueError("Model has no parameters; fit or load first")
+        params = model.params
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(
+                    f"Unsupported quantize={quantize!r}; only 'int8'")
+            from analytics_zoo_tpu.serving.quantization import \
+                quantize_model_params
+            params = quantize_model_params(model, jax.device_get(params))
         return self.load_fn(lambda p, x: model.apply(p, x, training=False),
-                            model.params)
+                            params)
 
-    def load_zoo_model(self, cls, path: str) -> "InferenceModel":
+    def load_zoo_model(self, cls, path: str,
+                       quantize: Optional[str] = None) -> "InferenceModel":
         """`doLoadBigDL` analogue: a saved ZooModel directory."""
-        return self.load_keras(cls.load_model(path))
+        return self.load_keras(cls.load_model(path), quantize=quantize)
 
     def load_fn(self, fn: Callable, params) -> "InferenceModel":
         """Pure `fn(params, x)` forward."""
         self._fn = fn
-        self._params = params
+        # weights transfer ONCE at load: a host pytree here would be
+        # re-uploaded on every predict (jit does not cache arg transfers)
+        self._params = jax.device_put(params)
         # one jit wrapper; jax caches an executable per input shape (= per
         # bucket), so no per-bucket bookkeeping is needed
         self._jit = jax.jit(fn)

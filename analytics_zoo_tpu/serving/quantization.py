@@ -1,0 +1,126 @@
+"""Int8 post-training quantization for inference.
+
+Parity target: the reference's int8 inference engine
+(`zoo/src/main/scala/com/intel/analytics/zoo/pipeline/inference/
+OpenVinoInferenceSupportive.scala:34-57` — `loadOpenVinoIRInt8*`, VNNI;
+validated by `zoo/src/test/.../inference/OpenVINOInt8Suite.scala:301`).
+TPU-native redesign: instead of a separate IR + runtime, the SAME keras
+param pytree is rewritten in place — weight leaves become symmetric
+per-output-channel int8 (`kernel_q` + f32 `kernel_scale`) and the layer's
+own `call` dispatches to an int8 MXU path (`lax.dot_general` /
+`conv_general_dilated` with int8 operands and `preferred_element_type=
+int32`), with dynamic per-tensor activation quantization. Embedding
+tables quantize per row (gather → dequantize only the touched rows).
+
+Entry points:
+- `quantize_model_params(model, params)` → quantized pytree for any
+  Sequential/Model/ZooModel built from the stock layer library.
+- `InferenceModel.load_keras(..., quantize="int8")` (serving façade).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# int8 compute paths (used by the layers' quantized dispatch)
+# ---------------------------------------------------------------------------
+def quantize_activations(x):
+    """Dynamic symmetric per-tensor activation quantization: scalar scale
+    from the batch's abs-max (data-dependent scalars are jit-safe)."""
+    sx = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, _EPS)
+    x_q = jnp.clip(jnp.round(x / sx), -127, 127).astype(jnp.int8)
+    return x_q, sx
+
+
+def int8_matmul(x, w_q, w_scale):
+    """y ≈ x @ (w_q * w_scale): int8×int8→int32 on the MXU, dequantized
+    with the product of the activation and per-channel weight scales."""
+    x_q, sx = quantize_activations(x)
+    y = jax.lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * (sx * w_scale)
+
+
+def int8_conv(x, w_q, w_scale, **conv_kwargs):
+    """Weight-only int8 for convolutions: int8 weights dequantize to bf16
+    at use (4× fewer weight bytes from HBM) and the conv itself runs on
+    the bf16 MXU path. Measured on v5e: XLA's true int8×int8 conv
+    lowering runs ~1.6× SLOWER than bf16 (no VNNI-style win to copy —
+    `OpenVinoInferenceSupportive.scala:34` is an avx512-vnni play), while
+    weight-only keeps full conv throughput; activations stay unquantized
+    so conv accuracy is better than the Dense path's."""
+    w = w_q.astype(jnp.bfloat16) * w_scale.astype(jnp.bfloat16)
+    # same invariant as the f32 conv path (_match_param_dtype): float
+    # inputs follow the weights; integer inputs error loudly rather than
+    # silently serving on unscaled 0-255 pixel values
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.bfloat16)
+    y = jax.lax.conv_general_dilated(x, w, **conv_kwargs)
+    return y.astype(jnp.float32)
+
+
+def dequantize_rows(table_q, scale, ids):
+    """Embedding path: gather int8 rows, dequantize only what was read."""
+    return table_q[ids].astype(jnp.float32) * scale[ids][..., None]
+
+
+# ---------------------------------------------------------------------------
+# param-tree rewrite
+# ---------------------------------------------------------------------------
+def _quantize_tensor(w, reduce_axes) -> Dict[str, Any]:
+    """Symmetric int8 over `reduce_axes`; scale keeps the other axes."""
+    w = np.asarray(w, np.float32)
+    amax = np.maximum(np.abs(w).max(axis=reduce_axes, keepdims=True), _EPS)
+    scale = (amax / 127.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, np.squeeze(scale, axis=reduce_axes)
+
+
+def _iter_layers(model):
+    layers = getattr(model, "layers", None)
+    if layers is None:
+        layers = getattr(model, "_layers", None)
+    return layers or []
+
+
+def quantize_model_params(model, params) -> Dict[str, Any]:
+    """Rewrite a built model's param pytree with int8 weights for every
+    Dense / conv-family / Embedding layer (recursing into nested
+    Sequential/Model containers). Layers with no int8 path (BatchNorm,
+    recurrent cells, LayerNorm, ...) keep f32 — they are bandwidth-thin
+    next to the matmuls."""
+    from analytics_zoo_tpu.keras.engine import Model, Sequential
+    from analytics_zoo_tpu.keras.layers import Dense, Embedding, _ConvND
+
+    out = dict(params)
+    for layer in _iter_layers(model):
+        sub = out.get(layer.name)
+        if sub is None:
+            continue
+        if isinstance(layer, (Sequential, Model)):
+            out[layer.name] = quantize_model_params(layer, sub)
+        elif isinstance(layer, Dense):
+            q, scale = _quantize_tensor(sub["kernel"], (0,))
+            new = {k: v for k, v in sub.items() if k != "kernel"}
+            new["kernel_q"], new["kernel_scale"] = q, scale
+            out[layer.name] = new
+        elif isinstance(layer, _ConvND):
+            k = np.asarray(sub["kernel"])
+            q, scale = _quantize_tensor(k, tuple(range(k.ndim - 1)))
+            new = {kk: v for kk, v in sub.items() if kk != "kernel"}
+            new["kernel_q"], new["kernel_scale"] = q, scale
+            out[layer.name] = new
+        elif isinstance(layer, Embedding):
+            q, scale = _quantize_tensor(sub["embeddings"], (1,))
+            out[layer.name] = {"embeddings_q": q,
+                               "embeddings_scale": scale}
+    return out

@@ -88,13 +88,9 @@ def _measure(infer, broker_kind: str, n: int = N_REQUESTS):
     return (float(np.percentile(lat, 50)), float(np.percentile(lat, 99)))
 
 
-def main():
-    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+def _serving_model():
     from analytics_zoo_tpu.keras import Sequential
     from analytics_zoo_tpu.keras import layers as L
-    from analytics_zoo_tpu.serving.inference_model import InferenceModel
-
-    init_orca_context(cluster_mode="local")
     model = Sequential([
         L.Convolution2D(16, 3, 3, input_shape=(32, 32, 3),
                         border_mode="same", activation="relu"),
@@ -104,6 +100,146 @@ def main():
         L.Dense(10, activation="softmax"),
     ])
     model.ensure_built(np.zeros((1, 32, 32, 3), np.float32))
+    return model
+
+
+def _device_forward_main():
+    """BENCH_DEVICE_FORWARD=1: the model's batched forward ON THE TPU,
+    tunnel excluded (VERDICT r4 #3). A single dispatch through the dev
+    tunnel costs ~100 ms of HTTP round trip that a production v5e host
+    (model in-process) never pays, so per-forward device time is measured
+    the same way the training bench does: chain k forwards with a data
+    dependency inside one jitted fori_loop, read back once, divide by k.
+    Percentiles are over repeated trials (sustained-forward latency).
+    Also measures the int8-quantized forward (serving/quantization.py)
+    for the OpenVINO-int8-parity speedup number."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.serving.quantization import quantize_model_params
+
+    init_orca_context(cluster_mode="local")
+    model = _serving_model()
+    batch = int(os.environ.get("BENCH_SERVE_BATCH", 32))
+    # k large enough that k forwards >> the ~120 ms tunnel RTT being
+    # subtracted (tiny CNN ≈ 0.1 ms/forward → ~0.2 s of compute/trial)
+    k, trials = 2000, 10
+    x0 = jnp.asarray(np.random.rand(batch, 32, 32, 3).astype(np.float32))
+
+    def chained(params):
+        @jax.jit
+        def run(x):
+            def body(_, carry):
+                x, acc = carry
+                out = model.apply(params, x, training=False)
+                # data dependency so XLA cannot elide iterations
+                return (x + 1e-12 * jnp.mean(out), acc + jnp.sum(out))
+            return jax.lax.fori_loop(0, k, body, (x, 0.0))
+        run(x0)[1].block_until_ready()
+        float(run(x0)[1])                  # forced readback (warm)
+        lat = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            float(run(x0)[1])
+            lat.append((time.perf_counter() - t0 - _rtt) * 1e3 / k)
+        lat = np.asarray(sorted(lat))
+        return (float(np.percentile(lat, 50)),
+                float(np.percentile(lat, 99)))
+
+    # measure the dispatch+readback round trip to subtract it: an empty
+    # chained program of the same calling shape
+    @jax.jit
+    def empty(x):
+        return jnp.sum(x[0, 0, 0])
+    float(empty(x0))
+    rtts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        float(empty(x0))
+        rtts.append(time.perf_counter() - t0)
+    _rtt = float(np.median(rtts))
+
+    f32_params = model.params
+    p50, p99 = chained(f32_params)
+    q_params = quantize_model_params(model, jax.device_get(f32_params))
+    q_params = jax.device_put(q_params)
+    p50_q, p99_q = chained(q_params)
+
+    # int8's speedup case is DENSE stacks (the OpenVINO-int8 workload
+    # class); the tiny serving CNN above is compute-trivial so its int8
+    # delta is noise. Measure a 4096-wide classifier head, f32 vs bf16
+    # vs int8. NOTE on regime: inside the chained loop the weights are
+    # loop-invariant, so XLA keeps them hot (hoisted conversions /
+    # on-chip residency) — this measures STEADY-STATE serving under
+    # load (weights resident, activations streaming), where int8's win
+    # is the MXU's 2x int8 rate, not weight-fetch bandwidth.
+    from analytics_zoo_tpu.keras import Sequential
+    from analytics_zoo_tpu.keras import layers as L
+    mlp = Sequential([
+        L.Dense(4096, activation="relu", input_shape=(4096,)),
+        L.Dense(4096, activation="relu"),
+        L.Dense(4096, activation="relu"),
+        L.Dense(1000, activation="softmax")])
+    mlp.ensure_built(np.zeros((1, 4096), np.float32))
+    x_mlp = jnp.asarray(np.random.rand(128, 4096).astype(np.float32))
+
+    k_mlp = 500
+
+    def make_run(params):
+        @jax.jit
+        def run(x):
+            def body(_, carry):
+                x, acc = carry
+                out = mlp.apply(params, x, training=False)
+                return (x + 1e-12 * jnp.mean(out), acc + jnp.sum(out))
+            return jax.lax.fori_loop(0, k_mlp, body, (x, 0.0))
+        float(run(x_mlp)[1])                 # warm/compile
+        return run
+
+    runs = {
+        "f32": make_run(mlp.params),
+        "bf16": make_run(jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), mlp.params)),
+        "int8": make_run(jax.device_put(
+            quantize_model_params(mlp, jax.device_get(mlp.params)))),
+    }
+    # interleaved A/B/C rounds, min-of-N per config: the tunnel chip's
+    # minute-scale throughput drift would otherwise bias sequential blocks
+    best = {kname: float("inf") for kname in runs}
+    for _ in range(6):
+        for kname, run in runs.items():
+            t0 = time.perf_counter()
+            float(run(x_mlp)[1])
+            best[kname] = min(best[kname], time.perf_counter() - t0)
+    mlp_f32, mlp_bf16, mlp_q = (
+        (best[kname] - _rtt) * 1e3 / k_mlp
+        for kname in ("f32", "bf16", "int8"))
+
+    print(json.dumps({
+        "serving_device_forward_p50_ms": round(p50, 3),
+        "serving_device_forward_p99_ms": round(p99, 3),
+        "serving_device_forward_int8_p50_ms": round(p50_q, 3),
+        "serving_device_forward_int8_p99_ms": round(p99_q, 3),
+        "serving_device_batch": batch,
+        "mlp4096_f32_ms": round(mlp_f32, 3),
+        "mlp4096_bf16_ms": round(mlp_bf16, 3),
+        "mlp4096_int8_ms": round(mlp_q, 3),
+        "serving_int8_speedup": round(mlp_bf16 / max(mlp_q, 1e-9), 2),
+        "device_dispatch_rtt_ms": round(_rtt * 1e3, 1),
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+    }))
+
+
+def main():
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    if os.environ.get("BENCH_DEVICE_FORWARD") == "1":
+        return _device_forward_main()
+
+    init_orca_context(cluster_mode="local")
+    model = _serving_model()
     infer = InferenceModel(concurrent_num=2).load_keras(model)
     # warm every jit bucket the run will hit
     for b in (1, 2, 4, 8, 16, 32):
@@ -113,6 +249,12 @@ def main():
     for kind in ("memory", "tcp", "redis"):
         p50, p99 = _measure(infer, kind)
         results[kind] = {"p50_ms": round(p50, 2), "p99_ms": round(p99, 2)}
+
+    # pure wire cost: identity model through the redis path, so the
+    # composed TPU number (wire + device forward) never counts a model
+    # forward twice
+    ident = InferenceModel().load_fn(lambda p, x: x, params=())
+    wire_p50, wire_p99 = _measure(ident, "redis")
     stop_orca_context()
 
     # headline: the Redis-wire path (what BASELINE.md names)
@@ -125,6 +267,8 @@ def main():
         "broker": "redis",
         "p99_ms": results["redis"]["p99_ms"],
         "by_broker": results,
+        "wire_only_p50_ms": round(wire_p50, 2),
+        "wire_only_p99_ms": round(wire_p99, 2),
         "n_requests": N_REQUESTS,
     }))
 
