@@ -52,13 +52,17 @@ class ClassificationConfig:
     input_size: int
     class_num: int
     dataset: str
-    arch: str = "resnet"        # "resnet" | "inception-v1"
+    arch: str = "resnet"        # "resnet" | "inception-v1" | "lenet"
     depth: int = 0              # resnet depth; unused by other archs
     # ImageNet-style preprocess: resize shorter side, center crop,
     # per-channel mean/std (RGB, 0-255 domain)
     resize: int = 256
     mean_rgb: Tuple[float, float, float] = (123.68, 116.78, 103.94)
     std_rgb: Tuple[float, float, float] = (58.4, 57.12, 57.38)
+    channels: int = 3
+    # caffe-lineage architectures run channels-first so pretrained
+    # artifacts transfer weight-for-weight (flatten order matches)
+    layout: str = "NHWC"        # "NHWC" | "NCHW"
 
 
 CLASSIFICATION_MODELS: Dict[str, ClassificationConfig] = {
@@ -72,6 +76,12 @@ CLASSIFICATION_MODELS: Dict[str, ClassificationConfig] = {
     # the reference's headline ImageNet trainer (examples/inception)
     "inception-v1-imagenet": ClassificationConfig(
         224, 1000, "imagenet", arch="inception-v1"),
+    # the canonical Caffe artifact — pretrained-interop entry
+    # (weights_path="caffe:deploy.prototxt,lenet.caffemodel")
+    "lenet-mnist": ClassificationConfig(
+        28, 10, "mnist", arch="lenet", resize=28,
+        mean_rgb=(0.0, 0.0, 0.0), std_rgb=(255.0, 255.0, 255.0),
+        channels=1, layout="NCHW"),
 }
 
 
@@ -95,12 +105,30 @@ class ConfiguredClassifier:
                                                   ImageChannelNormalize)
         cfg = self.config
         crop = ImageCenterCrop(cfg.input_size, cfg.input_size)
-        norm = ImageChannelNormalize(*cfg.mean_rgb, *cfg.std_rgb)
-        if isinstance(images, np.ndarray) and images.ndim == 3:
-            images = [images]
+        if cfg.channels == 1:
+            # single-channel (MNIST-style): scalar normalize — the RGB
+            # normalizer's [3]-vector would broadcast HW1 → HW3
+            norm = lambda im: ((im - cfg.mean_rgb[0])  # noqa: E731
+                               / cfg.std_rgb[0])
+        else:
+            norm = ImageChannelNormalize(*cfg.mean_rgb, *cfg.std_rgb)
+        if isinstance(images, np.ndarray):
+            if images.ndim == 2:          # one grayscale image
+                images = [images]
+            elif images.ndim == 3:
+                # HWC single image vs (N,H,W) stacked grayscale batch:
+                # a trailing channel dim (3 or 1) means single image
+                images = ([images]
+                          if cfg.channels == 3 or images.shape[-1] == 1
+                          else list(images))
+            else:
+                images = list(images)
         out = []
         for img in images:
             img = np.asarray(img).astype(np.float32)
+            if cfg.channels == 1 and img.ndim == 3 and img.shape[-1] == 1:
+                img = img[..., 0]   # 2-D throughout: cv2.resize drops
+                                    # the (H,W,1) channel dim anyway
             h, w = img.shape[:2]
             # resize shorter side to cfg.resize (ImageResize is fixed WxH)
             if min(h, w) != cfg.resize:
@@ -110,7 +138,12 @@ class ConfiguredClassifier:
                                        max(cfg.input_size,
                                            int(round(h * scale)))))
             out.append(norm(crop(img)))
-        return np.stack(out)
+        batch = np.stack(out).astype(np.float32)
+        if cfg.channels == 1 and batch.ndim == 3:
+            batch = batch[..., None]
+        if cfg.layout == "NCHW":
+            batch = batch.transpose(0, 3, 1, 2)
+        return batch
 
     def predict_top_n(self, images, top_n: int = 5,
                       batch_per_thread: int = 8):
@@ -143,15 +176,22 @@ def load_image_classifier(model_name: str,
         label_map: Dict[int, str] = {}
     else:
         label_map = classification_label_reader(cfg.dataset, label_path)
+    if cfg.layout == "NCHW":
+        in_shape = (cfg.channels, cfg.input_size, cfg.input_size)
+    else:
+        in_shape = (cfg.input_size, cfg.input_size, cfg.channels)
     clf = ImageClassifier(
         depth=cfg.depth, class_num=cfg.class_num,
-        input_shape=(cfg.input_size, cfg.input_size, 3),
-        label_map=label_map, arch=cfg.arch)
-    if weights_path:
-        clf.model.load_weights(weights_path)
-    else:
+        input_shape=in_shape, label_map=label_map, arch=cfg.arch)
+    from analytics_zoo_tpu.models.pretrained import (apply_weight_spec,
+                                                     parse_weight_spec)
+    spec = parse_weight_spec(weights_path) if weights_path else None
+    if weights_path and spec is None:
+        clf.model.load_weights(weights_path)    # native ckpt: no throwaway
+    else:                                       # random init build
         import jax
         clf.model.ensure_built(
-            np.zeros((1, cfg.input_size, cfg.input_size, 3), np.float32),
-            jax.random.PRNGKey(0))
+            np.zeros((1,) + in_shape, np.float32), jax.random.PRNGKey(0))
+        if spec is not None:
+            apply_weight_spec(clf.model, weights_path, strict=True)
     return ConfiguredClassifier(clf, cfg, model_name)

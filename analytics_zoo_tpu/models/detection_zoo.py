@@ -124,13 +124,25 @@ def load_object_detector(model_name: str, dataset: str = "pascal",
     model, anchors = od.build_ssd(
         n_classes, image_size=cfg.image_size, scales=cfg.scales,
         aspect_ratios=cfg.aspect_ratios)
-    if weights_path:
-        model.load_weights(weights_path)
+    from analytics_zoo_tpu.models.pretrained import (apply_weight_spec,
+                                                     parse_weight_spec)
+    spec = parse_weight_spec(weights_path) if weights_path else None
+    if weights_path and spec is None:
+        model.load_weights(weights_path)        # native ckpt: no throwaway
     else:
         import jax
         model.ensure_built(
             np.zeros((1, cfg.image_size, cfg.image_size, 3), np.float32),
             jax.random.PRNGKey(0))
+        if spec is not None:
+            # backbone-only transfer (strict=False): detection heads
+            # rarely shape-match a foreign backbone artifact — the
+            # CaffeLoader fine-tune pattern (`CaffeLoader.scala:718`)
+            stats = apply_weight_spec(model, weights_path, strict=False)
+            import logging
+            logging.getLogger("analytics_zoo_tpu").info(
+                "load_object_detector(%s): foreign weight transfer %s",
+                model_name, stats)
     k = len(cfg.aspect_ratios)
     sizes = (cfg.image_size // 8, cfg.image_size // 16)
     n_per_map = [s * s * k for s in sizes]

@@ -139,6 +139,28 @@ def inception_v1(class_num: int = 1000,
     return Model(inp, x)
 
 
+def lenet(class_num: int = 10,
+          input_shape: Sequence[int] = (1, 28, 28)) -> Model:
+    """LeNet-5 (BigDL `models/lenet`; the canonical Caffe artifact —
+    conv20-pool-conv50-pool-fc500-fc10). Channels-FIRST like its Caffe
+    lineage so an imported artifact's dense kernels transfer
+    weight-for-weight — the flatten order matches
+    (`models/pretrained.py` shape-matched transfer)."""
+    inp = Input(shape=tuple(input_shape))
+    x = L.Convolution2D(20, 5, 5, border_mode="valid",
+                        dim_ordering="th")(inp)
+    x = L.MaxPooling2D(pool_size=(2, 2), strides=(2, 2),
+                       dim_ordering="th")(x)
+    x = L.Convolution2D(50, 5, 5, border_mode="valid",
+                        dim_ordering="th")(x)
+    x = L.MaxPooling2D(pool_size=(2, 2), strides=(2, 2),
+                       dim_ordering="th")(x)
+    x = L.Flatten()(x)
+    x = L.Dense(500, activation="relu")(x)
+    x = L.Dense(class_num, activation="softmax")(x)
+    return Model(inp, x)
+
+
 class ImageClassifier(ZooModel):
     """Model + preprocessing + label map (`models/image/imageclassification/
     ImageClassifier.scala` surface)."""
@@ -159,8 +181,11 @@ class ImageClassifier(ZooModel):
             self.model = inception_v1(class_num, input_shape)
         elif arch == "resnet":
             self.model = resnet(depth, class_num, input_shape)
+        elif arch == "lenet":
+            self.model = lenet(class_num, input_shape)
         else:
-            raise ValueError(f"Unknown arch {arch!r}: resnet|inception-v1")
+            raise ValueError(
+                f"Unknown arch {arch!r}: resnet|inception-v1|lenet")
 
     def top_n(self, probs, top_n: int = 5) -> List[List]:
         """Per-row top-N (label, prob) via the label map — shared by

@@ -1,0 +1,268 @@
+"""Pretrained-artifact interop: Caffe/ONNX artifacts round-trip into the
+model-zoo entry points (`models/pretrained.py`; VERDICT r4 #4).
+
+Parity: `ObjectDetector.load` / `ImageClassifier.loadModel` consume
+published trained models whose weights originated in Caffe
+(`models/caffe/CaffeLoader.scala:718`). Fixtures are real wire-format
+caffemodel/onnx bytes built with the in-repo codecs; the bar is
+IDENTICAL logits between the imported model and the zoo entry point."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.caffe import load_caffe
+from analytics_zoo_tpu.caffe.caffe_loader import NET
+from analytics_zoo_tpu.models.classification_zoo import (
+    load_image_classifier)
+from analytics_zoo_tpu.models.pretrained import (parse_weight_spec,
+                                                 transfer_weights)
+from analytics_zoo_tpu.onnx import load_onnx, wire
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    return {"shape": [{"dim": list(arr.shape)}],
+            "data": list(arr.reshape(-1))}
+
+
+LENET_PROTOTXT = '''
+name: "LeNet"
+layer {
+  name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 1 dim: 28 dim: 28 } }
+}
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 }
+}
+layer {
+  name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
+  convolution_param { num_output: 50 kernel_size: 5 }
+}
+layer {
+  name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1" type: "InnerProduct" bottom: "pool2" top: "ip1"
+  inner_product_param { num_output: 500 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "ip1" top: "ip1r" }
+layer {
+  name: "ip2" type: "InnerProduct" bottom: "ip1r" top: "ip2"
+  inner_product_param { num_output: 10 }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip2" top: "prob" }
+'''
+
+
+def _lenet_weights(seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        "conv1": [rs.randn(20, 1, 5, 5).astype(np.float32) * 0.1,
+                  rs.randn(20).astype(np.float32) * 0.1],
+        "conv2": [rs.randn(50, 20, 5, 5).astype(np.float32) * 0.05,
+                  rs.randn(50).astype(np.float32) * 0.1],
+        "ip1": [rs.randn(500, 800).astype(np.float32) * 0.03,
+                rs.randn(500).astype(np.float32) * 0.1],
+        "ip2": [rs.randn(10, 500).astype(np.float32) * 0.05,
+                rs.randn(10).astype(np.float32) * 0.1],
+    }
+
+
+def _write_caffemodel(tmp_path, weights):
+    d = tmp_path / "lenet.prototxt"
+    d.write_text(LENET_PROTOTXT)
+    net = {"name": ["LeNet"],
+           "layer": [{"name": [n], "type": ["X"],
+                      "blobs": [_blob(b) for b in blobs]}
+                     for n, blobs in weights.items()]}
+    m = tmp_path / "lenet.caffemodel"
+    m.write_bytes(wire.encode(net, NET))
+    return str(d), str(m)
+
+
+class TestSpecParsing:
+    def test_grammar(self):
+        assert parse_weight_spec("onnx:/a/b.onnx") == ("onnx", ("/a/b.onnx",))
+        assert parse_weight_spec("caffe:d.prototxt,w.caffemodel") == \
+            ("caffe", ("d.prototxt", "w.caffemodel"))
+        assert parse_weight_spec("/plain/ckpt.npz") is None
+        with pytest.raises(ValueError, match="caffe:"):
+            parse_weight_spec("caffe:only-one-path")
+
+
+class TestCaffeRoundTrip:
+    def test_zoo_classifier_matches_imported_model(self, tmp_path):
+        weights = _lenet_weights()
+        def_p, model_p = _write_caffemodel(tmp_path, weights)
+
+        imported = load_caffe(def_p, model_p)
+        clf = load_image_classifier(
+            "lenet-mnist", weights_path=f"caffe:{def_p},{model_p}")
+
+        rs = np.random.RandomState(3)
+        x = rs.rand(4, 1, 28, 28).astype(np.float32)
+        ref = np.asarray(imported.predict(x, batch_per_thread=4))
+        got = np.asarray(
+            clf.classifier.predict(x, batch_per_thread=4))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_preprocess_to_prediction_pipeline(self, tmp_path):
+        def_p, model_p = _write_caffemodel(tmp_path, _lenet_weights())
+        clf = load_image_classifier(
+            "lenet-mnist", weights_path=f"caffe:{def_p},{model_p}")
+        imgs = (np.random.RandomState(5)
+                .randint(0, 255, (2, 28, 28)).astype(np.float32))
+        top = clf.predict_top_n(list(imgs), top_n=3)
+        assert len(top) == 2 and len(top[0]) == 3
+        # labels resolve through the mnist map (digit strings)
+        assert all(isinstance(lbl, str) for lbl, _ in top[0])
+
+
+def _onnx_lenet_bytes(weights):
+    """The same LeNet as an ONNX ModelProto (NCHW Conv/MaxPool/Gemm)."""
+    def t(name, arr):
+        arr = np.asarray(arr, np.float32)
+        return {"name": [name], "data_type": [1],
+                "dims": list(arr.shape), "float_data": list(arr.ravel())}
+
+    def vi(name, shape):
+        dims = [{"dim_value": [int(d)]} for d in shape]
+        return {"name": [name],
+                "type": [{"tensor_type": [
+                    {"elem_type": [1], "shape": [{"dim": dims}]}]}]}
+
+    def node(op, inputs, outputs, attrs=None):
+        n = {"op_type": [op], "input": inputs, "output": outputs}
+        if attrs:
+            n["attribute"] = attrs
+        return n
+
+    def a_ints(name, vals):
+        return {"name": [name], "type": [7], "ints": list(vals)}
+
+    def a_int(name, v):
+        return {"name": [name], "type": [2], "i": [int(v)]}
+
+    w = weights
+    graph = {
+        "name": ["lenet"],
+        "input": [vi("x", (1, 1, 28, 28))],
+        "output": [vi("prob", (1, 10))],
+        "initializer": [
+            t("c1w", w["conv1"][0]), t("c1b", w["conv1"][1]),
+            t("c2w", w["conv2"][0]), t("c2b", w["conv2"][1]),
+            t("f1w", w["ip1"][0]), t("f1b", w["ip1"][1]),
+            t("f2w", w["ip2"][0]), t("f2b", w["ip2"][1]),
+        ],
+        "node": [
+            node("Conv", ["x", "c1w", "c1b"], ["c1"],
+                 [a_ints("kernel_shape", (5, 5))]),
+            node("MaxPool", ["c1"], ["p1"],
+                 [a_ints("kernel_shape", (2, 2)), a_ints("strides", (2, 2))]),
+            node("Conv", ["p1", "c2w", "c2b"], ["c2"],
+                 [a_ints("kernel_shape", (5, 5))]),
+            node("MaxPool", ["c2"], ["p2"],
+                 [a_ints("kernel_shape", (2, 2)), a_ints("strides", (2, 2))]),
+            node("Flatten", ["p2"], ["fl"], [a_int("axis", 1)]),
+            node("Gemm", ["fl", "f1w", "f1b"], ["g1"],
+                 [a_int("transB", 1)]),
+            node("Relu", ["g1"], ["r1"]),
+            node("Gemm", ["r1", "f2w", "f2b"], ["g2"],
+                 [a_int("transB", 1)]),
+            node("Softmax", ["g2"], ["prob"], [a_int("axis", 1)]),
+        ],
+    }
+    return wire.encode({"ir_version": [8], "producer_name": ["test"],
+                        "opset_import": [{"version": [13]}],
+                        "graph": [graph]}, wire.MODEL)
+
+
+class TestOnnxRoundTrip:
+    def test_zoo_classifier_matches_imported_model(self, tmp_path):
+        weights = _lenet_weights(seed=7)
+        blob = _onnx_lenet_bytes(weights)
+        p = tmp_path / "lenet.onnx"
+        p.write_bytes(blob)
+
+        imported = load_onnx(str(p))
+        clf = load_image_classifier("lenet-mnist",
+                                    weights_path=f"onnx:{p}")
+        rs = np.random.RandomState(11)
+        x = rs.rand(4, 1, 28, 28).astype(np.float32)
+        ref = np.asarray(imported.predict(x, batch_per_thread=4))
+        got = np.asarray(clf.classifier.predict(x, batch_per_thread=4))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestTransferSemantics:
+    def test_many_same_class_layers_keep_structural_order(self):
+        # regression: jax.device_get re-sorts dict keys LEXICOGRAPHICALLY
+        # (dense_10 < dense_2), so insertion-order walking silently
+        # shuffles weights between 10+ same-shaped layers
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+
+        def build(seed):
+            m = Sequential([L.Dense(6, activation="tanh",
+                                    input_shape=(6,))] +
+                           [L.Dense(6, activation="tanh")
+                            for _ in range(11)])
+            m.ensure_built(np.zeros((1, 6), np.float32))
+            return m
+
+        src, dst = build(0), build(1)
+        stats = transfer_weights(src, dst, strict=True)
+        assert stats["unmatched_dst"] == 0 and stats["unused_src"] == 0
+        x = np.random.RandomState(9).randn(5, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(dst.predict(x, batch_per_thread=5)),
+            np.asarray(src.predict(x, batch_per_thread=5)),
+            rtol=1e-5, atol=1e-6)
+
+    def test_grayscale_preprocess_shapes(self, tmp_path):
+        def_p, model_p = _write_caffemodel(tmp_path, _lenet_weights())
+        clf = load_image_classifier(
+            "lenet-mnist", weights_path=f"caffe:{def_p},{model_p}")
+        rs = np.random.RandomState(13)
+        # one 2-D image, one (H,W,1) image, a stacked (N,H,W) batch, and
+        # a mixed-size list (one needing resize) must all preprocess
+        single = clf.preprocess(rs.rand(28, 28) * 255)
+        assert single.shape == (1, 1, 28, 28)
+        hw1 = clf.preprocess(rs.rand(28, 28, 1) * 255)
+        assert hw1.shape == (1, 1, 28, 28)
+        batch = clf.preprocess(rs.rand(3, 28, 28) * 255)
+        assert batch.shape == (3, 1, 28, 28)
+        mixed = clf.preprocess([rs.rand(32, 32, 1) * 255,
+                                rs.rand(28, 28, 1) * 255])
+        assert mixed.shape == (2, 1, 28, 28)
+
+    def test_strict_raises_on_architecture_mismatch(self, tmp_path):
+        def_p, model_p = _write_caffemodel(tmp_path, _lenet_weights())
+        imported = load_caffe(def_p, model_p)
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        other = Sequential([L.Dense(7, input_shape=(13,))])
+        other.ensure_built(np.zeros((1, 13), np.float32))
+        with pytest.raises(ValueError, match="strict=False"):
+            transfer_weights(imported, other, strict=True)
+        stats = transfer_weights(imported, other, strict=False)
+        assert stats["matched"] == 0 and stats["unmatched_dst"] == 2
+
+    def test_detector_backbone_transfer_smoke(self, tmp_path):
+        # strict=False through the detector entry: unmatched heads keep
+        # init, call succeeds, stats logged — the fine-tune pattern
+        def_p, model_p = _write_caffemodel(tmp_path, _lenet_weights())
+        from analytics_zoo_tpu.models.detection_zoo import (
+            load_object_detector)
+        det = load_object_detector(
+            "ssd-tpu-64x64", dataset="pascal",
+            weights_path=f"caffe:{def_p},{model_p}")
+        img = np.random.RandomState(0).rand(64, 64, 3).astype(np.float32)
+        out = det.predict([img * 255])
+        assert isinstance(out, list) and len(out) == 1
