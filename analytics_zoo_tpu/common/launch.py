@@ -127,10 +127,12 @@ def launch(hosts: Sequence[str], nproc: int, script: str,
     """Start the full host×nproc process group and return its monitor
     (fail-fast `.wait()`, group `.terminate()`).
 
-    Remote coordinators default to port 29400 (the conventional
-    rendezvous port — a locally-probed free port says nothing about the
-    remote head). Concurrent launches sharing a head host must pass
-    distinct ``port``s."""
+    Remote coordinators default to a port DERIVED from the job identity
+    (hash of script/hosts/nproc/cwd, range 29400-30399) — stable across
+    re-launches of the same job, distinct for different jobs sharing a
+    head host (a locally-probed free port says nothing about the remote
+    head). Open that range on the head's firewall, or pass an explicit
+    ``port``. Two concurrent IDENTICAL jobs still need distinct ports."""
     hosts = list(hosts)
     if coordinator is None:
         head = hosts[0].split("@")[-1]
@@ -140,9 +142,20 @@ def launch(hosts: Sequence[str], nproc: int, script: str,
             coordinator = f"{head}:{port or _free_port()}"
         else:
             # remote coordinator: a port probed by binding LOCALLY says
-            # nothing about the remote host — use the conventional
-            # rendezvous port unless the caller picked one
-            coordinator = f"{head}:{port or 29400}"
+            # nothing about the remote host. Derive a stable per-job port
+            # from (script, hosts, nproc, cwd) in 29400-30399 so two
+            # DIFFERENT jobs sharing a head host don't silently rendezvous
+            # into one process group; identical re-launches keep the same
+            # port (the conventional-fixed-port property that matters for
+            # firewalls). Callers needing two concurrent identical jobs
+            # must pass distinct ports.
+            if port is None:
+                import hashlib
+                digest = hashlib.sha1(
+                    f"{script}|{','.join(hosts)}|{nproc}|{os.getcwd()}"
+                    .encode()).digest()
+                port = 29400 + int.from_bytes(digest[:2], "big") % 1000
+            coordinator = f"{head}:{port}"
     cmds = build_commands(hosts, nproc, coordinator, script, script_args,
                           python=python, ssh_cmd=ssh_cmd,
                           extra_env=extra_env,

@@ -193,5 +193,6 @@ def load_image_classifier(model_name: str,
         clf.model.ensure_built(
             np.zeros((1,) + in_shape, np.float32), jax.random.PRNGKey(0))
         if spec is not None:
-            apply_weight_spec(clf.model, weights_path, strict=True)
+            apply_weight_spec(clf.model, weights_path, strict=True,
+                              parsed=spec)
     return ConfiguredClassifier(clf, cfg, model_name)

@@ -138,7 +138,8 @@ def load_object_detector(model_name: str, dataset: str = "pascal",
             # backbone-only transfer (strict=False): detection heads
             # rarely shape-match a foreign backbone artifact — the
             # CaffeLoader fine-tune pattern (`CaffeLoader.scala:718`)
-            stats = apply_weight_spec(model, weights_path, strict=False)
+            stats = apply_weight_spec(model, weights_path, strict=False,
+                                      parsed=spec)
             import logging
             logging.getLogger("analytics_zoo_tpu").info(
                 "load_object_detector(%s): foreign weight transfer %s",

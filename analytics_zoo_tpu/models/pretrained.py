@@ -17,7 +17,7 @@ Spec grammar (the `weights_path` argument of the zoo loaders):
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -69,7 +69,7 @@ def _ordered_leaves(model, params, prefix="") -> List[Tuple[str, Any]]:
     def flat(tree, pfx):
         if isinstance(tree, dict):
             for k in sorted(tree, key=_natural_key):
-                flat(tree[k], f"{pfx}/{k}")
+                flat(tree[k], f"{pfx}/{k}" if pfx else k)
         else:
             out.append((pfx, np.asarray(tree)))
 
@@ -147,11 +147,15 @@ def transfer_weights(src_model, dst_model, strict: bool = True
             "unused_src": int(len(src) - sum(used))}
 
 
-def apply_weight_spec(model, spec: str, strict: bool = True):
+def apply_weight_spec(model, spec: str, strict: bool = True,
+                      parsed: Optional[Tuple] = None):
     """Resolve a weights spec against a built native model. Returns the
     transfer stats dict for foreign artifacts, None for native paths
-    (caller falls back to load_weights)."""
-    parsed = parse_weight_spec(spec)
+    (caller falls back to load_weights). Callers that already ran
+    `parse_weight_spec` (the zoo loaders decide build-vs-load from it)
+    pass the result as `parsed` so the grammar is evaluated once."""
+    if parsed is None:
+        parsed = parse_weight_spec(spec)
     if parsed is None:
         return None
     kind, args = parsed

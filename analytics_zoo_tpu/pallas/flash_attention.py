@@ -300,6 +300,9 @@ def _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k, interpret):
 
 def _dq_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
                do_ref, lse_ref, delta_ref, dq_ref, dq_sc):
+    """Standalone dq (accumulate over ki in scratch): the fallback when
+    n_kb is large enough that the fused kernel's per-ki dq partials
+    (n_kb × T × D f32 in HBM) would cost real memory — see _flash_bwd."""
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -336,6 +339,7 @@ def _dq_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
 
 def _dkv_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
                 do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_sc, dv_sc):
+    """dk/dv-only companion of _dq_kernel for the large-n_kb fallback."""
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
@@ -378,6 +382,61 @@ def _dkv_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref,
+                      s_ref, do_ref, lse_ref, delta_ref, dqp_ref, dk_ref,
+                      dv_ref, dk_sc, dv_sc):
+    """ONE backward kernel (round-5 fusion): the previous dq/dkv pair each
+    recomputed `pnorm` and `dw` — 7 matmuls per tile where 5 suffice (and
+    two dropout-mask regenerations where one does). dk/dv accumulate over
+    qi exactly as before; dq has the transposed accumulation order, so
+    each grid step writes its PARTIAL contribution ds·K to its own
+    [ki]-indexed output block (no revisited-output accumulation) and the
+    caller reduces the n_kb partials — at 1024-blocks that is a 2-term
+    sum, trivially XLA-fused against the matmul that consumes dq."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    block_k = k_ref.shape[1]
+    block_q = q_ref.shape[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    qb = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    mb = m_ref[0]                                          # [1, bk]
+    dob = do_ref[0]
+    lse = lse_ref[0]                                       # [bq, 1]
+    delta = delta_ref[0]
+    pnorm = jnp.exp(jnp.dot(qb, kb.T,
+                            preferred_element_type=jnp.float32)
+                    * scale + mb - lse)                    # [bq, bk]
+    dw = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+    if rate > 0.0:
+        keep_scale = _keep_scale(s_ref, rate, n_qb, n_kb, qi, ki,
+                                 (block_q, block_k))
+        dw = dw * keep_scale
+        dv_p = pnorm * keep_scale
+    else:
+        dv_p = pnorm
+    ds = pnorm * (dw - delta)
+    dqp_ref[0, 0] = jnp.dot(ds.astype(k_ref.dtype), kb,
+                            preferred_element_type=jnp.float32)
+    dk_sc[...] += jnp.dot(ds.T.astype(q_ref.dtype), qb,
+                          preferred_element_type=jnp.float32)
+    dv_sc[...] += jnp.dot(dv_p.T.astype(do_ref.dtype), dob,
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_qb - 1)
+    def _flush():
+        dk_ref[0] = (dk_sc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
 def _flash_bwd(rate, _fwd_block_q, _fwd_block_k, block_q, block_k, interpret,
                res, dout):
     # _fwd_block_* are unused: mask regeneration derives its tile indices
@@ -400,56 +459,103 @@ def _flash_bwd(rate, _fwd_block_q, _fwd_block_k, block_q, block_k, interpret,
                     * out.reshape(B * H, T, D).astype(jnp.float32),
                     axis=-1, keepdims=True)                # [BH, T, 1]
 
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, rate, scale, n_qb, n_kb),
-        grid=(B * H, n_qb, n_kb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(qf, kf, vf, mf, seed, dof, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, rate, scale, n_qb, n_kb),
-        grid=(B * H, n_kb, n_qb),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(qf, kf, vf, mf, seed, dof, lse, delta)
+    # Fused single-kernel backward when (a) the dq-partials buffer is
+    # cheap (n_kb × T × D f32 per head-batch; ≤4 partials ≈ ≤2 dq-sized
+    # f32 buffers) and (b) the tile fits scoped VMEM — the fused kernel
+    # holds pnorm/dw/ds (+ the dropout mask) live together, and at
+    # 1024×1024 f32 tiles that measured 19.7 MB against the 16 MB scoped
+    # limit (compile-time OOM). Otherwise fall back to the two-kernel
+    # form — its dq accumulates in VMEM scratch with O(T·D) HBM, paying
+    # the duplicated pnorm/dw matmuls instead.
+    if n_kb <= 4 and block_q * block_k <= 512 * 1024:
+        dqp, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, rate, scale, n_qb, n_kb),
+            grid=(B * H, n_kb, n_qb),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, D),
+                             lambda b, j, i: (b, j, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, n_kb, T, D), jnp.float32),
+                jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+                jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(qf, kf, vf, mf, seed, dof, lse, delta)
+        # the transposed-order accumulation, done where it is cheap: n_kb
+        # partials summed by XLA (f32), then scaled — bytes ≈ one
+        # dq-sized read per partial, noise next to the matmuls it
+        # replaced
+        dq = (dqp.sum(axis=1) * scale).astype(q.dtype)
+    else:
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, rate, scale, n_qb, n_kb),
+            grid=(B * H, n_qb, n_kb),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(qf, kf, vf, mf, seed, dof, lse, delta)
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, rate, scale, n_qb, n_kb),
+            grid=(B * H, n_kb, n_qb),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+                jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=interpret,
+        )(qf, kf, vf, mf, seed, dof, lse, delta)
 
     shape = (B, H, T, D)
     # padding masks are data, not parameters — zero cotangent

@@ -1,0 +1,100 @@
+"""Per-op attribution for the seq-2048 flash BERT step (VERDICT r4 #5).
+
+    python scripts/profile_longseq.py [--batch 16] [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if ("JAX_DEFAULT_PRNG_IMPL" not in os.environ
+        and jax.default_backend() == "tpu"):
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+import numpy as np
+
+from profile_ncf import parse_xplane  # shared xplane recipe
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu import init_orca_context
+
+    init_orca_context(cluster_mode="local")
+    dev = jax.devices()[0]
+
+    # warm once via the bench helper, then trace one fit epoch
+    import optax
+    from analytics_zoo_tpu.learn.estimator import Estimator
+    from analytics_zoo_tpu.models.bert import BERTClassifier
+    from analytics_zoo_tpu.ops import objectives
+
+    model = BERTClassifier(
+        num_classes=2, vocab=30522, hidden_size=768, n_block=12, n_head=12,
+        seq_len=2048, intermediate_size=3072, use_flash=True, remat=False)
+    est = Estimator.from_keras(
+        model, optimizer=optax.adamw(1e-4),
+        loss=objectives.get("sparse_categorical_crossentropy",
+                            from_logits=True))
+    rs = np.random.RandomState(0)
+    n = args.batch * args.steps
+    data = {"x": [rs.randint(0, 30522, (n, 2048)).astype(np.int32),
+                  np.ones((n, 2048), np.float32)],
+            "y": rs.randint(0, 2, (n,)).astype(np.int32)}
+    fit_kw = dict(epochs=1, batch_size=args.batch,
+                  steps_per_run=args.steps, mixed_precision=True)
+    est.fit(data, **fit_kw)
+
+    trace_dir = tempfile.mkdtemp(prefix="longseq_prof_")
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    est.fit(data, **fit_kw)
+    wall = time.perf_counter() - t0
+    jax.profiler.stop_trace()
+
+    per_op = parse_xplane(trace_dir)
+    total = sum(per_op.values())
+    steps = args.steps
+
+    def cat(name):
+        n_ = name.lower()
+        if "tpu_custom_call" in n_ or "custom-call" in n_:
+            return "pallas-kernels"
+        if "rng" in n_:
+            return "rng"
+        if "convolution" in n_ or "dot" in n_:
+            return "matmul"
+        if "fusion" in n_:
+            return "fusion"
+        if "copy" in n_ or "transpose" in n_ or "reshape" in n_:
+            return "data-movement"
+        return "other"
+
+    cats = defaultdict(float)
+    for name, s in per_op.items():
+        cats[cat(name)] += s
+    print(f"\nwall {wall*1e3:.0f} ms  device {total*1e3:.0f} ms  "
+          f"steps {steps}  device/step {total/steps*1e3:.1f} ms")
+    for c, s in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"  {c:16s} {s/steps*1e3:8.2f} ms/step ({100*s/total:5.1f}%)")
+    print("\ntop 25 ops (ms/step):")
+    for name, s in sorted(per_op.items(), key=lambda kv: -kv[1])[:25]:
+        print(f"  {s/steps*1e3:8.2f}  {name[:120]}")
+
+
+if __name__ == "__main__":
+    main()

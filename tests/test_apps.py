@@ -37,8 +37,13 @@ def test_app_runs(script):
     path = os.path.join(APPS_DIR, script)
     proc = subprocess.run([sys.executable, path], env=env,
                           capture_output=True, text=True, timeout=600)
-    if proc.returncode != 0:
-        # one retry: transient host resource pressure under xdist load
+    if proc.returncode < 0:
+        # signal-killed (OOM under xdist load) is the ONE transient
+        # signature worth a retry; plain nonzero exits fail loudly. Log
+        # the first attempt so a passing retry never hides the signal.
+        print(f"{script}: first attempt killed by signal "
+              f"{-proc.returncode}; retrying\n"
+              f"stderr:\n{proc.stderr[-2000:]}")
         proc = subprocess.run([sys.executable, path], env=env,
                               capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, \

@@ -80,6 +80,27 @@ class TestFlashVJP:
                                            interpret=True))
             np.testing.assert_allclose(o, o_ref, rtol=1e-5, atol=1e-5)
 
+    def test_gradient_parity_two_kernel_fallback(self):
+        # n_kb > 4 routes the backward through the two-kernel (dq + dkv)
+        # fallback instead of the fused kernel + dq-partials buffer —
+        # both must match reference autodiff
+        q, k, v = _qkv(T=768)
+
+        def lf(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, block_q=128,
+                                           block_k=128, bwd_block_q=128,
+                                           bwd_block_k=128,
+                                           interpret=True) ** 2)
+
+        def lr(q, k, v):
+            return jnp.sum(_reference_attention(q, k, v) ** 2)
+
+        gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
     def test_full_mask_takes_reference_path_even_interpreted(self):
         q, k, v = _qkv(T=128)
         T = 128

@@ -102,6 +102,40 @@ class TestEndToEnd:
         assert r0["process_count"] == 2
         np.testing.assert_allclose(r0["loss"], r1["loss"], rtol=1e-5)
 
+    def test_pipeline_across_processes_matches_single_process(
+            self, tmp_path):
+        """VERDICT r4 #7: drive zoo-launch itself with a DCN-shaped mesh —
+        2 processes × 4 devices, pipeline stages split AT the process
+        boundary, ring attention crossing it — and assert numerics
+        against the same step on a single-process 8-device mesh."""
+        sys.path.insert(0, HERE)
+        import launch_pp_script as pp
+
+        script = os.path.join(HERE, "launch_pp_script.py")
+        mon = zl.launch(["localhost"], nproc=2, script=script,
+                        script_args=[str(tmp_path)], simulate_devices=4)
+        codes = mon.wait(timeout=300)
+        assert codes == [0, 0]
+        ranks = []
+        for r in range(2):
+            with open(os.path.join(str(tmp_path),
+                                   f"pp_rank{r}.json")) as fh:
+                ranks.append(json.load(fh))
+        assert ranks[0]["process_count"] == 2
+        assert ranks[0]["local_devices"] == 4
+        # both ranks computed the same global loss
+        np.testing.assert_allclose(ranks[0]["loss"], ranks[1]["loss"],
+                                   rtol=1e-6)
+
+        # single-process reference on this pytest process's 8 devices
+        from analytics_zoo_tpu.common.config import MeshConfig
+        from analytics_zoo_tpu.common.mesh import DeviceMesh
+        mesh = DeviceMesh(MeshConfig(pipeline=2, data=2, sequence=2))
+        ref_loss, ref_gn = pp.run_step(mesh)
+        np.testing.assert_allclose(ranks[0]["loss"], ref_loss, rtol=1e-5)
+        np.testing.assert_allclose(ranks[0]["grad_norm_sq"], ref_gn,
+                                   rtol=1e-4)
+
     def test_failing_worker_tears_down_group(self, tmp_path):
         bad = tmp_path / "bad.py"
         bad.write_text("import sys; sys.exit(3)\n")
