@@ -92,6 +92,11 @@ def build_commands(hosts: Sequence[str], nproc: int, coordinator: str,
                 "PYTHONPATH": pythonpath,
                 **(extra_env or {}),
             }
+            if simulate_devices:
+                # hermetic CPU workers: the dev rig's sitecustomize dials
+                # its TPU relay when this var is set — a relay outage
+                # would hang simulated (pure-CPU) clusters
+                env_vars.setdefault("PALLAS_AXON_POOL_IPS", "")
             if _is_local(host):
                 env = dict(os.environ)
                 env.update(env_vars)

@@ -219,6 +219,9 @@ def main():
         # would swamp the wire-path latency being measured (a production
         # v5e host runs the model in-process; bench_serving.py docstring)
         env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # hermetic CPU child: keep the rig's TPU-plugin sitecustomize
+        # (and its network relay) out of the wire-path measurement
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         r = _run_sub([sys.executable, os.path.join(here, "bench_serving.py")],
                      timeout=900, env=env)
         if r:

@@ -50,6 +50,10 @@ def test_example_runs(script, args):
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=8").strip()
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # hermetic CPU child: the dev rig's sitecustomize registers the TPU
+    # plugin (touching its network relay) whenever this var is set — a
+    # relay outage then hangs even pure-CPU subprocesses
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     path = os.path.join(EXAMPLES_DIR, script)
     proc = subprocess.run([sys.executable, path, *args], env=env,
                           cwd=repo_root, capture_output=True, text=True,
