@@ -1,0 +1,68 @@
+"""Int8 quantized serving + pretrained-artifact interop — the round-5
+surfaces in one walkthrough (reference: the OpenVINO int8 pipeline,
+`zoo/examples/vnni/`, and `ImageClassifier.loadModel` of published
+artifacts).
+
+1. Write a LeNet "pretrained artifact" in real caffemodel wire format.
+2. Load it through the zoo entry point
+   (`load_image_classifier(..., weights_path="caffe:...")`).
+3. Serve it f32 and int8 through InferenceModel; compare predictions.
+
+    python examples/quantized_serving.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from analytics_zoo_tpu import init_orca_context
+from analytics_zoo_tpu.models.classification_zoo import (
+    load_image_classifier)
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+
+def write_lenet_caffemodel(dirname: str):
+    """A pretrained-style artifact: deploy prototxt + binary caffemodel
+    (the test fixtures' generator, reused as a stand-in for a download)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tests"))
+    from pathlib import Path
+
+    from test_pretrained_interop import _lenet_weights, _write_caffemodel
+    return _write_caffemodel(Path(dirname), _lenet_weights(seed=42))
+
+
+def main():
+    init_orca_context(cluster_mode="local")
+    with tempfile.TemporaryDirectory() as d:
+        def_p, model_p = write_lenet_caffemodel(d)
+        clf = load_image_classifier(
+            "lenet-mnist", weights_path=f"caffe:{def_p},{model_p}")
+        print(f"loaded pretrained artifact through the zoo: {clf.name}")
+
+        rs = np.random.RandomState(0)
+        digits = [rs.randint(0, 255, (28, 28)).astype(np.float32)
+                  for _ in range(64)]
+        batch = clf.preprocess(digits)
+
+        im_f32 = InferenceModel(concurrent_num=2).load_keras(
+            clf.classifier)
+        im_int8 = InferenceModel(concurrent_num=2).load_keras(
+            clf.classifier, quantize="int8")
+
+        p32 = np.asarray(im_f32.predict(batch))
+        p8 = np.asarray(im_int8.predict(batch))
+        agree = float((p32.argmax(-1) == p8.argmax(-1)).mean())
+        drift = float(np.abs(p32 - p8).max())
+        print(f"f32 vs int8: top-1 agreement {agree:.3f}, "
+              f"max prob drift {drift:.4f}")
+        assert agree >= 0.95, "int8 drifted too far from f32"
+        top = clf.predict_top_n(digits[:2], top_n=3)
+        print(f"top-3 for the first image: {top[0]}")
+    print("quantized serving example OK")
+
+
+if __name__ == "__main__":
+    main()
