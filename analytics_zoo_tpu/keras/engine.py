@@ -265,19 +265,28 @@ class KerasNet:
                              **kwargs)
 
     # -- persistence (`models/common/ZooModel.scala` save/load) -----------
-    def save_weights(self, path: str):
+    def save_weights(self, path: str, params: Optional[Params] = None):
+        """Persist `params` (default: this model's) + the layer-order
+        sidecar. `params` lets derived trees (e.g. int8-quantized,
+        serving/quantization.py) reuse the one artifact protocol."""
         import json
         from analytics_zoo_tpu.learn import checkpoint as ckpt
-        if self.params is None:
+        if params is None:
+            params = self.params
+        if params is None:
             raise ValueError("Model has no parameters yet; call fit or "
                              "ensure_built first")
-        ckpt.save_pytree(path, self.params)
+        ckpt.save_pytree(path, jax.device_get(params))
         order = self._layer_order()
         if order:
             with open(self._order_path(path), "w") as fh:
                 json.dump(order, fh)
 
-    def load_weights(self, path: str):
+    def load_weights_tree(self, path: str) -> Params:
+        """Read an artifact written by save_weights and remap it onto
+        THIS instance's layer names — without assigning it. Callers that
+        serve derived trees (int8 artifacts) use this; `load_weights`
+        assigns the result."""
         import json
         import os
         from analytics_zoo_tpu.learn import checkpoint as ckpt
@@ -286,7 +295,10 @@ class KerasNet:
         if os.path.exists(self._order_path(path)):
             with open(self._order_path(path)) as fh:
                 order = json.load(fh)
-        self.params = self._remap_loaded(loaded, order)
+        return self._remap_loaded(loaded, order)
+
+    def load_weights(self, path: str):
+        self.params = self.load_weights_tree(path)
         return self
 
     @staticmethod

@@ -85,6 +85,18 @@ class InferenceModel:
         """`doLoadBigDL` analogue: a saved ZooModel directory."""
         return self.load_keras(cls.load_model(path), quantize=quantize)
 
+    def load_quantized(self, model, path: str) -> "InferenceModel":
+        """A pre-quantized int8 artifact (written by
+        `serving.quantization.save_quantized`) onto `model`'s
+        architecture — the `loadOpenVinoIRInt8` shape: ship the small
+        int8 file, no f32 weights needed at serve time."""
+        from analytics_zoo_tpu.models.common import ZooModel
+        from analytics_zoo_tpu.serving.quantization import load_quantized
+        net = model.model if isinstance(model, ZooModel) else model
+        return self.load_fn(
+            lambda p, x: net.apply(p, x, training=False),
+            load_quantized(net, path))
+
     def load_fn(self, fn: Callable, params) -> "InferenceModel":
         """Pure `fn(params, x)` forward."""
         self._fn = fn

@@ -92,6 +92,38 @@ class TestModelQuantization:
         # probabilities stay close in L1
         assert np.abs(p8 - pf).mean() < 0.05
 
+    def test_int8_artifact_roundtrip(self, tmp_path):
+        # save_quantized → load onto a FRESH architecture instance →
+        # identical predictions to the in-memory quantized model, and
+        # the artifact is ~4x smaller than an f32 checkpoint
+        import os
+
+        from analytics_zoo_tpu.serving.quantization import save_quantized
+
+        m, x, _ = _trained_classifier()
+        p_mem = np.asarray(
+            InferenceModel().load_keras(m, quantize="int8").predict(x[:64]))
+        qpath = str(tmp_path / "clf_int8.npz")
+        save_quantized(m, qpath)
+
+        fresh = Sequential([L.Dense(32, activation="relu",
+                                    input_shape=(16,)),
+                            L.Dense(4, activation="softmax")])
+        fresh.ensure_built(np.zeros((1, 16), np.float32))
+        im = InferenceModel().load_quantized(fresh, qpath)
+        p_art = np.asarray(im.predict(x[:64]))
+        np.testing.assert_allclose(p_art, p_mem, rtol=1e-5, atol=1e-6)
+        # int8 leaves persisted as int8 (not upcast by the codec) — the
+        # artifact's weight payload is ~4x smaller than f32
+        assert os.path.exists(qpath)
+        for leaf in jax.tree_util.tree_leaves(im._params):
+            assert np.asarray(leaf).dtype in (np.int8, np.float32)
+        q_bytes = sum(np.asarray(p).nbytes for p in
+                      jax.tree_util.tree_leaves(im._params))
+        f32_bytes = sum(np.asarray(p).nbytes for p in
+                        jax.tree_util.tree_leaves(m.params))
+        assert q_bytes < 0.5 * f32_bytes
+
     def test_bad_mode_rejected(self):
         m, _, _ = _trained_classifier()
         with pytest.raises(ValueError, match="int8"):
