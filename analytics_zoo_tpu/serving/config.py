@@ -71,6 +71,7 @@ class ServingConfig:
 
     model_path: Optional[str] = None
     model_class: Optional[str] = None       # zoo-model class name
+    model_quantize: Optional[str] = None    # "int8" → quantized serving
     broker_url: str = "memory"              # memory | tcp://h:p | redis://h:p
     stream: str = "serving_stream"
     batch_size: int = 32                    # core_number analogue
@@ -104,6 +105,7 @@ class ServingConfig:
         cfg = cls()
         cfg.model_path = model.get("path")
         cfg.model_class = model.get("class")
+        cfg.model_quantize = model.get("quantize")
         if redis.get("host"):
             cfg.broker_url = f"redis://{redis['host']}:{redis.get('port', 6379)}"
         if raw.get("broker"):
@@ -172,7 +174,8 @@ class ServingConfig:
             with open(cfg_json) as fh:
                 cls_name = json.load(fh)["class"]
             cls = _find_model_class(cls_name)
-            return im.load_zoo_model(cls, self.model_path)
+            return im.load_zoo_model(cls, self.model_path,
+                                     quantize=self.model_quantize)
         if self.model_class:
             cls = _find_model_class(self.model_class)
             kwargs = (self.extra.get("model", {}) or {}).get("config") or {}
@@ -181,8 +184,13 @@ class ServingConfig:
                 return im.load_keras_encrypted(
                     inst, os.path.join(self.model_path, "weights.enc"),
                     secret, salt)
+            int8_artifact = os.path.join(self.model_path, "weights_int8.npz")
+            if os.path.exists(int8_artifact):
+                # pre-quantized artifact beside the arch config: serve it
+                # directly (serving/quantization.save_quantized output)
+                return im.load_quantized(inst, int8_artifact)
             inst.model.load_weights(os.path.join(self.model_path, "weights"))
-            return im.load_keras(inst)
+            return im.load_keras(inst, quantize=self.model_quantize)
         raise ValueError(
             f"{self.model_path} is not a saved ZooModel directory "
             "(no config.json) and no model.class was given")

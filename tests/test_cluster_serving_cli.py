@@ -150,6 +150,25 @@ class TestServingConfig:
         out = im.predict(np.zeros((3, 6), np.int32))
         assert np.asarray(out).shape == (3, 2)
 
+    def test_build_model_quantized_from_config(self, tmp_path):
+        # config.yaml `model.quantize: int8` serves the int8 path
+        import jax
+
+        from analytics_zoo_tpu.models.textclassification import TextClassifier
+        m = TextClassifier(class_num=2, vocab_size=30, embedding_dim=8,
+                           sequence_length=6)
+        m.model.ensure_built(np.zeros((1, 6), np.int32))
+        m.save_model(str(tmp_path / "tc"))
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text(
+            f"model:\n  path: {tmp_path / 'tc'}\n  quantize: int8\n")
+        im = ServingConfig.load(str(cfg_file)).build_model()
+        out = im.predict(np.zeros((3, 6), np.int32))
+        assert np.asarray(out).shape == (3, 2)
+        dtypes = {np.asarray(leaf).dtype
+                  for leaf in jax.tree_util.tree_leaves(im._params)}
+        assert np.dtype(np.int8) in dtypes      # actually quantized
+
 
 class TestServingCLIEndToEnd:
     def test_broker_and_start_roundtrip(self, tmp_path):
@@ -167,6 +186,9 @@ class TestServingCLIEndToEnd:
             port = s.getsockname()[1]
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        # hermetic CPU children: the rig's sitecustomize dials its TPU
+        # relay when this var is set; a relay outage would hang them
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         broker = subprocess.Popen(
             [sys.executable, "-m", "analytics_zoo_tpu.serving.cli",
              "broker", "--host", "127.0.0.1", "--port", str(port)], env=env)

@@ -17,6 +17,9 @@ def _run(args, timeout=420):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # hermetic CPU child: the rig's sitecustomize dials its TPU relay
+    # when this var is set; a relay outage would hang the subprocess
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     return subprocess.run([sys.executable, *args], env=env, cwd=REPO,
                           capture_output=True, text=True, timeout=timeout)
 
