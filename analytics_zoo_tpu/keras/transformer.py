@@ -30,6 +30,7 @@ from analytics_zoo_tpu.keras.layers import (LayerNormalization, get_activation,
 from analytics_zoo_tpu.pallas.dropout import fused_dropout
 from analytics_zoo_tpu.pallas.flash_attention import (_reference_attention,
                                                       flash_attention)
+from analytics_zoo_tpu.serving.quantization import maybe_int8_matmul
 
 
 def _dropout(rng, rate: float, x):
@@ -100,7 +101,8 @@ class MultiHeadSelfAttention(Layer):
         if isinstance(x, (list, tuple)):
             x, mask = x
         B, T, D = x.shape
-        qkv = x @ params["qkv_kernel"] + params["qkv_bias"]
+        qkv = maybe_int8_matmul(x, params, "qkv_kernel") \
+            + params["qkv_bias"]
         qkv = qkv.reshape(B, T, 3, self.n_head, self.head_dim)
         q, k, v = [jnp.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3)]
         drop_rng = None
@@ -110,7 +112,8 @@ class MultiHeadSelfAttention(Layer):
                                     dropout_rate=self.attn_dropout,
                                     use_flash=self.use_flash)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(B, T, D)
-        out = ctx @ params["out_kernel"] + params["out_bias"]
+        out = maybe_int8_matmul(ctx, params, "out_kernel") \
+            + params["out_bias"]
         if training and rng is not None and self.output_dropout > 0:
             out = _dropout(rng, self.output_dropout, out)
         return out
@@ -167,8 +170,10 @@ class TransformerEncoderBlock(Layer):
         a = self.attn.call(params["attn"], x, training=training, rng=r1,
                            mask=mask)
         x = self.ln1.call(params["ln1"], x + a)
-        h = self.act(x @ params["ffn_in_kernel"] + params["ffn_in_bias"])
-        h = h @ params["ffn_out_kernel"] + params["ffn_out_bias"]
+        h = self.act(maybe_int8_matmul(x, params, "ffn_in_kernel")
+                     + params["ffn_in_bias"])
+        h = maybe_int8_matmul(h, params, "ffn_out_kernel") \
+            + params["ffn_out_bias"]
         if training and r2 is not None and self.hidden_dropout > 0:
             h = _dropout(r2, self.hidden_dropout, h)
         return self.ln2.call(params["ln2"], x + h)
@@ -328,7 +333,8 @@ class BERT(Layer):
             else:
                 h = blk.call(params[blk.name], [h, mask],
                              training=training, rng=sub)
-        pooled = jnp.tanh(h[:, 0] @ params["pooler_kernel"]
+        pooled = jnp.tanh(maybe_int8_matmul(h[:, 0], params,
+                                            "pooler_kernel")
                           + params["pooler_bias"])
         if self.pooled_only:
             return pooled

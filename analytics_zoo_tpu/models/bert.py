@@ -22,6 +22,7 @@ import numpy as np
 
 from analytics_zoo_tpu.keras.engine import KerasNet
 from analytics_zoo_tpu.keras.transformer import BERT, _dropout
+from analytics_zoo_tpu.serving.quantization import maybe_int8_matmul
 
 
 class _BERTTask(KerasNet):
@@ -87,7 +88,8 @@ class BERTClassifier(_BERTTask):
                                 training=training, rng=sub)
         if training and rng is not None and self.dropout > 0:
             pooled = _dropout(rng, self.dropout, pooled)
-        return pooled @ params["cls_kernel"] + params["cls_bias"]
+        return maybe_int8_matmul(pooled, params, "cls_kernel") \
+            + params["cls_bias"]
 
     def compute_output_shape(self, input_shape):
         return (None, self.num_classes)
@@ -117,7 +119,8 @@ class BERTNER(_BERTTask):
     def apply(self, params, inputs, *, training=False, rng=None):
         seq_out, _ = self.bert.call(params[self.bert.name], inputs,
                                     training=training, rng=rng)
-        return seq_out @ params["ner_kernel"] + params["ner_bias"]
+        return maybe_int8_matmul(seq_out, params, "ner_kernel") \
+            + params["ner_bias"]
 
     def compute_output_shape(self, input_shape):
         return (None, self.bert.seq_len, self.num_entities)
@@ -145,7 +148,8 @@ class BERTSQuAD(_BERTTask):
     def apply(self, params, inputs, *, training=False, rng=None):
         seq_out, _ = self.bert.call(params[self.bert.name], inputs,
                                     training=training, rng=rng)
-        logits = seq_out @ params["qa_kernel"] + params["qa_bias"]
+        logits = maybe_int8_matmul(seq_out, params, "qa_kernel") \
+            + params["qa_bias"]
         return logits[..., 0], logits[..., 1]      # start, end
 
     def compute_output_shape(self, input_shape):

@@ -73,6 +73,43 @@ def dequantize_rows(table_q, scale, ids):
     return table_q[ids].astype(jnp.float32) * scale[ids][..., None]
 
 
+def maybe_int8_matmul(x, params, key: str):
+    """`x @ params[key] `, taking the int8 MXU path when the quantized
+    form (`<key>_q` + `<key>_scale`) is present — the dispatch hook for
+    raw-matmul layers (transformer blocks, BERT task heads) that do not
+    go through the keras Dense layer."""
+    if key + "_q" in params:
+        return int8_matmul(x, params[key + "_q"], params[key + "_scale"])
+    return x @ params[key]
+
+
+# raw (non-Dense-layer) matmul kernels that have a maybe_int8_matmul
+# call site; ONLY these are rewritten — blanket *_kernel matching would
+# break layers that read their kernels directly (e.g. Highway's
+# transform_kernel)
+_RAW_INT8_KERNELS = frozenset({
+    "qkv_kernel", "out_kernel", "ffn_in_kernel", "ffn_out_kernel",
+    "pooler_kernel", "cls_kernel", "ner_kernel", "qa_kernel",
+})
+
+
+def _quantize_raw_kernels(tree):
+    """Recursively rewrite known raw matmul kernels ([in, out] leaves) in
+    a param tree — reaches inside composite layers (transformer blocks)
+    the layer-walk cannot see."""
+    if not isinstance(tree, dict):
+        return tree
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        if k in _RAW_INT8_KERNELS and not isinstance(v, dict) \
+                and np.ndim(v) == 2:
+            q, scale = _quantize_tensor(v, (0,))
+            out[k + "_q"], out[k + "_scale"] = q, scale
+        else:
+            out[k] = _quantize_raw_kernels(v)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # param-tree rewrite
 # ---------------------------------------------------------------------------
@@ -98,16 +135,36 @@ def quantize_model_params(model, params) -> Dict[str, Any]:
     Sequential/Model containers). Layers with no int8 path (BatchNorm,
     recurrent cells, LayerNorm, ...) keep f32 — they are bandwidth-thin
     next to the matmuls."""
+    from analytics_zoo_tpu.keras import transformer as tfm
     from analytics_zoo_tpu.keras.engine import Model, Sequential
     from analytics_zoo_tpu.keras.layers import Dense, Embedding, _ConvND
 
     out = dict(params)
+    # BERT task models carry the encoder + raw head kernels with no
+    # layer list (`models/bert._BERTTask._ordered_layers` is empty by
+    # design): rewrite their subtrees structurally, not by global name
+    # matching — a user layer with a same-named 2-D param elsewhere must
+    # never be touched.
+    from analytics_zoo_tpu.models.bert import _BERTTask
+    if isinstance(model, _BERTTask):
+        out[model.bert.name] = _quantize_raw_kernels(
+            out.get(model.bert.name, {}))
+        for head in ("cls_kernel", "ner_kernel", "qa_kernel"):
+            if head in out and not isinstance(out[head], dict) \
+                    and np.ndim(out[head]) == 2:
+                q, scale = _quantize_tensor(out[head], (0,))
+                del out[head]
+                out[head + "_q"], out[head + "_scale"] = q, scale
     for layer in _iter_layers(model):
         sub = out.get(layer.name)
         if sub is None:
             continue
         if isinstance(layer, (Sequential, Model)):
             out[layer.name] = quantize_model_params(layer, sub)
+        elif isinstance(layer, (tfm.MultiHeadSelfAttention,
+                                tfm.TransformerEncoderBlock,
+                                tfm.TransformerLayer, tfm.BERT)):
+            out[layer.name] = _quantize_raw_kernels(sub)
         elif isinstance(layer, Dense):
             q, scale = _quantize_tensor(sub["kernel"], (0,))
             new = {k: v for k, v in sub.items() if k != "kernel"}

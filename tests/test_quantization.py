@@ -124,6 +124,37 @@ class TestModelQuantization:
                         jax.tree_util.tree_leaves(m.params))
         assert q_bytes < 0.5 * f32_bytes
 
+    def test_bert_transformer_int8(self):
+        # raw-kernel pass: transformer qkv/out/ffn + pooler + cls head
+        # quantize and dispatch through maybe_int8_matmul
+        from analytics_zoo_tpu.models.bert import BERTClassifier
+        from analytics_zoo_tpu.serving.quantization import (
+            quantize_model_params)
+        rs = np.random.RandomState(0)
+        m = BERTClassifier(num_classes=3, vocab=64, hidden_size=32,
+                           n_block=2, n_head=2, seq_len=16,
+                           intermediate_size=64)
+        ids = rs.randint(0, 64, (8, 16)).astype(np.int32)
+        mask = np.ones((8, 16), np.float32)
+        m.ensure_built([ids, mask], jax.random.PRNGKey(0))
+
+        q = quantize_model_params(m, jax.device_get(m.params))
+        flat = jax.tree_util.tree_leaves_with_path(q)
+        q_keys = {str(p) for p, _ in flat if "_q" in str(p)}
+        assert any("qkv_kernel_q" in k for k in q_keys)
+        assert any("ffn_in_kernel_q" in k for k in q_keys)
+        assert any("cls_kernel_q" in k for k in q_keys)
+
+        imf = InferenceModel().load_keras(m)
+        im8 = InferenceModel().load_keras(m, quantize="int8")
+        pf = np.asarray(imf.predict([ids, mask]))
+        p8 = np.asarray(im8.predict([ids, mask]))
+        assert p8.shape == pf.shape
+        # logits stay close; argmax agreement on random-init logits is
+        # noisy, so bound the relative error instead
+        err = np.abs(p8 - pf).max() / (np.abs(pf).max() + 1e-9)
+        assert err < 0.1, f"int8 BERT drifted {err}"
+
     def test_bad_mode_rejected(self):
         m, _, _ = _trained_classifier()
         with pytest.raises(ValueError, match="int8"):
