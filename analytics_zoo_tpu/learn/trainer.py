@@ -247,9 +247,18 @@ def _cast_tree(tree, dtype, only=jnp.float32):
 
 
 def _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
-                   mixed_precision):
+                   mixed_precision, flat_spec=None):
     def one_step(params, opt_state, xb, yb, rng):
+        # flat mode: `params` is the tuple of shape-bucketed master
+        # buffers; the unravel happens INSIDE the differentiated
+        # function so gradients materialize directly in bucket form
+        # (the slice VJPs write each leaf's grad straight into its
+        # stack slot — a ravel after the fact re-copies every grad
+        # through dynamic-update-slice fusions, measured +32 ms/step
+        # on BERT-base seq 2048)
         def compute_loss(p):
+            if flat_spec is not None:
+                p = flat_spec.unravel(p)
             if mixed_precision:
                 p = _cast_tree(p, jnp.bfloat16)
                 # inputs are NOT cast here: float-encoded integer id
@@ -279,7 +288,12 @@ def _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
                                    only=jnp.bfloat16)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        params = _merge_state(params, state_upd)
+        if flat_spec is not None:
+            if jax.tree_util.tree_leaves(state_upd):
+                params = flat_spec.ravel(_merge_state(
+                    flat_spec.unravel(params), state_upd))
+        else:
+            params = _merge_state(params, state_upd)
         return params, opt_state, loss
 
     return one_step
@@ -289,7 +303,7 @@ def build_train_step(apply_fn: Callable, loss_fn: Callable,
                      optimizer: optax.GradientTransformation,
                      apply_and_state_fn: Optional[Callable] = None,
                      mixed_precision: bool = False,
-                     lazy_specs=None) -> Callable:
+                     lazy_specs=None, flat_spec=None) -> Callable:
     """One iteration as a pure function. jit + sharded inputs → GSPMD emits
     the gradient all-reduce; donation reuses parameter buffers in HBM.
     Stateful layers (BatchNorm moving stats) return updates through the aux
@@ -298,7 +312,7 @@ def build_train_step(apply_fn: Callable, loss_fn: Callable,
     matmuls in bf16 (MXU-native)."""
     one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
                               apply_and_state_fn, mixed_precision,
-                              lazy_specs)
+                              lazy_specs, flat_spec)
     return jax.jit(one_step, donate_argnums=(0, 1))
 
 
@@ -306,14 +320,14 @@ def build_train_run(apply_fn: Callable, loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
                     apply_and_state_fn: Optional[Callable] = None,
                     mixed_precision: bool = False,
-                    lazy_specs=None) -> Callable:
+                    lazy_specs=None, flat_spec=None) -> Callable:
     """Multi-step variant: one jit'd program `lax.scan`s over a
     (k, batch, ...) stack of batches, so k steps cost ONE dispatch and ONE
     loss readback. This is the framework's hot path — the analogue of the
     reference engine owning its hot loop (`Topology.scala:1160-1337`)."""
     one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
                               apply_and_state_fn, mixed_precision,
-                              lazy_specs)
+                              lazy_specs, flat_spec)
 
     def train_run(params, opt_state, xs, ys, rng):
         def body(carry, batch):
@@ -335,7 +349,7 @@ def build_device_epoch_run(apply_fn: Callable, loss_fn: Callable,
                            optimizer: optax.GradientTransformation,
                            apply_and_state_fn: Optional[Callable] = None,
                            mixed_precision: bool = False,
-                           lazy_specs=None, steps: int = 1,
+                           lazy_specs=None, flat_spec=None, steps: int = 1,
                            batch: int = 1, shuffle: bool = True) -> Callable:
     """Whole-epoch program over a DEVICE-RESIDENT dataset: shuffle
     (on-device permutation), batch (on-device gather) and all `steps`
@@ -346,7 +360,7 @@ def build_device_epoch_run(apply_fn: Callable, loss_fn: Callable,
     breakdown)."""
     one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
                               apply_and_state_fn, mixed_precision,
-                              lazy_specs)
+                              lazy_specs, flat_spec)
 
     def epoch_run(params, opt_state, x, y, rng):
         n = _tree_len(x)
@@ -442,13 +456,13 @@ def _device_cached_data(model, x, y, mesh):
 
 
 def _pick_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
-                   mixed_precision, lazy_specs):
+                   mixed_precision, lazy_specs, flat_spec=None):
     if lazy_specs:
         from analytics_zoo_tpu.learn.lazy_embedding import make_lazy_one_step
         return make_lazy_one_step(apply_fn, loss_fn, optimizer, lazy_specs,
                                   apply_and_state_fn, mixed_precision)
     return _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
-                          mixed_precision)
+                          mixed_precision, flat_spec=flat_spec)
 
 
 def build_eval_step(apply_fn: Callable, metrics: Sequence) -> Callable:
@@ -470,7 +484,8 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               steps_per_run: int = 1, mixed_precision: bool = False,
               prefetch: bool = True,
               lazy_embeddings: bool = False,
-              device_cache: Optional[bool] = None
+              device_cache: Optional[bool] = None,
+              flat_optimizer: bool = False
               ) -> Dict[str, List[float]]:
     """`KerasNet.fit` backend. Returns a Keras-style history dict.
     `batch_iter_factory(epoch) -> iterator of (xb, yb, real)` overrides the
@@ -483,6 +498,16 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     steps into one `lax.scan` program — one dispatch per k steps —
     trading trigger granularity (checked every k iterations) for dispatch
     overhead. `mixed_precision` runs fwd/bwd in bf16 with f32 masters.
+    `flat_optimizer=True` runs the optimizer sweep over ONE raveled
+    parameter buffer (`ops/flat_optimizer.py`) instead of per-tensor updates —
+    the TPU analogue of the reference's flat `AllReduceParameter`
+    (`Topology.scala:1204`). On BERT-base seq-2048 the per-tensor sweep
+    measured 153 separate ~9 MB fusions at 83 GB/s effective; flattened
+    it streams at HBM rate. Opt-in because it changes the
+    optimizer-state pytree (checkpoints within a run stay consistent;
+    per-tensor checkpoints won't resume under it) and tree-structure-
+    dependent transforms (e.g. `optax.masked` decay masks) don't
+    survive raveling. Ignored with `lazy_embeddings`.
     After fit, `model.params` holds DEVICE arrays (no gratuitous
     device→host pull; save/checkpoint paths transfer on demand)."""
     ctx = get_context()
@@ -584,6 +609,30 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if lazy_embeddings:
         from analytics_zoo_tpu.learn.lazy_embedding import resolve_specs
         lazy_specs = resolve_specs(model)
+    flat_spec = None
+    if flat_optimizer and not lazy_specs:
+        # carry the master params as ONE [rows, 128] f32 buffer: the
+        # optimizer sweep becomes a single streaming program (vs 153
+        # per-tensor fusions at 83 GB/s on BERT-base) and the tree view
+        # only exists as slices fused into the forward pass
+        from analytics_zoo_tpu.ops.flat_optimizer import ParamSpec
+        spec_memo = getattr(model, "_flat_spec_memo", None)
+        # keyed on structure AND shapes: reloading differently-shaped
+        # weights into the same model object must rebuild the buckets
+        key = (jax.tree_util.tree_structure(params),
+               tuple(tuple(l.shape)
+                     for l in jax.tree_util.tree_leaves(params)))
+        if spec_memo is None or spec_memo[0] != key:
+            spec_memo = (key, ParamSpec.from_tree(params))
+            model._flat_spec_memo = spec_memo
+        flat_spec = spec_memo[1]
+        params = jax.jit(flat_spec.ravel)(params)
+
+    def _as_tree(p):
+        """Touch-point view: checkpoints, validation and the final
+        model.params hand-off need the tree form of the flat carry."""
+        return flat_spec.unravel_device(p) if flat_spec is not None else p
+
     if lazy_specs:
         from analytics_zoo_tpu.learn.lazy_embedding import init_state
         opt_state = _put_replicated(
@@ -599,10 +648,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if use_device_cache:
         cache_key = (id(optimizer), id(model.loss), "devcache",
                      mixed_precision, lazy_embeddings, dc_steps,
-                     local_batch, shuffle)
+                     local_batch, shuffle, flat_optimizer)
     else:
-        cache_key = (id(optimizer), id(model.loss), multi, mixed_precision,
-                     lazy_embeddings)
+        cache_key = (id(optimizer), id(model.loss), multi,
+                     mixed_precision, lazy_embeddings, flat_optimizer)
     cached = getattr(model, "_train_cache", None)
     if cached is not None and cached[0] == cache_key:
         train_step = cached[1]
@@ -616,7 +665,8 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         train_step = builder(
             model.apply, model.loss, optimizer,
             apply_and_state_fn=getattr(model, "apply_and_state", None),
-            mixed_precision=mixed_precision, lazy_specs=lazy_specs)
+            mixed_precision=mixed_precision, lazy_specs=lazy_specs,
+            flat_spec=flat_spec)
         model._train_cache = (cache_key, train_step)
     x_dev = y_dev = None
     if use_device_cache:
@@ -691,7 +741,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
                         tg.TriggerState(epoch=epoch, iteration=iteration,
                                         loss=last_loss)):
-                    ckpt_mgr.save(iteration, jax.device_get(params),
+                    ckpt_mgr.save(iteration, jax.device_get(_as_tree(params)),
                                   jax.device_get(opt_state),
                                   extra={"epoch": epoch,
                                          "iteration": iteration})
@@ -724,7 +774,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
 
           if validation_data is not None:
               vx, vy = validation_data
-              model.params = params          # device-resident hand-off
+              model.params = _as_tree(params)  # device-resident hand-off
               val = evaluate_keras(model, vx, vy,
                                    batch_per_thread=max(batch_size // dp, 1))
               for k, v in val.items():
@@ -737,7 +787,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
           if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
                   tg.TriggerState(epoch=epoch + 1, iteration=iteration,
                                   epoch_finished=True)):
-              ckpt_mgr.save(iteration, jax.device_get(params),
+              ckpt_mgr.save(iteration, jax.device_get(_as_tree(params)),
                             jax.device_get(opt_state),
                             extra={"epoch": epoch + 1, "iteration": iteration})
           if end_trigger and end_trigger(
@@ -750,7 +800,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         # model never points at donated/deleted buffers): repeated
         # fit/evaluate/predict chains stay in HBM; save/checkpoint
         # paths device_get on demand.
-        model.params = params
+        model.params = _as_tree(params)
         if isinstance(batches, _Prefetcher):
             batches.close()
         if writer:

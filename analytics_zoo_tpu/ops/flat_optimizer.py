@@ -1,0 +1,96 @@
+"""Bucket-packed optimizer sweep (the TPU analogue of the reference's
+flat ``AllReduceParameter`` gradient/weight storage, `Topology.scala:1204`
+— few big contiguous buffers swept by the optimizer instead of one small
+update program per tensor).
+
+``ParamSpec`` is the shipped mechanism: `learn/trainer.py` uses it when
+``fit(..., flat_optimizer=True)`` to carry the master parameters as one
+stacked ``[count, *shape]`` f32 buffer per distinct leaf shape and to
+differentiate with respect to those buckets. See the class docstring for
+the measured design history (including the two rejected flat-vector
+layouts and why ``optax.flatten`` compile-OOMs on TPU at BERT scale).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+class ParamSpec:
+    """Static description of a parameter pytree for bucket-packed training.
+
+    The trainer's flat mode carries parameters as ONE stacked
+    ``[count, *shape]`` f32 buffer per DISTINCT leaf shape (BERT-base:
+    153 leaves -> 9 buffers), so the optimizer phase is a handful of big
+    streaming fusions instead of one small program per tensor.
+    ``unravel`` hands each consumer a dim-0 slice of its bucket — a pure
+    view with the leaf's exact layout, so the bf16 operand casts keep
+    fusing into the forward pass.
+
+    Two rejected designs, both measured on BERT-base (110.7 M params):
+    a 1-D concat ravel (``optax.flatten`` shape) compiles on TPU to a
+    ``reshape`` of the vector into ``f32[N/2,2]`` whose (8,128)-tiled
+    layout pads the minor dim 2->128 — a 64x, 28 GB allocation,
+    compile-time OOM; a tile-exact ``[rows,128]`` packing compiles and
+    collapses the Adam sweep 37.4 -> 4.6 ms/step, but reshaping row
+    blocks back to ``[768,3072]``-style weight shapes is a physical
+    tile shuffle (+32 ms/step of bitcast_convert fusions) — net zero.
+    Shape-bucketed stacking keeps the sweep collapse AND the zero-cost
+    views. All leaves must be float32 (mixed precision keeps f32
+    masters, so this is the trainer's steady state)."""
+
+    def __init__(self, treedef, shapes):
+        self.treedef = treedef
+        self.shapes = shapes
+        # bucket leaves by exact shape; order within a bucket = leaf order
+        by_shape: dict = {}
+        self.slots = []                      # per leaf: (group, pos)
+        for s in shapes:
+            g = by_shape.setdefault(s, len(by_shape))
+            pos = sum(1 for sl in self.slots if sl[0] == g)
+            self.slots.append((g, pos))
+        self.group_shapes = list(by_shape)   # insertion-ordered
+        self.group_counts = [sum(1 for sl in self.slots if sl[0] == g)
+                             for g in range(len(self.group_shapes))]
+        self.n = sum(int(np.prod(s)) if s else 1 for s in shapes)
+        self._unravel_jit = None
+
+    @classmethod
+    def from_tree(cls, tree) -> "ParamSpec":
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        bad = [tuple(l.shape) for l in leaves if l.dtype != jnp.float32]
+        if bad:
+            raise ValueError(
+                f"flat-parameter training needs all-f32 leaves; got "
+                f"non-f32 shapes {bad[:3]}")
+        return cls(treedef, [tuple(l.shape) for l in leaves])
+
+    def ravel(self, tree):
+        """Pack the tree into one stacked [count, *shape] buffer per
+        distinct shape (singleton buckets stay unstacked: zero-copy)."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        groups: list = [[] for _ in self.group_shapes]
+        for leaf, (g, _pos) in zip(leaves, self.slots):
+            groups[g].append(leaf)
+        return tuple(ls[0] if len(ls) == 1 else jnp.stack(ls)
+                     for ls in groups)
+
+    def unravel(self, buffers):
+        leaves = []
+        for (g, pos), shape in zip(self.slots, self.shapes):
+            buf = buffers[g]
+            if self.group_counts[g] == 1:
+                leaves.append(buf)
+            else:
+                leaves.append(jax.lax.index_in_dim(buf, pos, axis=0,
+                                                   keepdims=False))
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def unravel_device(self, flat2d):
+        """jit'd unravel for host-side touch points (checkpoint save,
+        validation hand-off) — compiled once per spec."""
+        if self._unravel_jit is None:
+            self._unravel_jit = jax.jit(self.unravel)
+        return self._unravel_jit(flat2d)

@@ -462,11 +462,15 @@ def _flash_bwd(rate, _fwd_block_q, _fwd_block_k, block_q, block_k, interpret,
     # Fused single-kernel backward when (a) the dq-partials buffer is
     # cheap (n_kb × T × D f32 per head-batch; ≤4 partials ≈ ≤2 dq-sized
     # f32 buffers) and (b) the tile fits scoped VMEM — the fused kernel
-    # holds pnorm/dw/ds (+ the dropout mask) live together, and at
-    # 1024×1024 f32 tiles that measured 19.7 MB against the 16 MB scoped
-    # limit (compile-time OOM). Otherwise fall back to the two-kernel
-    # form — its dq accumulates in VMEM scratch with O(T·D) HBM, paying
-    # the duplicated pnorm/dw matmuls instead.
+    # holds pnorm/dw/ds (+ the dropout mask) live together, ~19.7 MB of
+    # f32 tiles at 1024². Round-5 measured the alternative of raising
+    # `vmem_limit_bytes` to 48 MB so 1024² compiles: 12.2 ms bwd vs the
+    # two-kernel pair's 9.6 ms at the same tiling (B=16,H=12,T=2048,
+    # D=64, all three grads consumed) — that much live VMEM destroys
+    # Mosaic's DMA/compute overlap, so the fused form only pays at
+    # tiles ≤512k where it measured ~9.0 ms (1024×512). Otherwise fall
+    # back to the two-kernel form — its dq accumulates in VMEM scratch
+    # with O(T·D) HBM, paying the duplicated pnorm/dw matmuls instead.
     if n_kb <= 4 and block_q * block_k <= 512 * 1024:
         dqp, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, rate, scale, n_qb, n_kb),

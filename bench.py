@@ -70,7 +70,12 @@ def _measure_bert(dev, *, vocab, hidden, n_block, n_head, seq_len, inter,
                   np.ones((n, seq_len), np.float32)],
             "y": rs.randint(0, 2, (n,)).astype(np.int32)}
     fit_kw = dict(epochs=1, batch_size=batch, steps_per_run=steps_per_run,
-                  mixed_precision=True)
+                  mixed_precision=True,
+                  # bucketed optimizer sweep: collapses the per-tensor
+                  # Adam phase 37->4 ms/step, but regrouping the grads
+                  # costs an equal pass — net inside session noise on
+                  # BERT (docs/ROOFLINE.md round 5), so off by default
+                  flat_optimizer=os.environ.get("BENCH_FLATOPT", "0") == "1")
 
     est.fit(data, **fit_kw)                 # warmup: compile + first epoch
     # Best of 3 timed epochs: the dev-tunnel chip's minute-to-minute

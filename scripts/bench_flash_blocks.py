@@ -40,7 +40,13 @@ def main():
         acc, = carry
         gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(
             q + (acc * 1e-20).astype(q.dtype), k, v)
-        return (acc + jnp.sum(gq.astype(jnp.float32)),)
+        # consume ALL grads: with gq alone, XLA dead-code-eliminates the
+        # separate dk/dv pallas_call and the two-kernel backward times
+        # only its dq half (round-5 finding — made the fused kernel look
+        # slower than the pair at equal tiles when it wasn't)
+        return (acc + jnp.sum(gq.astype(jnp.float32))
+                + jnp.sum(gk.astype(jnp.float32))
+                + jnp.sum(gv.astype(jnp.float32)),)
 
     run = jax.jit(
         lambda: jax.lax.fori_loop(0, iters, step, (jnp.float32(0),))[0])

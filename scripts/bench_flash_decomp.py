@@ -1,0 +1,95 @@
+"""Decompose flash fwd vs bwd cost per (fwd_block, bwd_block) combo.
+
+At dropout rate 0 the fwd/bwd tilings decouple, so this isolates where
+the backward time goes and whether the fused bwd kernel wins at shapes
+the dropout-coupled path cannot reach today.
+
+    python scripts/bench_flash_decomp.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if jax.default_backend() == "tpu":
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+from analytics_zoo_tpu.pallas.flash_attention import flash_attention
+
+
+def timeit(run, iters):
+    float(run())
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        float(run())
+        best = min(best, time.perf_counter() - t0)
+    return best / iters * 1e3
+
+
+def main():
+    rate = float(sys.argv[1]) if len(sys.argv) > 1 else 0.0
+    B, H, T, D = 16, 12, 2048, 64
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, T, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, H, T, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, H, T, D), jnp.bfloat16)
+    iters = 10
+
+    def fwd_only(bq, bk):
+        def f():
+            def body(i, acc):
+                o = flash_attention(q + (acc * 1e-20).astype(q.dtype), k, v,
+                                    dropout_rate=rate, dropout_seed=7,
+                                    block_q=bq, block_k=bk)
+                return acc + jnp.sum(o.astype(jnp.float32))
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
+        return timeit(jax.jit(f), iters)
+
+    def fwd_bwd(bq, bk, bbq, bbk):
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, dropout_rate=rate, dropout_seed=7,
+                                block_q=bq, block_k=bk,
+                                bwd_block_q=bbq, bwd_block_k=bbk)
+            return jnp.sum(o.astype(jnp.float32))
+
+        def f():
+            def body(i, acc):
+                # consume ALL grads: with gq alone, XLA dead-code-
+                # eliminates the separate dk/dv pallas_call and the
+                # two-kernel path times only HALF its backward
+                gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(
+                    q + (acc * 1e-20).astype(q.dtype), k, v)
+                return (acc + jnp.sum(gq.astype(jnp.float32))
+                        + jnp.sum(gk.astype(jnp.float32))
+                        + jnp.sum(gv.astype(jnp.float32)))
+            return jax.lax.fori_loop(0, iters, body, jnp.float32(0))
+        return timeit(jax.jit(f), iters)
+
+    for bq, bk in [(1024, 1024), (1024, 512)]:
+        print(f"fwd-only {bq}x{bk} rate {rate}: {fwd_only(bq, bk):.2f} ms",
+              flush=True)
+    combos = [
+        (1024, 1024, 1024, 1024),   # bwd: two-kernel
+        (1024, 1024, 1024, 512),    # bwd: fused (n_kb=4, 512k tile)
+        (1024, 1024, 512, 512),     # bwd: fused small
+        (1024, 1024, 2048, 512),    # bwd: gated? n_kb=4 but 1M tile -> pair
+    ]
+    for bq, bk, bbq, bbk in combos:
+        try:
+            ms = fwd_bwd(bq, bk, bbq, bbk)
+            print(f"fwd {bq}x{bk} + bwd {bbq}x{bbk} rate {rate}: "
+                  f"{ms:.2f} ms", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"fwd {bq}x{bk} + bwd {bbq}x{bbk}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

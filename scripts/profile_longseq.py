@@ -56,7 +56,8 @@ def main():
                   np.ones((n, 2048), np.float32)],
             "y": rs.randint(0, 2, (n,)).astype(np.int32)}
     fit_kw = dict(epochs=1, batch_size=args.batch,
-                  steps_per_run=args.steps, mixed_precision=True)
+                  steps_per_run=args.steps, mixed_precision=True,
+                  flat_optimizer=os.environ.get("PROF_FLAT", "0") == "1")
     est.fit(data, **fit_kw)
 
     trace_dir = tempfile.mkdtemp(prefix="longseq_prof_")
@@ -91,9 +92,22 @@ def main():
           f"steps {steps}  device/step {total/steps*1e3:.1f} ms")
     for c, s in sorted(cats.items(), key=lambda kv: -kv[1]):
         print(f"  {c:16s} {s/steps*1e3:8.2f} ms/step ({100*s/total:5.1f}%)")
-    print("\ntop 25 ops (ms/step):")
-    for name, s in sorted(per_op.items(), key=lambda kv: -kv[1])[:25]:
+    print("\ntop ops (ms/step):")
+    for name, s in sorted(per_op.items(), key=lambda kv: -kv[1])[:40]:
         print(f"  {s/steps*1e3:8.2f}  {name[:120]}")
+
+    # group by op-name base (strip %, trailing .NNN and shape suffix) so
+    # the long tail of per-tensor fusions becomes visible
+    import re
+    groups = defaultdict(lambda: [0.0, 0])
+    for name, s in per_op.items():
+        base = name.split(" = ")[0].strip().lstrip("%")
+        base = re.sub(r"[.\d]+$", "", base)
+        groups[base][0] += s
+        groups[base][1] += 1
+    print("\nop groups (ms/step, count):")
+    for base, (s, c) in sorted(groups.items(), key=lambda kv: -kv[1][0])[:30]:
+        print(f"  {s/steps*1e3:8.2f}  x{c:4d}  {base[:90]}")
 
 
 if __name__ == "__main__":

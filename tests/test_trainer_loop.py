@@ -83,6 +83,100 @@ class TestMultiStepRun:
         np.testing.assert_allclose(pa, pb, rtol=1e-5, atol=1e-6)
 
 
+class TestFlatOptimizer:
+    def test_matches_per_tensor_numerics(self):
+        # the raveled sweep is the SAME math: one adam over a flat
+        # vector must reproduce the per-tensor path bit-for-bit modulo
+        # reduction order (fp-tolerance), including across epochs
+        x, y = _toy_data(128)
+        ma, mb = _toy_model(), _toy_model()
+        ha = ma.fit(x, y, batch_size=32, nb_epoch=3, shuffle=False, seed=7)
+        hb = mb.fit(x, y, batch_size=32, nb_epoch=3, shuffle=False, seed=7,
+                    flat_optimizer=True)
+        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=1e-5)
+        pa = np.asarray(ma.predict(x, batch_per_thread=32))
+        pb = np.asarray(mb.predict(x, batch_per_thread=32))
+        np.testing.assert_allclose(pa, pb, rtol=1e-5, atol=1e-6)
+
+    def test_global_norm_clip_matches_per_tensor(self):
+        # clipping couples elements ACROSS buckets (one global L2 over
+        # the whole tree): the bucketed sweep must see the same norm
+        import optax
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+
+        def mk():
+            m = Sequential()
+            m.add(L.Dense(16, activation="relu", input_shape=(8,)))
+            m.add(L.Dense(1))
+            m.compile(optimizer=optax.chain(
+                optax.clip_by_global_norm(1e-3),   # always-active clip
+                optax.adam(1e-2)), loss="mse")
+            return m
+
+        x, y = _toy_data(128)
+        ma, mb = mk(), mk()
+        ha = ma.fit(x, y, batch_size=32, nb_epoch=2, shuffle=False, seed=7)
+        hb = mb.fit(x, y, batch_size=32, nb_epoch=2, shuffle=False, seed=7,
+                    flat_optimizer=True)
+        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(ma.params),
+                        jax.tree_util.tree_leaves(mb.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_spec_rebuilt_when_shapes_change(self):
+        # same tree structure, different leaf shapes (weights reloaded
+        # wider) must rebuild the bucket spec, not reuse a stale memo
+        from analytics_zoo_tpu.ops.flat_optimizer import ParamSpec
+        x, y = _toy_data(128)
+        m = _toy_model()
+        m.fit(x, y, batch_size=32, nb_epoch=1, flat_optimizer=True)
+        first = m._flat_spec_memo[1]
+        m.fit(x, y, batch_size=32, nb_epoch=1, flat_optimizer=True)
+        assert m._flat_spec_memo[1] is first      # unchanged -> reused
+        import jax.numpy as jnp
+        m.params = jax.tree_util.tree_map(
+            lambda a: jnp.concatenate([a, a], axis=-1), m.params)
+        spec = ParamSpec.from_tree(m.params)
+        assert spec.group_shapes != first.group_shapes
+
+    def test_multistep_and_refit_hit_cache(self):
+        # the flatten wrapper is memoized per (model, optimizer): a
+        # second fit must reuse the jitted program, and steps_per_run
+        # composes with the flat sweep
+        x, y = _toy_data(128)
+        m = _toy_model()
+        m.fit(x, y, batch_size=32, nb_epoch=1, shuffle=False, seed=7,
+              flat_optimizer=True, steps_per_run=2)
+        cached = m._train_cache
+        m.fit(x, y, batch_size=32, nb_epoch=1, shuffle=False, seed=7,
+              flat_optimizer=True, steps_per_run=2)
+        assert m._train_cache is cached
+        h = m.fit(x, y, batch_size=32, nb_epoch=10, flat_optimizer=True)
+        assert h["loss"][-1] < h["loss"][0]
+
+    def test_flat_ignored_with_lazy_embeddings(self):
+        # lazy row-sparse updates need the per-table tree; the flag must
+        # not break that path (documented as ignored)
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.keras import layers as L
+        from analytics_zoo_tpu.learn.lazy_embedding import LazyEmbeddingSpec
+        import jax.numpy as jnp
+        rs = np.random.RandomState(0)
+        x = rs.randint(0, 50, (64, 4)).astype(np.float32)
+        y = rs.randn(64, 4, 8).astype(np.float32)
+        m = Sequential()
+        m.add(L.Embedding(50, 8, input_shape=(4,)))
+        m.compile(optimizer="adam", loss="mse")
+        m.lazy_embedding_specs = [LazyEmbeddingSpec(
+            ("embedding_1", "embeddings"),
+            lambda xb: jnp.reshape(jnp.asarray(xb, jnp.int32), (-1,)))]
+        h = m.fit(x, y, batch_size=32, nb_epoch=2, flat_optimizer=True,
+                  lazy_embeddings=True)
+        assert len(h["loss"]) == 2
+
+
 class TestMixedPrecision:
     def test_bf16_compute_converges(self):
         x, y = _toy_data()
