@@ -498,16 +498,17 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     steps into one `lax.scan` program — one dispatch per k steps —
     trading trigger granularity (checked every k iterations) for dispatch
     overhead. `mixed_precision` runs fwd/bwd in bf16 with f32 masters.
-    `flat_optimizer=True` runs the optimizer sweep over ONE raveled
-    parameter buffer (`ops/flat_optimizer.py`) instead of per-tensor updates —
-    the TPU analogue of the reference's flat `AllReduceParameter`
-    (`Topology.scala:1204`). On BERT-base seq-2048 the per-tensor sweep
-    measured 153 separate ~9 MB fusions at 83 GB/s effective; flattened
-    it streams at HBM rate. Opt-in because it changes the
-    optimizer-state pytree (checkpoints within a run stay consistent;
-    per-tensor checkpoints won't resume under it) and tree-structure-
-    dependent transforms (e.g. `optax.masked` decay masks) don't
-    survive raveling. Ignored with `lazy_embeddings`.
+    `flat_optimizer=True` runs the optimizer sweep over shape-bucketed
+    stacked parameter buffers (`ops/flat_optimizer.py`) instead of
+    per-tensor updates — the TPU analogue of the reference's flat
+    `AllReduceParameter` (`Topology.scala:1204`). On BERT-base the
+    per-tensor sweep measured 153 separate ~9 MB fusions at 83 GB/s
+    effective; bucketed it streams at HBM rate (net effect is workload-
+    dependent — see docs/ROOFLINE.md round 5). Opt-in because it changes
+    the optimizer-state pytree (checkpoints within a run stay
+    consistent; per-tensor checkpoints won't resume under it) and
+    tree-structure-dependent transforms (e.g. `optax.masked` decay
+    masks) don't survive repacking. Ignored with `lazy_embeddings`.
     After fit, `model.params` holds DEVICE arrays (no gratuitous
     device→host pull; save/checkpoint paths transfer on demand)."""
     ctx = get_context()
@@ -611,10 +612,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         lazy_specs = resolve_specs(model)
     flat_spec = None
     if flat_optimizer and not lazy_specs:
-        # carry the master params as ONE [rows, 128] f32 buffer: the
-        # optimizer sweep becomes a single streaming program (vs 153
-        # per-tensor fusions at 83 GB/s on BERT-base) and the tree view
-        # only exists as slices fused into the forward pass
+        # carry the master params as shape-bucketed stacked buffers:
+        # the optimizer sweep becomes a few streaming fusions (vs 153
+        # per-tensor programs at 83 GB/s on BERT-base) and the tree view
+        # only exists as dim-0 slices fused into the forward pass
         from analytics_zoo_tpu.ops.flat_optimizer import ParamSpec
         spec_memo = getattr(model, "_flat_spec_memo", None)
         # keyed on structure AND shapes: reloading differently-shaped
