@@ -205,19 +205,26 @@ def main():
     # redis wire.
     here = os.path.dirname(os.path.abspath(__file__))
     if not tiny and os.environ.get("BENCH_NCF", "1") == "1":
+        # BENCH_CALIBRATE=1 also runs the Adam-shaped streaming sweep in
+        # this (timeout-guarded) child: the tunnel chip swings 10-20% day
+        # to day on IDENTICAL programs, so the achieved-GB/s yardstick is
+        # surfaced as session_hbm_gbps for reading cross-round MFU deltas
+        # against the session, not just the noise floor.
         r = _run_sub([sys.executable, os.path.join(here, "bench_ncf.py")],
-                     timeout=900)
+                     timeout=900,
+                     env=dict(os.environ, BENCH_CALIBRATE="1"))
         if r:
             out["ncf_samples_per_sec"] = r.get("value")
             out["ncf_hbm_utilization_pct"] = r.get("hbm_utilization_pct")
             out["ncf_step_ms"] = r.get("step_ms")
             out["ncf_bound"] = r.get("bound")
+            out["session_hbm_gbps"] = r.get("achieved_hbm_gbps")
             if r.get("achieved_hbm_gbps") is not None:
-                out["ncf_achieved_hbm_gbps"] = r.get("achieved_hbm_gbps")
                 out["ncf_pct_of_achievable_bound"] = \
                     r.get("pct_of_achievable_bound")
         else:
             out["ncf_samples_per_sec"] = None
+            out["session_hbm_gbps"] = None
     if not tiny and os.environ.get("BENCH_SERVING", "1") == "1":
         # CPU backend for the serving stack: on dev rigs the TPU sits
         # behind an HTTP tunnel whose ~100 ms round trip per dispatch
