@@ -627,7 +627,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             spec_memo = (key, ParamSpec.from_tree(params))
             model._flat_spec_memo = spec_memo
         flat_spec = spec_memo[1]
-        params = jax.jit(flat_spec.ravel)(params)
+        params = flat_spec.ravel_device(params)
 
     def _as_tree(p):
         """Touch-point view: checkpoints, validation and the final
@@ -649,10 +649,12 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if use_device_cache:
         cache_key = (id(optimizer), id(model.loss), "devcache",
                      mixed_precision, lazy_embeddings, dc_steps,
-                     local_batch, shuffle, flat_optimizer)
+                     local_batch, shuffle,
+                     flat_spec.uid if flat_spec else None)
     else:
         cache_key = (id(optimizer), id(model.loss), multi,
-                     mixed_precision, lazy_embeddings, flat_optimizer)
+                     mixed_precision, lazy_embeddings,
+                     flat_spec.uid if flat_spec else None)
     cached = getattr(model, "_train_cache", None)
     if cached is not None and cached[0] == cache_key:
         train_step = cached[1]

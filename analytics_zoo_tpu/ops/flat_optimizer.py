@@ -13,10 +13,15 @@ layouts and why ``optax.flatten`` compile-OOMs on TPU at BERT scale).
 
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+_spec_uids = itertools.count()
+
 
 class ParamSpec:
     """Static description of a parameter pytree for bucket-packed training.
@@ -56,6 +61,10 @@ class ParamSpec:
                              for g in range(len(self.group_shapes))]
         self.n = sum(int(np.prod(s)) if s else 1 for s in shapes)
         self._unravel_jit = None
+        self._ravel_jit = None
+        # monotonic identity for compile-cache keys: id() of a replaced
+        # spec can be recycled by the allocator after GC
+        self.uid = next(_spec_uids)
 
     @classmethod
     def from_tree(cls, tree) -> "ParamSpec":
@@ -94,3 +103,11 @@ class ParamSpec:
         if self._unravel_jit is None:
             self._unravel_jit = jax.jit(self.unravel)
         return self._unravel_jit(flat2d)
+
+    def ravel_device(self, tree):
+        """jit'd ravel, compiled once per spec: warm-restart fit calls
+        must hit the compile cache, not re-trace the packing program
+        (a fresh jax.jit wrapper per call would be keyed on itself)."""
+        if self._ravel_jit is None:
+            self._ravel_jit = jax.jit(self.ravel)
+        return self._ravel_jit(tree)
