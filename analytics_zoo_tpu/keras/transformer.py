@@ -235,6 +235,29 @@ class TransformerLayer(Layer):
         return (input_shape[0], self.seq_len, self.hidden_size)
 
 
+def stack_block_params(params: dict, n_block: int, prefix: str) -> dict:
+    """Convert an UNSTACKED BERT param tree (per-block subtrees named
+    `{prefix}_block{i}`) to the stacked layout (`blocks` = one [L, ...]
+    buffer per tensor). Inverse: `unstack_block_params`. Used to move
+    imported artifacts (TF-checkpoint weights load into the unstacked
+    naming) onto a `stacked=True` encoder."""
+    per_block = [params[f"{prefix}_block{i}"] for i in range(n_block)]
+    out = {k: v for k, v in params.items()
+           if not k.startswith(prefix + "_block")}
+    out["blocks"] = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *per_block)
+    return out
+
+
+def unstack_block_params(params: dict, n_block: int, prefix: str) -> dict:
+    """Inverse of `stack_block_params`."""
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    for i in range(n_block):
+        out[f"{prefix}_block{i}"] = jax.tree_util.tree_map(
+            lambda x, _i=i: x[_i], params["blocks"])
+    return out
+
+
 class BERT(Layer):
     """BERT encoder as a layer (`keras/layers/BERT.scala:66`). Inputs:
     [token_ids, token_type_ids, attention_mask] (position ids are implicit);
@@ -246,13 +269,16 @@ class BERT(Layer):
                  seq_len: int = 512, intermediate_size: int = 3072,
                  type_vocab: int = 2, hidden_drop: float = 0.1,
                  attn_drop: float = 0.1, pooled_only: bool = False,
-                 use_flash: bool = False, remat: bool = False, **kw):
+                 use_flash: bool = False, remat: bool = False,
+                 stacked: bool = False, **kw):
         super().__init__(**kw)
         self.vocab, self.hidden_size = vocab, hidden_size
         self.seq_len, self.type_vocab = seq_len, type_vocab
         self.hidden_drop = hidden_drop
         self.pooled_only = pooled_only
         self.remat = remat
+        self.stacked = stacked
+        self.n_block = n_block
         self.blocks = [
             TransformerEncoderBlock(hidden_size, n_head, intermediate_size,
                                     hidden_dropout=hidden_drop,
@@ -278,9 +304,55 @@ class BERT(Layer):
             "pooler_bias": jnp.zeros((self.hidden_size,), jnp.float32),
         }
         h_shape = (None, self.seq_len, self.hidden_size)
-        for blk, k in zip(self.blocks, keys[5:]):
-            p[blk.name] = blk.build(k, h_shape)
+        per_block = [blk.build(k, h_shape)
+                     for blk, k in zip(self.blocks, keys[5:])]
+        if self.stacked:
+            # ONE [L, ...] buffer per block tensor; `call` lax.scans the
+            # block over dim 0. Why: (a) gradients are BORN stacked, so
+            # the optimizer phase is ~15 big streaming fusions instead of
+            # 12x13 small ones (the per-tensor Adam sweep measured 37
+            # ms/step on BERT-base, 21% of the seq-128 step — and
+            # repacking per-leaf grads after the fact costs the saving
+            # back, docs/ROOFLINE.md round 5); (b) the block compiles
+            # ONCE instead of 12 times. Same math, same init as the
+            # unstacked form (`stack_block_params` converts either way).
+            p["blocks"] = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *per_block)
+        else:
+            for blk, bp in zip(self.blocks, per_block):
+                p[blk.name] = bp
         return p
+
+    def _scan_blocks(self, stacked_params, h, mask, training, rng):
+        """lax.scan the (single, shared-code) encoder block over the
+        leading [L, ...] dim of the stacked params — identical math to
+        the unstacked loop (per-layer weights, per-layer dropout keys),
+        one compiled block body, gradients accumulated directly into the
+        stacked buffers by scan's transpose."""
+        blk = self.blocks[0]
+
+        def run_block(bp, hh, key):
+            fn = lambda p, a, m, r: blk.call(  # noqa: E731
+                p, [a, m], training=training, rng=r)
+            if self.remat:
+                fn = jax.checkpoint(
+                    fn, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            return fn(bp, hh, mask, key)
+
+        if rng is not None:
+            layer_keys = jax.random.split(rng, self.n_block)
+
+            def body(hh, xs):
+                bp, key = xs
+                return run_block(bp, hh, key), None
+
+            h, _ = jax.lax.scan(body, h, (stacked_params, layer_keys))
+        else:
+            h, _ = jax.lax.scan(
+                lambda hh, bp: (run_block(bp, hh, None), None),
+                h, stacked_params)
+        return h
 
     @staticmethod
     def make_mask(attention_mask) -> jax.Array:
@@ -313,26 +385,30 @@ class BERT(Layer):
             rng, sub = jax.random.split(rng)
             h = _dropout(sub, self.hidden_drop, h)
         mask = self.make_mask(attn_mask)
-        for blk in self.blocks:
-            sub = None
-            if rng is not None:
-                rng, sub = jax.random.split(rng)
-            if self.remat:
-                # activation rematerialization per block: save only the
-                # matmul outputs with no batch dims (i.e. nothing — all
-                # block dots carry the batch), recompute the rest in the
-                # backward pass. Trades ~1/3 more FLOPs on the block for
-                # O(1) blocks of live activations, unlocking batch sizes
-                # (and seq lengths) the non-remat program cannot fit.
-                h = jax.checkpoint(
-                    lambda p, hh, mm, rr, _blk=blk: _blk.call(
-                        p, [hh, mm], training=training, rng=rr),
-                    policy=jax.checkpoint_policies
-                    .dots_with_no_batch_dims_saveable)(
-                        params[blk.name], h, mask, sub)
-            else:
-                h = blk.call(params[blk.name], [h, mask],
-                             training=training, rng=sub)
+        if self.stacked:
+            h = self._scan_blocks(params["blocks"], h, mask, training, rng)
+        else:
+            for blk in self.blocks:
+                sub = None
+                if rng is not None:
+                    rng, sub = jax.random.split(rng)
+                if self.remat:
+                    # activation rematerialization per block: save only
+                    # the matmul outputs with no batch dims (i.e. nothing
+                    # — all block dots carry the batch), recompute the
+                    # rest in the backward pass. Trades ~1/3 more FLOPs
+                    # on the block for O(1) blocks of live activations,
+                    # unlocking batch sizes (and seq lengths) the
+                    # non-remat program cannot fit.
+                    h = jax.checkpoint(
+                        lambda p, hh, mm, rr, _blk=blk: _blk.call(
+                            p, [hh, mm], training=training, rng=rr),
+                        policy=jax.checkpoint_policies
+                        .dots_with_no_batch_dims_saveable)(
+                            params[blk.name], h, mask, sub)
+                else:
+                    h = blk.call(params[blk.name], [h, mask],
+                                 training=training, rng=sub)
         pooled = jnp.tanh(maybe_int8_matmul(h[:, 0], params,
                                             "pooler_kernel")
                           + params["pooler_bias"])

@@ -175,6 +175,11 @@ def load_tf_checkpoint(bert: BERT, ckpt_path: str,
         return np.asarray(reader.get_tensor(full))
 
     p = jax.tree_util.tree_map(np.asarray, params)  # mutable copy
+    if bert.stacked:
+        # import targets the per-block naming; convert the stacked tree
+        # out and back (`keras/transformer.py` converters)
+        from analytics_zoo_tpu.keras.transformer import unstack_block_params
+        p = unstack_block_params(p, bert.n_block, bert.name)
     p["word_embeddings"] = get("embeddings/word_embeddings")
     p["position_embeddings"] = get("embeddings/position_embeddings")
     p["token_type_embeddings"] = get("embeddings/token_type_embeddings")
@@ -207,6 +212,9 @@ def load_tf_checkpoint(bert: BERT, ckpt_path: str,
                      "beta": get(f"{base}/output/LayerNorm/beta")}
         p[blk.name] = bp
     # shape validation against the existing tree
+    if bert.stacked:
+        from analytics_zoo_tpu.keras.transformer import stack_block_params
+        p = stack_block_params(p, bert.n_block, bert.name)
     ref_shapes = jax.tree_util.tree_map(np.shape, params)
     new_shapes = jax.tree_util.tree_map(np.shape, p)
     if ref_shapes != new_shapes:

@@ -105,6 +105,14 @@ def _quantize_raw_kernels(tree):
                 and np.ndim(v) == 2:
             q, scale = _quantize_tensor(v, (0,))
             out[k + "_q"], out[k + "_scale"] = q, scale
+        elif k in _RAW_INT8_KERNELS and not isinstance(v, dict) \
+                and np.ndim(v) == 3:
+            # stacked encoder (`BERT(stacked=True)`): [L, in, out] — the
+            # scan body slices dim 0, so quantize per (layer, out_channel)
+            # and the sliced leaves ([in, out] int8 + [out] scale) hit
+            # the same int8_matmul path as the unstacked form
+            q, scale = _quantize_tensor(v, (1,))
+            out[k + "_q"], out[k + "_scale"] = q, scale
         else:
             out[k] = _quantize_raw_kernels(v)
     return out

@@ -58,7 +58,13 @@ def _measure_bert(dev, *, vocab, hidden, n_block, n_head, seq_len, inter,
     model = BERTClassifier(
         num_classes=2, vocab=vocab, hidden_size=hidden, n_block=n_block,
         n_head=n_head, seq_len=seq_len, intermediate_size=inter,
-        use_flash=use_flash, remat=remat, **drop_kw)
+        use_flash=use_flash, remat=remat,
+        # scan-over-layers (stacked block params): collapses the Adam
+        # phase but lax.scan's conservative residual saving OOMs the
+        # batch-256/seq-2048 bench configs on a 16 GB chip and its
+        # residual writes eat the win at batch 128 — measured wash;
+        # docs/ROOFLINE.md round 5. Off by default.
+        stacked=os.environ.get("BENCH_STACKED", "0") == "1", **drop_kw)
     est = Estimator.from_keras(
         model, optimizer=optax.adamw(1e-4),
         loss=objectives.get("sparse_categorical_crossentropy",

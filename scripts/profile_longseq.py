@@ -30,6 +30,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--no-flash", action="store_true")
     args = ap.parse_args()
 
     from analytics_zoo_tpu import init_orca_context
@@ -45,15 +47,17 @@ def main():
 
     model = BERTClassifier(
         num_classes=2, vocab=30522, hidden_size=768, n_block=12, n_head=12,
-        seq_len=2048, intermediate_size=3072, use_flash=True, remat=False)
+        seq_len=args.seq, intermediate_size=3072,
+        use_flash=not args.no_flash, remat=False,
+        stacked=os.environ.get("PROF_STACKED", "0") == "1")
     est = Estimator.from_keras(
         model, optimizer=optax.adamw(1e-4),
         loss=objectives.get("sparse_categorical_crossentropy",
                             from_logits=True))
     rs = np.random.RandomState(0)
     n = args.batch * args.steps
-    data = {"x": [rs.randint(0, 30522, (n, 2048)).astype(np.int32),
-                  np.ones((n, 2048), np.float32)],
+    data = {"x": [rs.randint(0, 30522, (n, args.seq)).astype(np.int32),
+                  np.ones((n, args.seq), np.float32)],
             "y": rs.randint(0, 2, (n,)).astype(np.int32)}
     fit_kw = dict(epochs=1, batch_size=args.batch,
                   steps_per_run=args.steps, mixed_precision=True,

@@ -122,6 +122,26 @@ class TestTFCheckpointImport:
         out = m.apply(m.params, bert_inputs())
         assert np.isfinite(np.asarray(out)).all()
 
+    def test_import_onto_stacked_matches_sequential(self, ckpt):
+        # stacked=True stores one [L, ...] buffer per block tensor; the
+        # importer must unstack/load/restack and produce the SAME logits
+        # as importing onto the sequential form
+        m_seq = BERTClassifier(num_classes=2, **TINY)
+        m_seq.ensure_built(bert_inputs())
+        m_seq.load_tf_checkpoint(ckpt)
+        m_stk = BERTClassifier(num_classes=2, stacked=True, **TINY)
+        m_stk.ensure_built(bert_inputs())
+        m_stk.load_tf_checkpoint(ckpt)
+        assert "blocks" in m_stk.params[m_stk.bert.name]
+        # classifier heads start random — compare the ENCODER outputs
+        # (classifier BERTs are pooled_only: call returns just pooled)
+        pool1 = m_seq.bert.call(
+            m_seq.params[m_seq.bert.name], bert_inputs(), training=False)
+        pool2 = m_stk.bert.call(
+            m_stk.params[m_stk.bert.name], bert_inputs(), training=False)
+        np.testing.assert_allclose(np.asarray(pool1), np.asarray(pool2),
+                                   rtol=1e-5, atol=1e-5)
+
     def test_wrong_config_rejected(self, ckpt):
         m = BERTClassifier(num_classes=2, vocab=64, hidden_size=32,
                            n_block=2, n_head=2, seq_len=8,

@@ -1,0 +1,137 @@
+"""Stacked (scan-over-layers) BERT: parity with the sequential form.
+
+`BERT(stacked=True)` carries one [L, ...] buffer per block tensor and
+`lax.scan`s a single compiled block over dim 0 — same math as the
+unstacked loop (per-layer weights, per-layer dropout keys), different
+memory/compile characteristics (docs/ROOFLINE.md round 5). These tests
+pin the conversion round-trip and exact numerical parity so either form
+can serve the other's checkpoints.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.keras.transformer import (BERT, stack_block_params,
+                                                 unstack_block_params)
+
+_KW = dict(vocab=200, hidden_size=32, n_block=3, n_head=2, seq_len=16,
+           intermediate_size=64, name="bert")
+
+
+def _data(rs, n=4):
+    return (rs.randint(0, 200, (n, 16)).astype(np.int32),
+            np.ones((n, 16), np.float32))
+
+
+class TestStackedParity:
+    def test_forward_and_grad_match_sequential(self):
+        rs = np.random.RandomState(0)
+        b_seq, b_stk = BERT(**_KW), BERT(stacked=True, **_KW)
+        p_seq = b_seq.build(jax.random.PRNGKey(0), None)
+        p_stk = stack_block_params(p_seq, 3, "bert")
+        ids, m = _data(rs)
+
+        o1, pool1 = b_seq.call(p_seq, [ids, m], training=False)
+        o2, pool2 = b_stk.call(p_stk, [ids, m], training=False)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(pool1), np.asarray(pool2),
+                                   rtol=1e-5, atol=1e-5)
+
+        g1 = jax.grad(lambda p: jnp.sum(
+            b_seq.call(p, [ids, m], training=False)[1]))(p_seq)
+        g2 = jax.grad(lambda p: jnp.sum(
+            b_stk.call(p, [ids, m], training=False)[1]))(p_stk)
+        g1s = stack_block_params(g1, 3, "bert")
+        for a, b in zip(jax.tree_util.tree_leaves(g1s),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_remat_matches_and_training_runs(self):
+        rs = np.random.RandomState(1)
+        b_stk = BERT(stacked=True, **_KW)
+        b_rm = BERT(stacked=True, remat=True, **_KW)
+        p = b_stk.build(jax.random.PRNGKey(1), None)
+        ids, m = _data(rs)
+        o, _ = b_stk.call(p, [ids, m], training=False)
+        o_rm, _ = b_rm.call(p, [ids, m], training=False)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_rm),
+                                   rtol=1e-6, atol=1e-6)
+        g = jax.grad(lambda q: jnp.sum(
+            b_stk.call(q, [ids, m], training=False)[1]))(p)
+        g_rm = jax.grad(lambda q: jnp.sum(
+            b_rm.call(q, [ids, m], training=False)[1]))(p)
+        for a, b in zip(jax.tree_util.tree_leaves(g),
+                        jax.tree_util.tree_leaves(g_rm)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+        # training path (per-layer dropout keys inside the scan) runs
+        o_tr = b_rm.call(p, [ids, m], training=True,
+                         rng=jax.random.PRNGKey(7))
+        assert bool(jnp.isfinite(o_tr[0]).all())
+
+    def test_stack_unstack_roundtrip(self):
+        b_seq = BERT(**_KW)
+        p_seq = b_seq.build(jax.random.PRNGKey(2), None)
+        p_stk = stack_block_params(p_seq, 3, "bert")
+        back = unstack_block_params(p_stk, 3, "bert")
+        sort_key = lambda kv: str(kv[0])  # noqa: E731
+        for (ka, a), (kb, b) in zip(
+                sorted(jax.tree_util.tree_leaves_with_path(p_seq),
+                       key=sort_key),
+                sorted(jax.tree_util.tree_leaves_with_path(back),
+                       key=sort_key)):
+            assert str(ka) == str(kb)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_int8_quantization_covers_stacked_blocks(self):
+        # the [L, in, out] stacked kernels must quantize per
+        # (layer, out_channel) — a 2-D-only rewrite would silently serve
+        # the whole encoder in float
+        from analytics_zoo_tpu.models.bert import BERTClassifier
+        from analytics_zoo_tpu.serving.quantization import (
+            quantize_model_params)
+        rs = np.random.RandomState(5)
+        m = BERTClassifier(num_classes=2, vocab=200, hidden_size=32,
+                           n_block=2, n_head=2, seq_len=16,
+                           intermediate_size=64, stacked=True)
+        x = [rs.randint(0, 200, (4, 16)).astype(np.int32),
+             np.ones((4, 16), np.float32)]
+        m.ensure_built(x)
+        q = quantize_model_params(m, jax.device_get(m.params))
+        blocks = q[m.bert.name]["blocks"]
+        for key in ("ffn_in_kernel", "ffn_out_kernel"):
+            assert key + "_q" in blocks and key not in blocks
+            assert blocks[key + "_q"].dtype == np.int8
+            assert blocks[key + "_q"].ndim == 3          # [L, in, out]
+            assert blocks[key + "_scale"].shape == \
+                blocks[key + "_q"].shape[::2]            # [L, out]
+        assert "qkv_kernel_q" in blocks["attn"]
+        # the quantized forward runs and stays close to f32
+        y_f32 = np.asarray(m.apply(m.params, x, training=False))
+        y_q = np.asarray(m.apply(q, x, training=False))
+        assert np.isfinite(y_q).all()
+        assert np.max(np.abs(y_f32 - y_q)) < 0.3
+
+    def test_fit_through_estimator(self):
+        # the flagship path: BERTClassifier(stacked=True) end-to-end
+        import optax
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        from analytics_zoo_tpu.models.bert import BERTClassifier
+        from analytics_zoo_tpu.ops import objectives
+        rs = np.random.RandomState(3)
+        model = BERTClassifier(
+            num_classes=2, vocab=200, hidden_size=32, n_block=2, n_head=2,
+            seq_len=16, intermediate_size=64, stacked=True)
+        est = Estimator.from_keras(
+            model, optimizer=optax.adamw(1e-3),
+            loss=objectives.get("sparse_categorical_crossentropy",
+                                from_logits=True))
+        n = 32
+        data = {"x": [rs.randint(0, 200, (n, 16)).astype(np.int32),
+                      np.ones((n, 16), np.float32)],
+                "y": rs.randint(0, 2, (n,)).astype(np.int32)}
+        h = est.fit(data, epochs=2, batch_size=8, mixed_precision=True)
+        assert np.isfinite(h["loss"]).all()
