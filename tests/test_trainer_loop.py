@@ -181,10 +181,13 @@ class TestFlatOptimizer:
         x = rs.randint(0, 50, (64, 4)).astype(np.float32)
         y = rs.randn(64, 4, 8).astype(np.float32)
         m = Sequential()
-        m.add(L.Embedding(50, 8, input_shape=(4,)))
+        emb = L.Embedding(50, 8, input_shape=(4,))
+        m.add(emb)
         m.compile(optimizer="adam", loss="mse")
+        # auto-numbered layer names are a global counter — read the real
+        # name rather than assuming this test ran first
         m.lazy_embedding_specs = [LazyEmbeddingSpec(
-            ("embedding_1", "embeddings"),
+            (emb.name, "embeddings"),
             lambda xb: jnp.reshape(jnp.asarray(xb, jnp.int32), (-1,)))]
         h = m.fit(x, y, batch_size=32, nb_epoch=2, flat_optimizer=True,
                   lazy_embeddings=True)
