@@ -115,14 +115,34 @@ def _measure_bert(dev, *, vocab, hidden, n_block, n_head, seq_len, inter,
 
 
 def _run_sub(cmd, timeout, env=None):
-    """Run a sibling benchmark; return its last-line JSON or None."""
+    """Run a sibling benchmark; return its last-line JSON or None. A
+    failed child reports its stderr tail to OUR stderr — the driver's
+    one shot at the round bench must not fail blind. Sets
+    `_run_sub.timed_out` so callers can distinguish a fast crash (worth
+    retrying) from a full-timeout hang (retrying doubles the cost)."""
+    _run_sub.timed_out = False
     try:
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout, env=env)
         last = [ln for ln in res.stdout.strip().splitlines()
                 if ln.startswith("{")]
-        return json.loads(last[-1]) if last else None
-    except (subprocess.TimeoutExpired, OSError, json.JSONDecodeError):
+        if last:
+            return json.loads(last[-1])
+        tail = "\n".join((res.stderr or "").strip().splitlines()[-8:])
+        print(f"bench child {cmd[-1]} produced no JSON (rc={res.returncode})"
+              f":\n{tail}", file=sys.stderr)
+        return None
+    except subprocess.TimeoutExpired as e:
+        _run_sub.timed_out = True
+        err = e.stderr or b""
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        tail = "\n".join(err.strip().splitlines()[-8:])
+        print(f"bench child {cmd[-1]} timed out after {timeout}s:\n{tail}",
+              file=sys.stderr)
+        return None
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench child {cmd[-1]} failed: {e}", file=sys.stderr)
         return None
 
 
@@ -200,6 +220,15 @@ def main():
         env = dict(os.environ, BENCH_LONGSEQ_CHILD="1")
         r = _run_sub([sys.executable, os.path.abspath(__file__)],
                      timeout=1800, env=env)
+        if r is None and not _run_sub.timed_out:
+            # one retry on a FAST failure only: the dev-tunnel TPU worker
+            # occasionally crashes under load and recovers within ~30 s —
+            # a transient must not cost the round its long-sequence
+            # headline. A timeout is a deterministic hang; retrying it
+            # would double a ~30-minute wait for the same outcome.
+            time.sleep(30)
+            r = _run_sub([sys.executable, os.path.abspath(__file__)],
+                         timeout=1800, env=env)
         if r:
             out.update(r)
         else:
