@@ -114,6 +114,26 @@ def _measure_bert(dev, *, vocab, hidden, n_block, n_head, seq_len, inter,
             float(hist["loss"][-1]), noise_frac)
 
 
+def _text(buf) -> str:
+    """bytes/str/None → str (TimeoutExpired carries raw bytes even under
+    text=True)."""
+    if buf is None:
+        return ""
+    if isinstance(buf, bytes):
+        return buf.decode(errors="replace")
+    return buf
+
+
+def _last_json(stdout):
+    last = [ln for ln in _text(stdout).strip().splitlines()
+            if ln.startswith("{")]
+    return json.loads(last[-1]) if last else None
+
+
+def _tail(stderr) -> str:
+    return "\n".join(_text(stderr).strip().splitlines()[-8:])
+
+
 def _run_sub(cmd, timeout, env=None):
     """Run a sibling benchmark; return its last-line JSON or None. A
     failed child reports its stderr tail to OUR stderr — the driver's
@@ -124,23 +144,23 @@ def _run_sub(cmd, timeout, env=None):
     try:
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout, env=env)
-        last = [ln for ln in res.stdout.strip().splitlines()
-                if ln.startswith("{")]
-        if last:
-            return json.loads(last[-1])
-        tail = "\n".join((res.stderr or "").strip().splitlines()[-8:])
+        r = _last_json(res.stdout)
+        if r is not None:
+            return r
         print(f"bench child {cmd[-1]} produced no JSON (rc={res.returncode})"
-              f":\n{tail}", file=sys.stderr)
+              f":\n{_tail(res.stderr)}", file=sys.stderr)
         return None
     except subprocess.TimeoutExpired as e:
         _run_sub.timed_out = True
-        err = e.stderr or b""
-        if isinstance(err, bytes):
-            err = err.decode(errors="replace")
-        tail = "\n".join(err.strip().splitlines()[-8:])
-        print(f"bench child {cmd[-1]} timed out after {timeout}s:\n{tail}",
-              file=sys.stderr)
-        return None
+        print(f"bench child {cmd[-1]} timed out after {timeout}s:"
+              f"\n{_tail(e.stderr)}", file=sys.stderr)
+        # a child can complete its measurement and then hang in runtime
+        # teardown (known tunnel-rig mode): recover a JSON line it
+        # already printed rather than nulling the field
+        try:
+            return _last_json(e.stdout)
+        except json.JSONDecodeError:
+            return None
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench child {cmd[-1]} failed: {e}", file=sys.stderr)
         return None

@@ -174,6 +174,36 @@ class TestFitOnChip:
         assert np.isfinite(h["loss"]).all()
         assert h["loss"][-1] <= h["loss"][0] + 0.1  # training, not diverging
 
+    def test_stacked_bert_fit_on_chip(self):
+        """BERT(stacked=True) through Estimator.fit on the real chip:
+        lax.scan over stacked block params + Mosaic dropout kernels
+        inside the scan body — interactions the CPU parity tests can't
+        exercise."""
+        import optax
+
+        from analytics_zoo_tpu.common.context import (init_orca_context,
+                                                      stop_orca_context)
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        from analytics_zoo_tpu.models.bert import BERTClassifier
+        from analytics_zoo_tpu.ops import objectives
+        stop_orca_context()
+        init_orca_context(cluster_mode="local")
+        rs = np.random.RandomState(0)
+        model = BERTClassifier(
+            num_classes=2, vocab=500, hidden_size=64, n_block=3, n_head=4,
+            seq_len=32, intermediate_size=128, stacked=True)
+        est = Estimator.from_keras(
+            model, optimizer=optax.adamw(1e-3),
+            loss=objectives.get("sparse_categorical_crossentropy",
+                                from_logits=True))
+        n = 64
+        data = {"x": [rs.randint(0, 500, (n, 32)).astype(np.int32),
+                      np.ones((n, 32), np.float32)],
+                "y": rs.randint(0, 2, (n,)).astype(np.int32)}
+        h = est.fit(data, epochs=2, batch_size=16, mixed_precision=True,
+                    steps_per_run=2)
+        assert np.isfinite(h["loss"]).all()
+
     def test_flat_optimizer_fit_on_chip(self):
         """fit(flat_optimizer=True) ON the chip: the bucketed parameter
         packing exists precisely because TPU layout assignment rejected
