@@ -141,6 +141,10 @@ def _run_sub(cmd, timeout, env=None):
     `_run_sub.timed_out` so callers can distinguish a fast crash (worth
     retrying) from a full-timeout hang (retrying doubles the cost)."""
     _run_sub.timed_out = False
+    # unbuffered child stdout: a block-buffered JSON line would die with
+    # the child's userspace buffer when a teardown hang forces a kill,
+    # making the timeout-recovery path below a no-op
+    env = dict(env if env is not None else os.environ, PYTHONUNBUFFERED="1")
     try:
         res = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout, env=env)
@@ -274,12 +278,14 @@ def main():
             out["ncf_step_ms"] = r.get("step_ms")
             out["ncf_bound"] = r.get("bound")
             out["session_hbm_gbps"] = r.get("achieved_hbm_gbps")
+            out["session_mxu_tflops"] = r.get("achieved_mxu_tflops")
             if r.get("achieved_hbm_gbps") is not None:
                 out["ncf_pct_of_achievable_bound"] = \
                     r.get("pct_of_achievable_bound")
         else:
             out["ncf_samples_per_sec"] = None
             out["session_hbm_gbps"] = None
+            out["session_mxu_tflops"] = None
     if not tiny and os.environ.get("BENCH_SERVING", "1") == "1":
         # CPU backend for the serving stack: on dev rigs the TPU sits
         # behind an HTTP tunnel whose ~100 ms round trip per dispatch

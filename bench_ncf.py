@@ -113,13 +113,14 @@ def main():
     # Adam-shaped 7-pass sweep (the tunnel chip swings 0.3-1x of
     # nameplate day to day; docs/ROOFLINE.md round-5 NCF section) so the
     # bound can be judged against what the chip can actually stream.
-    achieved_gbps = pct_achievable = None
+    achieved_gbps = pct_achievable = achieved_tflops = None
     if os.environ.get("BENCH_CALIBRATE") == "1":
         # the sweep itself needs no analytic byte model — run it even in
         # lazy mode so the session yardstick (bench.py session_hbm_gbps)
         # survives A/B configurations; only the bound comparison needs
         # bytes_step
         achieved_gbps = _calibrate_hbm(n_params)
+        achieved_tflops = _calibrate_mxu()
         if bytes_step is not None:
             floor_s = bytes_step / (achieved_gbps * 1e9)
             pct_achievable = round(100 * floor_s / (dt / steps), 1)
@@ -140,6 +141,7 @@ def main():
         "lazy_embeddings": lazy,
         "device": getattr(dev, "device_kind", str(dev)),
         "achieved_hbm_gbps": achieved_gbps,
+        "achieved_mxu_tflops": achieved_tflops,
         "pct_of_achievable_bound": pct_achievable,
         "final_loss": float(hist["loss"][-1]),
     }))
@@ -177,6 +179,38 @@ def _calibrate_hbm(n_params: int, iters: int = 1000) -> float:
         float(jnp.sum(r[0]))
         best = min(best, time.perf_counter() - t0)
     return round(iters * 7 * 4 * n_params / best / 1e9, 1)
+
+
+def _calibrate_mxu(n: int = 4096, iters: int = 400) -> float:
+    """Achieved bf16 TFLOP/s for a chained n×n matmul, `iters` in one
+    dispatch (~0.3-0.6 s of pure MXU work). Companion to _calibrate_hbm:
+    the tunnel chip's degraded windows measured a HEALTHY bandwidth
+    sweep while the same cached BERT step ran 45% slow — whatever
+    contends is visible on sustained compute, not short streaming
+    bursts, so session health needs both axes."""
+    import jax.numpy as jnp
+
+    a = jnp.full((n, n), 0.01, jnp.bfloat16)
+    b = jnp.full((n, n), 0.01, jnp.bfloat16)
+
+    @jax.jit
+    def run(a, b):
+        # y = x.b has entries 0.01*n*x; rescale by exactly that factor so
+        # the carry stays ~0.01 (a stronger scale underflows bf16 to zero
+        # within ~20 iterations and the sweep times zero matrices)
+        inv = jnp.asarray(1.0 / (0.01 * n), jnp.bfloat16)
+
+        def body(_, x):
+            return jnp.dot(x, b) * inv
+        return jax.lax.fori_loop(0, iters, body, a)
+
+    float(jnp.sum(run(a, b).astype(jnp.float32)))   # warm
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        float(jnp.sum(run(a, b).astype(jnp.float32)))
+        best = min(best, time.perf_counter() - t0)
+    return round(iters * 2 * n**3 / best / 1e12, 1)
 
 
 if __name__ == "__main__":
