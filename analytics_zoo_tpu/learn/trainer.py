@@ -744,10 +744,18 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
                         tg.TriggerState(epoch=epoch, iteration=iteration,
                                         loss=last_loss)):
+                    # params save in TREE layout (unraveled) but a flat
+                    # run's opt_state stays in bucketed-tuple layout:
+                    # record which, so a future restore can't silently
+                    # structurally mismatch the two
                     ckpt_mgr.save(iteration, jax.device_get(_as_tree(params)),
                                   jax.device_get(opt_state),
                                   extra={"epoch": epoch,
-                                         "iteration": iteration})
+                                         "iteration": iteration,
+                                         "opt_state_layout":
+                                             "flat_bucketed"
+                                             if flat_spec is not None
+                                             else "tree"})
                 if end_trigger and end_trigger(
                         tg.TriggerState(epoch=epoch, iteration=iteration,
                                         loss=last_loss)):
@@ -792,7 +800,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                                   epoch_finished=True)):
               ckpt_mgr.save(iteration, jax.device_get(_as_tree(params)),
                             jax.device_get(opt_state),
-                            extra={"epoch": epoch + 1, "iteration": iteration})
+                            extra={"epoch": epoch + 1,
+                                   "iteration": iteration,
+                                   "opt_state_layout": "flat_bucketed"
+                                   if flat_spec is not None else "tree"})
           if end_trigger and end_trigger(
                   tg.TriggerState(epoch=epoch + 1, iteration=iteration,
                                   epoch_finished=True)):

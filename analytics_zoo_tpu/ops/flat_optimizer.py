@@ -49,16 +49,21 @@ class ParamSpec:
     def __init__(self, treedef, shapes):
         self.treedef = treedef
         self.shapes = shapes
-        # bucket leaves by exact shape; order within a bucket = leaf order
+        # bucket leaves by exact shape; order within a bucket = leaf
+        # order. One pass with a per-group running counter: each leaf's
+        # position IS the group's current count (BERT-scale trees have
+        # hundreds of leaves — the old rescan-per-leaf was O(n²))
         by_shape: dict = {}
         self.slots = []                      # per leaf: (group, pos)
+        counts: list = []                    # running per-group counters
         for s in shapes:
             g = by_shape.setdefault(s, len(by_shape))
-            pos = sum(1 for sl in self.slots if sl[0] == g)
-            self.slots.append((g, pos))
+            if g == len(counts):
+                counts.append(0)
+            self.slots.append((g, counts[g]))
+            counts[g] += 1
         self.group_shapes = list(by_shape)   # insertion-ordered
-        self.group_counts = [sum(1 for sl in self.slots if sl[0] == g)
-                             for g in range(len(self.group_shapes))]
+        self.group_counts = counts
         self.n = sum(int(np.prod(s)) if s else 1 for s in shapes)
         self._unravel_jit = None
         self._ravel_jit = None

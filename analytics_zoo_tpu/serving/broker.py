@@ -46,6 +46,15 @@ def decode_ndarray(blob: Dict) -> np.ndarray:
 class Broker:
     """Stream + result-hash contract."""
 
+    def clone(self) -> "Broker":
+        """A connection suitable for a SECOND serving thread. Pipelined
+        serving reads (blocking XREADGROUP) and writes results from
+        different stages concurrently; on a single-socket transport the
+        reader would hold the connection lock for its whole block window
+        and starve the sink. Default: share (in-process brokers take the
+        lock per-op; TCPBroker sockets are per-thread already)."""
+        return self
+
     def xadd(self, stream: str, record: Dict) -> str:
         raise NotImplementedError
 
@@ -60,6 +69,14 @@ class Broker:
     def hset(self, key: str, field: str, value: str) -> None:
         raise NotImplementedError
 
+    def hset_many(self, key: str, mapping: Dict[str, str]) -> None:
+        """Batched result writeback: ONE round trip for a whole batch of
+        (field, value) pairs (`HSET key f1 v1 f2 v2 ...` on Redis) instead
+        of one per record — the pipelined sink stage's write path.
+        Default loops hset for brokers without a cheaper path."""
+        for field, value in mapping.items():
+            self.hset(key, field, value)
+
     def hget(self, key: str, field: str) -> Optional[str]:
         raise NotImplementedError
 
@@ -68,6 +85,13 @@ class Broker:
 
     def hdel(self, key: str, field: str) -> None:
         raise NotImplementedError
+
+    def hdel_many(self, key: str, fields) -> None:
+        """Batched delete (variadic HDEL): result-drain loops
+        (`OutputQueue.dequeue`) clear a whole poll's worth of fields in
+        one round trip."""
+        for field in fields:
+            self.hdel(key, field)
 
 
 class MemoryBroker(Broker):
@@ -121,6 +145,11 @@ class MemoryBroker(Broker):
             self._hashes.setdefault(key, {})[field] = value
             self._lock.notify_all()
 
+    def hset_many(self, key, mapping):
+        with self._lock:  # one lock acquisition for the whole batch
+            self._hashes.setdefault(key, {}).update(mapping)
+            self._lock.notify_all()
+
     def hget(self, key, field):
         with self._lock:
             return self._hashes.get(key, {}).get(field)
@@ -132,6 +161,12 @@ class MemoryBroker(Broker):
     def hdel(self, key, field):
         with self._lock:
             self._hashes.get(key, {}).pop(field, None)
+
+    def hdel_many(self, key, fields):
+        with self._lock:
+            h = self._hashes.get(key, {})
+            for field in fields:
+                h.pop(field, None)
 
 
 # ---------------------------------------------------------------------------
@@ -227,6 +262,10 @@ class TCPBroker(Broker):
     def hset(self, key, field, value):
         return self._call("hset", key, field, value)
 
+    def hset_many(self, key, mapping):
+        # one RPC round trip for the whole batch
+        return self._call("hset_many", key, mapping)
+
     def hget(self, key, field):
         return self._call("hget", key, field)
 
@@ -235,6 +274,9 @@ class TCPBroker(Broker):
 
     def hdel(self, key, field):
         return self._call("hdel", key, field)
+
+    def hdel_many(self, key, fields):
+        return self._call("hdel_many", key, list(fields))
 
 
 class RESPError(RuntimeError):
@@ -352,8 +394,14 @@ class RedisBroker(Broker):
     XREADGROUP with `>`, XACK+XDEL on ack, HSET/HGET results."""
 
     def __init__(self, host: str = "localhost", port: int = 6379):
+        self.host, self.port = host, port
         self._r = _RESPClient(host, port)
         self._groups_made = set()
+
+    def clone(self):
+        # fresh socket: a blocking XREADGROUP on this connection must not
+        # serialize the clone's HSET/XACK behind its block window
+        return RedisBroker(self.host, self.port)
 
     def close(self):
         self._r.close()
@@ -397,6 +445,15 @@ class RedisBroker(Broker):
     def hset(self, key, field, value):
         self._r.command("HSET", key, field, value)
 
+    def hset_many(self, key, mapping):
+        if not mapping:
+            return
+        # variadic HSET (Redis >= 4): one command, one round trip
+        flat = []
+        for field, value in mapping.items():
+            flat.extend((field, value))
+        self._r.command("HSET", key, *flat)
+
     def hget(self, key, field):
         return self._r.command("HGET", key, field)
 
@@ -406,6 +463,11 @@ class RedisBroker(Broker):
 
     def hdel(self, key, field):
         self._r.command("HDEL", key, field)
+
+    def hdel_many(self, key, fields):
+        fields = list(fields)
+        if fields:
+            self._r.command("HDEL", key, *fields)
 
 
 def connect_broker(url: Optional[str] = None) -> Broker:

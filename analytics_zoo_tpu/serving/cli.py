@@ -43,9 +43,28 @@ def cmd_start(args) -> int:
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     model = cfg.build_model(broker=broker)
+    if cfg.warmup_shapes:
+        # pre-compile every REACHABLE shape bucket BEFORE the stream
+        # opens: no XLA compile ever lands on a request. The reader never
+        # hands dispatch more than batch_size records, so buckets past
+        # the one covering batch_size would pay compile time (and cached
+        # executable memory) for batches that cannot occur
+        import numpy as np
+
+        from analytics_zoo_tpu.serving.inference_model import _next_bucket
+        dtype = np.dtype(cfg.warmup_dtype)
+        cap = _next_bucket(cfg.batch_size, model.buckets)
+        buckets = [b for b in model.buckets if b <= cap]
+        for shape in cfg.warmup_shapes:
+            model.warmup(np.zeros(tuple(shape), dtype), buckets=buckets)
+        print(f"warmed {len(model.warmed_buckets)} shape buckets: "
+              f"{json.dumps(model.warmup_report)}", flush=True)
     serving = ClusterServing(model, broker, stream=cfg.stream,
                              batch_size=cfg.batch_size,
-                             batch_timeout_ms=cfg.batch_timeout_ms).start()
+                             batch_timeout_ms=cfg.batch_timeout_ms,
+                             pipelined=cfg.pipelined,
+                             decode_workers=cfg.decode_workers,
+                             queue_depth=cfg.queue_depth).start()
     if frontend is not None:
         frontend._srv.serving = serving
     print("cluster serving started", flush=True)

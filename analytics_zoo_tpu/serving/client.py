@@ -101,12 +101,14 @@ class OutputQueue:
         return self._decode(raw)
 
     def dequeue(self) -> Dict[str, np.ndarray]:
-        """Drain all results (`client.py:203` semantics)."""
+        """Drain all results (`client.py:203` semantics): one read plus
+        one batched delete, not one round trip per field."""
         allr = self.broker.hgetall(self.result_key)
         out = {}
         for uri, raw in allr.items():
             out[uri] = self._decode(raw)
-            self.broker.hdel(self.result_key, uri)
+        if allr:
+            self.broker.hdel_many(self.result_key, list(allr))
         return out
 
     @staticmethod

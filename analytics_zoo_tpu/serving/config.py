@@ -77,6 +77,16 @@ class ServingConfig:
     batch_size: int = 32                    # core_number analogue
     batch_timeout_ms: int = 5
     concurrent_num: int = 1
+    # pipelined engine knobs (overlapped decode/compute/sink)
+    pipelined: bool = True
+    decode_workers: int = 2
+    queue_depth: int = 8
+    # shape-bucket pre-warming: list of per-record shapes, e.g.
+    # [[32, 32, 3]] (or the string "32x32x3,224x224x3" in bare-parser
+    # YAML) — every bucket of each shape pre-compiles at load so no XLA
+    # compile lands on the request path
+    warmup_shapes: Optional[list] = None
+    warmup_dtype: str = "float32"
     http_port: Optional[int] = None
     # secure block (`ClusterServingHelper.scala:121-134` — model_encrypted
     # gates the wait-for-secret/salt flow before weights load)
@@ -114,6 +124,12 @@ class ServingConfig:
                                         params.get("batch_size", 32)))
         cfg.batch_timeout_ms = int(params.get("batch_timeout_ms", 5))
         cfg.concurrent_num = int(params.get("concurrent_num", 1))
+        cfg.pipelined = bool(params.get("pipelined", True))
+        cfg.decode_workers = int(params.get("decode_workers", 2))
+        cfg.queue_depth = int(params.get("queue_depth", 8))
+        cfg.warmup_shapes = _parse_warmup_shapes(
+            params.get("warmup_shapes"))
+        cfg.warmup_dtype = str(params.get("warmup_dtype", "float32"))
         if raw.get("http_port") is not None:
             cfg.http_port = int(raw["http_port"])
         secure = raw.get("secure", {}) or {}
@@ -194,6 +210,35 @@ class ServingConfig:
         raise ValueError(
             f"{self.model_path} is not a saved ZooModel directory "
             "(no config.json) and no model.class was given")
+
+
+def _parse_warmup_shapes(raw) -> Optional[list]:
+    """Per-record warmup shapes from config: a YAML list of int lists or
+    "32x32x3" strings, or (bare-parser friendly) one comma-joined string
+    like "32x32x3,224x224x3"; "scalar" names the 0-d record shape ()."""
+    def one(part: str) -> tuple:
+        part = part.strip()
+        return () if part == "scalar" else \
+            tuple(int(d) for d in part.split("x"))
+
+    if raw is None:
+        return None
+    if isinstance(raw, str):
+        return [one(p) for p in raw.split(",") if p.strip()] or None
+    if raw and all(isinstance(s, int) for s in raw):
+        # flat int list `warmup_shapes: [32, 32, 3]` = ONE record shape
+        return [tuple(int(d) for d in raw)]
+
+    def elem(s) -> tuple:
+        if isinstance(s, str):
+            return one(s)
+        if isinstance(s, int):
+            raise ValueError(
+                "warmup_shapes mixes bare ints with shapes — write one "
+                'shape per element, e.g. [[32], [64, 64]] or "32,64x64"')
+        return tuple(int(d) for d in s)
+
+    return [elem(s) for s in raw] or None
 
 
 def wait_model_secret(broker, timeout_s: float = 60.0,

@@ -3,8 +3,11 @@
 
 Routes preserved: `POST /predict` (sync prediction: enqueue to the broker,
 await the result — `FrontEndApp.scala:163`), `GET /metrics` (timer snapshots
-as JSON, `:131,241`), `POST /model-secure` ("secret=xxx&salt=yyy" stored on
-the broker for encrypted-model loading, `:140-152`), plus `GET /` liveness
+as JSON, `:131,241` — with a pipelined ClusterServing attached this
+includes per-stage decode/dispatch/sink p50/p95/p99 and live queue-depth
+gauges, so an operator can see which stage is the bottleneck), `POST
+/model-secure` ("secret=xxx&salt=yyy" stored on the broker for
+encrypted-model loading, `:140-152`), plus `GET /` liveness
 ("welcome to analytics zoo web serving frontend").
 
 Hardening, matching the reference's front-end options:

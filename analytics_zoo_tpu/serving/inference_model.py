@@ -21,6 +21,7 @@ thread-safe `doPredict`. TPU-native redesign:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -37,6 +38,87 @@ def _next_bucket(n: int, buckets) -> int:
     return buckets[-1]
 
 
+class PendingPrediction:
+    """Async handle from `predict_async`: the device computes while the
+    caller keeps dispatching; `result()` materializes the output (the one
+    blocking `np.asarray`) and slices off bucket padding. `result()` is
+    idempotent and thread-safe — the sink stage and a curious caller can
+    both touch it."""
+
+    def __init__(self, out, valid_n: int, timer=None,
+                 dispatch_s: float = 0.0):
+        self._out = out
+        self._n = valid_n
+        self._timer = timer
+        self._dispatch_s = dispatch_s
+        self._result = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        """True once the device output is ready (or already materialized);
+        a done() poll never blocks — it must not share the materialize
+        lock, or polling would stall for the whole device sync inside a
+        concurrent result()."""
+        if self._done:
+            return True
+        out = self._out          # racy snapshot: result() may be midway
+        if out is None:          # ... in which case it is done or about to be
+            return True
+        try:
+            return all(a.is_ready() for a in
+                       jax.tree_util.tree_leaves(out))
+        except AttributeError:
+            # jax without Array.is_ready(): report ready rather than
+            # trap a done() poll loop at forever-False — result() is
+            # the authoritative sync either way
+            return True
+
+    def result(self):
+        with self._lock:
+            if not self._done:
+                t0 = time.perf_counter()
+                out = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[:self._n], self._out)
+                self._out = None            # free device refs promptly
+                self._result = out
+                self._done = True
+                if self._timer is not None:
+                    # model time = dispatch + materialize wait; time the
+                    # handle sat unmaterialized (e.g. behind a slow sink
+                    # queue) is excluded, so /metrics "predict" doesn't
+                    # misattribute a broker stall to the device
+                    self._timer.record(
+                        self._dispatch_s + time.perf_counter() - t0)
+        return self._result
+
+
+class _JoinedPending:
+    """PendingPrediction over max_batch chunks: each chunk was dispatched
+    independently; result() syncs them in order and concatenates."""
+
+    def __init__(self, parts: List[PendingPrediction]):
+        self._parts = parts
+        self._result = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        # lock-free like PendingPrediction.done(): _parts is reassigned
+        # (never mutated), so a racy snapshot is safe and all([]) is True
+        return self._done or all(p.done() for p in self._parts)
+
+    def result(self):
+        with self._lock:
+            if not self._done:
+                chunks = [p.result() for p in self._parts]
+                self._result = jax.tree_util.tree_map(
+                    lambda *cs: np.concatenate(cs), *chunks)
+                self._parts = []
+                self._done = True
+        return self._result
+
+
 class InferenceModel:
     def __init__(self, concurrent_num: int = 1, auto_scaling: bool = False,
                  max_batch: int = 512):
@@ -51,6 +133,8 @@ class InferenceModel:
                         if b <= max_batch] or [max_batch]
         self._jit: Optional[Callable] = None
         self.timer = Timer("predict")
+        self.warmup_report: Dict[str, float] = {}
+        self.warmed_buckets: set = set()
 
     # -- loaders (`doLoad*`, InferenceModel.scala:76-318) ------------------
     def load_keras(self, model, params=None,
@@ -106,6 +190,8 @@ class InferenceModel:
         # one jit wrapper; jax caches an executable per input shape (= per
         # bucket), so no per-bucket bookkeeping is needed
         self._jit = jax.jit(fn)
+        self.warmup_report = {}
+        self.warmed_buckets = set()
         return self
 
     def load_keras_encrypted(self, model, path: str, secret: str,
@@ -134,21 +220,40 @@ class InferenceModel:
 
     # -- predict (`doPredict`, InferenceModel.scala:520-624) ---------------
     def predict(self, x) -> np.ndarray:
+        """Sync predict: dispatch + materialize. Equivalent to
+        `predict_async(x).result()`."""
+        return self.predict_async(x).result()
+
+    def predict_async(self, x, valid_n: Optional[int] = None):
+        """Dispatch without syncing: pad to the shape bucket (on device —
+        the raw batch uploads once and extends by broadcasting its last
+        row, so the dispatch thread never runs a host-side pad copy),
+        hand the padded batch to the cached per-bucket executable, and
+        return a `PendingPrediction` immediately. XLA computes in the
+        background; the caller (the serving sink stage) materializes via
+        `.result()` while the dispatch thread feeds batch N+1.
+
+        `valid_n` marks how many leading records are real when the caller
+        already stacked the batch to a bucket size (the serving decode
+        stage does: stacking straight to the bucket is free — the stack
+        copies every record anyway — and skips the pad entirely)."""
         if self._fn is None:
             raise RuntimeError("No model loaded")
         x = jax.tree_util.tree_map(np.asarray, x)
         leaves = jax.tree_util.tree_leaves(x)
         n = leaves[0].shape[0] if leaves[0].ndim > 0 else 1
+        valid_n = n if valid_n is None else min(valid_n, n)
 
         if n > self.max_batch:
-            # split oversize inputs into max_batch chunks
-            chunks = []
+            # split oversize inputs into max_batch chunks, all in flight
+            parts = []
             for s in range(0, n, self.max_batch):
                 part = jax.tree_util.tree_map(
                     lambda a: a[s:s + self.max_batch], x)
-                chunks.append(self.predict(part))
-            return jax.tree_util.tree_map(
-                lambda *cs: np.concatenate(cs), *chunks)
+                remain = max(0, valid_n - s)
+                parts.append(self.predict_async(
+                    part, valid_n=min(remain, self.max_batch)))
+            return _JoinedPending(parts)
 
         acquired = self._sema.acquire(timeout=60)
         if not acquired:
@@ -156,21 +261,63 @@ class InferenceModel:
                 raise TimeoutError("predict queue exhausted "
                                    "(concurrent_num permits busy)")
             self._sema.release()  # grow like the reference's auto-scaling
+        t0 = time.perf_counter()
         try:
-            with self.timer.timing():
-                bucket = _next_bucket(n, self.buckets)
-                if n != bucket:
-                    pad = bucket - n
-                    x = jax.tree_util.tree_map(
-                        lambda a: np.concatenate(
-                            [a, np.repeat(a[-1:], pad, axis=0)]), x)
-                out = self._jit(self._params, x)
-                out = jax.tree_util.tree_map(
-                    lambda a: np.asarray(a)[:n], out)
-                return out
+            bucket = _next_bucket(n, self.buckets)
+            if n != bucket:
+                pad = bucket - n
+                x = jax.tree_util.tree_map(
+                    lambda a: jnp.concatenate(
+                        [jnp.asarray(a),
+                         jnp.broadcast_to(jnp.asarray(a)[-1:],
+                                          (pad,) + a.shape[1:])]), x)
+            out = self._jit(self._params, x)
         finally:
+            # the permit bounds dispatch admission, not result lifetime:
+            # async callers bound in-flight results with their own queue
+            # (ClusterServing's sink queue), so holding the permit until
+            # result() would serialize the pipeline at concurrent_num=1
             if acquired:
                 self._sema.release()
+        # recorded once at result(): dispatch cost + materialize wait
+        return PendingPrediction(out, valid_n, timer=self.timer,
+                                 dispatch_s=time.perf_counter() - t0)
 
     def predict_batches(self, xs: List) -> List:
         return [self.predict(x) for x in xs]
+
+    # -- warmup (`warmup()` per-bucket pre-compile) ------------------------
+    def warmup(self, sample, buckets: Optional[List[int]] = None
+               ) -> "InferenceModel":
+        """Pre-compile every shape bucket at load time so no XLA compile
+        ever lands on the request path. `sample` is ONE record (no batch
+        dim, serving dtype — executables are keyed on dtype too), e.g.
+        ``np.zeros((32, 32, 3), np.float32)``, or a pytree of records for
+        multi-input models. Per-bucket compile+run seconds land in
+        ``self.warmup_report``; warmed buckets in ``self.warmed_buckets``."""
+        if self._fn is None:
+            raise RuntimeError("No model loaded")
+        buckets = list(buckets) if buckets is not None else list(self.buckets)
+        sample = jax.tree_util.tree_map(np.asarray, sample)
+        tag = "x".join(map(str, jax.tree_util.tree_leaves(sample)[0].shape)
+                       ) or "scalar"
+        for b in buckets:
+            batch = jax.tree_util.tree_map(
+                lambda a: np.ascontiguousarray(
+                    np.broadcast_to(a[None], (b,) + a.shape)), sample)
+            t0 = time.perf_counter()
+            # straight through the jit (not predict): warmup must not
+            # pollute the serving timer percentiles
+            jax.block_until_ready(self._jit(self._params, batch))
+            self.warmup_report[f"{tag}:b{b}"] = round(
+                time.perf_counter() - t0, 4)
+            self.warmed_buckets.add(b)
+        return self
+
+    def compile_cache_size(self) -> int:
+        """Number of cached executables (one per warmed shape bucket);
+        -1 when the running jax version doesn't expose the counter."""
+        try:
+            return self._jit._cache_size()
+        except Exception:  # noqa: BLE001 — diagnostics only
+            return -1

@@ -150,11 +150,18 @@ class MiniRedisStore:
         return removed
 
     def cmd_hset(self, a):
+        # variadic since Redis 4: HSET key f1 v1 [f2 v2 ...]
+        if len(a) < 3 or len(a) % 2 == 0:
+            raise RESPError("ERR wrong number of arguments for 'hset' "
+                            "command")
         h = self.hashes.setdefault(a[0], {})
-        is_new = a[1] not in h
-        h[a[1]] = a[2]
+        added = 0
+        for f, v in zip(a[1::2], a[2::2]):
+            if f not in h:
+                added += 1
+            h[f] = v
         # real Redis replies with the number of NEW fields added
-        return 1 if is_new else 0
+        return added
 
     def cmd_hget(self, a):
         return self.hashes.get(a[0], {}).get(a[1])
@@ -166,8 +173,9 @@ class MiniRedisStore:
         return out
 
     def cmd_hdel(self, a):
+        # variadic like real Redis: HDEL key f1 [f2 ...]
         h = self.hashes.get(a[0], {})
-        return 1 if h.pop(a[1], None) is not None else 0
+        return sum(1 for f in a[1:] if h.pop(f, None) is not None)
 
     def cmd_ping(self, a):
         # bare PING -> +PONG simple string; PING msg echoes a bulk string

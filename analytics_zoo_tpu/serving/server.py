@@ -1,33 +1,80 @@
-"""ClusterServing — the serving loop.
+"""ClusterServing — the serving engine.
 
 Reference: Flink job `RedisSource -> inference map -> RedisSink`
 (`ClusterServing.scala:55-68`), batching up to core count
 (`ClusterServingInference.scala:152` batchInput), singleton model per task
 manager (`FlinkInference.scala:41-52`), per-record failures degrade to "NaN"
-(`:71-79`). TPU redesign: one host thread drains the broker stream, groups
-records into a batch (up to `batch_size`, waiting at most `batch_timeout_ms`
-for stragglers), pads to the InferenceModel's shape bucket, runs the jit'd
-forward once, and writes per-record results back — dynamic batching under a
-latency SLO instead of Flink operator parallelism."""
+(`:71-79`).
+
+TPU redesign, pipelined (the default): the reference gets throughput from
+Flink scheduling its source/map/sink operators concurrently; here the same
+overlap comes from three explicit stages connected by bounded queues —
+
+    reader ──▶ decode pool ──▶ dispatch ──▶ sink
+         _decode_q        _dispatch_q   _sink_q
+
+- **reader**: drains the broker stream (up to `batch_size` records within
+  `batch_timeout_ms`) and hands raw record lists to the decode pool.
+- **decode** (`decode_workers` threads): b64 → ndarray per record, grouped
+  into shape-homogeneous host batches; a record that fails to decode turns
+  into a "NaN" result batch without touching the device.
+- **dispatch** (one thread): stacks each shape group straight to its
+  power-of-two bucket (stacking to the bucket is free — the stack copies
+  every record anyway) and calls `InferenceModel.predict_async`, which
+  returns WITHOUT materializing: the device computes batch N while this
+  thread stacks and dispatches batch N+1.
+- **sink** (one thread): materializes completed results (the only blocking
+  `np.asarray`), encodes per-record values, and writes a whole batch back
+  with ONE broker round trip (`hset_many`) plus one batched ack — instead
+  of the old one `hset` per record.
+
+Backpressure is the bounded queues: a slow device fills `_sink_q` and
+stalls dispatch; a slow broker fills `_decode_q` and stalls the reader.
+`stop()` drains: each stage is poisoned only after the previous stage has
+joined, so in-flight work flows out before threads exit. Per-record
+failure degradation ("NaN", batch survives) is preserved in every stage.
+
+`pipelined=False` keeps the old single-thread drain→batch→predict→sink
+loop — the baseline `bench_serving.py --concurrent` compares against.
+"""
 
 from __future__ import annotations
 
 import json
 import logging
+import queue
 import threading
-from typing import Optional, Union
+import time
+from typing import List, Optional, Union
 
 import numpy as np
 
 from analytics_zoo_tpu.serving.broker import (Broker, connect_broker,
                                               decode_ndarray, encode_ndarray,
                                               new_consumer_name)
-from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.inference_model import (InferenceModel,
+                                                       _next_bucket)
 from analytics_zoo_tpu.serving.timer import Timer
 
 log = logging.getLogger("analytics_zoo_tpu.serving")
 
 GROUP = "serving_group"
+
+_STOP = object()          # stage poison pill
+
+
+class _Batch:
+    """One shape-homogeneous unit of pipeline work."""
+
+    __slots__ = ("ids", "uris", "arrays", "t0", "pending", "nan")
+
+    def __init__(self, ids, uris, arrays, t0, nan=False):
+        self.ids = ids            # broker record ids (for the batched ack)
+        self.uris = uris          # result-hash fields
+        self.arrays = arrays      # decoded host arrays (None once stacked)
+        self.t0 = t0              # read timestamp: end-to-end latency base
+        self.pending = None       # PendingPrediction after dispatch
+        self.nan = nan            # failure batch: sink writes "NaN"
 
 
 class ClusterServing:
@@ -35,10 +82,18 @@ class ClusterServing:
                  broker: Union[Broker, str, None] = None,
                  stream: str = "serving_stream",
                  batch_size: int = 32, batch_timeout_ms: int = 5,
-                 output_filter: Optional[str] = None):
+                 output_filter: Optional[str] = None,
+                 pipelined: bool = True, decode_workers: int = 2,
+                 queue_depth: int = 8):
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
+        # the reader sits in a blocking read for up to ~50ms per cycle
+        # and the sink writes results concurrently: on single-socket
+        # transports each needs its own connection, and the caller's
+        # broker stays free for frontends/clients sharing it
+        self.reader_broker = self.broker.clone() if pipelined else self.broker
+        self.sink_broker = self.broker.clone() if pipelined else self.broker
         self.stream = stream
         # e.g. "topN(5)" — the reference's PostProcessing filter grammar;
         # validated here so a bad spec fails at construction, not as
@@ -51,73 +106,304 @@ class ClusterServing:
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
         self.consumer = new_consumer_name()
+        self.pipelined = pipelined
+        self.decode_workers = max(1, decode_workers)
+        self.queue_depth = max(1, queue_depth)
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.batch_timer = Timer("batch")
+        self._threads: List[threading.Thread] = []
+        self._decode_q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        self._dispatch_q: "queue.Queue" = queue.Queue(
+            maxsize=self.queue_depth)
+        self._sink_q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        self.batch_timer = Timer("batch")          # end-to-end per batch
+        self.decode_timer = Timer("decode")
+        self.dispatch_timer = Timer("dispatch")
+        self.sink_timer = Timer("sink")
         self.records_served = 0
+        self.records_read = 0
+        self._counter_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ClusterServing":
-        self._thread = threading.Thread(target=self.run, daemon=True)
-        self._thread.start()
+        if self.pipelined:
+            specs = [("serving-reader", self._reader_loop)]
+            specs += [(f"serving-decode-{i}", self._decode_loop)
+                      for i in range(self.decode_workers)]
+            specs += [("serving-dispatch", self._dispatch_loop),
+                      ("serving-sink", self._sink_loop)]
+            for name, target in specs:
+                t = threading.Thread(target=target, name=name, daemon=True)
+                t.start()
+                self._threads.append(t)
+        else:
+            t = threading.Thread(target=self.run, daemon=True)
+            t.start()
+            self._threads.append(t)
         return self
 
-    def stop(self):
-        self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=10)
+    def is_alive(self) -> bool:
+        """True while every stage thread is still running."""
+        return bool(self._threads) and all(
+            t.is_alive() for t in self._threads)
 
+    def stop(self):
+        """Drain and join: each stage is poisoned only after every thread
+        feeding it has exited, so work already read from the broker flows
+        through to the sink before shutdown."""
+        self._stop.set()
+        if not self.pipelined:
+            for t in self._threads:
+                t.join(timeout=10)
+            self._threads = []
+            return
+        readers = [t for t in self._threads if "reader" in t.name]
+        decoders = [t for t in self._threads if "decode" in t.name]
+        dispatchers = [t for t in self._threads if "dispatch" in t.name]
+        sinks = [t for t in self._threads if "sink" in t.name]
+        for t in readers:
+            t.join(timeout=10)
+        self._poison(self._decode_q, len(decoders))
+        for t in decoders:
+            t.join(timeout=10)
+        self._poison(self._dispatch_q, len(dispatchers))
+        for t in dispatchers:
+            t.join(timeout=10)
+        self._poison(self._sink_q, len(sinks))
+        for t in sinks:
+            t.join(timeout=10)
+        self._threads = []
+        for br in (self.reader_broker, self.sink_broker):
+            if br is not self.broker and hasattr(br, "close"):
+                try:
+                    br.close()
+                except Exception:  # noqa: BLE001 — shutdown best effort
+                    pass
+
+    @staticmethod
+    def _poison(q: "queue.Queue", n: int):
+        """Deliver `n` stop pills without ever wedging stop(): if the
+        queue stays full (its consumer is stuck, e.g. a stalled device
+        under dispatch), drop queued work and keep trying for a bounded
+        window — unacked records redeliver, and a bounded shutdown beats
+        the drain guarantee once a stage is already wedged."""
+        for _ in range(n):
+            deadline = time.monotonic() + 5.0
+            while True:
+                try:
+                    q.put(_STOP, timeout=0.25)
+                    break
+                except queue.Full:
+                    if time.monotonic() > deadline:
+                        break
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
+
+    # -- stage: reader -----------------------------------------------------
+    def _reader_loop(self):
+        # idle wait is LONG (an XADD wakes a blocked XREADGROUP
+        # immediately, so latency doesn't suffer): a short block here
+        # would hammer the broker with nil reads that contend with the
+        # sink's writes and the clients' polls for the whole run
+        idle_block = max(self.batch_timeout_ms, 50)
+        while not self._stop.is_set():
+            try:
+                records = self.reader_broker.read_group(
+                    self.stream, GROUP, self.consumer, self.batch_size,
+                    block_ms=idle_block)
+                if not records:
+                    continue
+                if len(records) < self.batch_size \
+                        and self.batch_timeout_ms > 0:
+                    # straggler sweep: requests from concurrent clients
+                    # land within ~ms of each other — waiting the SLO
+                    # budget builds full batches (fewer pipeline units,
+                    # one forward and one writeback for more records)
+                    records += self.reader_broker.read_group(
+                        self.stream, GROUP, self.consumer,
+                        self.batch_size - len(records),
+                        block_ms=self.batch_timeout_ms)
+                with self._counter_lock:
+                    self.records_read += len(records)
+                self._decode_q.put((time.perf_counter(), records))
+            except Exception as e:  # noqa: BLE001 — the Flink-restart role
+                # transient broker failures (redis stall/restart) must not
+                # kill the stage; brokers reconnect on next use
+                log.warning("reader cycle failed (%s: %s); retrying",
+                            type(e).__name__, e)
+                self._stop.wait(1.0)
+
+    # -- stage: decode -----------------------------------------------------
+    def _decode_records(self, records):
+        """Per-record decode + shape grouping, shared by the pipelined
+        decode stage and the legacy synchronous loop. Returns
+        ``(by_shape, failed)``: shape → [(rid, uri, array)] plus the
+        [(rid, uri)] records that failed to decode (degrade to "NaN")."""
+        from analytics_zoo_tpu.serving.pre_post import decode_record_field
+        by_shape: dict = {}
+        failed = []
+        for rid, rec in records:
+            try:
+                data = rec["data"]
+                # single-tensor fast path: field "t" or "image"
+                field = "t" if "t" in data else (
+                    "image" if "image" in data else next(iter(data)))
+                arr = decode_record_field(data[field])
+                by_shape.setdefault(arr.shape, []).append(
+                    (rid, rec["uri"], arr))
+            except Exception as e:  # noqa: BLE001 — degrade per record
+                # rec itself may be malformed (a foreign producer can
+                # XADD any JSON): the failure path must not raise, or one
+                # poison record would drop its whole read batch into a
+                # redeliver loop
+                uri = rec.get("uri", rid) if isinstance(rec, dict) \
+                    else str(rid)
+                log.warning("decode failure for %s: %s", uri, e)
+                failed.append((rid, uri))
+        return by_shape, failed
+
+    def _decode_loop(self):
+        while True:
+            item = self._decode_q.get()
+            if item is _STOP:
+                return
+            t0, records = item
+            try:
+                with self.decode_timer.timing():
+                    by_shape, failed = self._decode_records(records)
+                    if failed:
+                        self._sink_q.put(_Batch(
+                            [rid for rid, _ in failed],
+                            [uri for _, uri in failed], None, t0, nan=True))
+                    for items in by_shape.values():
+                        self._dispatch_q.put(_Batch(
+                            [rid for rid, _, _ in items],
+                            [uri for _, uri, _ in items],
+                            [a for _, _, a in items], t0))
+            except Exception as e:  # noqa: BLE001 — stage must survive
+                log.error("decode stage failed for a read batch: %s", e)
+
+    # -- stage: dispatch ---------------------------------------------------
+    def _dispatch_loop(self):
+        while True:
+            batch = self._dispatch_q.get()
+            if batch is _STOP:
+                return
+            try:
+                with self.dispatch_timer.timing():
+                    n = len(batch.arrays)
+                    bucket = _next_bucket(n, self.model.buckets)
+                    arrs = batch.arrays
+                    if bucket > n:
+                        # stack straight to the bucket: padding costs
+                        # nothing extra (the stack copies anyway) and
+                        # predict_async skips its device-side pad
+                        arrs = arrs + [arrs[-1]] * (bucket - n)
+                    stacked = np.stack(arrs)
+                    batch.arrays = None
+                    # async: returns before the device finishes — the
+                    # sink materializes while we stack the next batch
+                    batch.pending = self.model.predict_async(
+                        stacked, valid_n=n)
+                self._sink_q.put(batch)
+            except Exception as e:  # noqa: BLE001 — stream must survive
+                log.error("dispatch failure for batch of %d: %s",
+                          len(batch.uris), e)
+                batch.arrays = None
+                batch.nan = True
+                self._sink_q.put(batch)
+
+    # -- stage: sink -------------------------------------------------------
+    def _sink_loop(self):
+        while True:
+            batch = self._sink_q.get()
+            if batch is _STOP:
+                return
+            try:
+                with self.sink_timer.timing():
+                    values = self._materialize(batch)
+                    # ONE pipelined broker write for the whole batch,
+                    # then one batched ack — 2 round trips, not N+1
+                    self.sink_broker.hset_many(
+                        self.result_key, dict(zip(batch.uris, values)))
+                    self.sink_broker.ack(self.stream, GROUP, batch.ids)
+                with self._counter_lock:
+                    self.records_served += len(batch.uris)
+                self.batch_timer.record(time.perf_counter() - batch.t0)
+            except Exception as e:  # noqa: BLE001 — no ack → the broker
+                # redelivers after its pending window (at-least-once)
+                log.error("sink writeback failed for %d records (%s: %s); "
+                          "leaving unacked for redelivery",
+                          len(batch.uris), type(e).__name__, e)
+
+    def _materialize(self, batch) -> List[str]:
+        """Per-record encoded result strings for a batch; inference
+        failure degrades the whole batch to "NaN" (the per-shape batch is
+        the reference's failure unit, `ClusterServingInference.scala:71`)."""
+        if batch.nan:
+            return ["NaN"] * len(batch.uris)
+        try:
+            preds = batch.pending.result()
+        except Exception as e:  # noqa: BLE001 — stream must survive
+            log.error("inference failure for batch of %d: %s",
+                      len(batch.uris), e)
+            return ["NaN"] * len(batch.uris)
+        values = []
+        for pred in list(preds)[:len(batch.uris)]:
+            try:
+                if self.output_filter:
+                    from analytics_zoo_tpu.serving.pre_post import \
+                        apply_filter
+                    values.append(apply_filter(np.asarray(pred),
+                                               self.output_filter))
+                else:
+                    values.append(json.dumps(
+                        encode_ndarray(np.asarray(pred))))
+            except Exception as e:  # noqa: BLE001 — degrade per record
+                log.warning("encode failure: %s", e)
+                values.append("NaN")
+        return values
+
+    # -- legacy synchronous loop (pipelined=False, serve_once) -------------
     def run(self):
         while not self._stop.is_set():
             try:
                 self.serve_once()
             except Exception as e:  # noqa: BLE001 — the Flink-restart role
-                # transient broker failures (redis stall/restart) must not
-                # kill the serving thread; brokers reconnect on next use
                 log.warning("serving cycle failed (%s: %s); retrying",
                             type(e).__name__, e)
                 self._stop.wait(1.0)
 
-    # -- one drain->batch->predict->sink cycle -----------------------------
     def serve_once(self) -> int:
+        """One synchronous drain->batch->predict->sink cycle (the
+        pre-pipeline behavior; also handy for tests and notebooks)."""
         records = self.broker.read_group(
             self.stream, GROUP, self.consumer, self.batch_size,
             block_ms=self.batch_timeout_ms)
         if not records:
             return 0
-        with self.batch_timer.timing():
-            self._process(records)
+        with self._counter_lock:
+            self.records_read += len(records)
+        t0 = time.perf_counter()
+        self._process(records)
         self.broker.ack(self.stream, GROUP, [rid for rid, _ in records])
-        self.records_served += len(records)
+        with self._counter_lock:
+            self.records_served += len(records)
+        self.batch_timer.record(time.perf_counter() - t0)
         return len(records)
 
     def _process(self, records):
-        # decode; per-record decode failure -> NaN without killing the batch
-        from analytics_zoo_tpu.serving.pre_post import decode_record_field
-        decoded = []
-        for rid, rec in records:
-            try:
-                data = rec["data"]
-                # single-tensor fast path: field "t" or "image"
-                field = "t" if "t" in data else ("image" if "image" in data
-                                                 else next(iter(data)))
-                decoded.append((rec["uri"],
-                                decode_record_field(data[field])))
-            except Exception as e:  # noqa: BLE001 — degrade per record
-                log.warning("decode failure for %s: %s", rec.get("uri"), e)
-                self.broker.hset(self.result_key, rec.get("uri", rid), "NaN")
-
-        if not decoded:
-            return
-        # group by shape so one forward serves each homogeneous sub-batch
-        by_shape = {}
-        for uri, arr in decoded:
-            by_shape.setdefault(arr.shape, []).append((uri, arr))
+        # per-record decode failure -> NaN without killing the batch; one
+        # forward per shape-homogeneous sub-batch
+        by_shape, failed = self._decode_records(records)
+        for _rid, uri in failed:
+            self.broker.hset(self.result_key, uri, "NaN")
         for shape, items in by_shape.items():
-            batch = np.stack([a for _, a in items])
+            batch = np.stack([a for _, _, a in items])
             try:
                 preds = self.model.predict(batch)
-                for (uri, _), pred in zip(items, preds):
+                for (_rid, uri, _), pred in zip(items, preds):
                     if self.output_filter:
                         from analytics_zoo_tpu.serving.pre_post import \
                             apply_filter
@@ -128,13 +414,27 @@ class ClusterServing:
                     self.broker.hset(self.result_key, uri, value)
             except Exception as e:  # noqa: BLE001 — stream must survive
                 log.error("inference failure for batch %s: %s", shape, e)
-                for uri, _ in items:
+                for _rid, uri, _ in items:
                     self.broker.hset(self.result_key, uri, "NaN")
 
     # -- metrics (`/metrics`, FrontEndApp.scala:241) -----------------------
     def metrics(self) -> dict:
-        return {
+        m = {
             "records_served": self.records_served,
+            "records_read": self.records_read,
+            "pipelined": self.pipelined,
             "batch": self.batch_timer.snapshot(),
             "predict": self.model.timer.snapshot(),
         }
+        if self.pipelined:
+            m["stages"] = {
+                "decode": self.decode_timer.snapshot(),
+                "dispatch": self.dispatch_timer.snapshot(),
+                "sink": self.sink_timer.snapshot(),
+            }
+            m["queue_depths"] = {
+                "decode": self._decode_q.qsize(),
+                "dispatch": self._dispatch_q.qsize(),
+                "sink": self._sink_q.qsize(),
+            }
+        return m

@@ -1,13 +1,34 @@
 """Per-stage serving timers (`serving/engine/Timer.scala:33-100`): running
 min/max/avg and top-N slowest, printed per batch window; plus a metrics
-snapshot for the HTTP `/metrics` route (`http/FrontEndApp.scala:131,241`)."""
+snapshot for the HTTP `/metrics` route (`http/FrontEndApp.scala:131,241`).
+
+Percentiles come from a streaming log-bucketed histogram (O(1) memory,
+O(1) record): sample durations land in geometrically-spaced buckets
+spanning 1 µs .. ~5 min, and p50/p95/p99 interpolate within the bucket
+that crosses the target rank. Relative error is bounded by the bucket
+growth factor (~9%), which is plenty for tail-latency dashboards."""
 
 from __future__ import annotations
 
 import heapq
+import math
 import threading
 import time
 from typing import Dict, List
+
+# Histogram geometry: bucket i covers [BASE*GROWTH^i, BASE*GROWTH^(i+1)).
+# BASE=1µs, GROWTH=1.2 → 107 buckets reach ~300 s; under/overflows clamp.
+_HIST_BASE = 1e-6
+_HIST_GROWTH = 1.2
+_HIST_LOG_GROWTH = math.log(_HIST_GROWTH)
+_HIST_BUCKETS = 107
+
+
+def _bucket_index(seconds: float) -> int:
+    if seconds <= _HIST_BASE:
+        return 0
+    i = int(math.log(seconds / _HIST_BASE) / _HIST_LOG_GROWTH)
+    return min(i, _HIST_BUCKETS - 1)
 
 
 class Timer:
@@ -24,6 +45,7 @@ class Timer:
             self.min = float("inf")
             self.max = 0.0
             self._top: List[float] = []
+            self._hist = [0] * _HIST_BUCKETS
 
     def record(self, seconds: float):
         with self._lock:
@@ -31,10 +53,31 @@ class Timer:
             self.total += seconds
             self.min = min(self.min, seconds)
             self.max = max(self.max, seconds)
+            self._hist[_bucket_index(seconds)] += 1
             if len(self._top) < self.top_n:
                 heapq.heappush(self._top, seconds)
             else:
                 heapq.heappushpop(self._top, seconds)
+
+    def _percentile_locked(self, q: float) -> float:
+        """Histogram percentile: find the bucket crossing rank q*count and
+        interpolate linearly inside it; clamp to the observed min/max so
+        bucket-edge estimates never exceed reality."""
+        if not self.count:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self._hist):
+            if not c:
+                continue
+            if seen + c >= target:
+                lo = _HIST_BASE * (_HIST_GROWTH ** i)
+                hi = lo * _HIST_GROWTH
+                frac = (target - seen) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, self.min), self.max)
+            seen += c
+        return self.max
 
     def timing(self):
         """Context manager: `with timer.timing(): ...`"""
@@ -44,6 +87,11 @@ class Timer:
     def avg(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    def percentile(self, q: float) -> float:
+        """Seconds at quantile q in [0, 1] from the streaming histogram."""
+        with self._lock:
+            return self._percentile_locked(q)
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             return {
@@ -52,6 +100,9 @@ class Timer:
                 "avg_ms": round(self.avg * 1e3, 3),
                 "min_ms": round(self.min * 1e3, 3) if self.count else 0.0,
                 "max_ms": round(self.max * 1e3, 3),
+                "p50_ms": round(self._percentile_locked(0.50) * 1e3, 3),
+                "p95_ms": round(self._percentile_locked(0.95) * 1e3, 3),
+                "p99_ms": round(self._percentile_locked(0.99) * 1e3, 3),
                 "top": sorted((round(t * 1e3, 3) for t in self._top),
                               reverse=True),
             }

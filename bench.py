@@ -135,12 +135,11 @@ def _tail(stderr) -> str:
 
 
 def _run_sub(cmd, timeout, env=None):
-    """Run a sibling benchmark; return its last-line JSON or None. A
+    """Run a sibling benchmark; return `(json_or_None, timed_out)`. A
     failed child reports its stderr tail to OUR stderr — the driver's
-    one shot at the round bench must not fail blind. Sets
-    `_run_sub.timed_out` so callers can distinguish a fast crash (worth
-    retrying) from a full-timeout hang (retrying doubles the cost)."""
-    _run_sub.timed_out = False
+    one shot at the round bench must not fail blind. The second element
+    lets callers distinguish a fast crash (worth retrying) from a
+    full-timeout hang (retrying doubles the cost)."""
     # unbuffered child stdout: a block-buffered JSON line would die with
     # the child's userspace buffer when a teardown hang forces a kill,
     # making the timeout-recovery path below a no-op
@@ -150,24 +149,23 @@ def _run_sub(cmd, timeout, env=None):
                              timeout=timeout, env=env)
         r = _last_json(res.stdout)
         if r is not None:
-            return r
+            return r, False
         print(f"bench child {cmd[-1]} produced no JSON (rc={res.returncode})"
               f":\n{_tail(res.stderr)}", file=sys.stderr)
-        return None
+        return None, False
     except subprocess.TimeoutExpired as e:
-        _run_sub.timed_out = True
         print(f"bench child {cmd[-1]} timed out after {timeout}s:"
               f"\n{_tail(e.stderr)}", file=sys.stderr)
         # a child can complete its measurement and then hang in runtime
         # teardown (known tunnel-rig mode): recover a JSON line it
         # already printed rather than nulling the field
         try:
-            return _last_json(e.stdout)
+            return _last_json(e.stdout), True
         except json.JSONDecodeError:
-            return None
+            return None, True
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench child {cmd[-1]} failed: {e}", file=sys.stderr)
-        return None
+        return None, False
 
 
 def _longseq_child():
@@ -242,17 +240,17 @@ def main():
         # hang or runtime-level abort on a smaller chip must never lose
         # the headline line.
         env = dict(os.environ, BENCH_LONGSEQ_CHILD="1")
-        r = _run_sub([sys.executable, os.path.abspath(__file__)],
-                     timeout=1800, env=env)
-        if r is None and not _run_sub.timed_out:
+        r, timed_out = _run_sub([sys.executable, os.path.abspath(__file__)],
+                                timeout=1800, env=env)
+        if r is None and not timed_out:
             # one retry on a FAST failure only: the dev-tunnel TPU worker
             # occasionally crashes under load and recovers within ~30 s —
             # a transient must not cost the round its long-sequence
             # headline. A timeout is a deterministic hang; retrying it
             # would double a ~30-minute wait for the same outcome.
             time.sleep(30)
-            r = _run_sub([sys.executable, os.path.abspath(__file__)],
-                         timeout=1800, env=env)
+            r, _ = _run_sub([sys.executable, os.path.abspath(__file__)],
+                            timeout=1800, env=env)
         if r:
             out.update(r)
         else:
@@ -269,9 +267,9 @@ def main():
         # to day on IDENTICAL programs, so the achieved-GB/s yardstick is
         # surfaced as session_hbm_gbps for reading cross-round MFU deltas
         # against the session, not just the noise floor.
-        r = _run_sub([sys.executable, os.path.join(here, "bench_ncf.py")],
-                     timeout=900,
-                     env=dict(os.environ, BENCH_CALIBRATE="1"))
+        r, _ = _run_sub([sys.executable, os.path.join(here, "bench_ncf.py")],
+                        timeout=900,
+                        env=dict(os.environ, BENCH_CALIBRATE="1"))
         if r:
             out["ncf_samples_per_sec"] = r.get("value")
             out["ncf_hbm_utilization_pct"] = r.get("hbm_utilization_pct")
@@ -295,22 +293,36 @@ def main():
         # hermetic CPU child: keep the rig's TPU-plugin sitecustomize
         # (and its network relay) out of the wire-path measurement
         env.pop("PALLAS_AXON_POOL_IPS", None)
-        r = _run_sub([sys.executable, os.path.join(here, "bench_serving.py")],
-                     timeout=900, env=env)
+        r, _ = _run_sub([sys.executable,
+                         os.path.join(here, "bench_serving.py")],
+                        timeout=900, env=env)
         if r:
             out["serving_p50_ms"] = r.get("value")
             out["serving_p99_ms"] = r.get("p99_ms")
             out["serving_broker"] = r.get("broker")
             out["serving_wire_only_p50_ms"] = r.get("wire_only_p50_ms")
+            # pipelined-engine sustained throughput (concurrent clients)
+            for key in ("serving_concurrent_rps_pipelined",
+                        "serving_concurrent_rps_sync",
+                        "serving_pipeline_speedup",
+                        "serving_concurrent_p50_ms",
+                        "serving_concurrent_p99_ms",
+                        "serving_drain_rps_pipelined",
+                        "serving_drain_rps_sync",
+                        "serving_drain_speedup",
+                        "serving_warm_first_request_ms",
+                        "serving_steady_p50_ms"):
+                if r.get(key) is not None:
+                    out[key] = r.get(key)
         else:
             out["serving_p50_ms"] = None
         # the model's forward ON the TPU (tunnel excluded), plus the int8
         # path; composed with the wire p50 above this is the full
         # production-host serving latency (VERDICT r4 #3)
         env = dict(os.environ, BENCH_DEVICE_FORWARD="1")
-        r2 = _run_sub([sys.executable, os.path.join(here,
-                                                    "bench_serving.py")],
-                      timeout=900, env=env)
+        r2, _ = _run_sub([sys.executable, os.path.join(here,
+                                                       "bench_serving.py")],
+                         timeout=900, env=env)
         if r2:
             for key in ("serving_device_forward_p50_ms",
                         "serving_device_forward_p99_ms",

@@ -11,6 +11,15 @@ reports each (the reference measures through Redis,
           MiniRedisServer over a localhost socket — the wire path a
           production Redis would serve; the headline number.
 
+A closed-loop concurrent-client section measures SUSTAINED throughput
+(what the single-in-flight p50 above cannot see): N client threads each
+keep one request in flight against the pipelined engine (overlapped
+decode/compute/sink, batched writeback) and against the old synchronous
+loop on the same model — `serving_concurrent_rps_*` and the
+`serving_pipeline_speedup` ratio. A warmup probe also reports post-
+`warmup()` first-request latency vs steady-state p50 (no XLA compile on
+the request path).
+
 Note on dev rigs with a remote-tunneled TPU (axon): every device call pays
 the tunnel's HTTP round trip (~100 ms), which dominates. A real v5e host
 runs the model in-process; set JAX_PLATFORMS=cpu to measure the serving
@@ -40,32 +49,46 @@ import numpy as np
 N_REQUESTS = 200
 
 
-def _measure(infer, broker_kind: str, n: int = N_REQUESTS):
-    from analytics_zoo_tpu.serving.broker import (MemoryBroker, TCPBroker,
-                                                  TCPBrokerServer)
-    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+def _setup_brokers(broker_kind: str, n_clients: int = 1):
+    """One serving-side connection plus `n_clients` client connections;
+    returns (serve_broker, client_brokers, server_or_None)."""
+    from analytics_zoo_tpu.serving.broker import (MemoryBroker, RedisBroker,
+                                                  TCPBroker, TCPBrokerServer)
     from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+
+    if broker_kind == "memory":
+        br = MemoryBroker()
+        return br, [br] * n_clients, None
+    if broker_kind == "tcp":
+        server = TCPBrokerServer().start()
+        return (TCPBroker(server.host, server.port),
+                [TCPBroker(server.host, server.port)
+                 for _ in range(n_clients)], server)
+    if broker_kind == "redis":
+        server = MiniRedisServer().start()
+        return (RedisBroker(server.host, server.port),
+                [RedisBroker(server.host, server.port)
+                 for _ in range(n_clients)], server)
+    raise ValueError(broker_kind)
+
+
+def _teardown_brokers(serve_broker, client_brokers, server):
+    for br in [serve_broker] + list(client_brokers):
+        if hasattr(br, "close"):
+            br.close()
+    if server is not None:
+        server.stop()
+
+
+def _measure(infer, broker_kind: str, n: int = N_REQUESTS):
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
     from analytics_zoo_tpu.serving.server import ClusterServing
 
-    server = None
-    if broker_kind == "memory":
-        serve_broker = client_broker = MemoryBroker()
-    elif broker_kind == "tcp":
-        server = TCPBrokerServer().start()
-        serve_broker = TCPBroker(server.host, server.port)
-        client_broker = TCPBroker(server.host, server.port)
-    elif broker_kind == "redis":
-        from analytics_zoo_tpu.serving.broker import RedisBroker
-        server = MiniRedisServer().start()
-        serve_broker = RedisBroker(server.host, server.port)
-        client_broker = RedisBroker(server.host, server.port)
-    else:
-        raise ValueError(broker_kind)
-
+    serve_broker, clients, server = _setup_brokers(broker_kind, 1)
     serving = ClusterServing(infer, broker=serve_broker, batch_size=32,
                              batch_timeout_ms=2).start()
-    inq = InputQueue(client_broker)
-    outq = OutputQueue(client_broker)
+    inq = InputQueue(clients[0])
+    outq = OutputQueue(clients[0])
 
     img = np.random.rand(32, 32, 3).astype(np.float32)
     lat = []
@@ -79,13 +102,126 @@ def _measure(infer, broker_kind: str, n: int = N_REQUESTS):
             time.sleep(0.0005)
         lat.append((time.perf_counter() - t0) * 1e3)
     serving.stop()
-    for br in (serve_broker, client_broker):
-        if hasattr(br, "close"):
-            br.close()
-    if server is not None:
-        server.stop()
+    _teardown_brokers(serve_broker, clients, server)
     lat = np.asarray(sorted(lat))
     return (float(np.percentile(lat, 50)), float(np.percentile(lat, 99)))
+
+
+def _measure_concurrent(infer, broker_kind: str, n_clients: int = 8,
+                        total: int = 320, pipelined: bool = True):
+    """Closed loop, `n_clients` logical clients: a request is submitted
+    the moment one completes, keeping exactly `n_clients` in flight. One
+    single-threaded loop drives all of them — per-client polling threads
+    would measure GIL/poll churn, not the engine. Each sweep drains
+    completed results with one `hgetall` + one batched delete, then
+    backfills one submit per completion. Returns (sustained records/s,
+    p50 ms, p99 ms)."""
+    from analytics_zoo_tpu.serving.client import RESULT_KEY, InputQueue
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    serve_broker, (submit_br, poll_br), server = _setup_brokers(
+        broker_kind, 2)
+    serving = ClusterServing(infer, broker=serve_broker, batch_size=32,
+                             batch_timeout_ms=2,
+                             pipelined=pipelined).start()
+    img = np.random.rand(32, 32, 3).astype(np.float32)
+    inq = InputQueue(submit_br)
+    inflight = {}
+    lat = []
+    submitted = 0
+
+    def submit():
+        nonlocal submitted
+        uri = inq.enqueue(t=img)
+        inflight[uri] = time.perf_counter()
+        submitted += 1
+
+    t_wall = time.perf_counter()
+    for _ in range(min(n_clients, total)):
+        submit()
+    deadline = time.time() + 120
+    while len(lat) < total and time.time() < deadline:
+        allr = poll_br.hgetall(RESULT_KEY)
+        done = [u for u in allr if u in inflight]
+        if not done:
+            time.sleep(0.001)
+            continue
+        now = time.perf_counter()
+        poll_br.hdel_many(RESULT_KEY, done)
+        for uri in done:
+            lat.append((now - inflight.pop(uri)) * 1e3)
+            if submitted < total:
+                submit()
+    t_wall = time.perf_counter() - t_wall
+    serving.stop()
+    _teardown_brokers(serve_broker, [submit_br, poll_br], server)
+    if not lat:
+        return 0.0, float("nan"), float("nan")
+    arr = np.asarray(sorted(lat))
+    return (len(lat) / t_wall,
+            float(np.percentile(arr, 50)), float(np.percentile(arr, 99)))
+
+
+def _measure_drain(infer, broker_kind: str, total: int = 480,
+                   pipelined: bool = True):
+    """Engine-limited throughput: pre-fill the stream with `total`
+    records, start the engine, time until every result lands. Client
+    costs are excluded (the backlog already exists), so unlike the
+    closed loop this is stable run-to-run and measures the serving
+    engine itself."""
+    from analytics_zoo_tpu.serving.client import RESULT_KEY, InputQueue
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    serve_broker, (submit_br, poll_br), server = _setup_brokers(
+        broker_kind, 2)
+    img = np.random.rand(32, 32, 3).astype(np.float32)
+    inq = InputQueue(submit_br)
+    for _ in range(total):
+        inq.enqueue(t=img)
+    serving = ClusterServing(infer, broker=serve_broker, batch_size=32,
+                             batch_timeout_ms=2,
+                             pipelined=pipelined).start()
+    t0 = time.perf_counter()
+    ndone = 0
+    deadline = time.time() + 120
+    while ndone < total and time.time() < deadline:
+        allr = poll_br.hgetall(RESULT_KEY)
+        if allr:
+            poll_br.hdel_many(RESULT_KEY, list(allr))
+            ndone += len(allr)
+        else:
+            time.sleep(0.001)
+    dt = time.perf_counter() - t0
+    serving.stop()
+    _teardown_brokers(serve_broker, [submit_br, poll_br], server)
+    return ndone / dt
+
+
+def _warmup_probe(model, replicas: int = 3):
+    """Fresh InferenceModel + warmup(): is the FIRST request's latency
+    within noise of steady-state (i.e. no compile on the request path)?
+    Min over independent fresh replicas: a single first-request sample on
+    a loaded box measures scheduler noise, while a compile on the request
+    path would inflate EVERY replica's first request, so the min still
+    detects it."""
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    x = np.random.rand(8, 32, 32, 3).astype(np.float32)  # exact bucket
+    firsts, steadies = [], []
+    for _ in range(replicas):
+        infer = InferenceModel().load_keras(model)
+        infer.warmup(np.zeros((32, 32, 3), np.float32),
+                     buckets=[1, 2, 4, 8, 16, 32])
+        t0 = time.perf_counter()
+        infer.predict(x)
+        firsts.append((time.perf_counter() - t0) * 1e3)
+        steady = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            infer.predict(x)
+            steady.append((time.perf_counter() - t0) * 1e3)
+        steadies.append(float(np.percentile(np.asarray(steady), 50)))
+    return min(firsts), float(np.median(steadies))
 
 
 def _serving_model():
@@ -290,6 +426,38 @@ def main():
         p50, p99 = _measure(infer, kind)
         results[kind] = {"p50_ms": round(p50, 2), "p99_ms": round(p99, 2)}
 
+    # sustained concurrent throughput: pipelined engine vs the old
+    # synchronous loop, same model, same redis wire path. Interleaved
+    # rounds, MEDIAN per engine: single-process thread scheduling swings
+    # individual runs up to 3x in both directions (2-core rigs), so a
+    # best-of estimator would crown whoever got the lucky spike while
+    # sequential blocks would hand one engine the warmed-up half of the
+    # session
+    # 32 in-flight: shallower closed loops leave the engine unsaturated
+    # (the single-process harness, not the server, becomes the limiter
+    # and the comparison measures harness scheduling)
+    # 5 rounds: with 3, one lucky scheduling spike for either engine
+    # still flips the median (observed: sync spiking 186 rps in a round
+    # while its other rounds sat at 115-128)
+    pipe_rounds, sync_rounds = [], []
+    for _ in range(5):
+        pipe_rounds.append(_measure_concurrent(infer, "redis",
+                                               n_clients=32,
+                                               pipelined=True))
+        sync_rounds.append(_measure_concurrent(infer, "redis",
+                                               n_clients=32,
+                                               pipelined=False))
+    pipe_rounds.sort(key=lambda r: r[0])
+    rps_pipe, cp50, cp99 = pipe_rounds[len(pipe_rounds) // 2]  # median round
+    rps_sync = float(np.median([r[0] for r in sync_rounds]))
+
+    # engine-limited drain (stable): pre-filled backlog, no client costs
+    drain_pipe = _measure_drain(infer, "redis", pipelined=True)
+    drain_sync = _measure_drain(infer, "redis", pipelined=False)
+
+    # no-compile-on-request-path probe
+    first_ms, steady_p50 = _warmup_probe(model)
+
     # pure wire cost: identity model through the redis path, so the
     # composed TPU number (wire + device forward) never counts a model
     # forward twice
@@ -310,6 +478,18 @@ def main():
         "wire_only_p50_ms": round(wire_p50, 2),
         "wire_only_p99_ms": round(wire_p99, 2),
         "n_requests": N_REQUESTS,
+        "serving_concurrent_rps_pipelined": round(rps_pipe, 1),
+        "serving_concurrent_rps_sync": round(rps_sync, 1),
+        "serving_pipeline_speedup": round(rps_pipe / max(rps_sync, 1e-9),
+                                          2),
+        "serving_concurrent_p50_ms": round(cp50, 2),
+        "serving_concurrent_p99_ms": round(cp99, 2),
+        "serving_drain_rps_pipelined": round(drain_pipe, 1),
+        "serving_drain_rps_sync": round(drain_sync, 1),
+        "serving_drain_speedup": round(drain_pipe / max(drain_sync, 1e-9),
+                                       2),
+        "serving_warm_first_request_ms": round(first_ms, 3),
+        "serving_steady_p50_ms": round(steady_p50, 3),
     }))
 
 

@@ -107,7 +107,7 @@ class TestRedisBrokerProtocol:
             time.sleep(0.1)
             broker._r.close()   # yank the connection under the loop
             time.sleep(0.2)
-            assert serving._thread.is_alive()
+            assert serving.is_alive()
             out = InputQueue(RedisBroker("127.0.0.1", port)).predict(
                 np.ones(3, np.float32), timeout_s=30)
             assert np.asarray(out).shape == (2,)

@@ -1,0 +1,333 @@
+"""Pipelined serving engine tests: concurrent mixed-shape clients through
+the staged decode/dispatch/sink pipeline, per-record failure degradation
+under load, clean drain on stop(), `InferenceModel.warmup`/`predict_async`,
+batched broker writeback (`hset_many`/`hdel_many`), and the per-stage
+percentile/queue-depth metrics surface."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.serving import (ClusterServing, InferenceModel,
+                                       InputQueue, MemoryBroker, OutputQueue)
+
+
+def make_model(in_dim=4, out_dim=3):
+    m = Sequential([L.Dense(out_dim, input_shape=(in_dim,))])
+    m.ensure_built(np.zeros((1, in_dim), np.float32))
+    im = InferenceModel()
+    im.load_keras(m)
+    return m, im
+
+
+def _wait_results(broker, uris, timeout_s=20.0, delete=False):
+    out = OutputQueue(broker)
+    results = {}
+    deadline = time.time() + timeout_s
+    while len(results) < len(uris) and time.time() < deadline:
+        for u in uris:
+            if u not in results:
+                r = out.query(u, delete=delete)
+                if r is not None:
+                    results[u] = r
+        time.sleep(0.005)
+    return results
+
+
+class TestWarmup:
+    def test_warmup_precompiles_every_bucket(self):
+        _, im = make_model()
+        im.warmup(np.zeros((4,), np.float32), buckets=[1, 2, 4, 8])
+        assert im.warmed_buckets == {1, 2, 4, 8}
+        assert set(im.warmup_report) == {"4:b1", "4:b2", "4:b4", "4:b8"}
+        n_compiled = im.compile_cache_size()
+        if n_compiled >= 0:
+            assert n_compiled == 4
+        # bucket-sized predicts afterwards add NO new executables:
+        # nothing compiles on the request path
+        for n in (1, 2, 4, 8):
+            im.predict(np.ones((n, 4), np.float32))
+        if n_compiled >= 0:
+            assert im.compile_cache_size() == n_compiled
+
+    def test_warmup_requires_model(self):
+        with pytest.raises(RuntimeError):
+            InferenceModel().warmup(np.zeros((4,), np.float32))
+
+
+class TestPredictAsync:
+    def test_matches_sync_predict(self):
+        m, im = make_model()
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        pending = im.predict_async(x)
+        np.testing.assert_allclose(pending.result(),
+                                   m.predict(x, batch_per_thread=8),
+                                   atol=1e-5)
+        # idempotent: second result() returns the same array, no resync
+        assert pending.result() is pending.result()
+
+    def test_valid_n_slices_prestacked_padding(self):
+        m, im = make_model()
+        x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        # caller stacked straight to the 4-bucket (last row repeated),
+        # as the serving dispatch stage does
+        stacked = np.concatenate([x, x[-1:]])
+        out = im.predict_async(stacked, valid_n=3).result()
+        assert out.shape == (3, 3)
+        np.testing.assert_allclose(out, m.predict(x, batch_per_thread=8),
+                                   atol=1e-5)
+
+    def test_many_in_flight_then_drain(self):
+        m, im = make_model()
+        xs = [np.random.RandomState(i).randn(2, 4).astype(np.float32)
+              for i in range(6)]
+        pendings = [im.predict_async(x) for x in xs]   # none materialized
+        for x, p in zip(xs, pendings):
+            np.testing.assert_allclose(p.result(),
+                                       m.predict(x, batch_per_thread=8),
+                                       atol=1e-5)
+        assert im.timer.count == 6
+
+    def test_oversize_batch_joins_chunks(self):
+        m = Sequential([L.Dense(3, input_shape=(4,))])
+        m.ensure_built(np.zeros((1, 4), np.float32))
+        im = InferenceModel(max_batch=8).load_keras(m)
+        x = np.random.RandomState(2).randn(20, 4).astype(np.float32)
+        out = im.predict_async(x).result()
+        assert out.shape == (20, 3)
+        np.testing.assert_allclose(out, m.predict(x, batch_per_thread=32),
+                                   atol=1e-5)
+
+
+class TestPipelinedServing:
+    def test_concurrent_clients_mixed_shapes(self):
+        """N threads submit records of DIFFERENT shapes concurrently; the
+        decode stage groups per shape, every result lands and matches the
+        direct forward."""
+        m4 = Sequential([L.Dense(2, input_shape=(4,))])
+        m4.ensure_built(np.zeros((1, 4), np.float32))
+
+        # shape-generic fn: sums features — serves any (n, d) input, so
+        # mixed shapes exercise distinct buckets through one model
+        im = InferenceModel().load_fn(
+            lambda p, x: x.sum(axis=-1, keepdims=True), params=())
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=16, decode_workers=3,
+                                 pipelined=True).start()
+        try:
+            results = {}
+            lock = threading.Lock()
+            errs = []
+
+            def client(seed, dim):
+                try:
+                    rng = np.random.RandomState(seed)
+                    q = InputQueue(br)
+                    mine = {}
+                    for _ in range(8):
+                        x = rng.randn(dim).astype(np.float32)
+                        mine[q.enqueue(None, t=x)] = x
+                    got = _wait_results(br, list(mine), timeout_s=30)
+                    with lock:
+                        for u, x in mine.items():
+                            results[u] = (x, got.get(u))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=client, args=(i, dim))
+                       for i, dim in enumerate([3, 5, 8, 3, 5, 8])]
+            [t.start() for t in threads]
+            [t.join(timeout=60) for t in threads]
+            assert not errs
+            assert len(results) == 48
+            for x, got in results.values():
+                assert got is not None, "a result never landed"
+                np.testing.assert_allclose(
+                    got, x.sum(keepdims=True), atol=1e-5)
+        finally:
+            serving.stop()
+
+    def test_decode_failure_degrades_without_stalling(self):
+        """Poisoned records interleaved with good ones under the
+        pipelined path: bad ones yield "NaN", good ones still serve."""
+        m, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=8,
+                                 pipelined=True).start()
+        try:
+            q = InputQueue(br)
+            good, bad = [], []
+            for i in range(6):
+                good.append(q.enqueue(
+                    None, t=np.ones((4,), np.float32) * i))
+                bad_uri = f"bad-{i}"
+                br.xadd("serving_stream",
+                        {"uri": bad_uri,
+                         "data": {"t": {"b64": "!!!", "dtype": "float32",
+                                        "shape": [4]}}})
+                bad.append(bad_uri)
+            results = _wait_results(br, good + bad, timeout_s=20)
+            assert len(results) == 12
+            for u in bad:
+                assert isinstance(results[u], float) \
+                    and np.isnan(results[u])
+            for u in good:
+                assert np.asarray(results[u]).shape == (3,)
+        finally:
+            serving.stop()
+
+    def test_non_dict_record_degrades_and_batch_survives(self):
+        """A foreign producer can XADD any JSON — a record that isn't
+        even a dict must degrade without starving the rest of its read
+        batch (a raised failure path would drop the whole batch into the
+        broker's redelivery loop forever)."""
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_timeout_ms=5,
+                                 pipelined=True).start()
+        try:
+            br.xadd("serving_stream", [1, 2, 3])
+            q = InputQueue(br)
+            uri = q.enqueue(None, t=np.ones((4,), np.float32))
+            results = _wait_results(br, [uri], timeout_s=20)
+            assert np.asarray(results[uri]).shape == (3,)
+        finally:
+            serving.stop()
+
+    def test_stop_drains_in_flight_work(self):
+        """Records already read from the broker must flow out through the
+        sink before stop() returns."""
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=8,
+                                 pipelined=True).start()
+        q = InputQueue(br)
+        uris = [q.enqueue(None, t=np.ones((4,), np.float32))
+                for _ in range(12)]
+        deadline = time.time() + 20
+        while serving.records_read < 12 and time.time() < deadline:
+            time.sleep(0.005)
+        assert serving.records_read == 12
+        serving.stop()
+        # all work that was read is now written back and acked
+        assert serving.records_served == 12
+        out = OutputQueue(br)
+        for u in uris:
+            assert out.query(u) is not None
+        # stage threads are gone
+        assert not serving._threads
+
+    def test_metrics_expose_stage_percentiles_and_queue_depths(self):
+        _, im = make_model()
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, pipelined=True).start()
+        try:
+            InputQueue(br).predict(np.ones((4,), np.float32))
+            m = serving.metrics()
+            assert m["records_served"] >= 1
+            assert m["pipelined"] is True
+            for stage in ("decode", "dispatch", "sink"):
+                snap = m["stages"][stage]
+                assert snap["count"] >= 1
+                for k in ("p50_ms", "p95_ms", "p99_ms"):
+                    assert snap[k] >= 0.0
+            assert set(m["queue_depths"]) == {"decode", "dispatch", "sink"}
+            # end-to-end batch + predict timers carry percentiles too
+            assert m["batch"]["p50_ms"] > 0.0
+            assert m["predict"]["p99_ms"] >= m["predict"]["p50_ms"]
+        finally:
+            serving.stop()
+
+    def test_output_filter_through_pipeline(self):
+        im = InferenceModel().load_fn(
+            lambda p, x: x, params=())
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, output_filter="topN(2)",
+                                 pipelined=True).start()
+        try:
+            q = InputQueue(br)
+            uri = q.enqueue(None, t=np.asarray([0.1, 0.7, 0.2], np.float32))
+            results = _wait_results(br, [uri], timeout_s=20)
+            assert isinstance(results[uri], str) \
+                and results[uri].startswith("[")
+        finally:
+            serving.stop()
+
+
+class TestBatchedWriteback:
+    def test_hset_many_memory(self):
+        br = MemoryBroker()
+        br.hset_many("k", {"a": "1", "b": "2"})
+        assert br.hgetall("k") == {"a": "1", "b": "2"}
+        br.hdel_many("k", ["a", "b"])
+        assert br.hgetall("k") == {}
+
+    def test_hset_many_tcp(self):
+        from analytics_zoo_tpu.serving.broker import (TCPBroker,
+                                                      TCPBrokerServer)
+        srv = TCPBrokerServer().start()
+        try:
+            cli = TCPBroker(srv.host, srv.port)
+            cli.hset_many("k", {"a": "1", "b": "2"})
+            assert cli.hgetall("k") == {"a": "1", "b": "2"}
+            cli.hdel_many("k", ["a"])
+            assert cli.hgetall("k") == {"b": "2"}
+        finally:
+            srv.stop()
+
+    def test_hset_many_redis_variadic(self):
+        from analytics_zoo_tpu.serving.broker import RedisBroker
+        from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+        srv = MiniRedisServer().start()
+        try:
+            cli = RedisBroker(srv.host, srv.port)
+            cli.hset_many("k", {"a": "1", "b": "2", "c": "3"})
+            assert cli.hgetall("k") == {"a": "1", "b": "2", "c": "3"}
+            cli.hdel_many("k", ["a", "c"])
+            assert cli.hgetall("k") == {"b": "2"}
+            cli.close()
+        finally:
+            srv.stop()
+
+    def test_redis_broker_clone_is_independent_connection(self):
+        from analytics_zoo_tpu.serving.broker import RedisBroker
+        from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+        srv = MiniRedisServer().start()
+        try:
+            a = RedisBroker(srv.host, srv.port)
+            b = a.clone()
+            assert b is not a and b._r is not a._r
+            a.hset("k", "f", "v")
+            assert b.hget("k", "f") == "v"
+            a.close()
+            b.close()
+        finally:
+            srv.stop()
+
+
+class TestTimerHistogram:
+    def test_streaming_percentiles_close_to_exact(self):
+        from analytics_zoo_tpu.serving.timer import Timer
+        rng = np.random.RandomState(0)
+        samples = rng.lognormal(mean=-6.0, sigma=1.0, size=5000)
+        t = Timer("t")
+        for s in samples:
+            t.record(float(s))
+        snap = t.snapshot()
+        for q, key in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+            exact = float(np.percentile(samples, q)) * 1e3
+            # log-bucketed histogram: bounded relative error
+            assert abs(snap[key] - exact) / exact < 0.25, (key, snap[key],
+                                                           exact)
+        assert snap["p50_ms"] <= snap["p95_ms"] <= snap["p99_ms"]
+
+    def test_percentiles_clamped_to_observed_range(self):
+        from analytics_zoo_tpu.serving.timer import Timer
+        t = Timer("t")
+        t.record(0.010)
+        snap = t.snapshot()
+        assert snap["p50_ms"] == snap["p99_ms"] == 10.0
