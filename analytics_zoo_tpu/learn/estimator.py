@@ -155,7 +155,10 @@ class Estimator:
         """`fit_kwargs` pass through to the trainer loop: `steps_per_run=k`
         fuses k steps per dispatch, `mixed_precision=True` runs bf16
         compute with f32 masters, `prefetch=False` disables the
-        background batch pipeline."""
+        background batch pipeline, `metrics_report_s=30` logs a periodic
+        registry digest, `flops_per_step=...` enables the MFU gauge.
+        Step/loss/throughput telemetry lands in the process-wide
+        `MetricsRegistry` either way (`observability/`)."""
         ds = to_dataset(data, batch_size=batch_size or 32,
                         feature_cols=feature_cols, label_cols=label_cols)
         # a pre-built TPUDataset's own batch/shuffle settings win over fit()
@@ -248,6 +251,13 @@ class Estimator:
                               "giving up", cfg.failure.retry_times,
                               cfg.failure.retry_time_interval_s)
                     raise
+                # counted only once the budget check passed: the final
+                # fatal failure re-raises above and is NOT a recovery
+                from analytics_zoo_tpu.observability import get_registry
+                get_registry().counter(
+                    "training_retries_total",
+                    "training failures recovered by snapshot-restore "
+                    "retry").inc()
                 log.warning("Training failure (%s: %s); restoring latest "
                             "snapshot and retrying (%d/%d)",
                             type(e).__name__, e, len(failures),

@@ -29,8 +29,54 @@ import optax
 
 from analytics_zoo_tpu.common.context import get_context
 from analytics_zoo_tpu.common import triggers as tg
+from analytics_zoo_tpu.observability.registry import get_registry
 
 log = logging.getLogger("analytics_zoo_tpu.trainer")
+
+
+class _TrainingMetrics:
+    """Training telemetry published into the process-wide registry — the
+    same spine the serving pipeline and HTTP frontend feed, so one
+    `GET /metrics` scrape answers for both sides of the platform.
+    Registration is get-or-create: repeated fits converge on the same
+    families and counters accumulate across fits (that is the Prometheus
+    model; per-fit views come from `MetricsRegistry.delta`)."""
+
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else get_registry()
+        self.step_ms = reg.histogram(
+            "training_step_ms",
+            "per-step wall time, averaged over each epoch's device sync")
+        self.steps = reg.counter("training_steps_total",
+                                 "optimizer steps run")
+        self.samples = reg.counter("training_samples_total",
+                                   "training samples consumed")
+        self.epochs = reg.counter("training_epochs_total",
+                                  "epochs completed")
+        self.loss = reg.gauge("training_loss", "mean loss of the last epoch")
+        self.throughput = reg.gauge("training_samples_per_sec",
+                                    "last epoch's training throughput")
+        self.mfu = reg.gauge(
+            "training_mfu",
+            "model FLOPs utilization vs per-chip peak (needs "
+            "flops_per_step)")
+        self.val = reg.gauge("training_validation_metric",
+                             "last validation metrics, labeled by name")
+
+    def epoch(self, steps: int, n_seen: int, dt: float, mean_loss: float,
+              flops_per_step: Optional[float] = None):
+        step_ms = dt / max(steps, 1) * 1e3
+        self.step_ms.observe(step_ms)
+        self.steps.inc(steps)
+        self.samples.inc(n_seen)
+        self.epochs.inc()
+        self.loss.set(mean_loss)
+        self.throughput.set(n_seen / max(dt, 1e-9))
+        if flops_per_step:
+            from analytics_zoo_tpu.utils.roofline import peak_flops
+            peak = peak_flops(jax.devices()[0]) * jax.device_count()
+            self.mfu.set(flops_per_step * steps / max(dt, 1e-9) / peak)
+        return step_ms
 
 
 # ---------------------------------------------------------------------------
@@ -485,7 +531,9 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               prefetch: bool = True,
               lazy_embeddings: bool = False,
               device_cache: Optional[bool] = None,
-              flat_optimizer: bool = False
+              flat_optimizer: bool = False,
+              flops_per_step: Optional[float] = None,
+              metrics_report_s: Optional[float] = None
               ) -> Dict[str, List[float]]:
     """`KerasNet.fit` backend. Returns a Keras-style history dict.
     `batch_iter_factory(epoch) -> iterator of (xb, yb, real)` overrides the
@@ -498,6 +546,13 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     steps into one `lax.scan` program — one dispatch per k steps —
     trading trigger granularity (checked every k iterations) for dispatch
     overhead. `mixed_precision` runs fwd/bwd in bf16 with f32 masters.
+    `flops_per_step` (fwd+bwd FLOPs of one step, e.g. from
+    `utils.profiling.transformer_train_flops`) enables the
+    `training_mfu` gauge; `metrics_report_s` runs a `MetricsReporter`
+    for the duration of the fit, logging a one-line registry digest at
+    that interval. Step/throughput/loss telemetry always publishes to
+    the process-wide `MetricsRegistry` (and mirrors to TensorBoard when
+    `set_tensorboard` is on).
     `flat_optimizer=True` runs the optimizer sweep over shape-bucketed
     stacked parameter buffers (`ops/flat_optimizer.py`) instead of
     per-tensor updates — the TPU analogue of the reference's flat
@@ -687,11 +742,19 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         from analytics_zoo_tpu.utils.tensorboard import SummaryWriter
         writer = SummaryWriter(model._tensorboard_dir + "/train")
 
+    telemetry = _TrainingMetrics()
+    reporter = None
+    if metrics_report_s:
+        from analytics_zoo_tpu.observability.reporter import MetricsReporter
+        reporter = MetricsReporter(interval_s=metrics_report_s,
+                                   writer=writer).start()
+
     history: Dict[str, List[float]] = {"loss": []}
     iteration = 0
     batches = None
     try:
         for epoch in range(epochs):
+          it0 = iteration
           losses_dev: List[Any] = []   # device scalars/vectors; sync at end
           t0 = time.time()
           n_seen = 0
@@ -777,9 +840,12 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
           mean_loss = float(step_losses.mean()) if len(step_losses) else 0.0
           history["loss"].append(mean_loss)
           throughput = n_seen / max(dt, 1e-9)
+          step_ms = telemetry.epoch(iteration - it0, n_seen, dt, mean_loss,
+                                    flops_per_step=flops_per_step)
           if writer:
               writer.scalar("Loss", mean_loss, iteration)
               writer.scalar("Throughput", throughput, iteration)
+              writer.scalar("StepTime_ms", step_ms, iteration)
           log.info("Epoch %d/%d  loss=%.4f  %.0f samples/s",
                    epoch + 1, epochs, mean_loss, throughput)
 
@@ -790,6 +856,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                                    batch_per_thread=max(batch_size // dp, 1))
               for k, v in val.items():
                   history.setdefault("val_" + k, []).append(v)
+                  telemetry.val.set(v, name=k)
               if writer:
                   for k, v in val.items():
                       writer.scalar("val_" + k, v, iteration)
@@ -817,6 +884,8 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         model.params = _as_tree(params)
         if isinstance(batches, _Prefetcher):
             batches.close()
+        if reporter is not None:
+            reporter.stop()   # logs a final digest (before writer closes)
         if writer:
             writer.close()
     return history

@@ -1,0 +1,29 @@
+"""Unified observability layer (ISSUE 2): one metrics registry, one
+tracer, one exposition path for serving AND training.
+
+- `MetricsRegistry` / `get_registry()` — labeled Counter/Gauge/Histogram
+  families; the Histogram is the log-bucketed streaming histogram from
+  `serving/timer.py`, generalized.
+- `render_prometheus(registry)` — Prometheus 0.0.4 text, served by the
+  HTTP frontend's `GET /metrics` under `Accept: text/plain`.
+- `Tracer` — request-scoped spans with Chrome trace-event JSON export
+  (Perfetto-viewable), threaded through the serving pipeline.
+- `MetricsReporter` — periodic one-line digest thread.
+"""
+
+from analytics_zoo_tpu.observability.prometheus import (CONTENT_TYPE,
+                                                        render_prometheus)
+from analytics_zoo_tpu.observability.registry import (Counter, Gauge,
+                                                      Histogram,
+                                                      LogHistogram,
+                                                      MetricsRegistry,
+                                                      get_registry)
+from analytics_zoo_tpu.observability.reporter import MetricsReporter, digest
+from analytics_zoo_tpu.observability.tracing import (Span, Tracer,
+                                                     span_coverage)
+
+__all__ = [
+    "CONTENT_TYPE", "Counter", "Gauge", "Histogram", "LogHistogram",
+    "MetricsRegistry", "MetricsReporter", "Span", "Tracer", "digest",
+    "get_registry", "render_prometheus", "span_coverage",
+]

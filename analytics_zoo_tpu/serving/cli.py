@@ -59,12 +59,17 @@ def cmd_start(args) -> int:
             model.warmup(np.zeros(tuple(shape), dtype), buckets=buckets)
         print(f"warmed {len(model.warmed_buckets)} shape buckets: "
               f"{json.dumps(model.warmup_report)}", flush=True)
+    tracer = None
+    if cfg.trace or cfg.trace_path:
+        from analytics_zoo_tpu.observability import Tracer
+        tracer = Tracer()
     serving = ClusterServing(model, broker, stream=cfg.stream,
                              batch_size=cfg.batch_size,
                              batch_timeout_ms=cfg.batch_timeout_ms,
                              pipelined=cfg.pipelined,
                              decode_workers=cfg.decode_workers,
-                             queue_depth=cfg.queue_depth).start()
+                             queue_depth=cfg.queue_depth,
+                             tracer=tracer).start()
     if frontend is not None:
         frontend._srv.serving = serving
     print("cluster serving started", flush=True)
@@ -74,6 +79,10 @@ def cmd_start(args) -> int:
             frontend.stop()
         serving.stop()
         print(json.dumps(serving.metrics()), flush=True)
+        if tracer is not None and cfg.trace_path:
+            tracer.write_chrome_trace(cfg.trace_path)
+            print(f"chrome trace written to {cfg.trace_path} "
+                  "(open in ui.perfetto.dev)", flush=True)
 
     return _run_until_signal(shutdown)
 
@@ -111,8 +120,13 @@ def cmd_metrics(args) -> int:
         raise SystemExit(
             f"metrics is served by the HTTP frontend; expected an http(s) "
             f"URL (host:http_port), got {url!r}")
-    print(urllib.request.urlopen(url.rstrip("/") + "/metrics",
-                                 timeout=10).read().decode())
+    # --prometheus negotiates the text exposition (what a scraper sees);
+    # default stays the JSON timer snapshot
+    headers = {"Accept": "text/plain"} if getattr(
+        args, "prometheus", False) else {}
+    req = urllib.request.Request(url.rstrip("/") + "/metrics",
+                                 headers=headers)
+    print(urllib.request.urlopen(req, timeout=10).read().decode())
     return 0
 
 
@@ -133,6 +147,9 @@ def main(argv=None) -> int:
     pr.set_defaults(fn=cmd_redis)
     pm = sub.add_parser("metrics", help="fetch frontend metrics")
     pm.add_argument("--url", required=True)
+    pm.add_argument("--prometheus", action="store_true",
+                    help="request Prometheus text exposition "
+                         "(Accept: text/plain)")
     pm.set_defaults(fn=cmd_metrics)
     args = p.parse_args(argv)
     return args.fn(args)

@@ -87,6 +87,11 @@ class ServingConfig:
     # compile lands on the request path
     warmup_shapes: Optional[list] = None
     warmup_dtype: str = "float32"
+    # request-scoped tracing (`observability/tracing.py`): `trace: true`
+    # attaches a span Tracer to the pipeline; trace_path additionally
+    # dumps Chrome trace JSON (Perfetto-viewable) on shutdown
+    trace: bool = False
+    trace_path: Optional[str] = None
     http_port: Optional[int] = None
     # secure block (`ClusterServingHelper.scala:121-134` — model_encrypted
     # gates the wait-for-secret/salt flow before weights load)
@@ -130,6 +135,8 @@ class ServingConfig:
         cfg.warmup_shapes = _parse_warmup_shapes(
             params.get("warmup_shapes"))
         cfg.warmup_dtype = str(params.get("warmup_dtype", "float32"))
+        cfg.trace = bool(params.get("trace", False))
+        cfg.trace_path = params.get("trace_path")
         if raw.get("http_port") is not None:
             cfg.http_port = int(raw["http_port"])
         secure = raw.get("secure", {}) or {}

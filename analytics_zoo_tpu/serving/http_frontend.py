@@ -34,6 +34,10 @@ from urllib.parse import parse_qs
 
 import numpy as np
 
+from analytics_zoo_tpu.observability.prometheus import (
+    CONTENT_TYPE as PROMETHEUS_CONTENT_TYPE, render_prometheus)
+from analytics_zoo_tpu.observability.registry import (MetricsRegistry,
+                                                      get_registry)
 from analytics_zoo_tpu.serving.broker import Broker, connect_broker
 from analytics_zoo_tpu.serving.client import InputQueue
 from analytics_zoo_tpu.serving.server import ClusterServing
@@ -43,6 +47,12 @@ from analytics_zoo_tpu.serving.timer import Timer
 MODEL_SECURED_KEY = "model_secured"
 MODEL_SECURED_SECRET = "secret"
 MODEL_SECURED_SALT = "salt"
+
+# route tables: a known route hit with the wrong method answers 405 with
+# an Allow header (silent 404s made method typos indistinguishable from
+# wrong URLs); unknown paths stay 404
+ROUTES_GET = ("/", "/metrics", "/trace")
+ROUTES_POST = ("/predict", "/model-secure")
 
 
 class TokenBucket:
@@ -86,37 +96,93 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
         pass
 
-    def _send(self, code: int, payload):
-        body = json.dumps(payload).encode()
+    def _count_request(self, code: int):
+        counter = getattr(self.server, "http_requests", None)
+        if counter is not None:
+            route = self.path.split("?", 1)[0]
+            if route not in ROUTES_GET and route not in ROUTES_POST:
+                route = "other"   # bound label cardinality against scans
+            counter.inc(route=route, code=str(code),
+                        method=self.command or "GET")
+
+    def _send_bytes(self, code: int, body: bytes, content_type: str,
+                    allow: Optional[str] = None):
+        self._count_request(code)
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if allow:
+            self.send_header("Allow", allow)
         self.end_headers()
         self.wfile.write(body)
 
+    def _send(self, code: int, payload, allow: Optional[str] = None):
+        self._send_bytes(code, json.dumps(payload).encode(),
+                         "application/json", allow=allow)
+
+    def _method_not_allowed(self, allow: str):
+        self._send(405, {"error": f"method {self.command} not allowed; "
+                                  f"allowed: {allow}"}, allow=allow)
+
     def do_GET(self):
-        if self.path == "/":
+        path = self.path.split("?", 1)[0]
+        if path == "/":
             self._send(200, {"message": "welcome to analytics zoo web "
                                         "serving frontend"})
-        elif self.path == "/metrics":
-            serving: Optional[ClusterServing] = self.server.serving
-            timers = {"frontend": self.server.request_timer.snapshot()}
-            if serving is not None:
-                timers.update(serving.metrics())
-            self._send(200, timers)
+        elif path == "/metrics":
+            self._metrics()
+        elif path == "/trace":
+            self._trace()
+        elif path in ROUTES_POST:
+            self._method_not_allowed("POST")
         else:
             self._send(404, {"error": "not found"})
+
+    def _metrics(self):
+        """Content negotiation: `Accept: text/plain` (Prometheus scrape)
+        gets 0.0.4 exposition text of the process-wide registry —
+        serving per-stage histograms, queue gauges, HTTP counters, and
+        any training metrics published in-process; everything else keeps
+        the original JSON timer snapshot (now with the registry snapshot
+        alongside)."""
+        accept = self.headers.get("Accept", "") or ""
+        registry: MetricsRegistry = self.server.registry
+        if "text/plain" in accept or "openmetrics" in accept:
+            self._send_bytes(200, render_prometheus(registry).encode(),
+                             PROMETHEUS_CONTENT_TYPE)
+            return
+        serving: Optional[ClusterServing] = self.server.serving
+        timers = {"frontend": self.server.request_timer.snapshot()}
+        if serving is not None:
+            timers.update(serving.metrics())
+        timers["registry"] = registry.snapshot()
+        self._send(200, timers)
+
+    def _trace(self):
+        """Chrome trace-event JSON of the serving pipeline's spans
+        (open in Perfetto); 404 when no tracer is attached."""
+        serving: Optional[ClusterServing] = self.server.serving
+        tracer = getattr(serving, "tracer", None) if serving else None
+        if tracer is None:
+            self._send(404, {"error": "tracing not enabled; attach a "
+                                      "Tracer to ClusterServing"})
+            return
+        self._send(200, tracer.chrome_trace())
 
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", 0))
         return self.rfile.read(length)
 
     def do_POST(self):
-        if self.path == "/model-secure":
+        path = self.path.split("?", 1)[0]
+        if path == "/model-secure":
             self._model_secure()
             return
-        if self.path != "/predict":
-            self._send(404, {"error": "not found"})
+        if path != "/predict":
+            if path in ROUTES_GET:
+                self._method_not_allowed("GET")
+            else:
+                self._send(404, {"error": "not found"})
             return
         limiter: Optional[TokenBucket] = self.server.rate_limiter
         if limiter is not None and not limiter.try_acquire(
@@ -152,6 +218,19 @@ class _Handler(BaseHTTPRequestHandler):
                                      .tolist()})
             except Exception as e:  # noqa: BLE001 — frontend must not die
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    def _unsupported_method(self):
+        path = self.path.split("?", 1)[0]
+        if path in ROUTES_GET:
+            self._method_not_allowed("GET")
+        elif path in ROUTES_POST:
+            self._method_not_allowed("POST")
+        else:
+            self._send(404, {"error": "not found"})
+
+    do_PUT = _unsupported_method
+    do_DELETE = _unsupported_method
+    do_PATCH = _unsupported_method
 
     def _model_secure(self):
         """`FrontEndApp.scala:140-152`: body `secret=xxx&salt=yyy` → broker
@@ -207,7 +286,8 @@ class FrontEnd:
                  token_bucket_capacity: Optional[float] = None,
                  token_acquire_timeout_ms: float = 100.0,
                  tls_certfile: Optional[str] = None,
-                 tls_keyfile: Optional[str] = None):
+                 tls_keyfile: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self._srv = _FrontEndServer((host, port), _Handler)
@@ -216,6 +296,15 @@ class FrontEnd:
         self._srv.broker = self.broker
         self._srv.serving = serving
         self._srv.request_timer = Timer("http_predict")
+        self.registry = registry if registry is not None else get_registry()
+        self._srv.registry = self.registry
+        self._srv.http_requests = self.registry.counter(
+            "http_requests_total",
+            "frontend responses by route, method and status code")
+        req_hist = self.registry.histogram(
+            "http_request_ms", "frontend /predict round-trip duration")
+        self._srv.request_timer.add_observer(
+            lambda s: req_hist.observe(s * 1e3))
         self._srv.timeout_s = timeout_s
         self._srv.rate_limiter = (
             TokenBucket(tokens_per_second, token_bucket_capacity)

@@ -49,6 +49,9 @@ from typing import List, Optional, Union
 
 import numpy as np
 
+from analytics_zoo_tpu.observability.registry import (MetricsRegistry,
+                                                      get_registry)
+from analytics_zoo_tpu.observability.tracing import Tracer
 from analytics_zoo_tpu.serving.broker import (Broker, connect_broker,
                                               decode_ndarray, encode_ndarray,
                                               new_consumer_name)
@@ -63,10 +66,21 @@ GROUP = "serving_group"
 _STOP = object()          # stage poison pill
 
 
+def _record_uris(records) -> List[str]:
+    """Request ids (the result-hash uris) for a raw read batch — the
+    trace ids every stage span is tagged with. Malformed records fall
+    back to the broker record id, matching `_decode_records`."""
+    out = []
+    for rid, rec in records:
+        out.append(rec.get("uri", rid) if isinstance(rec, dict)
+                   else str(rid))
+    return out
+
+
 class _Batch:
     """One shape-homogeneous unit of pipeline work."""
 
-    __slots__ = ("ids", "uris", "arrays", "t0", "pending", "nan")
+    __slots__ = ("ids", "uris", "arrays", "t0", "pending", "nan", "t_enq")
 
     def __init__(self, ids, uris, arrays, t0, nan=False):
         self.ids = ids            # broker record ids (for the batched ack)
@@ -75,6 +89,7 @@ class _Batch:
         self.t0 = t0              # read timestamp: end-to-end latency base
         self.pending = None       # PendingPrediction after dispatch
         self.nan = nan            # failure batch: sink writes "NaN"
+        self.t_enq = t0           # last enqueue timestamp (queue-wait spans)
 
 
 class ClusterServing:
@@ -84,7 +99,9 @@ class ClusterServing:
                  batch_size: int = 32, batch_timeout_ms: int = 5,
                  output_filter: Optional[str] = None,
                  pipelined: bool = True, decode_workers: int = 2,
-                 queue_depth: int = 8):
+                 queue_depth: int = 8,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
@@ -122,6 +139,50 @@ class ClusterServing:
         self.records_served = 0
         self.records_read = 0
         self._counter_lock = threading.Lock()
+        self.registry = registry if registry is not None else get_registry()
+        self.tracer = tracer
+        self._wire_registry()
+
+    def _wire_registry(self):
+        """Mirror the engine's private Timers into the process-wide
+        registry (the telemetry spine): per-stage histograms via Timer
+        observers, record counters by outcome, and live queue-depth
+        gauges evaluated at snapshot/scrape time."""
+        reg = self.registry
+        stage_hist = reg.histogram(
+            "serving_stage_ms",
+            "per-stage serving pipeline duration (decode, dispatch, sink, "
+            "predict)")
+        batch_hist = reg.histogram(
+            "serving_batch_ms",
+            "end-to-end latency per pipeline batch, broker read to result "
+            "writeback")
+        self._records_total = reg.counter(
+            "serving_records_total",
+            "records through the serving engine, by outcome (read, served)")
+        for timer, stage in ((self.decode_timer, "decode"),
+                             (self.dispatch_timer, "dispatch"),
+                             (self.sink_timer, "sink")):
+            timer.add_observer(
+                lambda s, _st=stage: stage_hist.observe(s * 1e3, stage=_st))
+        self.batch_timer.add_observer(lambda s: batch_hist.observe(s * 1e3))
+        # the model (and its predict Timer) may outlive/be shared across
+        # ClusterServing instances — attach the mirror exactly once
+        if not getattr(self.model.timer, "_registry_mirrored", False):
+            self.model.timer.add_observer(
+                lambda s: stage_hist.observe(s * 1e3, stage="predict"))
+            self.model.timer._registry_mirrored = True
+        qd = reg.gauge("serving_queue_depth",
+                       "live depth of each inter-stage pipeline queue")
+        qd.set_function(self._decode_q.qsize, queue="decode")
+        qd.set_function(self._dispatch_q.qsize, queue="dispatch")
+        qd.set_function(self._sink_q.qsize, queue="sink")
+
+    def _enqueue(self, q: "queue.Queue", batch: _Batch):
+        """Stamp the enqueue time (the consumer's queue-wait span starts
+        here — a blocking put under backpressure counts as wait) and put."""
+        batch.t_enq = time.perf_counter()
+        q.put(batch)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ClusterServing":
@@ -226,6 +287,7 @@ class ClusterServing:
                         block_ms=self.batch_timeout_ms)
                 with self._counter_lock:
                     self.records_read += len(records)
+                self._records_total.inc(len(records), outcome="read")
                 self._decode_q.put((time.perf_counter(), records))
             except Exception as e:  # noqa: BLE001 — the Flink-restart role
                 # transient broker failures (redis stall/restart) must not
@@ -269,18 +331,28 @@ class ClusterServing:
             if item is _STOP:
                 return
             t0, records = item
+            tr = self.tracer
+            uris = _record_uris(records) if tr is not None else None
+            if tr is not None:
+                # queue wait: broker read (t0) -> this dequeue
+                tr.add_span("decode_q_wait", t0, time.perf_counter(),
+                            cat="serving.queue", trace_ids=uris)
             try:
-                with self.decode_timer.timing():
-                    by_shape, failed = self._decode_records(records)
-                    if failed:
-                        self._sink_q.put(_Batch(
-                            [rid for rid, _ in failed],
-                            [uri for _, uri in failed], None, t0, nan=True))
-                    for items in by_shape.values():
-                        self._dispatch_q.put(_Batch(
-                            [rid for rid, _, _ in items],
-                            [uri for _, uri, _ in items],
-                            [a for _, _, a in items], t0))
+                t_work = time.perf_counter()
+                by_shape, failed = self._decode_records(records)
+                if failed:
+                    self._enqueue(self._sink_q, _Batch(
+                        [rid for rid, _ in failed],
+                        [uri for _, uri in failed], None, t0, nan=True))
+                for items in by_shape.values():
+                    self._enqueue(self._dispatch_q, _Batch(
+                        [rid for rid, _, _ in items],
+                        [uri for _, uri, _ in items],
+                        [a for _, _, a in items], t0))
+                t_end = time.perf_counter()
+                self.decode_timer.record(t_end - t_work)
+                if tr is not None:
+                    tr.add_span("decode", t_work, t_end, trace_ids=uris)
             except Exception as e:  # noqa: BLE001 — stage must survive
                 log.error("decode stage failed for a read batch: %s", e)
 
@@ -290,29 +362,39 @@ class ClusterServing:
             batch = self._dispatch_q.get()
             if batch is _STOP:
                 return
+            tr = self.tracer
+            if tr is not None:
+                tr.add_span("dispatch_q_wait", batch.t_enq,
+                            time.perf_counter(), cat="serving.queue",
+                            trace_ids=batch.uris)
             try:
-                with self.dispatch_timer.timing():
-                    n = len(batch.arrays)
-                    bucket = _next_bucket(n, self.model.buckets)
-                    arrs = batch.arrays
-                    if bucket > n:
-                        # stack straight to the bucket: padding costs
-                        # nothing extra (the stack copies anyway) and
-                        # predict_async skips its device-side pad
-                        arrs = arrs + [arrs[-1]] * (bucket - n)
-                    stacked = np.stack(arrs)
-                    batch.arrays = None
-                    # async: returns before the device finishes — the
-                    # sink materializes while we stack the next batch
-                    batch.pending = self.model.predict_async(
-                        stacked, valid_n=n)
-                self._sink_q.put(batch)
+                t_work = time.perf_counter()
+                n = len(batch.arrays)
+                bucket = _next_bucket(n, self.model.buckets)
+                arrs = batch.arrays
+                if bucket > n:
+                    # stack straight to the bucket: padding costs
+                    # nothing extra (the stack copies anyway) and
+                    # predict_async skips its device-side pad
+                    arrs = arrs + [arrs[-1]] * (bucket - n)
+                stacked = np.stack(arrs)
+                batch.arrays = None
+                # async: returns before the device finishes — the
+                # sink materializes while we stack the next batch
+                batch.pending = self.model.predict_async(
+                    stacked, valid_n=n)
+                t_end = time.perf_counter()
+                self.dispatch_timer.record(t_end - t_work)
+                if tr is not None:
+                    tr.add_span("dispatch", t_work, t_end,
+                                trace_ids=batch.uris)
+                self._enqueue(self._sink_q, batch)
             except Exception as e:  # noqa: BLE001 — stream must survive
                 log.error("dispatch failure for batch of %d: %s",
                           len(batch.uris), e)
                 batch.arrays = None
                 batch.nan = True
-                self._sink_q.put(batch)
+                self._enqueue(self._sink_q, batch)
 
     # -- stage: sink -------------------------------------------------------
     def _sink_loop(self):
@@ -320,17 +402,30 @@ class ClusterServing:
             batch = self._sink_q.get()
             if batch is _STOP:
                 return
+            tr = self.tracer
+            if tr is not None:
+                tr.add_span("sink_q_wait", batch.t_enq,
+                            time.perf_counter(), cat="serving.queue",
+                            trace_ids=batch.uris)
             try:
-                with self.sink_timer.timing():
-                    values = self._materialize(batch)
-                    # ONE pipelined broker write for the whole batch,
-                    # then one batched ack — 2 round trips, not N+1
-                    self.sink_broker.hset_many(
-                        self.result_key, dict(zip(batch.uris, values)))
-                    self.sink_broker.ack(self.stream, GROUP, batch.ids)
+                t_work = time.perf_counter()
+                values = self._materialize(batch)
+                # ONE pipelined broker write for the whole batch,
+                # then one batched ack — 2 round trips, not N+1
+                self.sink_broker.hset_many(
+                    self.result_key, dict(zip(batch.uris, values)))
+                self.sink_broker.ack(self.stream, GROUP, batch.ids)
+                t_end = time.perf_counter()
+                self.sink_timer.record(t_end - t_work)
+                if tr is not None:
+                    # includes the device wait inside _materialize — the
+                    # only blocking readback in the pipeline
+                    tr.add_span("sink", t_work, t_end,
+                                trace_ids=batch.uris)
                 with self._counter_lock:
                     self.records_served += len(batch.uris)
-                self.batch_timer.record(time.perf_counter() - batch.t0)
+                self._records_total.inc(len(batch.uris), outcome="served")
+                self.batch_timer.record(t_end - batch.t0)
             except Exception as e:  # noqa: BLE001 — no ack → the broker
                 # redelivers after its pending window (at-least-once)
                 log.error("sink writeback failed for %d records (%s: %s); "
@@ -385,12 +480,19 @@ class ClusterServing:
             return 0
         with self._counter_lock:
             self.records_read += len(records)
+        self._records_total.inc(len(records), outcome="read")
         t0 = time.perf_counter()
         self._process(records)
         self.broker.ack(self.stream, GROUP, [rid for rid, _ in records])
         with self._counter_lock:
             self.records_served += len(records)
-        self.batch_timer.record(time.perf_counter() - t0)
+        self._records_total.inc(len(records), outcome="served")
+        t_end = time.perf_counter()
+        self.batch_timer.record(t_end - t0)
+        if self.tracer is not None:
+            # the sync loop is one fused stage: a single span per cycle
+            self.tracer.add_span("serve_once", t0, t_end,
+                                 trace_ids=_record_uris(records))
         return len(records)
 
     def _process(self, records):

@@ -6,103 +6,113 @@ Percentiles come from a streaming log-bucketed histogram (O(1) memory,
 O(1) record): sample durations land in geometrically-spaced buckets
 spanning 1 µs .. ~5 min, and p50/p95/p99 interpolate within the bucket
 that crosses the target rank. Relative error is bounded by the bucket
-growth factor (~9%), which is plenty for tail-latency dashboards."""
+growth factor (~9%), which is plenty for tail-latency dashboards.
+
+The histogram itself lives in `observability/registry.py` (`LogHistogram`
+— this is where it was proven, then generalized); Timer keeps only the
+top-N heap and the lock on top. Observers (`add_observer`) let a Timer
+mirror every recorded duration into a registry `Histogram`, which is how
+the serving pipeline's per-stage timers feed the process-wide
+`MetricsRegistry` without double bookkeeping at the call sites.
+"""
 
 from __future__ import annotations
 
 import heapq
-import math
 import threading
 import time
-from typing import Dict, List
+from typing import Callable, Dict, List, Optional
 
-# Histogram geometry: bucket i covers [BASE*GROWTH^i, BASE*GROWTH^(i+1)).
-# BASE=1µs, GROWTH=1.2 → 107 buckets reach ~300 s; under/overflows clamp.
+from analytics_zoo_tpu.observability.registry import LogHistogram
+
+# Timer records SECONDS: base=1µs, growth=1.2 → 107 buckets reach ~300 s.
 _HIST_BASE = 1e-6
 _HIST_GROWTH = 1.2
-_HIST_LOG_GROWTH = math.log(_HIST_GROWTH)
 _HIST_BUCKETS = 107
 
 
-def _bucket_index(seconds: float) -> int:
-    if seconds <= _HIST_BASE:
-        return 0
-    i = int(math.log(seconds / _HIST_BASE) / _HIST_LOG_GROWTH)
-    return min(i, _HIST_BUCKETS - 1)
-
-
 class Timer:
-    def __init__(self, name: str, top_n: int = 10):
+    def __init__(self, name: str, top_n: int = 10,
+                 observer: Optional[Callable[[float], None]] = None):
         self.name = name
         self.top_n = top_n
+        # the lock MUST exist before reset() runs: the old getattr
+        # fallback locked a throwaway Lock on first call, leaving that
+        # reset racy against a concurrent record()
         self._lock = threading.Lock()
+        self._observers: List[Callable[[float], None]] = (
+            [observer] if observer is not None else [])
         self.reset()
 
+    def add_observer(self, fn: Callable[[float], None]) -> "Timer":
+        """Mirror every recorded duration (seconds) into `fn` — e.g. a
+        registry histogram's observe. Called outside this Timer's lock."""
+        self._observers.append(fn)
+        return self
+
     def reset(self):
-        with getattr(self, "_lock", threading.Lock()):
-            self.count = 0
-            self.total = 0.0
-            self.min = float("inf")
-            self.max = 0.0
+        with self._lock:
             self._top: List[float] = []
-            self._hist = [0] * _HIST_BUCKETS
+            self._hist = LogHistogram(base=_HIST_BASE, growth=_HIST_GROWTH,
+                                      n_buckets=_HIST_BUCKETS)
 
     def record(self, seconds: float):
         with self._lock:
-            self.count += 1
-            self.total += seconds
-            self.min = min(self.min, seconds)
-            self.max = max(self.max, seconds)
-            self._hist[_bucket_index(seconds)] += 1
+            self._hist.observe(seconds)
             if len(self._top) < self.top_n:
                 heapq.heappush(self._top, seconds)
             else:
                 heapq.heappushpop(self._top, seconds)
-
-    def _percentile_locked(self, q: float) -> float:
-        """Histogram percentile: find the bucket crossing rank q*count and
-        interpolate linearly inside it; clamp to the observed min/max so
-        bucket-edge estimates never exceed reality."""
-        if not self.count:
-            return 0.0
-        target = q * self.count
-        seen = 0
-        for i, c in enumerate(self._hist):
-            if not c:
-                continue
-            if seen + c >= target:
-                lo = _HIST_BASE * (_HIST_GROWTH ** i)
-                hi = lo * _HIST_GROWTH
-                frac = (target - seen) / c
-                est = lo + (hi - lo) * frac
-                return min(max(est, self.min), self.max)
-            seen += c
-        return self.max
+        for fn in self._observers:
+            fn(seconds)
 
     def timing(self):
         """Context manager: `with timer.timing(): ...`"""
         return _Span(self)
 
+    # -- accessors (all lock-guarded reads of the shared histogram) --------
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._hist.count
+
+    @property
+    def total(self) -> float:
+        with self._lock:
+            return self._hist.total
+
+    @property
+    def min(self) -> float:
+        with self._lock:
+            return self._hist.vmin
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._hist.vmax
+
     @property
     def avg(self) -> float:
-        return self.total / self.count if self.count else 0.0
+        with self._lock:
+            return self._hist.mean
 
     def percentile(self, q: float) -> float:
         """Seconds at quantile q in [0, 1] from the streaming histogram."""
         with self._lock:
-            return self._percentile_locked(q)
+            return self._hist.percentile(q)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
+            h = self._hist
             return {
                 "name": self.name,
-                "count": self.count,
-                "avg_ms": round(self.avg * 1e3, 3),
-                "min_ms": round(self.min * 1e3, 3) if self.count else 0.0,
-                "max_ms": round(self.max * 1e3, 3),
-                "p50_ms": round(self._percentile_locked(0.50) * 1e3, 3),
-                "p95_ms": round(self._percentile_locked(0.95) * 1e3, 3),
-                "p99_ms": round(self._percentile_locked(0.99) * 1e3, 3),
+                "count": h.count,
+                "avg_ms": round(h.mean * 1e3, 3),
+                "min_ms": round(h.vmin * 1e3, 3) if h.count else 0.0,
+                "max_ms": round(h.vmax * 1e3, 3),
+                "p50_ms": round(h.percentile(0.50) * 1e3, 3),
+                "p95_ms": round(h.percentile(0.95) * 1e3, 3),
+                "p99_ms": round(h.percentile(0.99) * 1e3, 3),
                 "top": sorted((round(t * 1e3, 3) for t in self._top),
                               reverse=True),
             }

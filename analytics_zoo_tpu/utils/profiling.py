@@ -90,6 +90,43 @@ class StepTimer:
             out["mfu"] = round(self.mfu, 4)
         return out
 
+    def publish(self, registry=None, batch_size: Optional[int] = None):
+        """Push this timer's accounting into the metrics registry, under
+        the SAME family names the trainer loop uses
+        (`training_step_ms`/`training_samples_per_sec`/`training_mfu`) —
+        hand-rolled loops built on StepTimer land on the unified spine
+        without their own naming. Safe to call repeatedly: the step
+        counter only advances by steps recorded since the last publish."""
+        from analytics_zoo_tpu.observability import get_registry
+        reg = registry if registry is not None else get_registry()
+        published = getattr(self, "_published_steps", 0)
+        if self.steps > published:
+            # one observation per publish WINDOW (the average step time
+            # of the steps recorded since the last publish) — repeated
+            # per-step publish() calls then histogram the step-time
+            # distribution instead of re-observing a running mean
+            pub_total = getattr(self, "_published_total_s", 0.0)
+            window_ms = ((self.total_s - pub_total)
+                         / (self.steps - published) * 1e3)
+            reg.histogram(
+                "training_step_ms",
+                "per-step wall time, averaged over each epoch's device "
+                "sync").observe(window_ms)
+            reg.counter("training_steps_total",
+                        "optimizer steps run").inc(self.steps - published)
+            self._published_steps = self.steps
+            self._published_total_s = self.total_s
+        if batch_size:
+            reg.gauge("training_samples_per_sec",
+                      "last epoch's training throughput").set(
+                self.samples_per_sec(batch_size))
+        if self.mfu is not None:
+            reg.gauge(
+                "training_mfu",
+                "model FLOPs utilization vs per-chip peak (needs "
+                "flops_per_step)").set(self.mfu)
+        return self
+
 
 def transformer_train_flops(n_params_matmul: int, tokens: int,
                             n_layers: int, seq_len: int,

@@ -123,6 +123,28 @@ class SummaryWriter:
         self.close()
 
 
+def write_metrics_snapshot(writer: "SummaryWriter",
+                           snapshot: Dict[str, dict], step: int):
+    """Mirror a `MetricsRegistry.snapshot()` into TensorBoard scalars:
+    counters/gauges write their value, histograms write count/p50/p99.
+    Label sets become tag suffixes (`serving_stage_ms/decode/p50`), so
+    the TB run shows the same numbers a Prometheus scrape would."""
+    for name, fam in snapshot.items():
+        for s in fam.get("series", []):
+            tag = name + "".join(
+                f"/{v}" for _, v in sorted(s["labels"].items()))
+            if fam["kind"] in ("counter", "gauge"):
+                v = s["value"]
+                if v == v:                       # skip NaN gauge reads
+                    writer.scalar(tag, v, step)
+            else:
+                if not s["count"]:
+                    continue
+                writer.scalar(tag + "/count", s["count"], step)
+                writer.scalar(tag + "/p50", s["p50"], step)
+                writer.scalar(tag + "/p99", s["p99"], step)
+
+
 def read_scalars(path_or_dir: str) -> Dict[str, List[Tuple[int, float]]]:
     """Read back scalars: tag -> [(step, value)]. Mirrors the reference's
     `FileReader` used by `get_train_summary`."""

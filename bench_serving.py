@@ -407,6 +407,28 @@ def _device_forward_main():
     }))
 
 
+def _registry_tail_metrics():
+    """Registry-sourced tail latency + live queue depths for the JSON
+    output: the process-wide `MetricsRegistry` accumulated every serving
+    instance this bench ran (all broker kinds, pipelined and sync), so
+    BENCH_*.json entries carry p50/p95/p99 per stage — not just
+    throughput."""
+    from analytics_zoo_tpu.observability import get_registry
+    snap = get_registry().snapshot()
+    latency = {}
+    for fam in ("serving_batch_ms", "serving_stage_ms"):
+        for s in snap.get(fam, {}).get("series", []):
+            key = fam + "".join(f"_{v}" for _, v in
+                                sorted(s["labels"].items()))
+            latency[key] = {"count": s["count"],
+                            "p50_ms": round(s["p50"], 3),
+                            "p95_ms": round(s["p95"], 3),
+                            "p99_ms": round(s["p99"], 3)}
+    depths = {s["labels"]["queue"]: s["value"]
+              for s in snap.get("serving_queue_depth", {}).get("series", [])}
+    return latency, depths
+
+
 def main():
     from analytics_zoo_tpu import init_orca_context, stop_orca_context
     from analytics_zoo_tpu.serving.inference_model import InferenceModel
@@ -463,6 +485,7 @@ def main():
     # forward twice
     ident = InferenceModel().load_fn(lambda p, x: x, params=())
     wire_p50, wire_p99 = _measure(ident, "redis")
+    registry_latency, registry_queue_depth = _registry_tail_metrics()
     stop_orca_context()
 
     # headline: the Redis-wire path (what BASELINE.md names)
@@ -490,6 +513,8 @@ def main():
                                        2),
         "serving_warm_first_request_ms": round(first_ms, 3),
         "serving_steady_p50_ms": round(steady_p50, 3),
+        "registry_latency": registry_latency,
+        "registry_queue_depth": registry_queue_depth,
     }))
 
 

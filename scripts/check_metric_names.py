@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Static lint for metric names (ISSUE 2 satellite; tier-1 via
+tests/test_metric_names.py).
+
+Scans every Python source under `analytics_zoo_tpu/` (plus the bench
+scripts) for literal registry registrations —
+`<registry>.counter("name", ...)`, `.gauge(...)`, `.histogram(...)` —
+and enforces the conventions the runtime registry also checks, so a
+violation fails CI before it ever runs:
+
+- names are snake_case: `[a-z][a-z0-9]*(_[a-z0-9]+)*`
+- unit-suffix conventions: counters end `_total`; histograms end with a
+  unit (`_ms`, `_bytes`, `_seconds`); gauges must NOT claim `_total`
+- unique registration: one name maps to exactly one metric kind across
+  the whole codebase (get-or-create from several sites is fine — that
+  is the convergence the registry exists for — but the same name as
+  both a counter and a gauge is a collision Prometheus would reject)
+
+Exit code 0 when clean; 1 with one line per violation otherwise.
+
+    python scripts/check_metric_names.py [root ...]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+# `registry.counter("x"` / `reg.gauge('y'` / `.histogram("z"` — literal
+# first argument only; dynamically-built names are the runtime
+# registry's job
+CALL_RE = re.compile(
+    r"\.\s*(counter|gauge|histogram)\s*\(\s*(?:\n\s*)?['\"]([^'\"]+)['\"]",
+    re.MULTILINE)
+
+COUNTER_SUFFIX = ("_total",)
+HIST_SUFFIXES = ("_ms", "_bytes", "_seconds")
+
+DEFAULT_ROOTS = ("analytics_zoo_tpu", "bench_serving.py", "bench.py",
+                 "bench_ncf.py")
+
+
+def iter_sources(roots) -> List[str]:
+    out = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            out.extend(os.path.join(dirpath, f)
+                       for f in files if f.endswith(".py"))
+    return sorted(out)
+
+
+def find_registrations(path: str) -> List[Tuple[str, str, int]]:
+    """(kind, name, line) for every literal registration in one file."""
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    out = []
+    for m in CALL_RE.finditer(src):
+        line = src.count("\n", 0, m.start()) + 1
+        out.append((m.group(1), m.group(2), line))
+    return out
+
+
+def check(roots=DEFAULT_ROOTS) -> List[str]:
+    errors: List[str] = []
+    seen: Dict[str, Tuple[str, str, int]] = {}   # name -> (kind, file, ln)
+    for path in iter_sources(roots):
+        for kind, name, line in find_registrations(path):
+            where = f"{path}:{line}"
+            if not NAME_RE.match(name):
+                errors.append(
+                    f"{where}: {kind} {name!r} is not snake_case")
+            if kind == "counter" and not name.endswith(COUNTER_SUFFIX):
+                errors.append(
+                    f"{where}: counter {name!r} must end with '_total'")
+            if kind == "histogram" and not name.endswith(HIST_SUFFIXES):
+                errors.append(
+                    f"{where}: histogram {name!r} must end with a unit "
+                    f"suffix ({', '.join(HIST_SUFFIXES)})")
+            if kind == "gauge" and name.endswith(COUNTER_SUFFIX):
+                errors.append(
+                    f"{where}: gauge {name!r} must not end with '_total' "
+                    "(that suffix claims a monotonic counter)")
+            prev = seen.get(name)
+            if prev is not None and prev[0] != kind:
+                errors.append(
+                    f"{where}: {name!r} registered as {kind} but already "
+                    f"a {prev[0]} at {prev[1]}:{prev[2]}")
+            else:
+                seen.setdefault(name, (kind, path, line))
+    return errors
+
+
+def main(argv=None) -> int:
+    roots = (argv if argv else None) or list(DEFAULT_ROOTS)
+    errors = check(roots)
+    for e in errors:
+        print(e)
+    if errors:
+        print(f"{len(errors)} metric-name violation(s)")
+        return 1
+    n = sum(len(find_registrations(p)) for p in iter_sources(roots))
+    print(f"metric names OK ({n} registrations checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

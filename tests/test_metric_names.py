@@ -1,0 +1,64 @@
+"""Tier-1 metric-name lint (ISSUE 2 satellite): every literal registry
+registration in the codebase follows snake_case + unit-suffix + unique
+kind conventions. The same rules run at runtime in
+`observability/registry.py`; this catches dead/unexercised call sites
+too."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_metric_names  # noqa: E402
+
+
+def test_codebase_metric_names_clean():
+    os.chdir(REPO)
+    errors = check_metric_names.check()
+    assert not errors, "\n".join(errors)
+
+
+def test_lint_catches_violations(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "reg.counter('records')\n"             # missing _total
+        "reg.histogram('latency')\n"           # missing unit suffix
+        "reg.gauge('depth_total')\n"           # gauge claiming _total
+        "reg.counter('CamelCase_total')\n"     # not snake_case
+        "reg.gauge('dup_name')\n"
+        "reg.counter('dup_name_total')\n"
+        "other.histogram('dup_name')\n")       # kind collision with gauge
+    errors = check_metric_names.check([str(bad)])
+    # the dup_name histogram violates twice: kind collision AND missing
+    # unit suffix
+    assert len(errors) == 6
+    joined = "\n".join(errors)
+    for frag in ("'records'", "'latency'", "'depth_total'",
+                 "'CamelCase_total'", "already a gauge"):
+        assert frag in joined
+
+
+def test_lint_accepts_get_or_create_from_many_sites(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "reg.counter('requests_total', 'from serving')\n"
+        "reg.counter('requests_total', 'from frontend')\n"
+        "reg.histogram('stage_ms')\n"
+        "reg.histogram(\n    'payload_bytes', 'multiline call')\n"
+        "reg.gauge('queue_depth')\n")
+    assert check_metric_names.check([str(ok)]) == []
+
+
+@pytest.mark.parametrize("name,ok", [
+    ("serving_stage_ms", True),
+    ("http_requests_total", True),
+    ("queue_depth", True),
+    ("BadName_total", False),
+    ("double__under_total", False),
+    ("_leading_total", False),
+])
+def test_name_regex(name, ok):
+    assert bool(check_metric_names.NAME_RE.match(name)) == ok
