@@ -184,6 +184,28 @@ class Gauge(_Metric):
         with self._lock:
             self._series[_label_key(labels)] = fn
 
+    def release_function(self, fn: Callable[[], float],
+                         freeze: bool = False, **labels):
+        """Compare-and-release the closure installed by `set_function` —
+        the uninstall: a retiring provider (a stopped server, a closed
+        replica pool) must not leave a closure pinning it in the
+        process-wide registry. A no-op when another provider has since
+        replaced the series (label keys are process-global, so an
+        unconditional removal would destroy the NEWER owner's live
+        telemetry). With ``freeze=True`` the series keeps its final
+        float value instead of disappearing."""
+        key = _label_key(labels)
+        with self._lock:
+            if self._series.get(key) is not fn:
+                return
+            if freeze:
+                try:
+                    self._series[key] = float(fn())
+                    return
+                except Exception:  # noqa: BLE001 — dead provider:
+                    pass           # drop rather than freeze a NaN
+            self._series.pop(key, None)
+
     def value(self, **labels) -> float:
         with self._lock:
             v = self._series.get(_label_key(labels), 0.0)

@@ -24,7 +24,16 @@ def cmd_start(args) -> int:
     from analytics_zoo_tpu.serving.http_frontend import FrontEnd
     from analytics_zoo_tpu.serving.server import ClusterServing
     from analytics_zoo_tpu.serving.broker import connect_broker
-    cfg = ServingConfig.load(args.config)
+    replicas = getattr(args, "num_replicas", None)
+    if replicas is not None:
+        try:
+            replicas = int(replicas)
+        except ValueError:
+            pass                    # 'auto' (load() validates spellings)
+    # overrides go INTO load(): validation must see the effective values,
+    # or a config authored for a bigger host could never be rescued here
+    cfg = ServingConfig.load(args.config, num_replicas=replicas,
+                             placement=getattr(args, "placement", None))
     if cfg.model_encrypted and cfg.http_port is None:
         raise SystemExit(
             "secure.model_encrypted needs http_port: the secret/salt "
@@ -43,6 +52,8 @@ def cmd_start(args) -> int:
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     model = cfg.build_model(broker=broker)
+    print(f"placement={model.placement} replicas={model.num_replicas} "
+          f"devices={len(model.devices)}", flush=True)
     if cfg.warmup_shapes:
         # pre-compile every REACHABLE shape bucket BEFORE the stream
         # opens: no XLA compile ever lands on a request. The reader never
@@ -136,6 +147,12 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     ps = sub.add_parser("start", help="run the serving loop")
     ps.add_argument("--config", required=True)
+    ps.add_argument("--num-replicas", default=None,
+                    help="override params.num_replicas: an integer, or "
+                         "'auto' for one replica per local device")
+    ps.add_argument("--placement", choices=["replicated", "sharded"],
+                    default=None,
+                    help="override params.placement")
     ps.set_defaults(fn=cmd_start)
     pb = sub.add_parser("broker", help="run a standalone TCP broker")
     pb.add_argument("--host", default="0.0.0.0")

@@ -61,12 +61,24 @@ class InputQueue:
         """Sync multi-record path: each sample is ONE serving record (the
         per-instance contract of the reference frontend — records batch up
         inside the serving loop, not inside one record). Results return in
-        input order; a failed record yields float('nan')."""
+        input order; a failed record yields float('nan').
+
+        Deadlines use `time.monotonic()` (a wall-clock step — NTP slew,
+        suspend/resume — must not shrink or blow the budget), and idle
+        polls back off exponentially from 1 ms to a 50 ms cap instead of
+        hammering the broker at a fixed tight interval; any progress
+        resets the backoff so a streaming burst is drained promptly."""
         uris = [self.enqueue(None, t=np.asarray(s)) for s in samples]
         out = OutputQueue(self.broker, self.stream)
         results: dict = {}
-        deadline = time.time() + timeout_s
-        while len(results) < len(uris) and time.time() < deadline:
+        deadline = time.monotonic() + timeout_s
+        backoff = 0.001
+        while len(results) < len(uris):
+            # deadline checked every pass, progress or not: trickling
+            # results must tighten the remaining budget, not renew it
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
             progress = False
             for uri in uris:
                 if uri in results:
@@ -75,8 +87,11 @@ class InputQueue:
                 if res is not None:
                     results[uri] = res
                     progress = True
-            if not progress:
-                time.sleep(0.005)
+            if progress:
+                backoff = 0.001
+                continue
+            time.sleep(min(backoff, max(0.0, remaining)))
+            backoff = min(backoff * 2, 0.05)
         missing = [u for u in uris if u not in results]
         if missing:
             raise TimeoutError(

@@ -77,6 +77,10 @@ class ServingConfig:
     batch_size: int = 32                    # core_number analogue
     batch_timeout_ms: int = 5
     concurrent_num: int = 1
+    # multi-device placement: model replicas (one per chip; "auto"/-1 =
+    # every local device) or one GSPMD-sharded copy spanning all chips
+    num_replicas: Any = 1                   # int, or "auto"
+    placement: str = "replicated"           # replicated | sharded
     # pipelined engine knobs (overlapped decode/compute/sink)
     pipelined: bool = True
     decode_workers: int = 2
@@ -112,7 +116,12 @@ class ServingConfig:
                      "max_latency_ms": "batch_timeout_ms"}
 
     @classmethod
-    def load(cls, path: str) -> "ServingConfig":
+    def load(cls, path: str, num_replicas=None,
+             placement: Optional[str] = None) -> "ServingConfig":
+        """`num_replicas`/`placement` keyword overrides (the CLI flags)
+        replace the file's values BEFORE validation, so an override can
+        rescue a config authored for a bigger host (e.g. an 8-chip
+        config started on a 2-device box with `--num-replicas 2`)."""
         raw = _load_yaml(path)
         model = raw.get("model", {}) or {}
         params = raw.get("params", {}) or {}
@@ -129,6 +138,14 @@ class ServingConfig:
                                         params.get("batch_size", 32)))
         cfg.batch_timeout_ms = int(params.get("batch_timeout_ms", 5))
         cfg.concurrent_num = int(params.get("concurrent_num", 1))
+        cfg.num_replicas = num_replicas if num_replicas is not None \
+            else params.get("num_replicas", 1)
+        cfg.placement = placement if placement is not None \
+            else str(params.get("placement", "replicated"))
+        # fail HERE, not deep inside the dispatch stage: a bad placement
+        # string or a replica count the host cannot satisfy is a config
+        # error, and config errors belong at load time
+        cfg._validate_placement()
         cfg.pipelined = bool(params.get("pipelined", True))
         cfg.decode_workers = int(params.get("decode_workers", 2))
         cfg.queue_depth = int(params.get("queue_depth", 8))
@@ -155,6 +172,42 @@ class ServingConfig:
         cfg.extra = raw
         return cfg
 
+    def _validate_placement(self):
+        """Reject bad `placement`/`num_replicas` values with a clear error
+        while still parsing the config (counting local devices is cheap —
+        the backend initializes lazily and serving needs it anyway)."""
+        from analytics_zoo_tpu.serving.inference_model import PLACEMENTS
+        if self.placement not in PLACEMENTS:
+            raise ValueError(
+                f"params.placement={self.placement!r} is not one of "
+                f"{'/'.join(PLACEMENTS)}")
+        n = self.num_replicas
+        if n is None or n == "auto":   # bare `num_replicas:` == auto,
+            return                     # matching InferenceModel(None)
+        try:
+            n = int(n)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"params.num_replicas={n!r} must be an integer, "
+                "'auto', or -1 (one replica per local device)") from None
+        if n in (0, -1):           # auto spellings
+            return
+        if n < -1:
+            raise ValueError(
+                f"params.num_replicas={n} must be >= 1 (or 'auto'/-1)")
+        if n == 1:
+            # cannot exceed any host's >=1 devices — and counting them
+            # would initialize the jax backend at config-parse time,
+            # freezing platform selection before a forced-host re-exec
+            # (bench --devices / dryrun) can set its env
+            return
+        import jax
+        avail = jax.local_device_count()
+        if n > avail:
+            raise ValueError(
+                f"params.num_replicas={n} exceeds the {avail} available "
+                f"local device(s); lower it or use 'auto'")
+
     def build_model(self, broker=None):
         """Model resolution (`ClusterServingHelper` model-type dispatch):
         a ZooModel dir (config.json names the class), or bare weights plus
@@ -168,7 +221,15 @@ class ServingConfig:
         from analytics_zoo_tpu.serving.inference_model import InferenceModel
         if not self.model_path:
             raise ValueError("config has no model.path")
-        im = InferenceModel(concurrent_num=self.concurrent_num)
+        self._validate_placement()
+        try:
+            n = int(self.num_replicas)   # accepts YAML-quoted "4" too
+        except (TypeError, ValueError):
+            n = "auto"                   # None / "auto" (just validated)
+        if n in (0, -1):
+            n = "auto"
+        im = InferenceModel(concurrent_num=self.concurrent_num,
+                            num_replicas=n, placement=self.placement)
         secret = salt = None
         if self.model_encrypted:
             if broker is None:

@@ -127,8 +127,18 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         path = self.path.split("?", 1)[0]
         if path == "/":
-            self._send(200, {"message": "welcome to analytics zoo web "
-                                        "serving frontend"})
+            payload = {"message": "welcome to analytics zoo web "
+                                  "serving frontend"}
+            serving = self.server.serving
+            # deployment at a glance: replicated-vs-sharded, replica
+            # count, device count (mesh axes when sharded); guarded like
+            # server.py — the engine only requires predict_async, so a
+            # duck-typed model must not break the liveness probe
+            info = getattr(getattr(serving, "model", None),
+                           "placement_info", None)
+            if info is not None:
+                payload["placement"] = info()
+            self._send(200, payload)
         elif path == "/metrics":
             self._metrics()
         elif path == "/trace":

@@ -16,10 +16,30 @@ thread-safe `doPredict`. TPU-native redesign:
   torch modules (via the torch bridge). The reference's TF/OpenVINO/Caffe
   loaders map onto the native-model path (their runtimes don't exist on TPU;
   weights must be converted, cf. `learn/torch_bridge.py`).
+
+Multi-device placement (the reference scales by one model replica per Flink
+task slot; here one per chip):
+
+- **replicated** (`num_replicas=N`): one params copy per device
+  (`jax.device_put(params, device)`), one cached executable per
+  (replica, bucket) — jax keys its jit cache on the committed device —
+  and a least-outstanding-work router with a per-replica in-flight
+  bound. Each replica owns a worker thread because XLA's CPU backend
+  executes in the dispatching thread: without per-replica threads N
+  chips would serialize behind one dispatcher (a real TPU dispatch is
+  async, where the extra hop costs ~µs).
+- **sharded** (`placement="sharded"`): for models too large for one
+  chip — params land with `NamedSharding`s from the GSPMD rule table
+  (`parallel/sharding.py`, fsdp fallback) over a `common/mesh.py`
+  DeviceMesh, and each batch is `device_put` split along the data axes.
+  One logical replica spans every device; XLA emits the collectives.
+- `num_replicas=1` (the default) is the original single-device path,
+  byte-for-byte: bare `device_put`, single jit, no router, no threads.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -29,6 +49,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.serving.timer import Timer
+
+PLACEMENTS = ("replicated", "sharded")
 
 
 def _next_bucket(n: int, buckets) -> int:
@@ -46,11 +68,12 @@ class PendingPrediction:
     both touch it."""
 
     def __init__(self, out, valid_n: int, timer=None,
-                 dispatch_s: float = 0.0):
+                 dispatch_s: float = 0.0, replica: int = 0):
         self._out = out
         self._n = valid_n
         self._timer = timer
         self._dispatch_s = dispatch_s
+        self.replica = replica        # which model replica computed this
         self._result = None
         self._done = False
         self._lock = threading.Lock()
@@ -93,9 +116,123 @@ class PendingPrediction:
         return self._result
 
 
+class _RoutedPending:
+    """PendingPrediction fulfilled by a replica worker thread:
+    `predict_async` returns it before the batch has even reached the
+    device; the worker attaches the device output (or the dispatch
+    failure, which `result()` re-raises so the serving sink's NaN
+    degradation path sees it exactly like a synchronous dispatch
+    error)."""
+
+    def __init__(self, valid_n: int, timer=None, replica: int = 0,
+                 on_done: Optional[Callable[[], None]] = None):
+        self._n = valid_n
+        self._timer = timer
+        self.replica = replica
+        self._on_done = on_done
+        self._event = threading.Event()
+        self._out = None
+        self._exc: Optional[BaseException] = None
+        self._dispatch_s = 0.0
+        self._result = None
+        self._done = False
+        self._lock = threading.Lock()
+
+    # -- worker side -------------------------------------------------------
+    def _fulfill(self, out, dispatch_s: float):
+        self._out = out
+        self._dispatch_s = dispatch_s
+        self._event.set()
+
+    def _fail(self, exc: BaseException):
+        self._exc = exc
+        self._event.set()
+
+    # -- consumer side -----------------------------------------------------
+    def done(self) -> bool:
+        """Never blocks (same contract as PendingPrediction.done): False
+        until the worker has dispatched, then device-readiness."""
+        if self._done:
+            return True
+        if not self._event.is_set():
+            return False
+        if self._exc is not None:
+            return True
+        out = self._out            # racy snapshot, same as PendingPrediction
+        if out is None:
+            return True
+        try:
+            return all(a.is_ready() for a in
+                       jax.tree_util.tree_leaves(out))
+        except AttributeError:
+            return True
+
+    def result(self):
+        with self._lock:
+            if not self._done:
+                self._event.wait()
+                try:
+                    if self._exc is None:
+                        t0 = time.perf_counter()
+                        out = jax.tree_util.tree_map(
+                            lambda a: np.asarray(a)[:self._n], self._out)
+                        self._out = None
+                        self._result = out
+                        if self._timer is not None:
+                            self._timer.record(
+                                self._dispatch_s
+                                + time.perf_counter() - t0)
+                except Exception as e:  # noqa: BLE001 — keep for re-raise
+                    self._exc = e
+                finally:
+                    # the replica permit releases exactly once, success or
+                    # failure — a leak here would wedge the router
+                    self._done = True
+                    cb, self._on_done = self._on_done, None
+                    if cb is not None:
+                        cb()
+            if self._exc is not None:
+                raise self._exc
+        return self._result
+
+    def abandon(self):
+        """Release the replica permit WITHOUT materializing — the
+        shutdown-drop path (`ClusterServing._poison` discarding queued
+        work once a stage is wedged): the device result is discarded and
+        the broker's redelivery owns the records, but the permit must
+        come back or the replica is down a slot forever."""
+        with self._lock:
+            if not self._done:
+                self._done = True
+                self._out = None
+                cb, self._on_done = self._on_done, None
+                if cb is not None:
+                    cb()
+
+
+class _Replica:
+    """One device's slot in the replicated pool: committed params, a work
+    queue, and the router's book-keeping. `inflight`/`batches` are guarded
+    by the model's router condition variable."""
+
+    __slots__ = ("index", "device", "params", "inflight", "batches",
+                 "work_q", "thread")
+
+    def __init__(self, index: int, device, params):
+        self.index = index
+        self.device = device
+        self.params = params
+        self.inflight = 0          # routed but not yet materialized
+        self.batches = 0           # total batches ever routed here
+        self.work_q: "queue.Queue" = queue.Queue()
+        self.thread: Optional[threading.Thread] = None
+
+
 class _JoinedPending:
     """PendingPrediction over max_batch chunks: each chunk was dispatched
     independently; result() syncs them in order and concatenates."""
+
+    replica = None                 # spans replicas; no single owner
 
     def __init__(self, parts: List[PendingPrediction]):
         self._parts = parts
@@ -121,7 +258,19 @@ class _JoinedPending:
 
 class InferenceModel:
     def __init__(self, concurrent_num: int = 1, auto_scaling: bool = False,
-                 max_batch: int = 512):
+                 max_batch: int = 512,
+                 num_replicas: Optional[int] = 1,
+                 placement: str = "replicated",
+                 devices: Optional[List] = None,
+                 mesh=None,
+                 max_inflight_per_replica: int = 2):
+        """`num_replicas`: model copies, one per device. 1 (default) keeps
+        the original single-device path untouched; ``"auto"``/``-1``/``0``/
+        ``None`` takes every local device. `placement="sharded"` instead
+        spreads ONE copy across all devices (`mesh`, or a data+fsdp
+        DeviceMesh over `devices`) for models too large for a chip.
+        `max_inflight_per_replica` bounds routed-but-unmaterialized
+        batches per replica — the router's backpressure."""
         self.concurrent_num = concurrent_num
         self.auto_scaling = auto_scaling
         self._sema = threading.BoundedSemaphore(concurrent_num) \
@@ -131,6 +280,37 @@ class InferenceModel:
         self.max_batch = max_batch
         self.buckets = [b for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
                         if b <= max_batch] or [max_batch]
+        if placement not in PLACEMENTS:
+            raise ValueError(
+                f"placement={placement!r} not in {PLACEMENTS}")
+        self.placement = placement
+        devs = list(devices) if devices is not None else jax.local_devices()
+        if not devs:
+            raise ValueError("no devices available")
+        if num_replicas in (None, 0, -1, "auto"):
+            n = len(devs) if placement == "replicated" else 1
+        else:
+            n = int(num_replicas)
+        if n < 1:
+            raise ValueError(f"num_replicas={num_replicas!r} must be >= 1 "
+                             "(or 'auto'/-1 for one per local device)")
+        if n > len(devs):
+            raise ValueError(
+                f"num_replicas={n} exceeds the {len(devs)} available "
+                "device(s); lower it or pass more devices")
+        if placement == "sharded":
+            n = 1                  # one logical replica spans the mesh
+        self.num_replicas = n
+        self.devices = devs[:n] if placement == "replicated" else devs
+        # explicit devices pin replica 1 too; the bare default keeps the
+        # legacy uncommitted device_put (single-replica byte-for-byte)
+        self._pin_single = devices is not None
+        self.mesh = mesh
+        self.max_inflight_per_replica = max(1, int(max_inflight_per_replica))
+        self._replicas: Optional[List[_Replica]] = None
+        self._replica_cv = threading.Condition()
+        self._rr = 0               # round-robin tie-break cursor
+        self._batch_sharding = None
         self._jit: Optional[Callable] = None
         self.timer = Timer("predict")
         self.warmup_report: Dict[str, float] = {}
@@ -183,16 +363,171 @@ class InferenceModel:
 
     def load_fn(self, fn: Callable, params) -> "InferenceModel":
         """Pure `fn(params, x)` forward."""
+        self.close()               # reload: retire any old replica pool
         self._fn = fn
-        # weights transfer ONCE at load: a host pytree here would be
-        # re-uploaded on every predict (jit does not cache arg transfers)
-        self._params = jax.device_put(params)
-        # one jit wrapper; jax caches an executable per input shape (= per
-        # bucket), so no per-bucket bookkeeping is needed
+        # one jit wrapper; jax caches an executable per input shape AND
+        # per committed device/sharding, so each (replica, bucket) pair
+        # gets its own cached executable with no bookkeeping here
         self._jit = jax.jit(fn)
+        if self.placement == "sharded":
+            if self.mesh is None:
+                from analytics_zoo_tpu.common.config import MeshConfig
+                from analytics_zoo_tpu.common.mesh import DeviceMesh
+                # fsdp carries both roles: params shard over it (the rule
+                # table's fallback axis) and it is a batch axis, so the
+                # input splits across every device too
+                self.mesh = DeviceMesh(MeshConfig(data=1, fsdp=-1),
+                                       self.devices)
+            from analytics_zoo_tpu.parallel.sharding import shard_params
+            self._params = shard_params(params, self.mesh)
+            self._batch_sharding = self.mesh.batch_sharding()
+            dp = self.mesh.data_parallel_size
+            # buckets must split evenly over the data axes: GSPMD would
+            # pad an uneven split, costing more than host-side padding to
+            # the next divisible bucket. When NO power-of-two bucket
+            # divides (dp=6, 12, ...), rebuild the ladder from dp itself
+            # — a single max-size bucket would pad every request to
+            # ~max_batch rows
+            kept = [b for b in self.buckets if b % dp == 0]
+            if not kept:
+                b = dp
+                while b <= self.max_batch:
+                    kept.append(b)
+                    b *= 2
+            self.buckets = kept or [dp]
+        elif self.num_replicas > 1:
+            self._replicas = []
+            for i, dev in enumerate(self.devices):
+                rep = _Replica(i, dev, jax.device_put(params, dev))
+                rep.thread = threading.Thread(
+                    target=self._replica_loop, args=(rep,),
+                    name=f"infer-replica-{i}", daemon=True)
+                rep.thread.start()
+                self._replicas.append(rep)
+        elif self._pin_single:
+            self._params = jax.device_put(params, self.devices[0])
+        else:
+            # weights transfer ONCE at load: a host pytree here would be
+            # re-uploaded on every predict (jit does not cache arg
+            # transfers)
+            self._params = jax.device_put(params)
         self.warmup_report = {}
         self.warmed_buckets = set()
         return self
+
+    def _replica_loop(self, rep: _Replica):
+        """Per-replica dispatcher: XLA:CPU executes in the calling thread,
+        so each replica needs its own; on TPU the jit call returns as soon
+        as the async dispatch is enqueued and this thread is just a cheap
+        hop. `t0` is the router hand-off time, so `dispatch_s` covers
+        queue wait + dispatch (+ compute, on synchronous backends)."""
+        while True:
+            job = rep.work_q.get()
+            if job is None:
+                return
+            x, pending, t0 = job
+            t_start = time.perf_counter() if t0 is None else t0
+            try:
+                out = self._jit(rep.params, x)
+                pending._fulfill(out, time.perf_counter() - t_start)
+            except Exception as e:  # noqa: BLE001 — surfaces in result()
+                pending._fail(e)
+
+    def close(self):
+        """Retire the replica pool's worker threads (no-op otherwise).
+        Safe to call repeatedly; `load_fn` calls it on reload. Stop the
+        serving engine BEFORE closing a model it still routes through —
+        after close the model needs a fresh `load_*` to predict again."""
+        with self._replica_cv:
+            # swap the pool out under the router CV: a concurrent
+            # predict_async either enqueued its job BEFORE this point
+            # (FIFO: the worker fulfills it before seeing the pill) or
+            # sees the dead pool and raises the clear closed error. The
+            # notify wakes permit-blocked routers into that error now,
+            # not after their 60s timeout.
+            reps, self._replicas = self._replicas, None
+            self._replica_cv.notify_all()
+        if reps:
+            for rep in reps:
+                rep.work_q.put(None)
+            for rep in reps:
+                if rep.thread is not None:
+                    rep.thread.join(timeout=5)
+            # the pool was the only executor (no single-device _params):
+            # a predict now must say "load first", not jit(None, x)
+            if self._params is None:
+                self._fn = None
+
+    # -- router ------------------------------------------------------------
+    def _acquire_replica(self, timeout: float = 60.0) -> _Replica:
+        """Least-outstanding-work selection with a per-replica in-flight
+        bound; round-robin tie-break so equally-idle replicas alternate
+        instead of piling onto index 0. Blocks (bounded) when every
+        replica is at the bound — the router's backpressure."""
+        deadline = time.monotonic() + timeout
+        with self._replica_cv:
+            while True:
+                reps = self._replicas
+                if reps is None:
+                    # close()/load_fn() retired the pool mid-route (the
+                    # documented misuse — stop the engine first); fail
+                    # with the real cause, not a NoneType iteration
+                    raise RuntimeError(
+                        "replica pool closed while routing; stop the "
+                        "serving engine before close()/load_fn()")
+                free = [r for r in reps
+                        if r.inflight < self.max_inflight_per_replica]
+                if free:
+                    lo = min(r.inflight for r in free)
+                    n = len(reps)
+                    rep = min((r for r in free if r.inflight == lo),
+                              key=lambda r: (r.index - self._rr) % n)
+                    self._rr = (rep.index + 1) % n
+                    rep.inflight += 1
+                    rep.batches += 1
+                    return rep
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._replica_cv.wait(remaining):
+                    raise TimeoutError(
+                        "every model replica is at its in-flight bound "
+                        f"({self.max_inflight_per_replica}); results are "
+                        "not being materialized")
+
+    def _release_replica(self, rep: _Replica):
+        with self._replica_cv:
+            rep.inflight -= 1
+            self._replica_cv.notify()
+
+    def replica_inflight(self, index: int) -> int:
+        """Routed-but-unmaterialized batches on one replica (live; 0 for
+        the single-device and sharded paths)."""
+        reps = self._replicas
+        if reps is None or index >= len(reps):
+            return 0
+        return reps[index].inflight
+
+    def replica_stats(self) -> List[Dict[str, Any]]:
+        """Per-replica routing book-keeping for metrics/bench output."""
+        if self._replicas is None:
+            return [{"replica": 0, "device": str(d), "batches": None,
+                     "inflight": 0}
+                    for d in (self.devices[:1] if self.placement ==
+                              "replicated" else self.devices)]
+        with self._replica_cv:
+            return [{"replica": r.index, "device": str(r.device),
+                     "batches": r.batches, "inflight": r.inflight}
+                    for r in self._replicas]
+
+    def placement_info(self) -> Dict[str, Any]:
+        """Placement summary for `ClusterServing.metrics()` / the CLI."""
+        info: Dict[str, Any] = {"placement": self.placement,
+                                "num_replicas": self.num_replicas,
+                                "n_devices": len(self.devices)}
+        if self.placement == "sharded" and self.mesh is not None:
+            info["mesh"] = {a: s for a, s in self.mesh.axis_sizes.items()
+                            if s != 1}
+            info["data_parallel_size"] = self.mesh.data_parallel_size
+        return info
 
     def load_keras_encrypted(self, model, path: str, secret: str,
                              salt: str = "analytics-zoo"
@@ -271,6 +606,31 @@ class InferenceModel:
                         [jnp.asarray(a),
                          jnp.broadcast_to(jnp.asarray(a)[-1:],
                                           (pad,) + a.shape[1:])]), x)
+            if self._replicas is not None:
+                # replica pool: route to the least-loaded device and
+                # return immediately — its worker thread dispatches.
+                # acquire AND enqueue under the router CV (an RLock, so
+                # _acquire_replica re-enters): close() also swaps the
+                # pool out under it, so a job can never land behind a
+                # worker's stop pill and wait forever unfulfilled
+                with self._replica_cv:
+                    rep = self._acquire_replica()
+                    pending = _RoutedPending(
+                        valid_n, timer=self.timer, replica=rep.index,
+                        on_done=lambda rep=rep:
+                            self._release_replica(rep))
+                    rep.work_q.put((x, pending, t0))
+                return pending
+            if self._batch_sharding is not None:
+                # sharded placement: split the (bucket-padded, so evenly
+                # divisible) batch along the data axes before the call
+                x = jax.device_put(x, self._batch_sharding)
+            if self._params is None:
+                # a concurrent close() retired a replica pool between
+                # the _fn check and here: params never existed on the
+                # single-device path — fail clearly, not jit(None, x)
+                raise RuntimeError(
+                    "model closed mid-predict; reload before predicting")
             out = self._jit(self._params, x)
         finally:
             # the permit bounds dispatch admission, not result lifetime:
@@ -298,19 +658,53 @@ class InferenceModel:
         if self._fn is None:
             raise RuntimeError("No model loaded")
         buckets = list(buckets) if buckets is not None else list(self.buckets)
+        if self._batch_sharding is not None:
+            # sharded placement only ever sees divisible buckets; all
+            # indivisible → warm the smallest real bucket, not nothing
+            dp = self.mesh.data_parallel_size
+            buckets = [b for b in buckets if b % dp == 0] or \
+                [self.buckets[0]]
         sample = jax.tree_util.tree_map(np.asarray, sample)
         tag = "x".join(map(str, jax.tree_util.tree_leaves(sample)[0].shape)
                        ) or "scalar"
+        if self._replicas is not None:
+            return self._warmup_replicas(sample, buckets, tag)
         for b in buckets:
             batch = jax.tree_util.tree_map(
                 lambda a: np.ascontiguousarray(
                     np.broadcast_to(a[None], (b,) + a.shape)), sample)
+            if self._batch_sharding is not None:
+                batch = jax.device_put(batch, self._batch_sharding)
             t0 = time.perf_counter()
             # straight through the jit (not predict): warmup must not
             # pollute the serving timer percentiles
             jax.block_until_ready(self._jit(self._params, batch))
             self.warmup_report[f"{tag}:b{b}"] = round(
                 time.perf_counter() - t0, 4)
+            self.warmed_buckets.add(b)
+        return self
+
+    def _warmup_replicas(self, sample, buckets, tag) -> "InferenceModel":
+        """Fan warmup out across the pool: every replica's worker thread
+        compiles its own (replica, bucket) executables concurrently —
+        N chips warm in roughly the time one takes. Jobs bypass the
+        router (no in-flight accounting: nothing else runs at load) and
+        carry no timer, so percentiles stay unpolluted."""
+        jobs = []
+        for b in buckets:
+            batch = jax.tree_util.tree_map(
+                lambda a, _b=b: np.ascontiguousarray(
+                    np.broadcast_to(a[None], (_b,) + a.shape)), sample)
+            for rep in self._replicas:
+                pending = _RoutedPending(b, timer=None, replica=rep.index)
+                # t0=None: the worker stamps its own start, so the report
+                # is per-(replica, bucket) compile+run, not queue wait
+                rep.work_q.put((batch, pending, None))
+                jobs.append((rep.index, b, pending))
+        for idx, b, pending in jobs:
+            pending.result()
+            self.warmup_report[f"r{idx}:{tag}:b{b}"] = round(
+                pending._dispatch_s, 4)
             self.warmed_buckets.add(b)
         return self
 

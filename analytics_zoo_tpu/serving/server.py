@@ -22,11 +22,17 @@ overlap comes from three explicit stages connected by bounded queues —
   power-of-two bucket (stacking to the bucket is free — the stack copies
   every record anyway) and calls `InferenceModel.predict_async`, which
   returns WITHOUT materializing: the device computes batch N while this
-  thread stacks and dispatches batch N+1.
+  thread stacks and dispatches batch N+1. With a multi-device model
+  (`num_replicas>1`) this stage is the ROUTER: predict_async picks the
+  least-outstanding-work replica under a per-replica in-flight bound, so
+  N batches compute on N chips concurrently; per-replica dispatch counts
+  land in `serving_replica_batches_total` and each dispatch span is
+  tagged with its replica.
 - **sink** (one thread): materializes completed results (the only blocking
-  `np.asarray`), encodes per-record values, and writes a whole batch back
-  with ONE broker round trip (`hset_many`) plus one batched ack — instead
-  of the old one `hset` per record.
+  `np.asarray`) in COMPLETION order — a slow or poisoned replica never
+  dams finished work from the others — encodes per-record values, and
+  writes a whole batch back with ONE broker round trip (`hset_many`)
+  plus one batched ack — instead of the old one `hset` per record.
 
 Backpressure is the bounded queues: a slow device fills `_sink_q` and
 stalls dispatch; a slow broker fills `_decode_q` and stalls the reader.
@@ -160,6 +166,25 @@ class ClusterServing:
         self._records_total = reg.counter(
             "serving_records_total",
             "records through the serving engine, by outcome (read, served)")
+        # multi-device router telemetry: families register unconditionally
+        # (stable /metrics schema); series appear only when a replica pool
+        # is actually routing, so single-replica output stays unchanged
+        self._replica_batches = reg.counter(
+            "serving_replica_batches_total",
+            "batches dispatched to each model replica, by replica index")
+        replica_gauge = reg.gauge(
+            "serving_replica_inflight",
+            "routed-but-unmaterialized batches per model replica (live)")
+        # every closure this engine installs is remembered so stop() can
+        # compare-and-release exactly these — never a newer engine's
+        self._gauge_installs = []       # (gauge, fn, labels, freeze)
+        self._multi_replica = getattr(self.model, "num_replicas", 1) > 1
+        if self._multi_replica:
+            for i in range(self.model.num_replicas):
+                fn = (lambda _i=i: self.model.replica_inflight(_i))
+                replica_gauge.set_function(fn, replica=str(i))
+                self._gauge_installs.append(
+                    (replica_gauge, fn, {"replica": str(i)}, False))
         for timer, stage in ((self.decode_timer, "decode"),
                              (self.dispatch_timer, "dispatch"),
                              (self.sink_timer, "sink")):
@@ -174,9 +199,13 @@ class ClusterServing:
             self.model.timer._registry_mirrored = True
         qd = reg.gauge("serving_queue_depth",
                        "live depth of each inter-stage pipeline queue")
-        qd.set_function(self._decode_q.qsize, queue="decode")
-        qd.set_function(self._dispatch_q.qsize, queue="dispatch")
-        qd.set_function(self._sink_q.qsize, queue="sink")
+        for q, fn in (("decode", self._decode_q.qsize),
+                      ("dispatch", self._dispatch_q.qsize),
+                      ("sink", self._sink_q.qsize)):
+            qd.set_function(fn, queue=q)
+            # frozen (not removed) on stop: post-run readers (the bench)
+            # still see the drained depths
+            self._gauge_installs.append((qd, fn, {"queue": q}, True))
 
     def _enqueue(self, q: "queue.Queue", batch: _Batch):
         """Stamp the enqueue time (the consumer's queue-wait span starts
@@ -216,6 +245,7 @@ class ClusterServing:
             for t in self._threads:
                 t.join(timeout=10)
             self._threads = []
+            self._unwire_gauges()
             return
         readers = [t for t in self._threads if "reader" in t.name]
         decoders = [t for t in self._threads if "decode" in t.name]
@@ -233,12 +263,27 @@ class ClusterServing:
         for t in sinks:
             t.join(timeout=10)
         self._threads = []
+        self._unwire_gauges()
         for br in (self.reader_broker, self.sink_broker):
             if br is not self.broker and hasattr(br, "close"):
                 try:
                     br.close()
                 except Exception:  # noqa: BLE001 — shutdown best effort
                     pass
+
+    def _unwire_gauges(self):
+        """Post-drain registry cleanup (runs AFTER the stage joins, so
+        values reflect the drained engine, not a mid-drain snapshot):
+        every closure this engine installed is compare-and-released —
+        left in the process-wide registry they would pin this engine
+        (the replica closures hold N device-resident param copies) for
+        the process lifetime and keep exporting series that read a
+        stopped engine, while a series a NEWER engine has since claimed
+        is left alone. Replica series disappear; queue depths freeze at
+        their drained values for post-run readers (the bench)."""
+        installs, self._gauge_installs = self._gauge_installs, []
+        for gauge, fn, labels, freeze in installs:
+            gauge.release_function(fn, freeze=freeze, **labels)
 
     @staticmethod
     def _poison(q: "queue.Queue", n: int):
@@ -257,9 +302,19 @@ class ClusterServing:
                     if time.monotonic() > deadline:
                         break
                     try:
-                        q.get_nowait()
+                        dropped = q.get_nowait()
                     except queue.Empty:
                         pass
+                    else:
+                        # a dropped batch may hold a routed pending whose
+                        # replica permit only releases on consumption —
+                        # abandon it (records redeliver; the permit must
+                        # not leak into the engine-outliving model)
+                        abandon = getattr(
+                            getattr(dropped, "pending", None),
+                            "abandon", None)
+                        if abandon is not None:
+                            abandon()
 
     # -- stage: reader -----------------------------------------------------
     def _reader_loop(self):
@@ -385,9 +440,17 @@ class ClusterServing:
                     stacked, valid_n=n)
                 t_end = time.perf_counter()
                 self.dispatch_timer.record(t_end - t_work)
+                replica = getattr(batch.pending, "replica", 0)
+                if self._multi_replica and replica is not None:
+                    self._replica_batches.inc(replica=str(replica))
                 if tr is not None:
+                    # replica tag only in multi-device mode: the default
+                    # single-replica trace schema stays unchanged
                     tr.add_span("dispatch", t_work, t_end,
-                                trace_ids=batch.uris)
+                                trace_ids=batch.uris,
+                                args={"replica": replica}
+                                if self._multi_replica
+                                and replica is not None else None)
                 self._enqueue(self._sink_q, batch)
             except Exception as e:  # noqa: BLE001 — stream must survive
                 log.error("dispatch failure for batch of %d: %s",
@@ -398,45 +461,110 @@ class ClusterServing:
 
     # -- stage: sink -------------------------------------------------------
     def _sink_loop(self):
+        """Materialize and write back in COMPLETION order, not dispatch
+        order: with a replica pool, batch N+1 on an idle device finishes
+        while batch N still computes elsewhere — FIFO materialization
+        would park the sink on the slowest replica and stall every other
+        chip's finished work (and one poisoned replica would dam the
+        stream). Batches are pulled greedily off the queue into a waiting
+        set; whichever `PendingPrediction` reports `done()` first is
+        written first. Per-batch writeback, NaN degradation, and ack
+        semantics are unchanged."""
+        waiting: List[_Batch] = []
+        stop_seen = False
+        # the completion-scan window is bounded at queue_depth: past the
+        # cap the sink stops pulling, _sink_q fills, and dispatch blocks
+        # on its put — the documented sink backpressure survives the
+        # completion-order rework (without the cap, a fast dispatcher on
+        # an async backend would pile unbounded un-materialized device
+        # results into this list). On stop the cap lifts to drain.
+        cap = max(2, self.queue_depth)
         while True:
-            batch = self._sink_q.get()
-            if batch is _STOP:
-                return
-            tr = self.tracer
-            if tr is not None:
-                tr.add_span("sink_q_wait", batch.t_enq,
+            batch = None
+            try:
+                if not (waiting or stop_seen):
+                    batch = self._sink_q.get()      # idle: block
+                elif stop_seen or len(waiting) < cap:
+                    batch = self._sink_q.get_nowait()
+            except queue.Empty:
+                pass
+            if batch is not None:
+                if batch is _STOP:
+                    stop_seen = True
+                else:
+                    if self.tracer is not None:
+                        self.tracer.add_span(
+                            "sink_q_wait", batch.t_enq,
                             time.perf_counter(), cat="serving.queue",
                             trace_ids=batch.uris)
-            try:
-                t_work = time.perf_counter()
-                values = self._materialize(batch)
-                # ONE pipelined broker write for the whole batch,
-                # then one batched ack — 2 round trips, not N+1
-                self.sink_broker.hset_many(
-                    self.result_key, dict(zip(batch.uris, values)))
-                self.sink_broker.ack(self.stream, GROUP, batch.ids)
-                t_end = time.perf_counter()
-                self.sink_timer.record(t_end - t_work)
-                if tr is not None:
-                    # includes the device wait inside _materialize — the
-                    # only blocking readback in the pipeline
-                    tr.add_span("sink", t_work, t_end,
-                                trace_ids=batch.uris)
-                with self._counter_lock:
-                    self.records_served += len(batch.uris)
-                self._records_total.inc(len(batch.uris), outcome="served")
-                self.batch_timer.record(t_end - batch.t0)
-            except Exception as e:  # noqa: BLE001 — no ack → the broker
-                # redelivers after its pending window (at-least-once)
-                log.error("sink writeback failed for %d records (%s: %s); "
-                          "leaving unacked for redelivery",
-                          len(batch.uris), type(e).__name__, e)
+                    # sink span base: from here on, time spent is the
+                    # device wait + materialize + writeback for this
+                    # batch
+                    batch.t_enq = time.perf_counter()
+                    waiting.append(batch)
+                continue
+            ready = [b for b in waiting
+                     if b.nan or b.pending is None or b.pending.done()]
+            if not ready and waiting and \
+                    (stop_seen or not self._multi_replica
+                     or (len(waiting) == 1 and self._sink_q.empty())):
+                # block in result() on the oldest instead of polling:
+                # on stop (drain), with a single device stream (one
+                # replica / sharded — completion order IS dispatch
+                # order, so this is exactly the pre-router sink, no
+                # poll tax on the default path), or when only one
+                # batch is in flight anyway
+                ready = [waiting[0]]
+            for b in ready:
+                waiting.remove(b)
+                self._sink_one(b)
+            if stop_seen and not waiting:
+                return
+            if waiting and not ready:
+                time.sleep(0.0005)     # all in flight; poll done() soon
+
+    def _sink_one(self, batch: _Batch):
+        tr = self.tracer
+        try:
+            t_work = batch.t_enq
+            values = self._materialize(batch)
+            # ONE pipelined broker write for the whole batch,
+            # then one batched ack — 2 round trips, not N+1
+            self.sink_broker.hset_many(
+                self.result_key, dict(zip(batch.uris, values)))
+            self.sink_broker.ack(self.stream, GROUP, batch.ids)
+            t_end = time.perf_counter()
+            self.sink_timer.record(t_end - t_work)
+            if tr is not None:
+                # includes the device wait inside _materialize — the
+                # only blocking readback in the pipeline
+                tr.add_span("sink", t_work, t_end,
+                            trace_ids=batch.uris)
+            with self._counter_lock:
+                self.records_served += len(batch.uris)
+            self._records_total.inc(len(batch.uris), outcome="served")
+            self.batch_timer.record(t_end - batch.t0)
+        except Exception as e:  # noqa: BLE001 — no ack → the broker
+            # redelivers after its pending window (at-least-once)
+            log.error("sink writeback failed for %d records (%s: %s); "
+                      "leaving unacked for redelivery",
+                      len(batch.uris), type(e).__name__, e)
 
     def _materialize(self, batch) -> List[str]:
         """Per-record encoded result strings for a batch; inference
         failure degrades the whole batch to "NaN" (the per-shape batch is
         the reference's failure unit, `ClusterServingInference.scala:71`)."""
         if batch.nan:
+            if batch.pending is not None:
+                # a batch can be marked nan AFTER routing succeeded (a
+                # dispatch-stage failure past predict_async): the routed
+                # pending still holds a replica permit that only
+                # result() releases — drain it or the replica is
+                # permanently down a slot
+                try:
+                    batch.pending.result()
+                except Exception:  # noqa: BLE001 — already degrading
+                    pass
             return ["NaN"] * len(batch.uris)
         try:
             preds = batch.pending.result()
@@ -539,4 +667,8 @@ class ClusterServing:
                 "dispatch": self._dispatch_q.qsize(),
                 "sink": self._sink_q.qsize(),
             }
+        if self._multi_replica or getattr(self.model, "placement",
+                                          "replicated") == "sharded":
+            m["placement"] = self.model.placement_info()
+            m["replicas"] = self.model.replica_stats()
         return m

@@ -26,12 +26,26 @@ runs the model in-process; set JAX_PLATFORMS=cpu to measure the serving
 stack itself.
 
     python bench_serving.py
+
+A multi-device section (`--devices N`) drains the same backlog through 1,
+2, ..., N model replicas (one per forced-host device; re-execs itself
+with `--xla_force_host_platform_device_count=N` when needed) plus one
+GSPMD-sharded copy, and reports the scaling curve, per-replica batch
+counts, and efficiency. NOTE the host-core ceiling: forced-host "chips"
+burn real CPU cores, so an M-core box caps replica scaling at ~M× no
+matter how many virtual devices exist; a real pod's chips compute
+off-host and scale to the device count. Both the raw curve and the
+core-normalized efficiency are reported.
+
+    python bench_serving.py --devices 8
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -108,7 +122,8 @@ def _measure(infer, broker_kind: str, n: int = N_REQUESTS):
 
 
 def _measure_concurrent(infer, broker_kind: str, n_clients: int = 8,
-                        total: int = 320, pipelined: bool = True):
+                        total: int = 320, pipelined: bool = True,
+                        batch_size: int = 32, sample=None):
     """Closed loop, `n_clients` logical clients: a request is submitted
     the moment one completes, keeping exactly `n_clients` in flight. One
     single-threaded loop drives all of them — per-client polling threads
@@ -121,10 +136,12 @@ def _measure_concurrent(infer, broker_kind: str, n_clients: int = 8,
 
     serve_broker, (submit_br, poll_br), server = _setup_brokers(
         broker_kind, 2)
-    serving = ClusterServing(infer, broker=serve_broker, batch_size=32,
+    serving = ClusterServing(infer, broker=serve_broker,
+                             batch_size=batch_size,
                              batch_timeout_ms=2,
                              pipelined=pipelined).start()
-    img = np.random.rand(32, 32, 3).astype(np.float32)
+    img = sample if sample is not None \
+        else np.random.rand(32, 32, 3).astype(np.float32)
     inq = InputQueue(submit_br)
     inflight = {}
     lat = []
@@ -163,7 +180,8 @@ def _measure_concurrent(infer, broker_kind: str, n_clients: int = 8,
 
 
 def _measure_drain(infer, broker_kind: str, total: int = 480,
-                   pipelined: bool = True):
+                   pipelined: bool = True, batch_size: int = 32,
+                   sample=None):
     """Engine-limited throughput: pre-fill the stream with `total`
     records, start the engine, time until every result lands. Client
     costs are excluded (the backlog already exists), so unlike the
@@ -174,11 +192,13 @@ def _measure_drain(infer, broker_kind: str, total: int = 480,
 
     serve_broker, (submit_br, poll_br), server = _setup_brokers(
         broker_kind, 2)
-    img = np.random.rand(32, 32, 3).astype(np.float32)
+    img = sample if sample is not None \
+        else np.random.rand(32, 32, 3).astype(np.float32)
     inq = InputQueue(submit_br)
     for _ in range(total):
         inq.enqueue(t=img)
-    serving = ClusterServing(infer, broker=serve_broker, batch_size=32,
+    serving = ClusterServing(infer, broker=serve_broker,
+                             batch_size=batch_size,
                              batch_timeout_ms=2,
                              pipelined=pipelined).start()
     t0 = time.perf_counter()
@@ -222,6 +242,147 @@ def _warmup_probe(model, replicas: int = 3):
             steady.append((time.perf_counter() - t0) * 1e3)
         steadies.append(float(np.percentile(np.asarray(steady), 50)))
     return min(firsts), float(np.median(steadies))
+
+
+# -- multi-device: replica pool + sharded placement ------------------------
+
+def _md_model(width: int = 512, iters: int = 32):
+    """Compute-heavy-per-batch forward: a fori_loop of small (width x
+    width) matmuls. Small matmuls keep XLA:CPU from spreading ONE
+    execution across cores, so concurrent replicas — not intra-op
+    threads — are the only way to use the whole machine; that mirrors a
+    TPU pod, where each replica's compute runs off-host on its own chip.
+    Returns (fn, params, one_record_sample)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    W = (rng.randn(width, width).astype(np.float32) / np.sqrt(width))
+
+    def fn(p, x):
+        def body(_, c):
+            return jnp.tanh(c @ p)
+        return jax.lax.fori_loop(0, iters, body, x)
+
+    return fn, W, rng.rand(width).astype(np.float32)
+
+
+def multidevice_summary(n_devices: int, total: int = 256,
+                        batch_size: int = 8, replica_counts=None,
+                        closed_loop: bool = True) -> dict:
+    """Backlog-drain scaling curve over the replica pool (requires
+    `len(jax.devices()) >= n_devices`; see `--devices` for the re-exec
+    wrapper). Per-replica batch counts come from the router's own
+    book-keeping, so the JSON shows WHERE the work actually ran."""
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    fn, W, sample = _md_model()
+    counts = sorted({c for c in (replica_counts or
+                                 [1, 2, max(1, n_devices // 2), n_devices])
+                     if 1 <= c <= n_devices})
+    drain_rps, per_replica = {}, {}
+    # every bucket the reader can form (straggler batches < batch_size
+    # included) pre-compiles, or a mid-drain XLA compile pollutes the
+    # scaling baseline
+    def reachable(im):
+        return [b for b in im.buckets if b <= batch_size] or im.buckets[:1]
+
+    for r in counts:
+        im = InferenceModel(num_replicas=r).load_fn(fn, W)
+        im.warmup(sample, buckets=reachable(im))  # compile off the clock
+        # best-of-2: an engine-limited drain is deterministic work, so
+        # the max filters one-sided scheduler noise (the 2-core rigs
+        # swing single runs 2-3x; a mean would keep the outlier). The
+        # per-replica routing counts are the BEST run's delta, not the
+        # sum over both — the JSON describes the run it publishes.
+        best_rps, best_counts = 0.0, []
+        for _ in range(2):
+            before = [s["batches"] for s in im.replica_stats()]
+            rps = _measure_drain(im, "memory", total=total,
+                                 batch_size=batch_size, sample=sample)
+            after = [s["batches"] for s in im.replica_stats()]
+            if rps >= best_rps:
+                best_rps = rps
+                best_counts = [None if a is None else a - (b or 0)
+                               for a, b in zip(after, before)]
+        drain_rps[str(r)] = round(best_rps, 1)
+        per_replica[str(r)] = best_counts
+        im.close()
+
+    ims = InferenceModel(placement="sharded").load_fn(fn, W)
+    ims.warmup(sample, buckets=reachable(ims))
+    sharded_rps = max(_measure_drain(ims, "memory", total=total,
+                                     batch_size=batch_size, sample=sample)
+                      for _ in range(2))
+
+    base = drain_rps[str(counts[0])]
+    best_r = max(drain_rps, key=lambda k: drain_rps[k])
+    speedup = drain_rps[str(counts[-1])] / max(base, 1e-9)
+    cores = os.cpu_count() or 1
+    out = {
+        "metric": "serving_multidevice_drain",
+        "devices": n_devices,
+        "host_cores": cores,
+        "total_records": total,
+        "batch_size": batch_size,
+        "drain_rps": drain_rps,
+        "drain_rps_sharded": round(sharded_rps, 1),
+        "scaling_speedup": round(speedup, 2),
+        "best_speedup": round(drain_rps[best_r] / max(base, 1e-9), 2),
+        "best_replicas": int(best_r),
+        "scaling_efficiency": round(speedup / n_devices, 3),
+        # forced-host devices burn real cores: an M-core box caps replica
+        # scaling at ~M x regardless of virtual device count. A real pod's
+        # chips compute off-host, so there the ceiling IS the device count.
+        "efficiency_vs_host_cores": round(
+            speedup / min(n_devices, cores), 3),
+        "per_replica_batches": per_replica,
+        "note": ("forced-host devices share the host's cores: replica "
+                 f"scaling here caps near {min(n_devices, cores)}x "
+                 "(and oversubscribing threads past the core count can "
+                 "degrade); on a real pod each chip computes off-host, "
+                 "so the ceiling is the device count"),
+    }
+    if closed_loop:
+        for label, r in (("1", 1), (str(n_devices), n_devices)):
+            im = InferenceModel(num_replicas=r).load_fn(fn, W)
+            im.warmup(sample, buckets=reachable(im))
+            rps, p50, _p99 = _measure_concurrent(
+                im, "memory", n_clients=4 * n_devices, total=total,
+                batch_size=batch_size, sample=sample)
+            out[f"closed_loop_rps_{label}"] = round(rps, 1)
+            out[f"closed_loop_p50_ms_{label}"] = round(p50, 2)
+            im.close()
+    return out
+
+
+def _multidevice_main(args) -> int:
+    """`--devices N`: run `multidevice_summary` on an N-device platform,
+    re-execing into a forced-host CPU child when this interpreter sees
+    fewer devices (env must be set before jax initializes its backend —
+    same pattern as `__graft_entry__._reexec_dryrun`)."""
+    n = args.devices
+    if len(jax.devices()) < n \
+            and os.environ.get("_ZOO_MD_BENCH_CHILD") != "1":
+        env = dict(os.environ)
+        env["_ZOO_MD_BENCH_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)   # hermetic CPU child
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={n}").strip()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--devices", str(n), "--total", str(args.total)],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=1800)
+        return proc.returncode
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    init_orca_context(cluster_mode="local")
+    summary = multidevice_summary(n, total=args.total)
+    stop_orca_context()
+    print(json.dumps(summary))
+    return 0
 
 
 def _serving_model():
@@ -432,6 +593,16 @@ def _registry_tail_metrics():
 def main():
     from analytics_zoo_tpu import init_orca_context, stop_orca_context
     from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=None,
+                    help="multi-device mode: replica-pool/sharded drain "
+                         "scaling over N (forced-host) devices")
+    ap.add_argument("--total", type=int, default=256,
+                    help="backlog size for the multi-device drain")
+    args = ap.parse_args()
+    if args.devices:
+        return _multidevice_main(args)
 
     if os.environ.get("BENCH_DEVICE_FORWARD") == "1":
         return _device_forward_main()

@@ -1,0 +1,452 @@
+"""Multi-device serving: replica-pool routing, sharded placement, and the
+config surface (ISSUE 3). Runs on the 8-device virtual CPU mesh the
+conftest forces via `XLA_FLAGS=--xla_force_host_platform_device_count=8`
+(the same stand-in-for-a-pod pattern as `tests/test_parallel.py`):
+
+- router fairness: least-outstanding-work + round-robin tie-break spreads
+  batches evenly over all replicas;
+- per-replica failure isolation: a poisoned batch NaNs on its replica
+  without stalling work on the others;
+- drain-on-stop with in-flight work spread across several devices;
+- sharded-placement predict parity with single-device output;
+- load-time config validation (num_replicas vs available devices,
+  placement spelling) and the client's monotonic-deadline backoff.
+"""
+
+import os
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving import (ClusterServing, InferenceModel,
+                                       InputQueue, MemoryBroker, OutputQueue)
+
+
+def make_model(in_dim=4, out_dim=3, seed=0):
+    W = np.random.RandomState(seed).randn(in_dim, out_dim).astype(np.float32)
+    return W, (lambda p, x: x @ p)
+
+
+def _wait_results(broker, uris, timeout_s=30.0):
+    out = OutputQueue(broker)
+    results = {}
+    deadline = time.monotonic() + timeout_s
+    while len(results) < len(uris) and time.monotonic() < deadline:
+        for u in uris:
+            if u not in results:
+                r = out.query(u)
+                if r is not None:
+                    results[u] = r
+        time.sleep(0.005)
+    return results
+
+
+class TestReplicaPool:
+    def test_single_replica_is_legacy_path(self, devices8):
+        """num_replicas=1 (the default) must keep the original
+        single-device path: no pool, no worker threads, same results."""
+        W, fn = make_model()
+        im = InferenceModel().load_fn(fn, W)
+        assert im.num_replicas == 1 and im._replicas is None
+        x = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+        np.testing.assert_allclose(im.predict(x), x @ W, atol=1e-5)
+        assert im.predict_async(x).replica == 0
+
+    def test_auto_takes_every_local_device(self, devices8):
+        W, fn = make_model()
+        im = InferenceModel(num_replicas="auto").load_fn(fn, W)
+        try:
+            assert im.num_replicas == len(devices8)
+            assert len(im._replicas) == len(devices8)
+            # one params copy per device, committed there
+            devs = {str(r.device) for r in im._replicas}
+            assert len(devs) == len(devices8)
+        finally:
+            im.close()
+
+    def test_routing_fairness_least_outstanding_work(self, devices8):
+        """16 dispatches with nothing materialized: the router must place
+        exactly max_inflight (2) on each of the 8 replicas — no pile-up
+        on replica 0."""
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=8).load_fn(fn, W)
+        try:
+            x = np.ones((4, 4), np.float32)
+            pends = [im.predict_async(x) for _ in range(16)]
+            per_replica = sorted(p.replica for p in pends)
+            assert per_replica == sorted(list(range(8)) * 2)
+            for p in pends:
+                p.result()
+            # permits all released: inflight back to 0 everywhere
+            assert all(s["inflight"] == 0 for s in im.replica_stats())
+        finally:
+            im.close()
+
+    def test_inflight_bound_blocks_then_times_out(self, devices8):
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=2,
+                            max_inflight_per_replica=1).load_fn(fn, W)
+        try:
+            x = np.ones((2, 4), np.float32)
+            held = [im.predict_async(x) for _ in range(2)]
+            # saturated pool and nobody materializing: the router's
+            # bounded wait must surface as TimeoutError, not a hang
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError):
+                im._acquire_replica(timeout=0.2)
+            assert time.monotonic() - t0 < 5
+            for p in held:
+                p.result()
+            assert im.predict_async(x).result().shape == (2, 3)
+        finally:
+            im.close()
+
+    def test_results_match_single_device(self, devices8):
+        W, fn = make_model()
+        im1 = InferenceModel().load_fn(fn, W)
+        im8 = InferenceModel(num_replicas=8).load_fn(fn, W)
+        try:
+            for seed in range(8):
+                x = np.random.RandomState(seed).randn(3, 4) \
+                    .astype(np.float32)
+                np.testing.assert_allclose(im8.predict(x), im1.predict(x),
+                                           atol=1e-5)
+        finally:
+            im8.close()
+
+    def test_dispatch_failure_releases_permit(self, devices8):
+        """A batch that fails at dispatch (shape mismatch inside the jit
+        trace) must re-raise from result() AND release its replica
+        permit — a leak would wedge the router."""
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=2,
+                            max_inflight_per_replica=1).load_fn(fn, W)
+        try:
+            bad = np.ones((2, 5), np.float32)   # contract-dim mismatch
+            for _ in range(4):                  # > total permits
+                with pytest.raises(Exception):
+                    im.predict_async(bad).result()
+            assert all(s["inflight"] == 0 for s in im.replica_stats())
+            good = np.ones((2, 4), np.float32)
+            assert im.predict(good).shape == (2, 3)
+        finally:
+            im.close()
+
+    def test_nan_batch_with_live_pending_releases_permit(self, devices8):
+        """A batch marked NaN AFTER routing succeeded (dispatch-stage
+        failure past predict_async) still holds a replica permit; the
+        sink's NaN path must drain it or the replica loses a slot
+        forever."""
+        from analytics_zoo_tpu.serving.server import _Batch
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=2,
+                            max_inflight_per_replica=1).load_fn(fn, W)
+        serving = ClusterServing(im, MemoryBroker(), pipelined=True)
+        try:
+            for _ in range(4):              # > total permits: a leak
+                p = im.predict_async(       # would wedge the router
+                    np.ones((2, 4), np.float32))
+                b = _Batch(["rid"], ["uri"], None, time.monotonic(),
+                           nan=True)
+                b.pending = p
+                assert serving._materialize(b) == ["NaN"]
+            assert all(s["inflight"] == 0 for s in im.replica_stats())
+        finally:
+            serving.stop()
+            im.close()
+
+    def test_warmup_fans_out_across_replicas(self, devices8):
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=4).load_fn(fn, W)
+        try:
+            im.warmup(np.zeros((4,), np.float32), buckets=[1, 4])
+            assert im.warmed_buckets == {1, 4}
+            assert set(im.warmup_report) == {
+                f"r{i}:4:b{b}" for i in range(4) for b in (1, 4)}
+        finally:
+            im.close()
+
+
+class TestServingEngineMultiDevice:
+    def test_pipeline_routes_across_all_replicas(self, devices8):
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=8).load_fn(fn, W)
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=1, batch_timeout_ms=0,
+                                 pipelined=True).start()
+        try:
+            q = InputQueue(br)
+            uris = [q.enqueue(None, t=np.ones((4,), np.float32) * i)
+                    for i in range(48)]
+            results = _wait_results(br, uris)
+            assert len(results) == 48
+            for i, u in enumerate(uris):
+                np.testing.assert_allclose(
+                    results[u], (np.ones(4, np.float32) * i) @ W,
+                    atol=1e-4)
+            m = serving.metrics()
+            assert m["placement"]["num_replicas"] == 8
+            used = [s for s in m["replicas"] if s["batches"] > 0]
+            # batch_size=1 → ≥48 routed batches; every replica gets work
+            assert len(used) == 8, m["replicas"]
+        finally:
+            serving.stop()
+            im.close()
+
+    def test_per_replica_failure_isolation(self, devices8):
+        """Poisoned batches (dispatch-time shape failure on whichever
+        replica drew them) degrade to "NaN" while good batches on the
+        other replicas keep serving — and the engine stays alive."""
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=8).load_fn(fn, W)
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=4,
+                                 pipelined=True).start()
+        try:
+            q = InputQueue(br)
+            good, bad = [], []
+            for i in range(16):
+                good.append(q.enqueue(None,
+                                      t=np.ones((4,), np.float32) * i))
+                if i % 4 == 0:
+                    bad.append(q.enqueue(None,
+                                         t=np.ones((5,), np.float32)))
+            results = _wait_results(br, good + bad)
+            assert len(results) == len(good) + len(bad)
+            for u in bad:
+                assert isinstance(results[u], float) \
+                    and np.isnan(results[u])
+            for u in good:
+                assert np.asarray(results[u]).shape == (3,)
+            assert serving.is_alive()
+        finally:
+            serving.stop()
+            im.close()
+
+    def test_drain_on_stop_with_multi_device_inflight(self, devices8):
+        """Work already read from the broker and in flight on several
+        devices must flow out through the completion-order sink before
+        stop() returns."""
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=8).load_fn(fn, W)
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=2, batch_timeout_ms=0,
+                                 pipelined=True).start()
+        q = InputQueue(br)
+        uris = [q.enqueue(None, t=np.ones((4,), np.float32))
+                for _ in range(32)]
+        deadline = time.monotonic() + 20
+        while serving.records_read < 32 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert serving.records_read == 32
+        serving.stop()
+        assert serving.records_served == 32
+        out = OutputQueue(br)
+        for u in uris:
+            assert out.query(u) is not None
+        assert not serving._threads
+        im.close()
+
+    def test_replica_metrics_in_registry(self, devices8):
+        from analytics_zoo_tpu.observability import get_registry
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=4).load_fn(fn, W)
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=1, batch_timeout_ms=0,
+                                 pipelined=True).start()
+        try:
+            InputQueue(br).predict_batch(
+                [np.ones((4,), np.float32)] * 8, timeout_s=20)
+            snap = get_registry().snapshot()
+            series = snap["serving_replica_batches_total"]["series"]
+            total = sum(s["value"] for s in series
+                        if s["labels"].get("replica") in
+                        {"0", "1", "2", "3"})
+            assert total >= 8
+            gauges = snap["serving_replica_inflight"]["series"]
+            assert {s["labels"]["replica"] for s in gauges} >= \
+                {"0", "1", "2", "3"}
+        finally:
+            serving.stop()
+            im.close()
+        # stop() uninstalls the live gauge closures: a stopped engine
+        # must not stay pinned in the process-wide registry, nor keep
+        # exporting series that read a dead model
+        snap = get_registry().snapshot()
+        assert not snap["serving_replica_inflight"].get("series")
+
+
+    def test_stop_does_not_clobber_newer_engines_gauges(self, devices8):
+        """Gauge label keys are process-global: engine A stopping must
+        compare-and-release only ITS closures, not delete the series a
+        newer engine B has since claimed under the same labels."""
+        from analytics_zoo_tpu.observability import get_registry
+        W, fn = make_model()
+        im_a = InferenceModel(num_replicas=2).load_fn(fn, W)
+        a = ClusterServing(im_a, MemoryBroker(), pipelined=True)
+        im_b = InferenceModel(num_replicas=2).load_fn(fn, W)
+        b = ClusterServing(im_b, MemoryBroker(), pipelined=True)
+        try:
+            a.stop()
+            snap = get_registry().snapshot()
+            live = {s["labels"]["replica"]
+                    for s in snap["serving_replica_inflight"]["series"]}
+            assert live >= {"0", "1"}, "B's series must survive A's stop"
+        finally:
+            b.stop()
+            im_a.close()
+            im_b.close()
+        snap = get_registry().snapshot()
+        assert not snap["serving_replica_inflight"].get("series")
+
+
+class TestShardedPlacement:
+    def test_sharded_predict_parity(self, devices8):
+        """One GSPMD-sharded copy over all 8 devices must produce the
+        single-device output bit-for-tolerance."""
+        W, fn = make_model(in_dim=8, out_dim=6)
+        im1 = InferenceModel().load_fn(fn, W)
+        ims = InferenceModel(placement="sharded").load_fn(fn, W)
+        assert ims.num_replicas == 1
+        assert ims.placement_info()["data_parallel_size"] == 8
+        # buckets restricted to even splits over the data axes
+        assert all(b % 8 == 0 for b in ims.buckets)
+        for n in (3, 8, 20):
+            x = np.random.RandomState(n).randn(n, 8).astype(np.float32)
+            np.testing.assert_allclose(ims.predict(x), im1.predict(x),
+                                       atol=1e-5)
+
+    def test_sharded_through_serving_engine(self, devices8):
+        W, fn = make_model()
+        im = InferenceModel(placement="sharded").load_fn(fn, W)
+        br = MemoryBroker()
+        serving = ClusterServing(im, br, batch_size=8,
+                                 pipelined=True).start()
+        try:
+            q = InputQueue(br)
+            uris = [q.enqueue(None, t=np.ones((4,), np.float32) * i)
+                    for i in range(12)]
+            results = _wait_results(br, uris)
+            assert len(results) == 12
+            for i, u in enumerate(uris):
+                np.testing.assert_allclose(
+                    results[u], (np.ones(4, np.float32) * i) @ W,
+                    atol=1e-4)
+            assert serving.metrics()["placement"]["placement"] == "sharded"
+        finally:
+            serving.stop()
+
+    def test_sharded_nonpow2_devices_get_a_bucket_ladder(self, devices8):
+        """dp=6 divides no power-of-two bucket; the fallback must rebuild
+        a ladder from dp (6, 12, 24, ...) — not serve every request
+        padded to one ~max_batch bucket."""
+        import jax
+        W, fn = make_model()
+        im = InferenceModel(placement="sharded",
+                            devices=jax.devices()[:6]).load_fn(fn, W)
+        assert im.buckets[0] == 6 and im.buckets[1] == 12
+        assert all(b % 6 == 0 for b in im.buckets)
+        x = np.random.RandomState(3).randn(4, 4).astype(np.float32)
+        ref = InferenceModel().load_fn(fn, W).predict(x)
+        np.testing.assert_allclose(im.predict(x), ref, atol=1e-5)
+
+    def test_abandon_releases_permit_without_materializing(self, devices8):
+        """The shutdown-drop path (stop() discarding queued batches)
+        releases permits via abandon(), never blocking on the device."""
+        W, fn = make_model()
+        im = InferenceModel(num_replicas=2,
+                            max_inflight_per_replica=1).load_fn(fn, W)
+        try:
+            for _ in range(4):          # > total permits: a leak wedges
+                p = im.predict_async(np.ones((2, 4), np.float32))
+                p.abandon()
+            assert all(s["inflight"] == 0 for s in im.replica_stats())
+            assert im.predict(np.ones((2, 4), np.float32)).shape == (2, 3)
+        finally:
+            im.close()
+
+    def test_sharded_warmup_skips_indivisible_buckets(self, devices8):
+        W, fn = make_model()
+        im = InferenceModel(placement="sharded").load_fn(fn, W)
+        im.warmup(np.zeros((4,), np.float32), buckets=[1, 2, 8, 16])
+        assert im.warmed_buckets == {8, 16}
+
+
+class TestConfigValidation:
+    def _load(self, tmp_path, params: str):
+        from analytics_zoo_tpu.serving.config import ServingConfig
+        cfg = tmp_path / "config.yaml"
+        lines = ["model:", "  path: /tmp/nope", "params:"]
+        lines += ["  " + ln for ln in textwrap.dedent(params).splitlines()]
+        cfg.write_text("\n".join(lines) + "\n")
+        return ServingConfig.load(os.fspath(cfg))
+
+    def test_rejects_excess_replicas_at_load(self, tmp_path, devices8):
+        with pytest.raises(ValueError, match="num_replicas=99 exceeds"):
+            self._load(tmp_path, "num_replicas: 99")
+
+    def test_rejects_unknown_placement_at_load(self, tmp_path):
+        with pytest.raises(ValueError, match="placement='mirrored'"):
+            self._load(tmp_path, "placement: mirrored")
+
+    def test_rejects_negative_replicas(self, tmp_path):
+        with pytest.raises(ValueError, match="must be >= 1"):
+            self._load(tmp_path, "num_replicas: -3")
+
+    def test_accepts_auto_and_valid_counts(self, tmp_path, devices8):
+        cfg = self._load(tmp_path, "num_replicas: auto\n"
+                                   "placement: replicated")
+        assert cfg.num_replicas == "auto"
+        cfg = self._load(tmp_path, "num_replicas: 8\nplacement: sharded")
+        assert cfg.num_replicas == 8 and cfg.placement == "sharded"
+
+    def test_model_ctor_rejects_excess_replicas(self, devices8):
+        with pytest.raises(ValueError, match="exceeds"):
+            InferenceModel(num_replicas=len(devices8) + 1)
+
+    def test_cli_override_rescues_oversized_config(self, tmp_path,
+                                                   devices8):
+        """A config authored for a bigger host must be startable with
+        `--num-replicas N`: the override reaches load() BEFORE the
+        device-count validation runs."""
+        from analytics_zoo_tpu.serving.config import ServingConfig
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("model:\n  path: /tmp/nope\nparams:\n"
+                       "  num_replicas: 99\n")
+        with pytest.raises(ValueError):
+            ServingConfig.load(os.fspath(cfg))
+        rescued = ServingConfig.load(os.fspath(cfg), num_replicas=2)
+        assert rescued.num_replicas == 2
+
+    def test_bare_num_replicas_key_means_auto(self, tmp_path):
+        # `num_replicas:` with no value parses to None == auto, matching
+        # InferenceModel(num_replicas=None)
+        cfg = self._load(tmp_path, "num_replicas:")
+        assert cfg.num_replicas is None
+
+    def test_non_numeric_num_replicas_is_clear_error(self, tmp_path):
+        with pytest.raises(ValueError, match="must be an integer"):
+            self._load(tmp_path, "num_replicas: lots")
+
+    def test_quoted_numeric_replicas_stays_numeric(self, tmp_path,
+                                                   devices8):
+        """YAML-quoted `num_replicas: "4"` must mean 4, not 'auto' —
+        build_model's normalization may not silently widen a validated
+        count to every device."""
+        cfg = self._load(tmp_path, 'num_replicas: "4"')
+        assert int(cfg.num_replicas) == 4
+
+
+class TestClientBackoff:
+    def test_deadline_is_monotonic_and_backoff_capped(self):
+        """No server: predict_batch must give up close to its timeout —
+        the capped-backoff sleep must never overshoot the deadline."""
+        br = MemoryBroker()
+        q = InputQueue(br)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            q.predict_batch([np.ones((4,), np.float32)], timeout_s=0.4)
+        elapsed = time.monotonic() - t0
+        assert 0.3 < elapsed < 2.0, elapsed
