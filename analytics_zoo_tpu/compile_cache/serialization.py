@@ -1,0 +1,127 @@
+"""AOT executable (de)serialization, with device retargeting.
+
+`jax.experimental.serialize_executable.serialize` returns (payload
+bytes, in_tree, out_tree); the pytrees pickle fine, so `pack` folds the
+triple into one bytes blob. Two wrinkles this module owns:
+
+- **Device retargeting** (`unpack(target_device_id=...)`): a serialized
+  single-device executable bakes in its compile-time device id — both
+  in the pickled args-info shardings and in the XLA executable's device
+  assignment. The replicated serving pool persists ONE entry per bucket
+  and loads it once per replica, so the deserializer re-pins both: the
+  pickled device persistent-ids map to the target device, and the raw
+  XLA executable reloads under `CompileOptions` carrying a fresh
+  single-device `DeviceAssignment`. Multi-device (GSPMD/sharded)
+  executables never retarget — their device set IS the key.
+- **Compile spy-ability** (`compile_lowered`): every fresh AOT compile
+  in the codebase funnels through this one function, so tests can
+  monkeypatch it and assert a cache-warm warmup performs ZERO compiles.
+
+Everything degrades: on a jax build without `serialize_executable`,
+`HAVE_AOT` is False and callers fall back to plain jit (backed by
+JAX's built-in persistent compilation cache when enabled).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Optional
+
+import jax
+
+try:
+    from jax.experimental import serialize_executable as _se
+    from jax._src.lib import xla_client as _xc
+    HAVE_AOT = True
+except Exception:  # noqa: BLE001 — optional capability, gated everywhere
+    _se = None
+    _xc = None
+    HAVE_AOT = False
+
+
+def compile_lowered(lowered):
+    """`lowered.compile()` — THE fresh-compile funnel (tests spy here)."""
+    return lowered.compile()
+
+
+def pack(compiled) -> bytes:
+    """One bytes blob from a `jax.stages.Compiled`. Raises on anything
+    unserializable (callbacks, unsupported backends) — callers treat
+    that as 'skip persisting', never as fatal."""
+    if not HAVE_AOT:
+        raise RuntimeError("jax.experimental.serialize_executable "
+                           "unavailable on this jax build")
+    payload, in_tree, out_tree = _se.serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree), protocol=4)
+
+
+class _RetargetUnpickler(_se._JaxPjrtUnpickler if HAVE_AOT else object):
+    """`_JaxPjrtUnpickler` that lands every device reference — and the
+    XLA executable's device assignment — on one target device."""
+
+    def __init__(self, file, backend, target_id: int):
+        super().__init__(file, backend)
+        self.target_id = target_id
+
+    def persistent_load(self, pid):
+        if pid[0] == "device":
+            return self.devices_by_id[self.target_id]
+        if pid[0] == "exec":
+            import numpy as np
+            opts = _xc.CompileOptions()
+            opts.device_assignment = _xc.DeviceAssignment.create(
+                np.array([[self.target_id]], np.int32))
+            return self.backend.deserialize_executable(pid[1], opts)
+        return super().persistent_load(pid)
+
+
+def args_treedef(compiled):
+    """The pytree structure a `Compiled` expects for its inputs — the
+    `((args...), {kwargs})` treedef, dict-key metadata included
+    (`Compiled` rejects calls whose trees differ even when every leaf
+    matches). Compare against `live_treedef(args)`."""
+    return compiled.in_tree
+
+
+def live_treedef(args) -> "jax.tree_util.PyTreeDef":
+    """`args_treedef`-comparable structure of a positional-args call."""
+    return jax.tree_util.tree_structure((tuple(args), {}))
+
+
+def retree_call(compiled, stored_tree):
+    """Adapter for a cache hit whose stored tree carries different
+    auto-numbered layer names than the live params ("dense_3" stored,
+    "dense_7" live): flatten the live args and rebuild them under the
+    stored `in_tree` before calling. Sound because the canonical key
+    (`structure_signature`) only matches trees whose jax flatten
+    orders correspond. Serving-side only — its OUTPUTS are
+    activations, so the stored names never leak back into a params
+    tree the caller keeps."""
+
+    def call(*args):
+        leaves = jax.tree_util.tree_leaves((tuple(args), {}))
+        new_args, new_kwargs = jax.tree_util.tree_unflatten(stored_tree,
+                                                            leaves)
+        return compiled(*new_args, **new_kwargs)
+
+    return call
+
+
+def unpack(data: bytes, target_device_id: Optional[int] = None):
+    """Rebuild a callable `jax.stages.Compiled` from `pack` output.
+    `target_device_id` re-pins a single-device executable onto that
+    device (replica fan-out); None keeps the stored assignment (the
+    single-device default path and all multi-device executables)."""
+    if not HAVE_AOT:
+        raise RuntimeError("jax.experimental.serialize_executable "
+                           "unavailable on this jax build")
+    payload, in_tree, out_tree = pickle.loads(data)
+    if target_device_id is None:
+        return _se.deserialize_and_load(payload, in_tree, out_tree)
+    backend = jax.devices()[0].client
+    unloaded, args_info_flat, no_kwargs = _RetargetUnpickler(
+        io.BytesIO(payload), backend, target_device_id).load()
+    args_info = in_tree.unflatten(args_info_flat)
+    return jax.stages.Compiled(unloaded.load(), args_info, out_tree,
+                               no_kwargs=no_kwargs)

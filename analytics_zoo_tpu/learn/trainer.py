@@ -533,7 +533,8 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               device_cache: Optional[bool] = None,
               flat_optimizer: bool = False,
               flops_per_step: Optional[float] = None,
-              metrics_report_s: Optional[float] = None
+              metrics_report_s: Optional[float] = None,
+              compile_cache_dir: Optional[str] = None
               ) -> Dict[str, List[float]]:
     """`KerasNet.fit` backend. Returns a Keras-style history dict.
     `batch_iter_factory(epoch) -> iterator of (xb, yb, real)` overrides the
@@ -564,6 +565,13 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     consistent; per-tensor checkpoints won't resume under it) and
     tree-structure-dependent transforms (e.g. `optax.masked` decay
     masks) don't survive repacking. Ignored with `lazy_embeddings`.
+    `compile_cache_dir` (or env `ZOO_COMPILE_CACHE_DIR`) enables the
+    persistent compilation cache: the jitted step/run executables are
+    AOT-serialized per input signature (`compile_cache/`), so a trainer
+    re-run in a fresh process loads its programs from disk instead of
+    re-lowering and re-compiling; JAX's built-in persistent cache
+    (`jax_compilation_cache_dir`, under `<dir>/xla`) is enabled as the
+    fallback layer for any shape AOT serialization can't carry.
     After fit, `model.params` holds DEVICE arrays (no gratuitous
     device→host pull; save/checkpoint paths transfer on demand)."""
     ctx = get_context()
@@ -701,15 +709,17 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     # closure every call.
     multi = steps_per_run > 1
     dc_steps = (_tree_len(x) // local_batch) if use_device_cache else 0
+    cc_dir = compile_cache_dir if compile_cache_dir is not None \
+        else os.environ.get("ZOO_COMPILE_CACHE_DIR") or None
     if use_device_cache:
         cache_key = (id(optimizer), id(model.loss), "devcache",
                      mixed_precision, lazy_embeddings, dc_steps,
                      local_batch, shuffle,
-                     flat_spec.uid if flat_spec else None)
+                     flat_spec.uid if flat_spec else None, cc_dir)
     else:
         cache_key = (id(optimizer), id(model.loss), multi,
                      mixed_precision, lazy_embeddings,
-                     flat_spec.uid if flat_spec else None)
+                     flat_spec.uid if flat_spec else None, cc_dir)
     cached = getattr(model, "_train_cache", None)
     if cached is not None and cached[0] == cache_key:
         train_step = cached[1]
@@ -725,6 +735,30 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             apply_and_state_fn=getattr(model, "apply_and_state", None),
             mixed_precision=mixed_precision, lazy_specs=lazy_specs,
             flat_spec=flat_spec)
+        if cc_dir:
+            # persistent compilation cache: AOT-serialize the step/run
+            # executable per input signature — a re-run in a fresh
+            # process loads its program from disk instead of
+            # re-compiling — with jax's own persistent cache as the
+            # fallback layer for shapes AOT can't carry
+            from analytics_zoo_tpu.compile_cache import (
+                AOTFunctionCache, enable_jax_persistent_cache, fingerprint,
+                get_cache)
+            enable_jax_persistent_cache(cc_dir)
+            # every program discriminator the in-memory cache_key
+            # carries must reach the DISK key too: a single-step
+            # executable and a multi-step run with coinciding arg
+            # shapes are different programs (3- vs 4-tuple outputs).
+            # steps_per_run itself stays OUT: the run program scans
+            # the leading axis, so k only lives in the arg shapes and
+            # a tail group may legitimately hit another run's entry.
+            step_fp = fingerprint(
+                [model, model.loss, optimizer.update, mixed_precision,
+                 lazy_embeddings, multi, bool(use_device_cache), dc_steps,
+                 shuffle if use_device_cache else None,
+                 flat_spec.uid if flat_spec else None])
+            train_step = AOTFunctionCache(train_step, get_cache(cc_dir),
+                                          step_fp)
         model._train_cache = (cache_key, train_step)
     x_dev = y_dev = None
     if use_device_cache:

@@ -33,7 +33,9 @@ def cmd_start(args) -> int:
     # overrides go INTO load(): validation must see the effective values,
     # or a config authored for a bigger host could never be rescued here
     cfg = ServingConfig.load(args.config, num_replicas=replicas,
-                             placement=getattr(args, "placement", None))
+                             placement=getattr(args, "placement", None),
+                             compile_cache_dir=getattr(
+                                 args, "compile_cache_dir", None))
     if cfg.model_encrypted and cfg.http_port is None:
         raise SystemExit(
             "secure.model_encrypted needs http_port: the secret/salt "
@@ -70,6 +72,17 @@ def cmd_start(args) -> int:
             model.warmup(np.zeros(tuple(shape), dtype), buckets=buckets)
         print(f"warmed {len(model.warmed_buckets)} shape buckets: "
               f"{json.dumps(model.warmup_report)}", flush=True)
+        if model.compile_cache is not None:
+            # what this restart actually paid: per-(replica, bucket)
+            # cache hits vs fresh compiles, plus the dir's state
+            src = model.warmup_source
+            s = model.compile_cache.stats()
+            print("compile cache: "
+                  f"{sum(1 for v in src.values() if v == 'cached')} "
+                  "warmed from disk, "
+                  f"{sum(1 for v in src.values() if v == 'compiled')} "
+                  f"compiled fresh ({s['entries']} entries, "
+                  f"{s['bytes']} bytes in {s['path']})", flush=True)
     tracer = None
     if cfg.trace or cfg.trace_path:
         from analytics_zoo_tpu.observability import Tracer
@@ -153,6 +166,10 @@ def main(argv=None) -> int:
     ps.add_argument("--placement", choices=["replicated", "sharded"],
                     default=None,
                     help="override params.placement")
+    ps.add_argument("--compile-cache-dir", default=None,
+                    help="override params.compile_cache_dir: persistent "
+                         "AOT executable cache directory (warm restarts "
+                         "skip XLA compilation)")
     ps.set_defaults(fn=cmd_start)
     pb = sub.add_parser("broker", help="run a standalone TCP broker")
     pb.add_argument("--host", default="0.0.0.0")

@@ -91,6 +91,13 @@ class ServingConfig:
     # compile lands on the request path
     warmup_shapes: Optional[list] = None
     warmup_dtype: str = "float32"
+    # persistent compilation cache (`compile_cache/`): warmup consults a
+    # disk-backed AOT executable cache per (replica, bucket) before
+    # compiling, so a restart warms from disk in ~ms per bucket.
+    # compile_cache_max_bytes (int, or "512M"/"2G") bounds the dir with
+    # LRU eviction.
+    compile_cache_dir: Optional[str] = None
+    compile_cache_max_bytes: Optional[int] = None
     # request-scoped tracing (`observability/tracing.py`): `trace: true`
     # attaches a span Tracer to the pipeline; trace_path additionally
     # dumps Chrome trace JSON (Perfetto-viewable) on shutdown
@@ -117,11 +124,13 @@ class ServingConfig:
 
     @classmethod
     def load(cls, path: str, num_replicas=None,
-             placement: Optional[str] = None) -> "ServingConfig":
-        """`num_replicas`/`placement` keyword overrides (the CLI flags)
-        replace the file's values BEFORE validation, so an override can
-        rescue a config authored for a bigger host (e.g. an 8-chip
-        config started on a 2-device box with `--num-replicas 2`)."""
+             placement: Optional[str] = None,
+             compile_cache_dir: Optional[str] = None) -> "ServingConfig":
+        """`num_replicas`/`placement`/`compile_cache_dir` keyword
+        overrides (the CLI flags) replace the file's values BEFORE
+        validation, so an override can rescue a config authored for a
+        bigger host (e.g. an 8-chip config started on a 2-device box
+        with `--num-replicas 2`)."""
         raw = _load_yaml(path)
         model = raw.get("model", {}) or {}
         params = raw.get("params", {}) or {}
@@ -146,6 +155,11 @@ class ServingConfig:
         # string or a replica count the host cannot satisfy is a config
         # error, and config errors belong at load time
         cfg._validate_placement()
+        cfg.compile_cache_dir = compile_cache_dir if compile_cache_dir \
+            is not None else params.get("compile_cache_dir")
+        cfg.compile_cache_max_bytes = _parse_bytes(
+            params.get("compile_cache_max_bytes"))
+        cfg._validate_compile_cache()
         cfg.pipelined = bool(params.get("pipelined", True))
         cfg.decode_workers = int(params.get("decode_workers", 2))
         cfg.queue_depth = int(params.get("queue_depth", 8))
@@ -208,6 +222,43 @@ class ServingConfig:
                 f"params.num_replicas={n} exceeds the {avail} available "
                 f"local device(s); lower it or use 'auto'")
 
+    def _validate_compile_cache(self):
+        """Cache-setting errors belong at config load, like placement:
+        a bad path or a non-positive byte budget must fail the start
+        command, not surface mid-warmup."""
+        d = self.compile_cache_dir
+        if d is not None:
+            if not isinstance(d, str) or not d.strip():
+                raise ValueError(
+                    f"params.compile_cache_dir={d!r} must be a non-empty "
+                    "path string")
+            expanded = os.path.abspath(os.path.expanduser(d))
+            if os.path.exists(expanded) and not os.path.isdir(expanded):
+                raise ValueError(
+                    f"params.compile_cache_dir={d!r} exists and is not a "
+                    "directory")
+        mb = self.compile_cache_max_bytes
+        if mb is not None:
+            if not isinstance(mb, int) or mb <= 0:
+                raise ValueError(
+                    f"params.compile_cache_max_bytes={mb!r} must be a "
+                    'positive byte count (int, or "512M"/"2G")')
+            if d is None:
+                raise ValueError(
+                    "params.compile_cache_max_bytes is set but "
+                    "params.compile_cache_dir is not; the budget bounds "
+                    "the cache directory")
+
+    def build_compile_cache(self, registry=None):
+        """The `CompileCache` this config names (None when caching is
+        off); `build_model` wires it into the InferenceModel."""
+        if not self.compile_cache_dir:
+            return None
+        from analytics_zoo_tpu.compile_cache import CompileCache
+        return CompileCache(self.compile_cache_dir,
+                            max_bytes=self.compile_cache_max_bytes,
+                            registry=registry)
+
     def build_model(self, broker=None):
         """Model resolution (`ClusterServingHelper` model-type dispatch):
         a ZooModel dir (config.json names the class), or bare weights plus
@@ -229,7 +280,8 @@ class ServingConfig:
         if n in (0, -1):
             n = "auto"
         im = InferenceModel(concurrent_num=self.concurrent_num,
-                            num_replicas=n, placement=self.placement)
+                            num_replicas=n, placement=self.placement,
+                            compile_cache=self.build_compile_cache())
         secret = salt = None
         if self.model_encrypted:
             if broker is None:
@@ -278,6 +330,31 @@ class ServingConfig:
         raise ValueError(
             f"{self.model_path} is not a saved ZooModel directory "
             "(no config.json) and no model.class was given")
+
+
+def _parse_bytes(raw) -> Optional[int]:
+    """Byte counts from YAML: a plain int, or a "512K"/"128M"/"2G"
+    string. Returns None for None; bad spellings raise at load time."""
+    if raw is None:
+        return None
+    if isinstance(raw, bool):
+        raise ValueError(f"byte count {raw!r} must be a number, "
+                         'or a "512M"-style string')
+    if isinstance(raw, int):
+        return raw
+    if isinstance(raw, float) and raw.is_integer():
+        return int(raw)
+    if isinstance(raw, str):
+        s = raw.strip().upper()
+        mult = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30}.get(s[-1:])
+        try:
+            if mult is not None:
+                return int(float(s[:-1]) * mult)
+            return int(s)
+        except ValueError:
+            pass
+    raise ValueError(f"cannot parse byte count {raw!r} "
+                     '(use an int, or "512K"/"128M"/"2G")')
 
 
 def _parse_warmup_shapes(raw) -> Optional[list]:

@@ -263,14 +263,22 @@ class InferenceModel:
                  placement: str = "replicated",
                  devices: Optional[List] = None,
                  mesh=None,
-                 max_inflight_per_replica: int = 2):
+                 max_inflight_per_replica: int = 2,
+                 compile_cache=None):
         """`num_replicas`: model copies, one per device. 1 (default) keeps
         the original single-device path untouched; ``"auto"``/``-1``/``0``/
         ``None`` takes every local device. `placement="sharded"` instead
         spreads ONE copy across all devices (`mesh`, or a data+fsdp
         DeviceMesh over `devices`) for models too large for a chip.
         `max_inflight_per_replica` bounds routed-but-unmaterialized
-        batches per replica — the router's backpressure."""
+        batches per replica — the router's backpressure.
+
+        `compile_cache`: a `compile_cache.CompileCache` — warmup then
+        consults the persistent executable cache per (replica, bucket)
+        before compiling (hit → deserialize in ~ms; miss → compile once,
+        persist, and every later process start hits). Replicated
+        placement persists ONE entry per bucket and retarget-loads it
+        onto each replica's device."""
         self.concurrent_num = concurrent_num
         self.auto_scaling = auto_scaling
         self._sema = threading.BoundedSemaphore(concurrent_num) \
@@ -314,7 +322,14 @@ class InferenceModel:
         self._jit: Optional[Callable] = None
         self.timer = Timer("predict")
         self.warmup_report: Dict[str, float] = {}
+        self.warmup_source: Dict[str, str] = {}
         self.warmed_buckets: set = set()
+        self.compile_cache = compile_cache
+        # AOT executable table, (replica index, input signature) ->
+        # jax.stages.Compiled — populated only by cache-backed warmup;
+        # empty ⇒ every predict path is byte-for-byte the legacy jit
+        self._aot: Dict[tuple, Any] = {}
+        self._model_fp: Optional[str] = None
 
     # -- loaders (`doLoad*`, InferenceModel.scala:76-318) ------------------
     def load_keras(self, model, params=None,
@@ -369,6 +384,13 @@ class InferenceModel:
         # per committed device/sharding, so each (replica, bucket) pair
         # gets its own cached executable with no bookkeeping here
         self._jit = jax.jit(fn)
+        self._aot = {}
+        self._model_fp = None
+        if self.compile_cache is not None:
+            from analytics_zoo_tpu.compile_cache import model_fingerprint
+            # fingerprint BEFORE any device placement: the key must be
+            # identical across processes, and device_put order is not
+            self._model_fp = model_fingerprint(fn, params)
         if self.placement == "sharded":
             if self.mesh is None:
                 from analytics_zoo_tpu.common.config import MeshConfig
@@ -412,8 +434,67 @@ class InferenceModel:
             # transfers)
             self._params = jax.device_put(params)
         self.warmup_report = {}
+        self.warmup_source = {}
         self.warmed_buckets = set()
         return self
+
+    # -- persistent compile cache (compile_cache/) -------------------------
+    @staticmethod
+    def _exec_sig(x) -> tuple:
+        """In-process executable-table key: tree structure + per-leaf
+        shape/dtype of the (bucket-padded) batch."""
+        from analytics_zoo_tpu.compile_cache import abstract_signature
+        return abstract_signature(x)
+
+    def _cache_key(self, sig):
+        from analytics_zoo_tpu.compile_cache import make_key
+        sharding = ""
+        if self.placement == "sharded" and self.mesh is not None:
+            sharding = repr(sorted(self.mesh.axis_sizes.items())) + \
+                f"/dev{sorted(d.id for d in self.devices)}"
+        return make_key("serving", self._model_fp or "", sig,
+                        placement=self.placement, sharding=sharding)
+
+    def _aot_call(self, replica_idx: int, params, x):
+        """One forward through the AOT table when it has an executable
+        for this (replica, signature), else through the jit wrapper —
+        the ONLY dispatch point shared by all three placement paths."""
+        if self._aot:
+            ex = self._aot.get((replica_idx, self._exec_sig(x)))
+            if ex is not None:
+                return ex(params, x)
+        return self._jit(params, x)
+
+    def _warm_executable(self, replica_idx: int, params, batch,
+                         target_device_id=None) -> str:
+        """Cache-backed warmup for one (replica, bucket): consult the
+        persistent cache before compiling; returns how the executable
+        was obtained ("warm" | "cached" | "compiled")."""
+        from analytics_zoo_tpu.compile_cache import serialization
+        sig = self._exec_sig(batch)
+        if (replica_idx, sig) in self._aot:
+            return "warm"
+        key = self._cache_key(sig)
+        ex = self.compile_cache.load(key, target_device_id=target_device_id)
+        if ex is not None:
+            stored = serialization.args_treedef(ex)
+            live = serialization.live_treedef((params, batch))
+            if stored != live:
+                # same canonical structure, different auto-numbered
+                # layer names (a naming-counter offset between the
+                # persisting process and this one): adapt the call
+                # rather than rejecting the hit
+                ex = serialization.retree_call(ex, stored)
+            self._aot[(replica_idx, sig)] = ex
+            return "cached"
+        t0 = time.perf_counter()
+        # module-attribute call: serialization.compile_lowered is THE
+        # fresh-compile funnel tests monkeypatch to assert zero compiles
+        ex = serialization.compile_lowered(self._jit.lower(params, batch))
+        self.compile_cache.put(
+            key, ex, compile_ms=(time.perf_counter() - t0) * 1e3)
+        self._aot[(replica_idx, sig)] = ex
+        return "compiled"
 
     def _replica_loop(self, rep: _Replica):
         """Per-replica dispatcher: XLA:CPU executes in the calling thread,
@@ -428,7 +509,18 @@ class InferenceModel:
             x, pending, t0 = job
             t_start = time.perf_counter() if t0 is None else t0
             try:
-                out = self._jit(rep.params, x)
+                if self._aot:
+                    ex = self._aot.get((rep.index, self._exec_sig(x)))
+                    if ex is not None:
+                        # AOT executables are strict about committed
+                        # placement: land the batch on this replica's
+                        # device first (a no-op when already there)
+                        x = jax.device_put(x, rep.device)
+                        out = ex(rep.params, x)
+                    else:
+                        out = self._jit(rep.params, x)
+                else:
+                    out = self._jit(rep.params, x)
                 pending._fulfill(out, time.perf_counter() - t_start)
             except Exception as e:  # noqa: BLE001 — surfaces in result()
                 pending._fail(e)
@@ -631,7 +723,7 @@ class InferenceModel:
                 # single-device path — fail clearly, not jit(None, x)
                 raise RuntimeError(
                     "model closed mid-predict; reload before predicting")
-            out = self._jit(self._params, x)
+            out = self._aot_call(0, self._params, x)
         finally:
             # the permit bounds dispatch admission, not result lifetime:
             # async callers bound in-flight results with their own queue
@@ -667,8 +759,9 @@ class InferenceModel:
         sample = jax.tree_util.tree_map(np.asarray, sample)
         tag = "x".join(map(str, jax.tree_util.tree_leaves(sample)[0].shape)
                        ) or "scalar"
+        use_cache = self._use_compile_cache()
         if self._replicas is not None:
-            return self._warmup_replicas(sample, buckets, tag)
+            return self._warmup_replicas(sample, buckets, tag, use_cache)
         for b in buckets:
             batch = jax.tree_util.tree_map(
                 lambda a: np.ascontiguousarray(
@@ -676,20 +769,74 @@ class InferenceModel:
             if self._batch_sharding is not None:
                 batch = jax.device_put(batch, self._batch_sharding)
             t0 = time.perf_counter()
-            # straight through the jit (not predict): warmup must not
-            # pollute the serving timer percentiles
-            jax.block_until_ready(self._jit(self._params, batch))
-            self.warmup_report[f"{tag}:b{b}"] = round(
-                time.perf_counter() - t0, 4)
+            if use_cache:
+                # persistent cache first: a hit deserializes in ~ms
+                # where a miss compiles once and persists for the next
+                # process. Sharded executables keep their stored device
+                # assignment (the mesh is part of the key); the single-
+                # device executable re-pins onto this model's device.
+                src = self._warm_executable(
+                    0, self._params, batch,
+                    target_device_id=None if self._batch_sharding
+                    is not None else self.devices[0].id)
+                jax.block_until_ready(
+                    self._aot[(0, self._exec_sig(batch))](
+                        self._params, batch))
+            else:
+                src = "jit"
+                # straight through the jit (not predict): warmup must
+                # not pollute the serving timer percentiles
+                jax.block_until_ready(self._jit(self._params, batch))
+            rkey = f"{tag}:b{b}"
+            self.warmup_report[rkey] = round(time.perf_counter() - t0, 4)
+            self.warmup_source[rkey] = src
             self.warmed_buckets.add(b)
         return self
 
-    def _warmup_replicas(self, sample, buckets, tag) -> "InferenceModel":
+    def _use_compile_cache(self) -> bool:
+        if self.compile_cache is None:
+            return False
+        from analytics_zoo_tpu.compile_cache import HAVE_AOT
+        return HAVE_AOT
+
+    def _warmup_replicas(self, sample, buckets, tag,
+                         use_cache: bool = False) -> "InferenceModel":
         """Fan warmup out across the pool: every replica's worker thread
         compiles its own (replica, bucket) executables concurrently —
         N chips warm in roughly the time one takes. Jobs bypass the
         router (no in-flight accounting: nothing else runs at load) and
-        carry no timer, so percentiles stay unpolluted."""
+        carry no timer, so percentiles stay unpolluted.
+
+        With a compile cache, each bucket is ONE cache entry: a hit
+        deserializes N times (re-pinned per replica device); a miss
+        compiles per replica in parallel as before, then persists a
+        single entry — "persist once, load N times"."""
+        if use_cache:
+            for b in buckets:
+                batch = jax.tree_util.tree_map(
+                    lambda a, _b=b: np.ascontiguousarray(
+                        np.broadcast_to(a[None], (_b,) + a.shape)), sample)
+                sig = self._exec_sig(batch)
+                # replica 0 probes the cache; on a miss it compiles and
+                # persists the bucket's ONE entry — which every later
+                # replica then LOADS (retargeted onto its own device,
+                # ~ms each) instead of re-compiling. Cold wall time ≈
+                # one compile + (N-1) deserializes; warm ≈ N
+                # deserializes. warmup_source shows exactly what this
+                # restart paid per replica.
+                for rep in self._replicas:
+                    t0 = time.perf_counter()
+                    src = self._warm_executable(
+                        rep.index, rep.params, batch,
+                        target_device_id=rep.device.id)
+                    jax.block_until_ready(
+                        self._aot[(rep.index, sig)](rep.params, batch))
+                    rkey = f"r{rep.index}:{tag}:b{b}"
+                    self.warmup_report[rkey] = round(
+                        time.perf_counter() - t0, 4)
+                    self.warmup_source[rkey] = src
+                self.warmed_buckets.add(b)
+            return self
         jobs = []
         for b in buckets:
             batch = jax.tree_util.tree_map(
@@ -703,15 +850,22 @@ class InferenceModel:
                 jobs.append((rep.index, b, pending))
         for idx, b, pending in jobs:
             pending.result()
-            self.warmup_report[f"r{idx}:{tag}:b{b}"] = round(
-                pending._dispatch_s, 4)
+            rkey = f"r{idx}:{tag}:b{b}"
+            self.warmup_report[rkey] = round(pending._dispatch_s, 4)
+            self.warmup_source[rkey] = "jit"
             self.warmed_buckets.add(b)
         return self
 
     def compile_cache_size(self) -> int:
-        """Number of cached executables (one per warmed shape bucket);
-        -1 when the running jax version doesn't expose the counter."""
+        """Number of in-process executables this model holds: AOT
+        executables installed by cache-backed warmup PLUS the jit
+        wrapper's own cache — which keys per (shape, committed device),
+        so replicated placement counts its per-(replica, bucket)
+        executables rather than reporting -1. -1 only when no counter
+        is available at all (no model loaded on an old jax)."""
+        n_aot = len(self._aot)
         try:
-            return self._jit._cache_size()
+            n_jit = int(self._jit._cache_size())
         except Exception:  # noqa: BLE001 — diagnostics only
-            return -1
+            return n_aot if n_aot else -1
+        return n_aot + n_jit

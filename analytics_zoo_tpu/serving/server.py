@@ -671,4 +671,18 @@ class ClusterServing:
                                           "replicated") == "sharded":
             m["placement"] = self.model.placement_info()
             m["replicas"] = self.model.replica_stats()
+        size_fn = getattr(self.model, "compile_cache_size", None)
+        if size_fn is not None:
+            # per-(replica, bucket) executable count, plus persistent-
+            # cache traffic when the model is cache-backed
+            cc_info = {"executables": size_fn()}
+            cache = getattr(self.model, "compile_cache", None)
+            if cache is not None:
+                s = cache.stats()
+                cc_info.update(hits=s["hits"], misses=s["misses"],
+                               bytes=s["bytes"], entries=s["entries"])
+            src = getattr(self.model, "warmup_source", None)
+            if src:
+                cc_info["warmup_source"] = dict(src)
+            m["compile_cache"] = cc_info
         return m

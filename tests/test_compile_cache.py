@@ -1,0 +1,544 @@
+"""Persistent compilation cache (ISSUE 4): AOT executable round trips
+through the disk store, key invalidation on dtype/bucket/placement
+change, corruption tolerance (degrade to recompile, never raise), LRU
+eviction under a byte budget, registry telemetry, the replicated
+persist-once/load-N path, the `compile_cache_size` fix, config
+validation, the maintenance tool, and the trainer's AOT re-run path.
+
+All on tmp_path + the conftest 8-device CPU mesh; tier-1 fast."""
+
+import os
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.compile_cache.serialization as ccser
+from analytics_zoo_tpu.compile_cache import (CompileCache, abstract_signature,
+                                             make_key)
+from analytics_zoo_tpu.keras import Sequential
+from analytics_zoo_tpu.keras import layers as L
+from analytics_zoo_tpu.observability.registry import MetricsRegistry
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+pytestmark = pytest.mark.skipif(
+    not ccser.HAVE_AOT,
+    reason="jax build lacks serialize_executable")
+
+
+def make_model(in_dim=4, out_dim=3):
+    m = Sequential([L.Dense(out_dim, input_shape=(in_dim,))])
+    m.ensure_built(np.zeros((1, in_dim), np.float32))
+    return m
+
+
+@pytest.fixture()
+def compile_spy(monkeypatch):
+    """Counts every fresh AOT compile; the zero-compile assertions."""
+    calls = []
+    orig = ccser.compile_lowered
+
+    def spy(lowered):
+        calls.append(1)
+        return orig(lowered)
+
+    monkeypatch.setattr(ccser, "compile_lowered", spy)
+    return calls
+
+
+class TestRoundTrip:
+    def test_warm_model_zero_compiles_bitwise_equal(self, tmp_path,
+                                                    compile_spy):
+        model = make_model()
+        reg1, reg2 = MetricsRegistry(), MetricsRegistry()
+        buckets = [1, 2, 4, 8]
+        im1 = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path), registry=reg1)
+        ).load_keras(model)
+        im1.warmup(np.zeros((4,), np.float32), buckets=buckets)
+        assert set(im1.warmup_source.values()) == {"compiled"}
+        assert len(compile_spy) == len(buckets)
+        assert reg1.get("compile_cache_misses_total").value() == len(buckets)
+
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        p1 = im1.predict(x)
+
+        # "restart": fresh model object, fresh cache handle, same dir
+        compile_spy.clear()
+        im2 = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path), registry=reg2)
+        ).load_keras(model)
+        im2.warmup(np.zeros((4,), np.float32), buckets=buckets)
+        assert len(compile_spy) == 0, "cache-warm warmup must not compile"
+        assert set(im2.warmup_source.values()) == {"cached"}
+        assert reg2.get("compile_cache_hits_total").value() == len(buckets)
+        assert reg2.get("compile_cache_misses_total").value() == 0
+        p2 = im2.predict(x)
+        assert np.array_equal(p1, p2), \
+            "deserialized executable must be bitwise-identical"
+
+    def test_unwarmed_bucket_still_serves(self, tmp_path):
+        model = make_model()
+        im = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path),
+                                       registry=MetricsRegistry())
+        ).load_keras(model)
+        im.warmup(np.zeros((4,), np.float32), buckets=[4])
+        # a bucket warmup never touched falls back to the jit path
+        out = im.predict(np.ones((16, 4), np.float32))
+        assert out.shape == (16, 3)
+
+    def test_warmup_report_and_source_keys_align(self, tmp_path):
+        model = make_model()
+        im = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path),
+                                       registry=MetricsRegistry())
+        ).load_keras(model)
+        im.warmup(np.zeros((4,), np.float32), buckets=[1, 2])
+        assert set(im.warmup_report) == set(im.warmup_source) \
+            == {"4:b1", "4:b2"}
+        # without a cache the source map still exists, marked jit
+        im2 = InferenceModel().load_keras(model)
+        im2.warmup(np.zeros((4,), np.float32), buckets=[1, 2])
+        assert set(im2.warmup_source.values()) == {"jit"}
+
+
+class TestKeyInvalidation:
+    def _warm(self, tmp_path, reg, dtype=np.float32, buckets=(4,),
+              **model_kw):
+        im = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path), registry=reg),
+            **model_kw).load_fn(lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), dtype), buckets=list(buckets))
+        return im
+
+    def test_dtype_change_misses(self, tmp_path):
+        reg = MetricsRegistry()
+        self._warm(tmp_path, reg, dtype=np.float32)
+        assert reg.get("compile_cache_misses_total").value() == 1
+        self._warm(tmp_path, reg, dtype=np.int32)
+        # int32 input is a different program: miss, not a wrong hit
+        assert reg.get("compile_cache_misses_total").value() == 2
+        self._warm(tmp_path, reg, dtype=np.float32)
+        assert reg.get("compile_cache_hits_total").value() == 1
+
+    def test_bucket_is_its_own_entry(self, tmp_path):
+        reg = MetricsRegistry()
+        im = self._warm(tmp_path, reg, buckets=(2, 4))
+        assert im.compile_cache.stats()["entries"] == 2
+        # warming only a NEW bucket misses even with the others cached
+        self._warm(tmp_path, reg, buckets=(8,))
+        assert reg.get("compile_cache_misses_total").value() == 3
+
+    def test_placement_change_misses(self, tmp_path, devices8):
+        reg = MetricsRegistry()
+        self._warm(tmp_path, reg, buckets=(8,))
+        misses0 = reg.get("compile_cache_misses_total").value()
+        im = self._warm(tmp_path, reg, buckets=(8,), placement="sharded")
+        assert im.placement == "sharded"
+        # a GSPMD executable for the mesh never hits a single-device key
+        assert reg.get("compile_cache_misses_total").value() == misses0 + 1
+
+    def test_model_change_misses(self, tmp_path):
+        reg = MetricsRegistry()
+        cc = CompileCache(str(tmp_path), registry=reg)
+        im1 = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im1.warmup(np.zeros((3,), np.float32), buckets=[4])
+        im2 = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x + p, np.float32(2.0))
+        im2.warmup(np.zeros((3,), np.float32), buckets=[4])
+        assert reg.get("compile_cache_hits_total").value() == 0
+        assert reg.get("compile_cache_misses_total").value() == 2
+
+
+class TestCorruption:
+    def _one_entry(self, tmp_path, reg):
+        im = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path), registry=reg)
+        ).load_fn(lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[4])
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".aotc")]
+        assert len(files) == 1
+        return os.path.join(str(tmp_path), files[0])
+
+    def test_truncated_entry_degrades_to_recompile(self, tmp_path,
+                                                   compile_spy):
+        reg = MetricsRegistry()
+        path = self._one_entry(tmp_path, reg)
+        with open(path, "r+b") as fh:
+            fh.truncate(os.path.getsize(path) // 2)
+        compile_spy.clear()
+        im = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path), registry=reg)
+        ).load_fn(lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[4])   # no raise
+        assert im.warmup_source == {"3:b4": "compiled"}
+        assert len(compile_spy) == 1
+        out = im.predict(np.ones((4, 3), np.float32))
+        np.testing.assert_array_equal(out, np.full((4, 3), 2.0))
+
+    def test_garbage_bytes_degrade(self, tmp_path):
+        reg = MetricsRegistry()
+        path = self._one_entry(tmp_path, reg)
+        with open(path, "wb") as fh:
+            fh.write(b"\x00garbage" * 100)
+        cc = CompileCache(str(tmp_path), registry=reg)
+        key = make_key("serving", "whatever",
+                       abstract_signature((np.zeros((4, 3), np.float32),)))
+        assert cc.load(key) is None                  # never an exception
+        # the corrupt file the digest DOES name also degrades silently
+        im = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[4])
+        assert im.warmup_source["3:b4"] == "compiled"
+
+    def test_format_version_mismatch_degrades(self, tmp_path):
+        import struct
+        reg = MetricsRegistry()
+        path = self._one_entry(tmp_path, reg)
+        blob = bytearray(open(path, "rb").read())
+        struct.pack_into("<I", blob, 4, 99)      # a future format version
+        open(path, "wb").write(bytes(blob))
+        im = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path), registry=reg)
+        ).load_fn(lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[4])   # no raise
+        assert im.warmup_source["3:b4"] == "compiled"
+
+    def test_flipped_payload_bit_fails_crc(self, tmp_path):
+        reg = MetricsRegistry()
+        path = self._one_entry(tmp_path, reg)
+        blob = bytearray(open(path, "rb").read())
+        blob[-1] ^= 0xFF                         # flip one payload bit
+        open(path, "wb").write(bytes(blob))
+        cc = CompileCache(str(tmp_path), registry=reg)
+        im = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[4])
+        assert im.warmup_source["3:b4"] == "compiled"
+        assert reg.get("compile_cache_misses_total").value() >= 2
+
+
+class TestEviction:
+    def test_lru_eviction_under_tiny_budget(self, tmp_path):
+        reg = MetricsRegistry()
+        # learn one entry's size, then budget for ~2
+        probe = CompileCache(str(tmp_path / "probe"), registry=reg)
+        im = InferenceModel(compile_cache=probe).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[1])
+        entry_bytes = probe.stats()["bytes"]
+        assert entry_bytes > 0
+
+        cc = CompileCache(str(tmp_path / "lru"),
+                          max_bytes=int(entry_bytes * 2.5), registry=reg)
+        im = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[1, 2, 4, 8])
+        st = cc.stats()
+        assert st["bytes"] <= int(entry_bytes * 2.5)
+        assert 1 <= st["entries"] <= 2
+        # the SURVIVORS are the most recently written (LRU evicts oldest)
+        digests = {e["digest"] for e in cc.index()}
+        sig8 = abstract_signature(np.zeros((8, 3), np.float32))
+        assert im._cache_key(sig8).digest in digests
+
+    def test_prune_and_clear(self, tmp_path):
+        reg = MetricsRegistry()
+        cc = CompileCache(str(tmp_path), registry=reg)
+        im = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[1, 2, 4])
+        assert cc.stats()["entries"] == 3
+        cc.prune(max_bytes=cc.stats()["bytes"] - 1)
+        assert cc.stats()["entries"] == 2
+        cc.clear()
+        assert cc.stats()["entries"] == 0
+        assert reg.get("compile_cache_bytes").value() == 0
+
+
+class TestReplicated:
+    def test_persist_once_load_n(self, tmp_path, devices8, compile_spy):
+        model = make_model()
+        reg = MetricsRegistry()
+        cc = CompileCache(str(tmp_path), registry=reg)
+        im = InferenceModel(num_replicas=2, compile_cache=cc
+                            ).load_keras(model)
+        im.warmup(np.zeros((4,), np.float32), buckets=[4])
+        # ONE disk entry; replica 0 compiled it, replica 1 loaded it
+        assert cc.stats()["entries"] == 1
+        assert im.warmup_source == {"r0:4:b4": "compiled",
+                                    "r1:4:b4": "cached"}
+        assert len(compile_spy) == 1
+        x = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+        p_pool = im.predict(x)
+        im.close()
+
+        # fresh pool restart: every (replica, bucket) loads, zero compiles
+        compile_spy.clear()
+        reg2 = MetricsRegistry()
+        im2 = InferenceModel(
+            num_replicas=2,
+            compile_cache=CompileCache(str(tmp_path), registry=reg2)
+        ).load_keras(model)
+        im2.warmup(np.zeros((4,), np.float32), buckets=[4])
+        assert len(compile_spy) == 0
+        assert set(im2.warmup_source.values()) == {"cached"}
+        assert reg2.get("compile_cache_hits_total").value() == 2
+        # both replicas produce the persisted program's exact output
+        for _ in range(4):       # router alternates replicas
+            assert np.array_equal(im2.predict(x), p_pool)
+        im2.close()
+
+    def test_compile_cache_size_counts_per_replica(self, devices8):
+        """Satellite: replicated placement reports per-(replica, bucket)
+        executables instead of -1."""
+        model = make_model()
+        im = InferenceModel(num_replicas=2).load_keras(model)
+        im.warmup(np.zeros((4,), np.float32), buckets=[1, 2])
+        n = im.compile_cache_size()
+        assert n == 4, f"2 replicas x 2 buckets must count 4, got {n}"
+        im.close()
+
+    def test_metrics_surfaces_executable_count(self, tmp_path):
+        from analytics_zoo_tpu.serving.broker import MemoryBroker
+        from analytics_zoo_tpu.serving.server import ClusterServing
+        model = make_model()
+        im = InferenceModel(
+            compile_cache=CompileCache(str(tmp_path),
+                                       registry=MetricsRegistry())
+        ).load_keras(model)
+        im.warmup(np.zeros((4,), np.float32), buckets=[1, 2, 4])
+        serving = ClusterServing(im, broker=MemoryBroker(),
+                                 registry=MetricsRegistry())
+        m = serving.metrics()
+        assert m["compile_cache"]["executables"] == 3
+        assert m["compile_cache"]["entries"] == 3
+        assert m["compile_cache"]["misses"] == 3
+        assert m["compile_cache"]["warmup_source"]["4:b1"] == "compiled"
+
+
+class TestRegistryTelemetry:
+    def test_all_five_families_populate(self, tmp_path):
+        reg = MetricsRegistry()
+        cc = CompileCache(str(tmp_path), registry=reg)
+        im = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[4])      # miss
+        im2 = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im2.warmup(np.zeros((3,), np.float32), buckets=[4])     # hit
+        snap = reg.snapshot()
+        assert snap["compile_cache_hits_total"]["series"][0]["value"] == 1
+        assert snap["compile_cache_misses_total"]["series"][0]["value"] == 1
+        assert snap["compile_cache_load_ms"]["series"][0]["count"] == 1
+        assert snap["compile_cache_compile_ms"]["series"][0]["count"] == 1
+        assert snap["compile_cache_bytes"]["series"][0]["value"] \
+            == cc.stats()["bytes"] > 0
+
+
+class TestConfigValidation:
+    def _load(self, tmp_path, params_lines):
+        from analytics_zoo_tpu.serving.config import ServingConfig
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("model:\n  path: /tmp/nope\nparams:\n"
+                       + "".join(f"  {ln}\n" for ln in params_lines))
+        return ServingConfig.load(str(cfg))
+
+    def test_cache_dir_parses_with_budget(self, tmp_path):
+        cfg = self._load(tmp_path, ["compile_cache_dir: /tmp/zoo-cc",
+                                    "compile_cache_max_bytes: 512M"])
+        assert cfg.compile_cache_dir == "/tmp/zoo-cc"
+        assert cfg.compile_cache_max_bytes == 512 << 20
+
+    def test_bad_path_rejected(self, tmp_path):
+        not_a_dir = tmp_path / "somefile"
+        not_a_dir.write_text("x")
+        with pytest.raises(ValueError, match="not a directory"):
+            self._load(tmp_path, [f"compile_cache_dir: {not_a_dir}"])
+
+    def test_non_positive_budget_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="positive"):
+            self._load(tmp_path, ["compile_cache_dir: /tmp/zoo-cc",
+                                  "compile_cache_max_bytes: 0"])
+        with pytest.raises(ValueError, match="positive"):
+            self._load(tmp_path, ["compile_cache_dir: /tmp/zoo-cc",
+                                  "compile_cache_max_bytes: -5"])
+
+    def test_budget_without_dir_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="compile_cache_dir"):
+            self._load(tmp_path, ["compile_cache_max_bytes: 1024"])
+
+    def test_build_model_wires_cache_from_config(self, tmp_path):
+        """YAML → ServingConfig → build_model: the InferenceModel comes
+        back cache-backed and a rebuilt "process" warms from disk. The
+        layer-naming scope is reset per build to simulate the fresh
+        processes a real restart gets (mid-scope counter offsets that
+        flip lexicographic key order are a designed safe-miss)."""
+        from analytics_zoo_tpu.keras.engine import reset_name_scope
+        from analytics_zoo_tpu.models.textclassification import \
+            TextClassifier
+        from analytics_zoo_tpu.serving.config import ServingConfig
+        reset_name_scope()
+        m = TextClassifier(class_num=2, vocab_size=30, embedding_dim=8,
+                           sequence_length=6)
+        m.model.ensure_built(np.zeros((1, 6), np.int32))
+        m.save_model(str(tmp_path / "tc"))
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text(
+            f"model:\n  path: {tmp_path / 'tc'}\n"
+            f"params:\n  compile_cache_dir: {tmp_path / 'cc'}\n"
+            "  compile_cache_max_bytes: 64M\n")
+        x = np.arange(3 * 6).reshape(3, 6).astype(np.int32) % 30
+        outs = []
+        for expect in ("compiled", "cached"):
+            reset_name_scope()               # fresh-process naming
+            im = ServingConfig.load(str(cfg_file)).build_model()
+            assert im.compile_cache is not None
+            assert im.compile_cache.max_bytes == 64 << 20
+            im.warmup(np.zeros((6,), np.int32), buckets=[4])
+            assert im.warmup_source["6:b4"] == expect
+            outs.append(im.predict(x))
+        assert np.array_equal(outs[0], outs[1])
+
+    def test_counter_offset_hits_with_retree_adapter(self, tmp_path):
+        """A mid-scope rebuild shifts every auto layer name
+        ("dense_1" → "dense_2"); the canonical key still hits and the
+        retree adapter maps the live params onto the stored tree —
+        identical predictions, no recompile. (An offset that flips the
+        sorted key order misses safely instead; small counters here
+        cannot flip.)"""
+        import jax
+        from analytics_zoo_tpu.keras.engine import reset_name_scope
+        reset_name_scope()
+        reg = MetricsRegistry()
+        cc = CompileCache(str(tmp_path), registry=reg)
+        x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+        m1 = make_model()
+        im1 = InferenceModel(compile_cache=cc).load_keras(m1)
+        im1.warmup(np.zeros((4,), np.float32), buckets=[4])
+        assert im1.warmup_source["4:b4"] == "compiled"
+        p1 = im1.predict(x)
+
+        m2 = make_model()                    # names shifted, same arch
+        assert list(m2.params) != list(m1.params), \
+            "test premise: auto names must differ"
+        # same weights, positionally (keys differ by the name shift)
+        m2.params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(m2.params),
+            jax.tree_util.tree_leaves(m1.params))
+        im2 = InferenceModel(compile_cache=cc).load_keras(m2)
+        im2.warmup(np.zeros((4,), np.float32), buckets=[4])
+        assert im2.warmup_source["4:b4"] == "cached"
+        assert np.array_equal(im2.predict(x), p1)
+
+    def test_cache_constructor_validates_too(self, tmp_path):
+        with pytest.raises(ValueError):
+            CompileCache(str(tmp_path), max_bytes=0,
+                         registry=MetricsRegistry())
+        f = tmp_path / "plainfile"
+        f.write_text("x")
+        with pytest.raises(ValueError):
+            CompileCache(str(f), registry=MetricsRegistry())
+
+
+class TestTool:
+    def _populate(self, tmp_path):
+        cc = CompileCache(str(tmp_path), registry=MetricsRegistry())
+        im = InferenceModel(compile_cache=cc).load_fn(
+            lambda p, x: x * p, np.float32(2.0))
+        im.warmup(np.zeros((3,), np.float32), buckets=[1, 2, 4])
+        return cc
+
+    def test_ls_stats_prune_clear(self, tmp_path, capsys):
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts"))
+        import compile_cache_tool as tool
+        cc = self._populate(tmp_path)
+        nbytes = cc.stats()["bytes"]
+
+        assert tool.main(["ls", "--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "3 entries" in out and "serving" in out
+
+        assert tool.main(["stats", "--dir", str(tmp_path)]) == 0
+        import json
+        st = json.loads(capsys.readouterr().out)
+        assert st["entries"] == 3 and st["bytes"] == nbytes
+        assert st["by_kind"]["serving"]["entries"] == 3
+
+        assert tool.main(["prune", "--dir", str(tmp_path),
+                          "--max-bytes", str(nbytes - 1)]) == 0
+        capsys.readouterr()
+        assert cc.total_bytes() < nbytes
+
+        assert tool.main(["clear", "--dir", str(tmp_path)]) == 0
+        capsys.readouterr()
+        assert cc.total_bytes() == 0
+
+
+@pytest.fixture()
+def jax_cache_config():
+    """`fit_keras(compile_cache_dir=...)` flips jax's global persistent-
+    cache config (the fallback layer); restore it so later tests don't
+    write XLA cache entries into a torn-down tmp dir."""
+    import jax
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      prev_min)
+
+
+class TestTrainerAOT:
+    def test_refit_after_cache_reset_zero_compiles(self, tmp_path,
+                                                   compile_spy,
+                                                   jax_cache_config):
+        """Simulated trainer restart: the jitted step is rebuilt from
+        scratch (the model's in-process step memo dropped), and the AOT
+        cache supplies the executable without one fresh compile."""
+        from analytics_zoo_tpu.learn.trainer import fit_keras
+        m = Sequential([L.Dense(4, input_shape=(4,)), L.Dense(1)])
+        m.compile(optimizer="sgd", loss="mse")
+        x = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+        y = np.random.RandomState(1).rand(32, 1).astype(np.float32)
+        h1 = fit_keras(m, x, y, batch_size=16, epochs=1,
+                       distributed=False, device_cache=False,
+                       compile_cache_dir=str(tmp_path))
+        assert len(compile_spy) == 1
+        step = m._train_cache[1]
+        assert step.sources and \
+            set(step.sources.values()) == {"compiled"}
+
+        compile_spy.clear()
+        m._train_cache = None                 # "restart": memo dropped
+        h2 = fit_keras(m, x, y, batch_size=16, epochs=1,
+                       distributed=False, device_cache=False,
+                       compile_cache_dir=str(tmp_path))
+        assert len(compile_spy) == 0, \
+            "trainer re-run must load its step executable from disk"
+        step2 = m._train_cache[1]
+        assert set(step2.sources.values()) == {"cached"}
+        assert np.isfinite(h2["loss"][0]) and np.isfinite(h1["loss"][0])
+
+    def test_aot_step_matches_plain_jit(self, tmp_path, jax_cache_config):
+        """Same data, same seed: a cache-backed fit reproduces the plain
+        fit's losses exactly."""
+        from analytics_zoo_tpu.learn.trainer import fit_keras
+
+        def run(cache_dir):
+            m = Sequential([L.Dense(4, input_shape=(4,)), L.Dense(1)])
+            m.compile(optimizer="sgd", loss="mse")
+            x = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+            y = np.random.RandomState(1).rand(32, 1).astype(np.float32)
+            return fit_keras(m, x, y, batch_size=16, epochs=2, seed=7,
+                             distributed=False, device_cache=False,
+                             compile_cache_dir=cache_dir)["loss"]
+
+        plain = run(None)
+        cached = run(str(tmp_path / "cc"))
+        again = run(str(tmp_path / "cc"))
+        assert plain == cached == again

@@ -21,6 +21,36 @@ def test_codebase_metric_names_clean():
     assert not errors, "\n".join(errors)
 
 
+def test_compile_cache_metrics_covered():
+    """The persistent-compile-cache families (ISSUE 4) are registered in
+    the scanned tree with the kinds the docs/dashboards depend on —
+    coverage, not just absence of violations."""
+    os.chdir(REPO)
+    regs = {}
+    for p in check_metric_names.iter_sources(
+            check_metric_names.DEFAULT_ROOTS):
+        for kind, name, _line in check_metric_names.find_registrations(p):
+            regs.setdefault(name, kind)
+    for name, kind in (("compile_cache_hits_total", "counter"),
+                       ("compile_cache_misses_total", "counter"),
+                       ("compile_cache_load_ms", "histogram"),
+                       ("compile_cache_compile_ms", "histogram"),
+                       ("compile_cache_bytes", "gauge")):
+        assert regs.get(name) == kind, (name, regs.get(name))
+
+
+def test_required_metric_coverage_enforced(tmp_path, monkeypatch):
+    """Deleting a required registration (e.g. renaming a compile_cache
+    family) fails the lint, not just the scrape."""
+    os.chdir(REPO)
+    monkeypatch.setattr(
+        check_metric_names, "REQUIRED",
+        dict(check_metric_names.REQUIRED,
+             nonexistent_metric_total="counter"))
+    errors = check_metric_names.check()
+    assert any("nonexistent_metric_total" in e for e in errors)
+
+
 def test_lint_catches_violations(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(
