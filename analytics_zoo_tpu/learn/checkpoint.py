@@ -9,18 +9,38 @@ optimizer state so epoch continuation matches `Topology.scala:379-394`.
 
 Format: each file is a numpy .npz of the flattened pytree plus a JSON sidecar
 of the tree structure — portable, no pickle of code objects.
+
+Durability (ISSUE 5, mirroring the compile-cache store's discipline):
+writes land in a same-directory temp file and `os.replace` into place,
+so a crashed writer never leaves a half-written artifact under the
+final name; the structure sidecar records the npz's CRC32C and is
+written LAST, acting as the commit marker. `load_pytree` verifies the
+CRC, and `latest_checkpoint` skips corrupt/truncated versions, falling
+back to the newest intact one — a torn disk can cost a checkpoint, not
+the run.
 """
 
 from __future__ import annotations
 
 import datetime
 import json
+import logging
 import os
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.utils.crc import crc32c
+
+log = logging.getLogger("analytics_zoo_tpu.checkpoint")
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint artifact failed its integrity check (missing
+    sidecar, truncated npz, CRC mismatch)."""
 
 
 # ---------------------------------------------------------------------------
@@ -49,16 +69,41 @@ def _walk(tree: Any, path: List[List[Any]], paths: List[Any],
 
 
 def save_pytree(path: str, tree: Any) -> None:
-    """Write a pytree to `<path>` (npz + structure json)."""
+    """Write a pytree to `<path>` (npz + structure json), atomically:
+    both files go through write-temp-then-rename, the npz first and the
+    CRC-bearing sidecar last (the commit marker) — a reader can never
+    observe a committed-looking checkpoint with torn bytes."""
     paths: List[Any] = []
     leaves: List[np.ndarray] = []
     _walk(tree, [], paths, leaves)
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
     flat = {f"leaf_{i}": l for i, l in enumerate(leaves)}
-    np.savez(path if path.endswith(".npz") else path + ".npz", **flat)
-    with open(_struct_path(path), "w") as fh:
-        json.dump({"nodes": paths}, fh)
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    tmp_npz = npz_path + f".tmp-{os.getpid()}"
+    tmp_struct = _struct_path(path) + f".tmp-{os.getpid()}"
+    try:
+        with open(tmp_npz, "wb") as fh:
+            np.savez(fh, **flat)
+        # CRC of the INTENDED bytes, read back before the commit point:
+        # a crash (or injected truncation) between here and the rename
+        # yields an artifact whose CRC cannot match
+        with open(tmp_npz, "rb") as fh:
+            crc = crc32c(fh.read())
+        nbytes = os.path.getsize(tmp_npz)
+        faults.fire("checkpoint.write", path=tmp_npz)
+        os.replace(tmp_npz, npz_path)
+        with open(tmp_struct, "w") as fh:
+            json.dump({"nodes": paths, "npz_crc32c": crc,
+                       "npz_bytes": nbytes}, fh)
+        os.replace(tmp_struct, _struct_path(path))
+    except BaseException:
+        for tmp in (tmp_npz, tmp_struct):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        raise
 
 
 def _struct_path(path: str) -> str:
@@ -66,12 +111,46 @@ def _struct_path(path: str) -> str:
     return base + ".structure.json"
 
 
-def load_pytree(path: str) -> Any:
+def verify_pytree(path: str) -> bool:
+    """True when `<path>` is a complete, CRC-intact artifact. Legacy
+    artifacts without a recorded CRC pass on existence alone."""
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    try:
+        with open(_struct_path(path)) as fh:
+            meta = json.load(fh)
+        if not os.path.exists(npz_path):
+            return False
+        if "npz_crc32c" not in meta:
+            return True
+        if os.path.getsize(npz_path) != meta.get("npz_bytes"):
+            return False
+        with open(npz_path, "rb") as fh:
+            return crc32c(fh.read()) == meta["npz_crc32c"]
+    except (OSError, ValueError):
+        return False
+
+
+def load_pytree(path: str, verify: bool = True) -> Any:
     """Load a pytree written by save_pytree; reconstructs nested
-    dicts/lists (tuples come back as lists)."""
-    npz = np.load(path if path.endswith(".npz") else path + ".npz")
+    dicts/lists (tuples come back as lists). With `verify` (default)
+    the npz's recorded CRC is checked against ONE read of the bytes
+    (np.load then parses the same in-memory buffer — no second disk
+    pass for multi-GB checkpoints) and a mismatch raises
+    `CorruptCheckpointError` instead of feeding torn bytes to np.load."""
+    import io
+    npz_path = path if path.endswith(".npz") else path + ".npz"
     with open(_struct_path(path)) as fh:
         meta = json.load(fh)
+    if verify and "npz_crc32c" in meta:
+        with open(npz_path, "rb") as fh:
+            raw = fh.read()
+        if len(raw) != meta.get("npz_bytes") \
+                or crc32c(raw) != meta["npz_crc32c"]:
+            raise CorruptCheckpointError(
+                f"checkpoint artifact {path} is corrupt or truncated")
+        npz = np.load(io.BytesIO(raw))
+    else:
+        npz = np.load(npz_path)
     root: Any = None
     for node in meta["nodes"]:
         if "leaf" in node:
@@ -121,15 +200,24 @@ class CheckpointManager:
 
     def save(self, iteration: int, params: Any, opt_state: Any = None,
              extra: Optional[Dict[str, Any]] = None) -> str:
+        """Commit ORDER makes the checkpoint SET atomic, not just each
+        artifact: optimizer state and metadata land first, the model
+        artifact (whose CRC sidecar `checkpoint_intact` keys on) lands
+        LAST as the commit marker. A crash anywhere before the final
+        rename leaves no model.<iter>.npz, so the torn set is invisible
+        to `latest_checkpoint`/resume — never a model that resumes with
+        fresh optimizer state or epoch-0 metadata."""
         mpath = os.path.join(self.run_dir, f"model.{iteration}")
-        save_pytree(mpath, params)
         if opt_state is not None:
             opath = os.path.join(self.run_dir,
                                  f"optimMethod-{self.optim_name}.{iteration}")
             save_pytree(opath, _optstate_to_tree(opt_state))
         if extra:
-            with open(mpath + ".meta.json", "w") as fh:
+            tmp = mpath + f".meta.json.tmp-{os.getpid()}"
+            with open(tmp, "w") as fh:
                 json.dump(extra, fh)
+            os.replace(tmp, mpath + ".meta.json")
+        save_pytree(mpath, params)
         self._saved.append(iteration)
         self._gc()
         return mpath
@@ -144,13 +232,14 @@ class CheckpointManager:
                         os.remove(p)
 
 
-def latest_checkpoint(root: str) -> Optional[Tuple[str, int]]:
-    """Find (run_dir, version) of the newest model.<iter> under root —
-    mirrors `find_latest_checkpoint` (`orca/learn/tf/utils.py`)."""
-    best: Optional[Tuple[str, int]] = None
+def list_checkpoints(root: str) -> List[Tuple[str, int]]:
+    """Every (run_dir, version) under root, newest first (version desc,
+    then run-dir stamp desc for ties across run dirs)."""
+    found: List[Tuple[str, int]] = []
     if not os.path.isdir(root):
-        return None
-    candidates = [root] + [os.path.join(root, d) for d in sorted(os.listdir(root))
+        return found
+    candidates = [root] + [os.path.join(root, d)
+                           for d in sorted(os.listdir(root))
                            if os.path.isdir(os.path.join(root, d))]
     for run_dir in candidates:
         if not os.path.isdir(run_dir):
@@ -158,16 +247,86 @@ def latest_checkpoint(root: str) -> Optional[Tuple[str, int]]:
         for f in os.listdir(run_dir):
             m = re.match(r"model\.(\d+)\.npz$", f)
             if m:
-                version = int(m.group(1))
-                if best is None or version >= best[1]:
-                    best = (run_dir, version)
-    return best
+                found.append((run_dir, int(m.group(1))))
+    return sorted(found, key=lambda rv: (rv[1], rv[0]), reverse=True)
+
+
+def checkpoint_intact(run_dir: str, version: int) -> bool:
+    """CRC/completeness check for one checkpoint version: the model
+    artifact and (when present) its optimizer artifacts must all
+    verify."""
+    if not verify_pytree(os.path.join(run_dir, f"model.{version}")):
+        return False
+    for f in os.listdir(run_dir):
+        if re.match(rf"optimMethod-.+\.{version}\.npz$", f):
+            if not verify_pytree(os.path.join(run_dir, f)):
+                return False
+    return True
+
+
+def latest_checkpoint(root: str,
+                      verify: bool = True) -> Optional[Tuple[str, int]]:
+    """Find (run_dir, version) of the newest INTACT model.<iter> under
+    root — mirrors `find_latest_checkpoint` (`orca/learn/tf/utils.py`),
+    plus the fallback discipline: a corrupt/truncated newest version is
+    skipped (with a warning) in favor of the newest version that
+    verifies. `verify=False` restores the raw newest-by-number scan."""
+    for run_dir, version in list_checkpoints(root):
+        if not verify or checkpoint_intact(run_dir, version):
+            return (run_dir, version)
+        log.warning(
+            "checkpoint model.%d in %s is corrupt/truncated; falling "
+            "back to an earlier version", version, run_dir)
+    return None
+
+
+def read_checkpoint_meta(run_dir: str, version: int) -> Dict[str, Any]:
+    """The extra-metadata sidecar of one checkpoint ({} when absent or
+    unreadable)."""
+    mpath = os.path.join(run_dir, f"model.{version}.meta.json")
+    try:
+        with open(mpath) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def find_resume_checkpoint(root: str) -> Optional[Tuple[str, int,
+                                                        Dict[str, Any]]]:
+    """The checkpoint `fit_keras(auto_resume=True)` should continue
+    from: the newest INTACT epoch-boundary checkpoint (mid-epoch and
+    emergency saves are skipped — resuming from one would replay part
+    of an epoch and break loss-identical continuation). Falls back to
+    the newest intact checkpoint of any kind, with a warning, when no
+    boundary checkpoint survives. Returns (run_dir, version, meta) or
+    None."""
+    fallback = None        # newest intact NON-boundary checkpoint
+    # lazy: intactness CRC-reads whole artifacts, so verify candidates
+    # newest-first only until a boundary hit instead of scanning every
+    # version under every run dir up front
+    for run_dir, version in list_checkpoints(root):
+        if not checkpoint_intact(run_dir, version):
+            continue
+        meta = read_checkpoint_meta(run_dir, version)
+        # legacy checkpoints predate the flag; treat them as boundaries
+        if meta.get("epoch_finished", True):
+            return (run_dir, version, meta)
+        if fallback is None:
+            fallback = (run_dir, version, meta)
+    if fallback is not None:
+        log.warning(
+            "no epoch-boundary checkpoint under %s; resuming from "
+            "mid-epoch model.%d (continuation will replay the partial "
+            "epoch from its start)", root, fallback[1])
+    return fallback
 
 
 def load_checkpoint(path: str, version: Optional[int] = None,
-                    optim_name: str = "default"):
+                    optim_name: str = "default", verify: bool = True):
     """Load (params, opt_tree, meta) from a checkpoint dir. `path` may be the
-    ckpt root or a run dir; `version=None` → latest."""
+    ckpt root or a run dir; `version=None` → latest. `verify=False` skips
+    the CRC pass — for callers (auto-resume) that ran `checkpoint_intact`
+    on this exact version moments earlier."""
     if version is None:
         found = latest_checkpoint(path)
         if found is None:
@@ -183,11 +342,12 @@ def load_checkpoint(path: str, version: Optional[int] = None,
                 run_dir = found[0]
             else:
                 raise FileNotFoundError(f"No model.{version} under {path}")
-    params = load_pytree(os.path.join(run_dir, f"model.{version}"))
+    params = load_pytree(os.path.join(run_dir, f"model.{version}"),
+                         verify=verify)
     opt_tree = None
     opath = os.path.join(run_dir, f"optimMethod-{optim_name}.{version}")
     if os.path.exists(opath + ".npz"):
-        opt_tree = load_pytree(opath)
+        opt_tree = load_pytree(opath, verify=verify)
     meta = {}
     mpath = os.path.join(run_dir, f"model.{version}.meta.json")
     if os.path.exists(mpath):

@@ -62,6 +62,12 @@ class _TrainingMetrics:
             "flops_per_step)")
         self.val = reg.gauge("training_validation_metric",
                              "last validation metrics, labeled by name")
+        self.resumes = reg.counter(
+            "training_resumes_total",
+            "training runs continued from a checkpoint by auto_resume")
+        self.step_retries = reg.counter(
+            "training_step_retries_total",
+            "failed/hung training steps retried by the step watchdog")
 
     def epoch(self, steps: int, n_seen: int, dt: float, mean_loss: float,
               flops_per_step: Optional[float] = None):
@@ -162,6 +168,80 @@ def _materialize(x):
     in fit_keras funnels through here so tests can count syncs (one per
     logging interval, not one per step)."""
     return jax.device_get(x)
+
+
+def _step_with_watchdog(step_fn, args, retries: int,
+                        timeout_s: Optional[float], retry_counter,
+                        iteration: int):
+    """One training step under the fault-tolerance contract
+    (`Topology.scala:1255-1337`'s retry role, made local): a failed step
+    is retried up to `retries` times; with `timeout_s` the step runs
+    under a watchdog thread so a hung dispatch surfaces as TimeoutError
+    instead of a silent stall. The `trainer.step` fault-injection point
+    fires before device dispatch, so an injected failure retries without
+    touching the donated parameter buffers. A REAL mid-execution failure
+    may consume them — then the retry fails too and the caller's
+    emergency-checkpoint path takes over."""
+    import threading
+    from analytics_zoo_tpu.common import faults
+    attempts = 0
+    while True:
+        try:
+            if timeout_s is None:
+                faults.fire("trainer.step", iteration=iteration,
+                            attempt=attempts)
+                return step_fn(*args)
+            box: Dict[str, Any] = {}
+            cancelled = threading.Event()
+            done = threading.Event()
+
+            def run():
+                try:
+                    faults.fire("trainer.step", iteration=iteration,
+                                attempt=attempts)
+                    if cancelled.is_set():
+                        return          # timed out during the stall:
+                    box["out"] = step_fn(*args)   # don't consume buffers
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    box["exc"] = e
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name="train-step-watchdog")
+            t.start()
+            if not done.wait(timeout_s):
+                cancelled.set()
+                # grace window before declaring it hung: a step that is
+                # merely SLOW (step 0 pays XLA compilation) completes
+                # here and its result is perfectly valid — retrying
+                # instead would race the still-running dispatch on the
+                # donated parameter buffers and abort the run
+                if done.wait(timeout_s) and "out" in box:
+                    log.warning(
+                        "training step %d exceeded the %ss watchdog but "
+                        "completed in the grace window; using its result "
+                        "(raise step_timeout_s if this recurs)",
+                        iteration, timeout_s)
+                    return box["out"]
+                raise TimeoutError(
+                    f"training step {iteration} exceeded the "
+                    f"{timeout_s}s watchdog")
+            if "exc" in box:
+                raise box["exc"]
+            if "out" not in box:
+                raise RuntimeError(
+                    f"training step {iteration} was cancelled by an "
+                    "earlier watchdog timeout")
+            return box["out"]
+        except Exception as e:  # noqa: BLE001 — retry policy owns this
+            attempts += 1
+            if attempts > retries:
+                raise
+            retry_counter.inc()
+            log.warning(
+                "training step %d failed (%s: %s); retry %d/%d",
+                iteration, type(e).__name__, e, attempts, retries)
 
 
 class _Prefetcher:
@@ -534,7 +614,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               flat_optimizer: bool = False,
               flops_per_step: Optional[float] = None,
               metrics_report_s: Optional[float] = None,
-              compile_cache_dir: Optional[str] = None
+              compile_cache_dir: Optional[str] = None,
+              auto_resume: bool = False,
+              step_retries: int = 0,
+              step_timeout_s: Optional[float] = None
               ) -> Dict[str, List[float]]:
     """`KerasNet.fit` backend. Returns a Keras-style history dict.
     `batch_iter_factory(epoch) -> iterator of (xb, yb, real)` overrides the
@@ -572,6 +655,17 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     re-lowering and re-compiling; JAX's built-in persistent cache
     (`jax_compilation_cache_dir`, under `<dir>/xla`) is enabled as the
     fallback layer for any shape AOT serialization can't carry.
+    `auto_resume=True` (needs `model.set_checkpoint(...)`) scans the
+    checkpoint root for the newest INTACT epoch-boundary checkpoint
+    before training and continues from it: params, optimizer state,
+    iteration counter and the RNG key are restored, so the continued
+    run's losses are bitwise-identical to an uninterrupted run (the
+    shuffle order is already `seed + epoch`-derived). A corrupt latest
+    checkpoint falls back to the newest intact one
+    (`learn/checkpoint.py` CRC discipline). `step_retries=N` retries a
+    failed step N times before writing an emergency checkpoint and
+    raising; `step_timeout_s` additionally runs each step under a
+    watchdog thread so a hung dispatch surfaces as TimeoutError.
     After fit, `model.params` holds DEVICE arrays (no gratuitous
     device→host pull; save/checkpoint paths transfer on demand)."""
     ctx = get_context()
@@ -668,6 +762,51 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if optimizer is None:
         raise RuntimeError("Model must be compiled before fit "
                            "(`Topology.scala:139` contract)")
+
+    # -- auto-resume (ISSUE 5): continue from the newest intact
+    # epoch-boundary checkpoint instead of step 0 -------------------------
+    start_epoch = 0
+    iteration = 0
+    resume_opt_tree = None
+    resume_meta = None
+    if auto_resume:
+        if not model._checkpoint_path:
+            raise ValueError(
+                "auto_resume=True needs a checkpoint directory; call "
+                "model.set_checkpoint(path) first")
+        from analytics_zoo_tpu.learn.checkpoint import (
+            find_resume_checkpoint, load_checkpoint)
+        found = find_resume_checkpoint(model._checkpoint_path)
+        if found is not None:
+            run_dir, version, _ = found
+            # verify=False: find_resume_checkpoint CRC-verified exactly
+            # this version moments ago — no second full-file pass
+            r_params, resume_opt_tree, resume_meta = load_checkpoint(
+                run_dir, version, verify=False)
+            # a fresh process's auto-generated layer names differ from
+            # the checkpointing process's — remap onto this instance
+            remap = getattr(model, "_remap_loaded", None)
+            model.params = remap(r_params) if remap is not None \
+                else r_params
+            start_epoch = int(resume_meta.get("epoch", 0))
+            iteration = int(resume_meta.get("iteration", version))
+            if "rng" in resume_meta:
+                # the checkpointed key IS the key the uninterrupted run
+                # held at this boundary — restoring it (plus the
+                # seed+epoch shuffle order) is what makes continuation
+                # bitwise-identical
+                rng = jnp.asarray(
+                    np.asarray(resume_meta["rng"], dtype=np.uint32))
+            else:
+                log.warning(
+                    "auto-resume: checkpoint has no RNG state (pre-"
+                    "ISSUE-5 layout); continuing with a fresh key — "
+                    "losses will diverge from the uninterrupted run")
+            log.info(
+                "auto-resume: continuing from %s/model.%d "
+                "(epoch %d, iteration %d)",
+                run_dir, version, start_epoch, iteration)
+
     params = _put_replicated(model.params, mesh)
     lazy_specs = None
     if lazy_embeddings:
@@ -703,6 +842,19 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             init_state(params, lazy_specs, optimizer), mesh)
     else:
         opt_state = _put_replicated(optimizer.init(params), mesh)
+    if resume_opt_tree is not None:
+        from analytics_zoo_tpu.learn.checkpoint import restore_opt_state
+        saved_layout = (resume_meta or {}).get("opt_state_layout", "tree")
+        live_layout = "flat_bucketed" if flat_spec is not None else "tree"
+        if saved_layout != live_layout:
+            raise ValueError(
+                f"auto_resume: checkpoint optimizer state is "
+                f"{saved_layout!r} but this fit would build "
+                f"{live_layout!r} (flat_optimizer toggled between "
+                "runs?); re-run with the original setting")
+        opt_state = _put_replicated(
+            restore_opt_state(jax.device_get(opt_state),
+                              resume_opt_tree), mesh)
 
     # Cache the jitted step on the model: repeated fit calls (warm restarts,
     # per-round loops) must hit the compile cache, not rebuild a fresh
@@ -783,11 +935,32 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         reporter = MetricsReporter(interval_s=metrics_report_s,
                                    writer=writer).start()
 
+    if resume_meta is not None:
+        telemetry.resumes.inc()
+
+    def _call_step(*step_args):
+        """Every branch's train_step dispatch funnels through the step
+        watchdog (retries + optional timeout); with step_retries=0 and
+        no timeout this is a plain call."""
+        return _step_with_watchdog(train_step, step_args, step_retries,
+                                   step_timeout_s, telemetry.step_retries,
+                                   iteration)
+
+    def _ckpt_extra(ep: int, finished: bool) -> Dict[str, Any]:
+        """Checkpoint sidecar: everything auto-resume needs for bitwise
+        continuation — epoch/iteration cursors, the live RNG key, and
+        the opt-state layout marker."""
+        return {"epoch": ep, "iteration": iteration,
+                "epoch_finished": finished,
+                "rng": np.asarray(jax.device_get(rng)).ravel().tolist(),
+                "opt_state_layout": "flat_bucketed"
+                if flat_spec is not None else "tree"}
+
     history: Dict[str, List[float]] = {"loss": []}
-    iteration = 0
     batches = None
+    epoch = start_epoch
     try:
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
           it0 = iteration
           losses_dev: List[Any] = []   # device scalars/vectors; sync at end
           t0 = time.time()
@@ -800,7 +973,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               # granularity trade as steps_per_run=steps.
               batches = None
               rng, erng = jax.random.split(rng)
-              params, opt_state, ep_losses = train_step(
+              params, opt_state, ep_losses = _call_step(
                   params, opt_state, x_dev, y_dev, erng)
               losses_dev.append(ep_losses)
               iteration += dc_steps
@@ -825,11 +998,11 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             for xb, yb, real, k in batches:
                 if multi:
                     rng, run_rng = jax.random.split(rng)
-                    params, opt_state, _, loss = train_step(
+                    params, opt_state, _, loss = _call_step(
                         params, opt_state, xb, yb, run_rng)
                 else:
                     rng, step_rng = jax.random.split(rng)
-                    params, opt_state, loss = train_step(params, opt_state,
+                    params, opt_state, loss = _call_step(params, opt_state,
                                                          xb, yb, step_rng)
                 iteration += k
                 n_seen += real * n_proc       # local count × processes
@@ -843,16 +1016,12 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                                         loss=last_loss)):
                     # params save in TREE layout (unraveled) but a flat
                     # run's opt_state stays in bucketed-tuple layout:
-                    # record which, so a future restore can't silently
+                    # the sidecar records which (plus the resume
+                    # cursors/RNG), so a future restore can't silently
                     # structurally mismatch the two
                     ckpt_mgr.save(iteration, jax.device_get(_as_tree(params)),
                                   jax.device_get(opt_state),
-                                  extra={"epoch": epoch,
-                                         "iteration": iteration,
-                                         "opt_state_layout":
-                                             "flat_bucketed"
-                                             if flat_spec is not None
-                                             else "tree"})
+                                  extra=_ckpt_extra(epoch, False))
                 if end_trigger and end_trigger(
                         tg.TriggerState(epoch=epoch, iteration=iteration,
                                         loss=last_loss)):
@@ -901,15 +1070,37 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                                   epoch_finished=True)):
               ckpt_mgr.save(iteration, jax.device_get(_as_tree(params)),
                             jax.device_get(opt_state),
-                            extra={"epoch": epoch + 1,
-                                   "iteration": iteration,
-                                   "opt_state_layout": "flat_bucketed"
-                                   if flat_spec is not None else "tree"})
+                            extra=_ckpt_extra(epoch + 1, True))
           if end_trigger and end_trigger(
                   tg.TriggerState(epoch=epoch + 1, iteration=iteration,
                                   epoch_finished=True)):
               break
 
+    except Exception:
+        # the step watchdog exhausted its retries, or any other mid-run
+        # failure: leave an emergency checkpoint behind so auto_resume
+        # (or an operator) can continue instead of restarting at step 0.
+        # Best-effort — a step that died mid-execution may have consumed
+        # the donated parameter buffers, in which case the last periodic
+        # checkpoint on disk remains the resume point.
+        if ckpt_mgr is not None and iteration > 0 \
+                and iteration not in ckpt_mgr._saved:
+            # (skipped when this iteration is already on disk — an
+            # emergency save would demote a boundary checkpoint's
+            # metadata to mid-epoch for identical params)
+            try:
+                ckpt_mgr.save(iteration,
+                              jax.device_get(_as_tree(params)),
+                              jax.device_get(opt_state),
+                              extra=dict(_ckpt_extra(epoch, False),
+                                         emergency=True))
+                log.warning("emergency checkpoint written at iteration "
+                            "%d", iteration)
+            except Exception as ce:  # noqa: BLE001 — already failing
+                log.warning("emergency checkpoint failed (%s: %s); the "
+                            "last periodic checkpoint is the resume "
+                            "point", type(ce).__name__, ce)
+        raise
     finally:
         # Keep parameters on device (even on an interrupted fit, so the
         # model never points at donated/deleted buffers): repeated

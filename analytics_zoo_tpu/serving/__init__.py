@@ -26,6 +26,10 @@ _EXPORTS = {
     "Timer": "analytics_zoo_tpu.serving.timer",
     "FrontEnd": "analytics_zoo_tpu.serving.http_frontend",
     "ServingConfig": "analytics_zoo_tpu.serving.config",
+    "BackoffPolicy": "analytics_zoo_tpu.serving.breaker",
+    "CircuitBreaker": "analytics_zoo_tpu.serving.breaker",
+    "ResilientBroker": "analytics_zoo_tpu.serving.breaker",
+    "ReplicaSupervisor": "analytics_zoo_tpu.serving.supervisor",
 }
 
 __all__ = list(_EXPORTS)

@@ -93,7 +93,16 @@ def cmd_start(args) -> int:
                              pipelined=cfg.pipelined,
                              decode_workers=cfg.decode_workers,
                              queue_depth=cfg.queue_depth,
-                             tracer=tracer).start()
+                             tracer=tracer,
+                             supervise=cfg.supervise,
+                             failure_threshold=cfg.failure_threshold,
+                             probe_interval_s=cfg.probe_interval_s,
+                             latency_factor=cfg.latency_factor,
+                             breaker_failure_threshold=cfg
+                             .breaker_failure_threshold,
+                             breaker_reset_s=cfg.breaker_reset_s,
+                             sink_buffer_batches=cfg
+                             .sink_buffer_batches).start()
     if frontend is not None:
         frontend._srv.serving = serving
     print("cluster serving started", flush=True)

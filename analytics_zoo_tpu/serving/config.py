@@ -85,6 +85,17 @@ class ServingConfig:
     pipelined: bool = True
     decode_workers: int = 2
     queue_depth: int = 8
+    # fault tolerance (ISSUE 5, docs/ProgrammingGuide/fault-tolerance.md):
+    # replica supervision (quarantine/canary revival) over a replica
+    # pool, circuit breaker on the engine's broker connections, bounded
+    # sink writeback buffer for broker outages
+    supervise: bool = True
+    failure_threshold: int = 3
+    probe_interval_s: float = 0.5
+    latency_factor: float = 8.0
+    breaker_failure_threshold: int = 3
+    breaker_reset_s: float = 1.0
+    sink_buffer_batches: int = 256
     # shape-bucket pre-warming: list of per-record shapes, e.g.
     # [[32, 32, 3]] (or the string "32x32x3,224x224x3" in bare-parser
     # YAML) — every bucket of each shape pre-compiles at load so no XLA
@@ -163,6 +174,16 @@ class ServingConfig:
         cfg.pipelined = bool(params.get("pipelined", True))
         cfg.decode_workers = int(params.get("decode_workers", 2))
         cfg.queue_depth = int(params.get("queue_depth", 8))
+        cfg.supervise = bool(params.get("supervise", True))
+        cfg.failure_threshold = int(params.get("failure_threshold", 3))
+        cfg.probe_interval_s = float(params.get("probe_interval_s", 0.5))
+        cfg.latency_factor = float(params.get("latency_factor", 8.0))
+        cfg.breaker_failure_threshold = int(
+            params.get("breaker_failure_threshold", 3))
+        cfg.breaker_reset_s = float(params.get("breaker_reset_s", 1.0))
+        cfg.sink_buffer_batches = int(
+            params.get("sink_buffer_batches", 256))
+        cfg._validate_fault_tolerance()
         cfg.warmup_shapes = _parse_warmup_shapes(
             params.get("warmup_shapes"))
         cfg.warmup_dtype = str(params.get("warmup_dtype", "float32"))
@@ -221,6 +242,25 @@ class ServingConfig:
             raise ValueError(
                 f"params.num_replicas={n} exceeds the {avail} available "
                 f"local device(s); lower it or use 'auto'")
+
+    def _validate_fault_tolerance(self):
+        """Supervision/breaker knobs fail at config load like placement:
+        a zero threshold or a negative interval is a config error, not a
+        runtime surprise inside the supervisor thread."""
+        for name, value, minimum in (
+                ("failure_threshold", self.failure_threshold, 1),
+                ("breaker_failure_threshold",
+                 self.breaker_failure_threshold, 1),
+                ("sink_buffer_batches", self.sink_buffer_batches, 1)):
+            if value < minimum:
+                raise ValueError(
+                    f"params.{name}={value} must be >= {minimum}")
+        for name, value in (("probe_interval_s", self.probe_interval_s),
+                            ("breaker_reset_s", self.breaker_reset_s),
+                            ("latency_factor", self.latency_factor)):
+            if value <= 0:
+                raise ValueError(
+                    f"params.{name}={value} must be > 0")
 
     def _validate_compile_cache(self):
         """Cache-setting errors belong at config load, like placement:

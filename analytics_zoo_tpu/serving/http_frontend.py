@@ -106,19 +106,24 @@ class _Handler(BaseHTTPRequestHandler):
                         method=self.command or "GET")
 
     def _send_bytes(self, code: int, body: bytes, content_type: str,
-                    allow: Optional[str] = None):
+                    allow: Optional[str] = None,
+                    extra_headers: Optional[dict] = None):
         self._count_request(code)
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         if allow:
             self.send_header("Allow", allow)
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
-    def _send(self, code: int, payload, allow: Optional[str] = None):
+    def _send(self, code: int, payload, allow: Optional[str] = None,
+              extra_headers: Optional[dict] = None):
         self._send_bytes(code, json.dumps(payload).encode(),
-                         "application/json", allow=allow)
+                         "application/json", allow=allow,
+                         extra_headers=extra_headers)
 
     def _method_not_allowed(self, allow: str):
         self._send(405, {"error": f"method {self.command} not allowed; "
@@ -200,6 +205,20 @@ class _Handler(BaseHTTPRequestHandler):
             # `FrontEndApp.scala:167` tryAcquire failure → reject
             self._send(429, {"error": "too many requests"})
             return
+        # every model replica quarantined (ISSUE 5): answer 503 +
+        # Retry-After sized to the canary-probe cadence instead of
+        # letting the request hang to its timeout behind a fully-sick
+        # pool. The records already in the pipeline wait for revival;
+        # new admissions are the frontend's to refuse.
+        serving = self.server.serving
+        if serving is not None:
+            healthy_fn = getattr(serving, "healthy_replicas", None)
+            if callable(healthy_fn) and healthy_fn() == 0:
+                retry_s = getattr(serving, "retry_after_s", 1)
+                self._send(503, {"error": "every model replica is "
+                                          "quarantined; retry shortly"},
+                           extra_headers={"Retry-After": str(retry_s)})
+                return
         with self.server.request_timer.timing():
             try:
                 req = json.loads(self._read_body())

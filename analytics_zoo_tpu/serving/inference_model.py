@@ -48,9 +48,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from analytics_zoo_tpu.common import faults
 from analytics_zoo_tpu.serving.timer import Timer
 
 PLACEMENTS = ("replicated", "sharded")
+
+
+class NoHealthyReplicaError(RuntimeError):
+    """Every replica in the pool is quarantined: the router fails FAST
+    (no 60 s permit wait) so callers can park work / answer 503 instead
+    of hanging behind a fully-sick pool."""
 
 
 def _next_bucket(n: int, buckets) -> int:
@@ -195,6 +202,30 @@ class _RoutedPending:
                 raise self._exc
         return self._result
 
+    def _rebind(self, replica: int, on_done) -> bool:
+        """Quarantine re-dispatch: point this pending at a new replica
+        (and its permit-release callback) BEFORE re-enqueueing it there.
+        Refused (False) once the pending is already done/abandoned — the
+        old callback has run and a rebind would leak the new permit.
+
+        NON-blocking on the pending lock: the caller holds the router
+        CV, and a sink thread can sit inside `result()` holding this
+        lock while waiting for the event — blocking here would deadlock
+        lock-order-inverted against `result()`'s `on_done` →
+        `_release_replica` (CV) path. A contended pending simply
+        refuses the rebind; the caller fails it instead (NaN degrade),
+        which sets the event lock-free and unblocks that waiter."""
+        if not self._lock.acquire(blocking=False):
+            return False
+        try:
+            if self._done:
+                return False
+            self.replica = replica
+            self._on_done = on_done
+            return True
+        finally:
+            self._lock.release()
+
     def abandon(self):
         """Release the replica permit WITHOUT materializing — the
         shutdown-drop path (`ClusterServing._poison` discarding queued
@@ -212,11 +243,11 @@ class _RoutedPending:
 
 class _Replica:
     """One device's slot in the replicated pool: committed params, a work
-    queue, and the router's book-keeping. `inflight`/`batches` are guarded
-    by the model's router condition variable."""
+    queue, and the router's book-keeping. `inflight`/`batches`/
+    `quarantined` are guarded by the model's router condition variable."""
 
     __slots__ = ("index", "device", "params", "inflight", "batches",
-                 "work_q", "thread")
+                 "work_q", "thread", "quarantined")
 
     def __init__(self, index: int, device, params):
         self.index = index
@@ -224,6 +255,7 @@ class _Replica:
         self.params = params
         self.inflight = 0          # routed but not yet materialized
         self.batches = 0           # total batches ever routed here
+        self.quarantined = False   # supervisor pulled it from the router
         self.work_q: "queue.Queue" = queue.Queue()
         self.thread: Optional[threading.Thread] = None
 
@@ -318,6 +350,12 @@ class InferenceModel:
         self._replicas: Optional[List[_Replica]] = None
         self._replica_cv = threading.Condition()
         self._rr = 0               # round-robin tie-break cursor
+        # supervision hooks (serving/supervisor.py): outcome stream and
+        # the canary batch probes reuse
+        self._on_replica_event: Optional[Callable[[int, bool, float],
+                                                  None]] = None
+        self._last_input = None        # most recent dispatched batch
+        self._last_good_input = None   # most recent SUCCESSFUL batch
         self._batch_sharding = None
         self._jit: Optional[Callable] = None
         self.timer = Timer("predict")
@@ -491,7 +529,7 @@ class InferenceModel:
         # module-attribute call: serialization.compile_lowered is THE
         # fresh-compile funnel tests monkeypatch to assert zero compiles
         ex = serialization.compile_lowered(self._jit.lower(params, batch))
-        self.compile_cache.put(
+        self.compile_cache.put(  # blocking-ok: disk cache write, not a queue
             key, ex, compile_ms=(time.perf_counter() - t0) * 1e3)
         self._aot[(replica_idx, sig)] = ex
         return "compiled"
@@ -501,14 +539,29 @@ class InferenceModel:
         so each replica needs its own; on TPU the jit call returns as soon
         as the async dispatch is enqueued and this thread is just a cheap
         hop. `t0` is the router hand-off time, so `dispatch_s` covers
-        queue wait + dispatch (+ compute, on synchronous backends)."""
+        queue wait + dispatch (+ compute, on synchronous backends).
+
+        Every job's outcome + latency reports through
+        `_on_replica_event` (the ReplicaSupervisor's feed) unless the
+        replica is quarantined — queued-before-quarantine stragglers and
+        canary probes must not double-count against or for it. The
+        `replica.dispatch` fault-injection point sits where a real chip
+        fault would land."""
         while True:
-            job = rep.work_q.get()
+            try:
+                job = rep.work_q.get(timeout=1.0)
+            except queue.Empty:
+                continue
             if job is None:
                 return
             x, pending, t0 = job
             t_start = time.perf_counter() if t0 is None else t0
+            # the canary the supervisor probes quarantined replicas
+            # with: the input is valid whatever the replica does to it
+            self._last_input = x
             try:
+                faults.fire("replica.dispatch", replica=rep.index,
+                            batch=rep.batches)
                 if self._aot:
                     ex = self._aot.get((rep.index, self._exec_sig(x)))
                     if ex is not None:
@@ -521,9 +574,27 @@ class InferenceModel:
                         out = self._jit(rep.params, x)
                 else:
                     out = self._jit(rep.params, x)
+                # the PREFERRED canary: an input a replica has actually
+                # handled successfully — probing with the most recent
+                # raw input alone would replay a poison batch forever
+                # and turn one bad input into an unrevivable pool
+                self._last_good_input = x
                 pending._fulfill(out, time.perf_counter() - t_start)
+                self._notify_replica(rep, True,
+                                     time.perf_counter() - t_start)
             except Exception as e:  # noqa: BLE001 — surfaces in result()
                 pending._fail(e)
+                self._notify_replica(rep, False,
+                                     time.perf_counter() - t_start)
+
+    def _notify_replica(self, rep: _Replica, ok: bool, latency_s: float):
+        cb = self._on_replica_event
+        if cb is None or rep.quarantined:
+            return
+        try:
+            cb(rep.index, ok, latency_s)
+        except Exception:  # noqa: BLE001 — supervision must never take
+            pass           # down the dispatch path it watches
 
     def close(self):
         """Retire the replica pool's worker threads (no-op otherwise).
@@ -541,7 +612,7 @@ class InferenceModel:
             self._replica_cv.notify_all()
         if reps:
             for rep in reps:
-                rep.work_q.put(None)
+                rep.work_q.put_nowait(None)
             for rep in reps:
                 if rep.thread is not None:
                     rep.thread.join(timeout=5)
@@ -567,7 +638,15 @@ class InferenceModel:
                     raise RuntimeError(
                         "replica pool closed while routing; stop the "
                         "serving engine before close()/load_fn()")
-                free = [r for r in reps
+                healthy = [r for r in reps if not r.quarantined]
+                if not healthy:
+                    # fail FAST, not after the 60s permit wait: the
+                    # caller (dispatch stage / frontend) owns the
+                    # park-or-503 decision
+                    raise NoHealthyReplicaError(
+                        f"all {len(reps)} replicas are quarantined; "
+                        "waiting on canary revival")
+                free = [r for r in healthy
                         if r.inflight < self.max_inflight_per_replica]
                 if free:
                     lo = min(r.inflight for r in free)
@@ -590,6 +669,131 @@ class InferenceModel:
             rep.inflight -= 1
             self._replica_cv.notify()
 
+    # -- quarantine / revival (driven by serving/supervisor.py) ------------
+    def quarantine_replica(self, index: int) -> bool:
+        """Pull one replica out of the routing set: the router stops
+        considering it, and every job still QUEUED on it (not yet picked
+        up by its worker) re-dispatches to the least-loaded healthy
+        replica with its in-flight permit transferred. The job the
+        worker is currently executing finishes (or fails) normally.
+        Idempotent; returns True when this call made the transition."""
+        with self._replica_cv:
+            reps = self._replicas
+            if reps is None or index >= len(reps):
+                return False
+            rep = reps[index]
+            if rep.quarantined:
+                return False
+            rep.quarantined = True
+            healthy = [r for r in reps if not r.quarantined]
+            moved = []
+            while True:
+                try:
+                    job = rep.work_q.get_nowait()
+                except queue.Empty:
+                    break
+                if job is None:
+                    # close() pill mid-quarantine: the worker must still
+                    # see it, and it carries no permit
+                    rep.work_q.put_nowait(job)
+                    break
+                moved.append(job)
+            for x, pending, t0 in moved:
+                target = min(healthy, key=lambda r: r.inflight) \
+                    if healthy else None
+                if target is not None and pending._rebind(
+                        target.index,
+                        lambda _r=target: self._release_replica(_r)):
+                    # permit transfer: the quarantined slot frees now,
+                    # the target's releases via the rebound callback
+                    rep.inflight -= 1
+                    target.inflight += 1
+                    target.batches += 1
+                    # t0 resets: charging the detour (queue wait on the
+                    # dead replica) to the healthy target's supervised
+                    # latency would read as an outlier and cascade the
+                    # quarantine across the pool
+                    target.work_q.put_nowait((x, pending,
+                                              time.perf_counter()))
+                else:
+                    # no healthy replica left (or the pending already
+                    # finished): fail it — the serving sink degrades the
+                    # batch to NaN and the OLD permit releases through
+                    # the pending's original callback
+                    pending._fail(NoHealthyReplicaError(
+                        "replica quarantined with no healthy peer to "
+                        "re-dispatch to"))
+            self._replica_cv.notify_all()
+            return True
+
+    def revive_replica(self, index: int) -> bool:
+        """Return a quarantined replica to the routing set (the
+        supervisor calls this after a successful canary probe)."""
+        with self._replica_cv:
+            reps = self._replicas
+            if reps is None or index >= len(reps) \
+                    or not reps[index].quarantined:
+                return False
+            reps[index].quarantined = False
+            self._replica_cv.notify_all()
+            return True
+
+    def healthy_replicas(self) -> int:
+        """Replicas currently accepting routed work (the whole model for
+        the single-device and sharded paths)."""
+        reps = self._replicas
+        if reps is None:
+            return self.num_replicas
+        with self._replica_cv:
+            return sum(1 for r in reps if not r.quarantined)
+
+    def quarantined_replicas(self) -> List[int]:
+        reps = self._replicas
+        if reps is None:
+            return []
+        with self._replica_cv:
+            return [r.index for r in reps if r.quarantined]
+
+    def probe_replica_async(self, index: int, x=None):
+        """Enqueue a canary batch on `index`'s worker (bypassing the
+        router — a quarantined replica still drains its queue) and
+        return the `_RoutedPending` WITHOUT waiting, or None when there
+        is nothing to probe with. `x` defaults to the most recent batch
+        any replica handled SUCCESSFULLY (falling back to the most
+        recent dispatched batch when no success ever happened — e.g.
+        every replica faulted from the first record): a poison input
+        must not become the only canary, or revival could never
+        succeed."""
+        reps = self._replicas
+        if reps is None or index >= len(reps):
+            return None
+        x = x if x is not None else (
+            self._last_good_input if self._last_good_input is not None
+            else self._last_input)
+        if x is None:
+            return None                # nothing credible to probe with
+        leaves = jax.tree_util.tree_leaves(x)
+        n = leaves[0].shape[0] if leaves and leaves[0].ndim > 0 else 1
+        pending = _RoutedPending(n, timer=None, replica=index)
+        reps[index].work_q.put_nowait((x, pending, None))
+        return pending
+
+    def probe_replica(self, index: int, x=None,
+                      timeout_s: float = 10.0) -> bool:
+        """Blocking canary probe: True iff the forward completes within
+        the budget — the revival signal. (The supervisor uses the async
+        variant so one wedged replica cannot stall the probe loop.)"""
+        pending = self.probe_replica_async(index, x)
+        if pending is None:
+            return False
+        if not pending._event.wait(timeout_s):
+            return False
+        try:
+            pending.result()
+        except Exception:  # noqa: BLE001 — a failing probe IS the signal
+            return False
+        return True
+
     def replica_inflight(self, index: int) -> int:
         """Routed-but-unmaterialized batches on one replica (live; 0 for
         the single-device and sharded paths)."""
@@ -607,7 +811,8 @@ class InferenceModel:
                               "replicated" else self.devices)]
         with self._replica_cv:
             return [{"replica": r.index, "device": str(r.device),
-                     "batches": r.batches, "inflight": r.inflight}
+                     "batches": r.batches, "inflight": r.inflight,
+                     "quarantined": r.quarantined}
                     for r in self._replicas]
 
     def placement_info(self) -> Dict[str, Any]:
@@ -711,7 +916,7 @@ class InferenceModel:
                         valid_n, timer=self.timer, replica=rep.index,
                         on_done=lambda rep=rep:
                             self._release_replica(rep))
-                    rep.work_q.put((x, pending, t0))
+                    rep.work_q.put_nowait((x, pending, t0))
                 return pending
             if self._batch_sharding is not None:
                 # sharded placement: split the (bucket-padded, so evenly
@@ -846,7 +1051,7 @@ class InferenceModel:
                 pending = _RoutedPending(b, timer=None, replica=rep.index)
                 # t0=None: the worker stamps its own start, so the report
                 # is per-(replica, bucket) compile+run, not queue wait
-                rep.work_q.put((batch, pending, None))
+                rep.work_q.put_nowait((batch, pending, None))
                 jobs.append((rep.index, b, pending))
         for idx, b, pending in jobs:
             pending.result()

@@ -46,6 +46,7 @@ loop — the baseline `bench_serving.py --concurrent` compares against.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import queue
@@ -58,10 +59,13 @@ import numpy as np
 from analytics_zoo_tpu.observability.registry import (MetricsRegistry,
                                                       get_registry)
 from analytics_zoo_tpu.observability.tracing import Tracer
+from analytics_zoo_tpu.serving.breaker import (BackoffPolicy, CircuitBreaker,
+                                               ResilientBroker)
 from analytics_zoo_tpu.serving.broker import (Broker, connect_broker,
                                               decode_ndarray, encode_ndarray,
                                               new_consumer_name)
 from analytics_zoo_tpu.serving.inference_model import (InferenceModel,
+                                                       NoHealthyReplicaError,
                                                        _next_bucket)
 from analytics_zoo_tpu.serving.timer import Timer
 
@@ -107,16 +111,56 @@ class ClusterServing:
                  pipelined: bool = True, decode_workers: int = 2,
                  queue_depth: int = 8,
                  registry: Optional[MetricsRegistry] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 supervise: bool = True,
+                 failure_threshold: int = 3,
+                 probe_interval_s: float = 0.5,
+                 latency_factor: float = 8.0,
+                 latency_floor_ms: float = 50.0,
+                 breaker_failure_threshold: int = 3,
+                 breaker_reset_s: float = 1.0,
+                 sink_buffer_batches: int = 256):
+        """Fault-tolerance knobs (ISSUE 5; the rest is PR 1-4 surface):
+        `supervise` starts a `ReplicaSupervisor` over a replica pool
+        (quarantine after `failure_threshold` consecutive failures or
+        `failure_threshold` latency outliers past `latency_factor`× the
+        pool median; canary-probe revival every `probe_interval_s`).
+        The engine's reader/sink broker connections wear a circuit
+        breaker (`breaker_*`), and failed sink writebacks buffer up to
+        `sink_buffer_batches` before the oldest is shed (shed records
+        stay unacked and redeliver)."""
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
+        self.registry = registry if registry is not None else get_registry()
         # the reader sits in a blocking read for up to ~50ms per cycle
         # and the sink writes results concurrently: on single-socket
         # transports each needs its own connection, and the caller's
-        # broker stays free for frontends/clients sharing it
-        self.reader_broker = self.broker.clone() if pipelined else self.broker
-        self.sink_broker = self.broker.clone() if pipelined else self.broker
+        # broker stays free for frontends/clients sharing it. Both wear
+        # a circuit breaker: a dead broker fast-fails instead of paying
+        # a connect timeout per pipeline cycle.
+        if pipelined:
+            # a caller may already hand us a ResilientBroker — wrap its
+            # INNER transport rather than double-wrapping (two breakers
+            # would fight and the broker.<op> fault points would fire
+            # twice per call)
+            base = self.broker.inner \
+                if isinstance(self.broker, ResilientBroker) else self.broker
+            self.reader_broker: Broker = ResilientBroker(
+                base.clone(), role="reader",
+                breaker=CircuitBreaker(
+                    "reader", failure_threshold=breaker_failure_threshold,
+                    reset_timeout_s=breaker_reset_s,
+                    registry=self.registry))
+            self.sink_broker: Broker = ResilientBroker(
+                base.clone(), role="sink",
+                breaker=CircuitBreaker(
+                    "sink", failure_threshold=breaker_failure_threshold,
+                    reset_timeout_s=breaker_reset_s,
+                    registry=self.registry))
+        else:
+            self.reader_broker = self.broker
+            self.sink_broker = self.broker
         self.stream = stream
         # e.g. "topN(5)" — the reference's PostProcessing filter grammar;
         # validated here so a bad spec fails at construction, not as
@@ -145,9 +189,28 @@ class ClusterServing:
         self.records_served = 0
         self.records_read = 0
         self._counter_lock = threading.Lock()
-        self.registry = registry if registry is not None else get_registry()
         self.tracer = tracer
+        # reconnect backoff for the reader loop (capped exponential with
+        # jitter — replaces the fixed 1s warn-loop)
+        self.reader_backoff = BackoffPolicy()
+        # failed sink writebacks, oldest first: (mapping, ids, t0, t_work)
+        # entries awaiting a live broker. Sink-thread-only; the registry
+        # gauge reads len() which is safe anywhere.
+        self.sink_buffer_batches = max(1, int(sink_buffer_batches))
+        self._wb_buffer: "collections.deque" = collections.deque()
+        self._sink_down = False
+        self.probe_interval_s = probe_interval_s
         self._wire_registry()
+        self.supervisor = None
+        if supervise and self._multi_replica:
+            from analytics_zoo_tpu.serving.supervisor import \
+                ReplicaSupervisor
+            self.supervisor = ReplicaSupervisor(
+                model, failure_threshold=failure_threshold,
+                latency_factor=latency_factor,
+                latency_floor_ms=latency_floor_ms,
+                probe_interval_s=probe_interval_s,
+                registry=self.registry)
 
     def _wire_registry(self):
         """Mirror the engine's private Timers into the process-wide
@@ -206,15 +269,52 @@ class ClusterServing:
             # frozen (not removed) on stop: post-run readers (the bench)
             # still see the drained depths
             self._gauge_installs.append((qd, fn, {"queue": q}, True))
+        # fault-tolerance telemetry (ISSUE 5)
+        self._reconnects = reg.counter(
+            "serving_broker_reconnects_total",
+            "successful broker reconnects after an outage, by role")
+        self._shed_records = reg.counter(
+            "serving_sink_shed_records_total",
+            "result records shed from the sink's writeback buffer at "
+            "its bound (unacked; the broker redelivers them)")
+        wb_gauge = reg.gauge(
+            "serving_sink_buffered_batches",
+            "writeback batches buffered while the broker is down (live)")
+        wb_fn = (lambda buf=self._wb_buffer: len(buf))
+        wb_gauge.set_function(wb_fn)
+        self._gauge_installs.append((wb_gauge, wb_fn, {}, True))
 
     def _enqueue(self, q: "queue.Queue", batch: _Batch):
         """Stamp the enqueue time (the consumer's queue-wait span starts
-        here — a blocking put under backpressure counts as wait) and put."""
+        here — a blocking put under backpressure counts as wait) and put.
+        The put blocks in bounded slices (the backpressure contract is
+        unchanged — drain still clears it) so a wedged consumer is a
+        visible timed loop, never an unbounded block."""
         batch.t_enq = time.perf_counter()
-        q.put(batch)
+        while True:
+            try:
+                q.put(batch, timeout=0.25)
+                return
+            except queue.Full:
+                continue
+
+    # -- health (frontend 503 gate + supervisor view) ----------------------
+    def healthy_replicas(self) -> Optional[int]:
+        """Replicas currently accepting work; None when the model has no
+        notion of health (a duck-typed model without the pool API)."""
+        fn = getattr(self.model, "healthy_replicas", None)
+        return fn() if callable(fn) else None
+
+    @property
+    def retry_after_s(self) -> int:
+        """What a 503 should tell clients: revival happens on the canary
+        probe cadence, so retrying sooner than that is wasted."""
+        return max(1, int(round(self.probe_interval_s + 0.5)))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ClusterServing":
+        if self.supervisor is not None:
+            self.supervisor.start()
         if self.pipelined:
             specs = [("serving-reader", self._reader_loop)]
             specs += [(f"serving-decode-{i}", self._decode_loop)
@@ -241,6 +341,10 @@ class ClusterServing:
         feeding it has exited, so work already read from the broker flows
         through to the sink before shutdown."""
         self._stop.set()
+        if self.supervisor is not None:
+            # first: a mid-drain revival would reshuffle routing under
+            # the draining dispatcher for no benefit
+            self.supervisor.stop()
         if not self.pipelined:
             for t in self._threads:
                 t.join(timeout=10)
@@ -323,11 +427,21 @@ class ClusterServing:
         # would hammer the broker with nil reads that contend with the
         # sink's writes and the clients' polls for the whole run
         idle_block = max(self.batch_timeout_ms, 50)
+        failures = 0
+        last_logged = None         # (breaker state) at last warning
         while not self._stop.is_set():
             try:
                 records = self.reader_broker.read_group(
                     self.stream, GROUP, self.consumer, self.batch_size,
                     block_ms=idle_block)
+                if failures:
+                    # back from an outage: ONE info line + the counter,
+                    # mirroring the one-warning-per-transition cap below
+                    self._reconnects.inc(role="reader")
+                    log.info("reader reconnected after %d failed "
+                             "attempt(s)", failures)
+                    failures = 0
+                    last_logged = None
                 if not records:
                     continue
                 if len(records) < self.batch_size \
@@ -335,21 +449,46 @@ class ClusterServing:
                     # straggler sweep: requests from concurrent clients
                     # land within ~ms of each other — waiting the SLO
                     # budget builds full batches (fewer pipeline units,
-                    # one forward and one writeback for more records)
-                    records += self.reader_broker.read_group(
-                        self.stream, GROUP, self.consumer,
-                        self.batch_size - len(records),
-                        block_ms=self.batch_timeout_ms)
+                    # one forward and one writeback for more records).
+                    # Its OWN failure domain: a broker that dies between
+                    # the main read and the sweep must not drop the
+                    # records already in hand into a redeliver loop
+                    try:
+                        records += self.reader_broker.read_group(
+                            self.stream, GROUP, self.consumer,
+                            self.batch_size - len(records),
+                            block_ms=self.batch_timeout_ms)
+                    except Exception as e:  # noqa: BLE001 — keep batch
+                        log.warning(
+                            "straggler sweep failed (%s: %s); "
+                            "continuing with %d record(s) in hand",
+                            type(e).__name__, e, len(records))
                 with self._counter_lock:
                     self.records_read += len(records)
                 self._records_total.inc(len(records), outcome="read")
-                self._decode_q.put((time.perf_counter(), records))
+                item = (time.perf_counter(), records)
+                while not self._stop.is_set():
+                    try:
+                        self._decode_q.put(item, timeout=0.25)
+                        break
+                    except queue.Full:
+                        continue
+                # stop while blocked: records stay unacked → redeliver
             except Exception as e:  # noqa: BLE001 — the Flink-restart role
-                # transient broker failures (redis stall/restart) must not
-                # kill the stage; brokers reconnect on next use
-                log.warning("reader cycle failed (%s: %s); retrying",
-                            type(e).__name__, e)
-                self._stop.wait(1.0)
+                # transient broker failures (redis stall/restart) must
+                # not kill the stage; the breaker owns fast-failing and
+                # the backoff paces reconnect attempts. Log spam is
+                # capped to one warning per breaker state transition.
+                failures += 1
+                breaker = getattr(self.reader_broker, "breaker", None)
+                state = breaker.state if breaker is not None else None
+                if state != last_logged:
+                    log.warning(
+                        "reader cycle failed (%s: %s); breaker %s, "
+                        "backing off", type(e).__name__, e,
+                        state or "n/a")
+                    last_logged = state
+                self._stop.wait(self.reader_backoff.delay(failures))
 
     # -- stage: decode -----------------------------------------------------
     def _decode_records(self, records):
@@ -382,7 +521,10 @@ class ClusterServing:
 
     def _decode_loop(self):
         while True:
-            item = self._decode_q.get()
+            try:
+                item = self._decode_q.get(timeout=1.0)
+            except queue.Empty:
+                continue               # exit is by pill, not timeout
             if item is _STOP:
                 return
             t0, records = item
@@ -414,7 +556,10 @@ class ClusterServing:
     # -- stage: dispatch ---------------------------------------------------
     def _dispatch_loop(self):
         while True:
-            batch = self._dispatch_q.get()
+            try:
+                batch = self._dispatch_q.get(timeout=1.0)
+            except queue.Empty:
+                continue               # exit is by pill, not timeout
             if batch is _STOP:
                 return
             tr = self.tracer
@@ -435,9 +580,20 @@ class ClusterServing:
                 stacked = np.stack(arrs)
                 batch.arrays = None
                 # async: returns before the device finishes — the
-                # sink materializes while we stack the next batch
-                batch.pending = self.model.predict_async(
-                    stacked, valid_n=n)
+                # sink materializes while we stack the next batch.
+                # With EVERY replica quarantined the router fails fast;
+                # the batch PARKS here (capacity loss, not correctness
+                # loss) until a canary revival — or NaN-degrades if the
+                # engine is stopping.
+                while True:
+                    try:
+                        batch.pending = self.model.predict_async(
+                            stacked, valid_n=n)
+                        break
+                    except NoHealthyReplicaError:
+                        if self._stop.is_set():
+                            raise
+                        self._stop.wait(0.05)
                 t_end = time.perf_counter()
                 self.dispatch_timer.record(t_end - t_work)
                 replica = getattr(batch.pending, "replica", 0)
@@ -483,11 +639,18 @@ class ClusterServing:
             batch = None
             try:
                 if not (waiting or stop_seen):
-                    batch = self._sink_q.get()      # idle: block
+                    # idle: block in bounded slices so buffered
+                    # writebacks still get flush attempts while no new
+                    # work arrives (a broker that comes back during a
+                    # quiet period must not wait for the next request)
+                    batch = self._sink_q.get(timeout=0.1)
                 elif stop_seen or len(waiting) < cap:
                     batch = self._sink_q.get_nowait()
             except queue.Empty:
-                pass
+                if self._wb_buffer:
+                    self._flush_writebacks()
+                if not (waiting or stop_seen):
+                    continue
             if batch is not None:
                 if batch is _STOP:
                     stop_seen = True
@@ -519,36 +682,99 @@ class ClusterServing:
                 waiting.remove(b)
                 self._sink_one(b)
             if stop_seen and not waiting:
+                # one last flush: results computed during an outage
+                # land if the broker is back; the rest stay unacked
+                # for redelivery after restart
+                if self._wb_buffer:
+                    self._flush_writebacks()
+                    if self._wb_buffer:
+                        log.warning(
+                            "stopping with %d writeback batch(es) "
+                            "still unflushed; their records are "
+                            "unacked and will redeliver",
+                            len(self._wb_buffer))
                 return
             if waiting and not ready:
                 time.sleep(0.0005)     # all in flight; poll done() soon
 
     def _sink_one(self, batch: _Batch):
-        tr = self.tracer
+        """Materialize one batch, then write back — or buffer the
+        writeback when the broker is down. Materialization errors
+        degrade to "NaN" inside `_materialize`; from here on the only
+        failure mode is the broker, and the buffer owns that."""
+        t_work = batch.t_enq
+        values = self._materialize(batch)
+        entry = (dict(zip(batch.uris, values)), list(batch.ids),
+                 batch.t0, t_work)
+        if self._wb_buffer:
+            # keep writeback order: flush the backlog first, and if any
+            # of it still can't go out, queue behind it
+            self._flush_writebacks()
+        if self._wb_buffer or not self._write_entry(entry):
+            self._buffer_writeback(entry)
+
+    def _write_entry(self, entry) -> bool:
+        """One batched writeback + ack; False (no raise) on a broker
+        failure. Counters/timers record only on success — a buffered
+        batch records its FULL latency (outage included) when it
+        finally lands."""
+        mapping, ids, t0, t_work = entry
         try:
-            t_work = batch.t_enq
-            values = self._materialize(batch)
             # ONE pipelined broker write for the whole batch,
             # then one batched ack — 2 round trips, not N+1
-            self.sink_broker.hset_many(
-                self.result_key, dict(zip(batch.uris, values)))
-            self.sink_broker.ack(self.stream, GROUP, batch.ids)
-            t_end = time.perf_counter()
-            self.sink_timer.record(t_end - t_work)
-            if tr is not None:
-                # includes the device wait inside _materialize — the
-                # only blocking readback in the pipeline
-                tr.add_span("sink", t_work, t_end,
-                            trace_ids=batch.uris)
-            with self._counter_lock:
-                self.records_served += len(batch.uris)
-            self._records_total.inc(len(batch.uris), outcome="served")
-            self.batch_timer.record(t_end - batch.t0)
-        except Exception as e:  # noqa: BLE001 — no ack → the broker
-            # redelivers after its pending window (at-least-once)
-            log.error("sink writeback failed for %d records (%s: %s); "
-                      "leaving unacked for redelivery",
-                      len(batch.uris), type(e).__name__, e)
+            self.sink_broker.hset_many(self.result_key, mapping)
+            self.sink_broker.ack(self.stream, GROUP, ids)
+        except Exception as e:  # noqa: BLE001 — the buffer owns retries
+            if not self._sink_down:
+                # one warning per outage, not per batch (the breaker
+                # logs its own transitions)
+                log.warning(
+                    "sink writeback failed for %d records (%s: %s); "
+                    "buffering until the broker returns",
+                    len(mapping), type(e).__name__, e)
+                self._sink_down = True
+            return False
+        t_end = time.perf_counter()
+        self.sink_timer.record(t_end - t_work)
+        if self.tracer is not None:
+            # includes the device wait inside _materialize — the
+            # only blocking readback in the pipeline
+            tr_ids = list(mapping)
+            self.tracer.add_span("sink", t_work, t_end,
+                                 trace_ids=tr_ids)
+        with self._counter_lock:
+            self.records_served += len(mapping)
+        self._records_total.inc(len(mapping), outcome="served")
+        self.batch_timer.record(t_end - t0)
+        return True
+
+    def _buffer_writeback(self, entry):
+        """Bounded: past `sink_buffer_batches` the OLDEST entry is shed
+        and counted — its records were never acked, so the broker
+        redelivers them after its pending window (duplicate work, never
+        loss)."""
+        self._wb_buffer.append(entry)
+        while len(self._wb_buffer) > self.sink_buffer_batches:
+            shed = self._wb_buffer.popleft()
+            self._shed_records.inc(len(shed[0]))
+            log.warning(
+                "sink buffer overflow: shed a writeback of %d records "
+                "(unacked; the broker will redeliver)", len(shed[0]))
+
+    def _flush_writebacks(self):
+        """Drain the buffered writebacks in order; stops at the first
+        entry the broker still refuses (the breaker makes that a fast
+        fail while the circuit is open)."""
+        flushed = False
+        while self._wb_buffer:
+            if not self._write_entry(self._wb_buffer[0]):
+                return
+            self._wb_buffer.popleft()
+            flushed = True
+        if flushed and self._sink_down:
+            self._sink_down = False
+            self._reconnects.inc(role="sink")
+            log.info("sink reconnected; buffered writebacks flushed")
 
     def _materialize(self, batch) -> List[str]:
         """Per-record encoded result strings for a batch; inference
@@ -642,6 +868,13 @@ class ClusterServing:
                     else:
                         value = json.dumps(encode_ndarray(np.asarray(pred)))
                     self.broker.hset(self.result_key, uri, value)
+            except NoHealthyReplicaError:
+                # transient whole-pool quarantine: park via redelivery
+                # (serve_once never acks this read) — NaN-acking every
+                # record through the outage would turn lost CAPACITY
+                # into lost correctness, the opposite of the
+                # quarantine contract
+                raise
             except Exception as e:  # noqa: BLE001 — stream must survive
                 log.error("inference failure for batch %s: %s", shape, e)
                 for _rid, uri, _ in items:
@@ -671,6 +904,15 @@ class ClusterServing:
                                           "replicated") == "sharded":
             m["placement"] = self.model.placement_info()
             m["replicas"] = self.model.replica_stats()
+        ft = {"sink_buffered_batches": len(self._wb_buffer)}
+        for role, br in (("reader", self.reader_broker),
+                         ("sink", self.sink_broker)):
+            breaker = getattr(br, "breaker", None)
+            if breaker is not None:
+                ft[f"breaker_{role}"] = breaker.state
+        if self.supervisor is not None:
+            ft["supervisor"] = self.supervisor.stats()
+        m["fault_tolerance"] = ft
         size_fn = getattr(self.model, "compile_cache_size", None)
         if size_fn is not None:
             # per-(replica, bucket) executable count, plus persistent-

@@ -340,6 +340,26 @@ def main():
                     wire + r2["serving_device_forward_p50_ms"], 2)
         else:
             out["serving_device_forward_p50_ms"] = None
+        # chaos run (ISSUE 5): replica crash + slow replica + broker
+        # outage against a live engine — quarantine detection time,
+        # accepted-record loss (must be 0), post-recovery throughput
+        if os.environ.get("BENCH_CHAOS", "1") == "1":
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            r3, _ = _run_sub([sys.executable,
+                              os.path.join(here, "bench_serving.py"),
+                              "--chaos"],
+                             timeout=900, env=env)
+            if r3:
+                out["serving_chaos_record_loss"] = r3.get("value")
+                for key in ("quarantine_detect_s", "quarantine_revive_s",
+                            "slow_quarantine_detect_s",
+                            "broker_outage_nans", "shed_records",
+                            "post_recovery_ratio"):
+                    if r3.get(key) is not None:
+                        out["serving_chaos_" + key] = r3.get(key)
+            else:
+                out["serving_chaos_record_loss"] = None
 
     print(json.dumps(out))
 

@@ -47,6 +47,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import jax
@@ -402,6 +403,187 @@ def _multidevice_main(args) -> int:
     return 0
 
 
+# -- chaos: fault injection against a live engine (ISSUE 5) ----------------
+
+def _chaos_summary(n_devices: int = 4, batch_size: int = 4) -> dict:
+    """Drive the fault-tolerance layer with real faults and measure what
+    an operator cares about: how fast a bad replica is quarantined, how
+    fast it revives, whether a broker outage loses accepted records, and
+    how much throughput survives after recovery.
+
+    Acceptance (ISSUE 5): zero accepted-record loss, quarantine
+    detection under 2 s, post-recovery drain throughput within 10% of
+    the no-fault baseline."""
+    from analytics_zoo_tpu.common import faults
+    from analytics_zoo_tpu.serving.broker import MemoryBroker
+    from analytics_zoo_tpu.serving.client import RESULT_KEY, InputQueue
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    fn, W, sample = _md_model(width=128, iters=8)
+    im = InferenceModel(num_replicas=n_devices).load_fn(fn, W)
+    im.warmup(sample,
+              buckets=[b for b in im.buckets if b <= batch_size]
+              or im.buckets[:1])
+    broker = MemoryBroker(redeliver_after_s=2.0)
+    serving = ClusterServing(
+        im, broker=broker, batch_size=batch_size, batch_timeout_ms=2,
+        failure_threshold=3, probe_interval_s=0.1, latency_factor=6.0,
+        breaker_failure_threshold=2, breaker_reset_s=0.1).start()
+    inq = InputQueue(broker)
+
+    def collect(n, deadline_s=120.0, t0=None):
+        """Wait for n results; returns (got, nans, seconds)."""
+        t0 = time.perf_counter() if t0 is None else t0
+        got = nans = 0
+        deadline = time.time() + deadline_s
+        while got < n and time.time() < deadline:
+            allr = broker.hgetall(RESULT_KEY)
+            if allr:
+                broker.hdel_many(RESULT_KEY, list(allr))
+                got += len(allr)
+                nans += sum(1 for v in allr.values() if v == "NaN")
+            else:
+                time.sleep(0.002)
+        return got, nans, time.perf_counter() - t0
+
+    from analytics_zoo_tpu.serving.broker import encode_ndarray
+    encoded = encode_ndarray(np.asarray(sample))
+
+    def drain_rps(total=400):
+        # engine-limited: the record payload is pre-encoded ONCE and
+        # xadd'd raw, so the submit loop costs ~µs/record and the clock
+        # (from first submit to last result) measures the ENGINE, not a
+        # b64-encoding client contending for the same two cores
+        import uuid
+        t0 = time.perf_counter()
+        for _ in range(total):
+            broker.xadd(serving.stream,
+                        {"uri": uuid.uuid4().hex, "data": {"t": encoded}})
+        got, _nans, _dt = collect(total, t0=t0)
+        return got / max(time.perf_counter() - t0, 1e-9)
+
+    def feed_until(cond, timeout_s=20.0):
+        """Steady singles until cond(); returns (elapsed or None, fed)."""
+        t0 = time.monotonic()
+        fed = 0
+        while time.monotonic() - t0 < timeout_s:
+            inq.enqueue(t=sample)
+            fed += 1
+            if cond():
+                return time.monotonic() - t0, fed
+            time.sleep(0.005)
+        return None, fed
+
+    def wait_healthy(n, timeout_s=30.0):
+        t0 = time.monotonic()
+        while im.healthy_replicas() < n:
+            if time.monotonic() - t0 > timeout_s:
+                return None
+            time.sleep(0.01)
+        return time.monotonic() - t0
+
+    out = {"metric": "serving_chaos_record_loss", "unit": "records",
+           "replicas": n_devices, "host_cores": os.cpu_count() or 1}
+
+    # -- no-fault baseline (best of 3: single runs on a loaded 2-core
+    # host swing ±2x one-sided; the max filters scheduler noise, same
+    # estimator as multidevice_summary) ------------------------------------
+    drain_rps()            # discarded: thread/executable warm-up drain
+    baseline = max(drain_rps() for _ in range(3))
+
+    # -- phase 1: replica crash → quarantine → revival ---------------------
+    faults.inject("replica.dispatch",
+                  faults.Fault(match=lambda c: c["replica"] == 1))
+    detect_s, fed = feed_until(
+        lambda: im.healthy_replicas() < n_devices)
+    _got, crash_nans, _ = collect(fed, deadline_s=60)
+    faults.clear("replica.dispatch")
+    revive_s = wait_healthy(n_devices)
+    out["quarantine_detect_s"] = round(detect_s, 3) if detect_s else None
+    out["quarantine_revive_s"] = round(revive_s, 3) \
+        if revive_s is not None else None
+    out["crash_nan_results"] = crash_nans   # pre-quarantine degradations
+
+    # -- phase 2: slow replica → latency-outlier quarantine ----------------
+    faults.inject("replica.dispatch",
+                  faults.Fault(mode="stall", delay_s=0.25,
+                               match=lambda c: c["replica"] == 2))
+    slow_s, fed = feed_until(
+        lambda: im.healthy_replicas() < n_devices, timeout_s=30.0)
+    collect(fed, deadline_s=60)
+    faults.clear("replica.dispatch")
+    wait_healthy(n_devices)
+    out["slow_quarantine_detect_s"] = round(slow_s, 3) if slow_s else None
+
+    # -- phase 3: broker outage → buffered writebacks, zero loss -----------
+    from analytics_zoo_tpu.observability import get_registry
+    shed = get_registry().get("serving_sink_shed_records_total")
+    shed_before = shed.value() if shed else 0.0
+    n_outage = 80
+    for _ in range(30):
+        inq.enqueue(t=sample)
+    outage = faults.Fault(match=lambda c: c["role"] in ("reader", "sink"))
+    faults.inject("broker.read_group", outage)
+    faults.inject("broker.hset_many", outage)
+    faults.inject("broker.ack", outage)
+    threading.Timer(1.0, lambda: (faults.clear("broker.read_group"),
+                                  faults.clear("broker.hset_many"),
+                                  faults.clear("broker.ack"))).start()
+    for _ in range(n_outage - 30):
+        inq.enqueue(t=sample)
+        time.sleep(0.002)
+    got, outage_nans, _ = collect(n_outage, deadline_s=90)
+    faults.clear()
+    out["value"] = n_outage - got            # record loss — must be 0
+    out["target"] = 0
+    out["vs_baseline"] = 1.0 if got == n_outage else 0.0
+    out["broker_outage_records"] = n_outage
+    out["broker_outage_nans"] = outage_nans
+    out["shed_records"] = round(
+        (shed.value() if shed else 0.0) - shed_before, 1)
+
+    # -- phase 4: post-recovery throughput (same best-of-3 estimator) ------
+    post = max(drain_rps() for _ in range(3))
+    out["baseline_drain_rps"] = round(baseline, 1)
+    out["post_recovery_drain_rps"] = round(post, 1)
+    out["post_recovery_ratio"] = round(post / max(baseline, 1e-9), 3)
+    out["post_recovery_target"] = ">=0.9"
+
+    serving.stop()
+    im.close()
+    return out
+
+
+def _chaos_main(args) -> int:
+    """`--chaos`: run `_chaos_summary` on a >=4-device platform,
+    re-execing into a forced-host CPU child when needed (same pattern as
+    `--devices`)."""
+    n = max(4, getattr(args, "devices", None) or 4)
+    if len(jax.devices()) < n \
+            and os.environ.get("_ZOO_CHAOS_BENCH_CHILD") != "1":
+        env = dict(os.environ)
+        env["_ZOO_CHAOS_BENCH_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)   # hermetic CPU child
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={n}").strip()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--chaos"],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=1800)
+        return proc.returncode
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    init_orca_context(cluster_mode="local")
+    summary = _chaos_summary(n)
+    stop_orca_context()
+    print(json.dumps(summary))
+    return 0
+
+
 # -- cold start: persistent compile cache across process restarts ----------
 
 def _cold_start_child(args) -> int:
@@ -713,6 +895,11 @@ def main():
                          "scaling over N (forced-host) devices")
     ap.add_argument("--total", type=int, default=256,
                     help="backlog size for the multi-device drain")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos mode: replica crash + slow replica + "
+                         "broker outage against a live 4-replica engine; "
+                         "reports quarantine detection/revival time, "
+                         "record loss, and post-recovery throughput")
     ap.add_argument("--cold-start", action="store_true",
                     help="cold-start mode: launch a child server twice "
                          "(cache-cold, cache-warm) against one persistent "
@@ -723,6 +910,8 @@ def main():
                     help="cache dir for --cold-start (default: throwaway "
                          "temp dir)")
     args = ap.parse_args()
+    if args.chaos:
+        return _chaos_main(args)
     if args.devices:
         return _multidevice_main(args)
     if args.cold_start_child:

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Static lint for unbounded blocking calls (ISSUE 5 satellite; tier-1
+via tests/test_fault_tolerance.py).
+
+A fault-tolerant serving engine must never block forever: a wedged
+queue peer or a dead socket has to surface as a timeout some layer can
+act on (backoff, quarantine, drain). This lint enforces that statically
+over `analytics_zoo_tpu/serving/`:
+
+- `Queue.get()` with no arguments (an indefinite block) is banned —
+  use `get(timeout=...)` in a loop, or `get_nowait()`. A no-argument
+  `.get()` can only be a queue (dict.get needs a key), so the check is
+  precise.
+- `.put(...)` without a `timeout=` keyword is banned unless it is
+  `put_nowait`. (`device_put`/`_put` helpers do not match the `.put(`
+  spelling.)
+- `.join()` with no timeout is banned (`"sep".join(...)` always has an
+  argument, so only thread/process joins match).
+- `socket.create_connection(...)` must pass `timeout=`.
+
+And over the WHOLE `analytics_zoo_tpu/` package:
+
+- bare `except:` is banned everywhere (it swallows KeyboardInterrupt
+  and SystemExit — a hung shutdown is a fault-tolerance bug).
+
+A line may opt out with a trailing `# blocking-ok: <reason>` comment;
+the reason is mandatory so the waiver documents itself.
+
+    python scripts/check_blocking_calls.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+SERVING_PKG = os.path.join("analytics_zoo_tpu", "serving")
+WHOLE_PKG = "analytics_zoo_tpu"
+
+ALLOW_RE = re.compile(r"#\s*blocking-ok:\s*\S")
+BARE_EXCEPT_RE = re.compile(r"^\s*except\s*:", re.MULTILINE)
+GET_NOARG_RE = re.compile(r"\.get\(\s*\)")
+JOIN_NOARG_RE = re.compile(r"\.join\(\s*\)")
+PUT_RE = re.compile(r"\.put\(")
+CONNECT_RE = re.compile(r"\bcreate_connection\s*\(")
+
+
+def _call_slice(src: str, open_paren: int) -> str:
+    """The argument text of the call whose '(' sits at `open_paren`,
+    respecting nested parens/brackets (multi-line calls included)."""
+    depth = 0
+    for i in range(open_paren, len(src)):
+        c = src[i]
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+            if depth == 0:
+                return src[open_paren + 1:i]
+    return src[open_paren + 1:]
+
+
+def _line_of(src: str, pos: int) -> int:
+    return src.count("\n", 0, pos) + 1
+
+
+def _line_text(src: str, pos: int) -> str:
+    start = src.rfind("\n", 0, pos) + 1
+    end = src.find("\n", pos)
+    return src[start:end if end != -1 else len(src)]
+
+
+def _allowed(src: str, pos: int) -> bool:
+    return bool(ALLOW_RE.search(_line_text(src, pos)))
+
+
+def check_file(path: str, serving: bool) -> List[str]:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    errors = []
+
+    for m in BARE_EXCEPT_RE.finditer(src):
+        if not _allowed(src, m.start()):
+            errors.append(f"{path}:{_line_of(src, m.start())}: bare "
+                          "'except:' (catches KeyboardInterrupt/"
+                          "SystemExit; name the exception)")
+    if not serving:
+        return errors
+
+    for m in GET_NOARG_RE.finditer(src):
+        if not _allowed(src, m.start()):
+            errors.append(
+                f"{path}:{_line_of(src, m.start())}: '.get()' with no "
+                "timeout blocks forever; use get(timeout=...) in a loop "
+                "or get_nowait()")
+    for m in JOIN_NOARG_RE.finditer(src):
+        if not _allowed(src, m.start()):
+            errors.append(
+                f"{path}:{_line_of(src, m.start())}: '.join()' with no "
+                "timeout can hang shutdown; pass join(timeout=...)")
+    for m in PUT_RE.finditer(src):
+        # `put_nowait(` never matches `.put(`; this is a plain `.put(`
+        args = _call_slice(src, m.end() - 1)
+        if "timeout" not in args and not _allowed(src, m.start()):
+            errors.append(
+                f"{path}:{_line_of(src, m.start())}: '.put(...)' without "
+                "timeout= blocks forever on a full queue; bound it (or "
+                "use put_nowait on unbounded queues)")
+    for m in CONNECT_RE.finditer(src):
+        args = _call_slice(src, m.end() - 1)
+        if "timeout" not in args and not _allowed(src, m.start()):
+            errors.append(
+                f"{path}:{_line_of(src, m.start())}: create_connection "
+                "without timeout= hangs on an unreachable host")
+    return errors
+
+
+def iter_py(root: str) -> List[str]:
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        out.extend(os.path.join(dirpath, f) for f in files
+                   if f.endswith(".py"))
+    return sorted(out)
+
+
+def check(repo_root: str = ".") -> Tuple[List[str], int]:
+    serving_root = os.path.join(repo_root, SERVING_PKG)
+    pkg_root = os.path.join(repo_root, WHOLE_PKG)
+    errors: List[str] = []
+    n = 0
+    for path in iter_py(pkg_root):
+        in_serving = os.path.abspath(path).startswith(
+            os.path.abspath(serving_root) + os.sep)
+        errors.extend(check_file(path, serving=in_serving))
+        n += 1
+    return errors, n
+
+
+def main(argv=None) -> int:
+    root = (argv or ["."])[0] if argv else "."
+    errors, n = check(root)
+    for e in errors:
+        print(e)
+    if errors:
+        print(f"{len(errors)} blocking-call violation(s)")
+        return 1
+    print(f"blocking calls OK ({n} files checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -56,6 +56,13 @@ REQUIRED = {
     "serving_records_total": "counter",
     "serving_stage_ms": "histogram",
     "training_steps_total": "counter",
+    # fault-tolerance layer (ISSUE 5): the failure-matrix metrics the
+    # docs table and the chaos bench read
+    "serving_replica_quarantined_total": "counter",
+    "serving_replica_revivals_total": "counter",
+    "serving_broker_breaker_state": "gauge",
+    "training_resumes_total": "counter",
+    "training_step_retries_total": "counter",
 }
 
 
