@@ -21,7 +21,8 @@ import time
 from typing import Any, Callable, Dict, Set, Tuple
 
 from analytics_zoo_tpu.compile_cache import serialization
-from analytics_zoo_tpu.compile_cache.key import abstract_signature, make_key
+from analytics_zoo_tpu.compile_cache.key import (abstract_signature,
+                                                 cheap_signature, make_key)
 
 log = logging.getLogger("analytics_zoo_tpu.compile_cache")
 
@@ -45,17 +46,15 @@ class AOTFunctionCache:
 
     @staticmethod
     def _cheap_sig(args) -> Tuple:
-        """Steady-state dispatch key: per-leaf shape/dtype only. The
-        full canonical `abstract_signature` (structure walk + per-key
-        regex) runs ONCE per new shape in `_build`; paying it per
-        training step would tax exactly the hot loop this cache
-        exists to speed up. Leaf shapes are discriminating here
-        because one wrapper serves one fixed (model, optimizer) —
-        arg STRUCTURE can't change under it, only batch shapes."""
-        import jax
-        return tuple((tuple(l.shape), l.dtype.name)
-                     if hasattr(l, "shape") else (type(l).__name__,)
-                     for l in jax.tree_util.tree_leaves(args))
+        """Steady-state dispatch key: per-leaf shape/dtype only (the
+        shared `key.cheap_signature`). The full canonical
+        `abstract_signature` (structure walk + per-key regex) runs ONCE
+        per new shape in `_build`; paying it per training step would
+        tax exactly the hot loop this cache exists to speed up. Leaf
+        shapes are discriminating here because one wrapper serves one
+        fixed (model, optimizer) — arg STRUCTURE can't change under it,
+        only batch shapes."""
+        return cheap_signature(args)
 
     def __call__(self, *args):
         csig = self._cheap_sig(args)
@@ -116,6 +115,13 @@ class AOTFunctionCache:
             self._failed.add(csig)
             self.sources[csig] = "jit"
             return None
+
+    def executables(self) -> Dict[Tuple, Any]:
+        """Live AOT executables by cheap signature — the roofline layer
+        harvests `cost_analysis()` from these (a deserialized executable
+        still answers it), so a cache-hit re-run gets utilization gauges
+        without ever lowering."""
+        return dict(self._execs)
 
     # the trainer's step-cache memo compares wrapped identity
     @property

@@ -84,6 +84,16 @@ class _TrainingMetrics:
             self.mfu.set(flops_per_step * steps / max(dt, 1e-9) / peak)
         return step_ms
 
+    def roofline(self, flops: float, bytes_: float, dt: float):
+        """Cost-analysis roofline for one epoch (ISSUE 6): publishes
+        `roofline_mfu{kind="train"}` / `roofline_hbm_utilization` etc.
+        from the XLA-counted FLOPs/bytes over the epoch's device wall
+        time — no hand-supplied flops_per_step, and HBM utilization
+        against the measured session roofline."""
+        from analytics_zoo_tpu.observability.roofline import get_accountant
+        get_accountant().account("train", flops, bytes_, dt,
+                                 device=jax.devices()[0])
+
 
 # ---------------------------------------------------------------------------
 # Data plumbing: numpy structures -> shard-ready batches
@@ -242,6 +252,132 @@ def _step_with_watchdog(step_fn, args, retries: int,
             log.warning(
                 "training step %d failed (%s: %s); retry %d/%d",
                 iteration, type(e).__name__, e, attempts, retries)
+
+
+class _StepCostTracker:
+    """Per-fit accumulation of XLA cost-analysis FLOPs/bytes for the
+    live train step (ISSUE 6 roofline). Two-phase per distinct argument
+    signature:
+
+    - `before(args)` (pre-dispatch): memo hit → accumulate; miss →
+      record the signature as pending with a ShapeDtypeStruct skeleton
+      (shape/dtype/sharding — the only parts lowering needs, and the
+      only parts safe to keep once the call donates the buffers).
+    - `after()` (post-dispatch): resolve pending signatures — prefer
+      `cost_analysis()` straight off the executable the call just built
+      (an `AOTFunctionCache` exposes it via `executables()`, so a warm
+      AOT re-run never lowers at all); plain-jit steps fall back to one
+      lowering of the SDS skeleton, which costs a trace but no compile.
+
+    Any failure marks the signature un-costed and the roofline gauges
+    simply stay absent — never an error in the hot loop. `memo` is the
+    per-train-step sub-dict of the model's cost memo, selected by the
+    SAME cache key the trainer's step cache uses (`id()`-keying the
+    step object would resurrect a stale program's cost after CPython
+    address reuse), so warm restarts and repeated bench fits never
+    re-harvest.
+
+    Units: XLA's cost analysis visits a While body ONCE (a k-step
+    `lax.scan` run program and the whole-epoch device-cache program
+    both report ≈ one step's flops/bytes — verified on this backend),
+    and the single-step program trivially reports one step's. So the
+    accumulated `flops`/`bytes` are PER-STEP costs × `calls`; the
+    epoch accounting in `fit_keras` scales the per-call mean by the
+    epoch's iteration count, which is exact for every program shape."""
+
+    def __init__(self, train_step, memo: Dict):
+        self._step = train_step
+        self._memo = memo
+        self._pending: Dict[Tuple, Any] = {}   # sig -> (sds_args, calls)
+        self.flops = 0.0
+        self.bytes = 0.0
+        self.calls = 0
+
+    def reset_epoch(self):
+        self.flops = 0.0
+        self.bytes = 0.0
+        self.calls = 0
+
+    @staticmethod
+    def _sig(args) -> Tuple:
+        from analytics_zoo_tpu.compile_cache.key import cheap_signature
+        return cheap_signature(args)
+
+    @staticmethod
+    def _skeleton(args):
+        """Avals of the live args, for a post-donation lowering
+        fallback. Shardings are carried only for MULTI-device leaves
+        (mesh-sharded params/batches — they change the program); a
+        single-device leaf stays unconstrained, because pinning e.g.
+        the rng key's device-0 placement next to 8-device params makes
+        jit.lower reject the skeleton as incompatible devices, where
+        the live (uncommitted) array resolved fine."""
+        def sds(a):
+            if not hasattr(a, "shape"):
+                return a
+            sharding = getattr(a, "sharding", None)
+            try:
+                multi = sharding is not None \
+                    and len(sharding.device_set) > 1
+            except Exception:  # noqa: BLE001 — exotic sharding object
+                multi = False
+            if multi:
+                try:
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                sharding=sharding)
+                except TypeError:   # jax without the sharding kwarg
+                    pass
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return jax.tree_util.tree_map(sds, args)
+
+    def _accumulate(self, cost, calls=1):
+        if cost is not None:
+            self.flops += cost.flops * calls
+            self.bytes += cost.bytes * calls
+            self.calls += calls
+
+    def before(self, args):
+        try:
+            key = self._sig(args)
+            if key in self._memo:
+                self._accumulate(self._memo[key])
+                return
+            entry = self._pending.get(key)
+            if entry is not None:
+                entry[1] += 1
+            else:
+                self._pending[key] = [self._skeleton(args), 1]
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    def after(self):
+        if not self._pending:
+            return
+        try:
+            pending, self._pending = self._pending, {}
+            for key, (sds_args, calls) in pending.items():
+                if key not in self._memo:
+                    self._memo[key] = self._harvest(key, sds_args)
+                self._accumulate(self._memo[key], calls)
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            log.debug("step cost harvest failed: %s: %s",
+                      type(e).__name__, e)
+
+    def _harvest(self, sig, sds_args):
+        from analytics_zoo_tpu.observability.roofline import cost_of
+        step = self._step
+        try:
+            execs_fn = getattr(step, "executables", None)
+            if execs_fn is not None:
+                cost = cost_of(execs_fn().get(sig))
+                if cost is not None:
+                    return cost
+            fn = getattr(step, "wrapped", step)
+            return cost_of(fn.lower(*sds_args))
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            log.debug("step cost harvest failed: %s: %s",
+                      type(e).__name__, e)
+            return None
 
 
 class _Prefetcher:
@@ -617,7 +753,9 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               compile_cache_dir: Optional[str] = None,
               auto_resume: bool = False,
               step_retries: int = 0,
-              step_timeout_s: Optional[float] = None
+              step_timeout_s: Optional[float] = None,
+              profile_steps: Optional[Tuple[int, int]] = None,
+              profile_dir: Optional[str] = None
               ) -> Dict[str, List[float]]:
     """`KerasNet.fit` backend. Returns a Keras-style history dict.
     `batch_iter_factory(epoch) -> iterator of (xb, yb, real)` overrides the
@@ -655,6 +793,15 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     re-lowering and re-compiling; JAX's built-in persistent cache
     (`jax_compilation_cache_dir`, under `<dir>/xla`) is enabled as the
     fallback layer for any shape AOT serialization can't carry.
+    `profile_steps=(start, stop)` wraps iterations [start, stop) in a
+    bounded `jax.profiler` capture (`observability/capture.py`): the
+    trace artifact lands in a rotated dir under `profile_dir` (or
+    `$ZOO_PROFILE_DIR`, default ./zoo_profiles) and its path is
+    appended to `history["profile_artifacts"]`. Cost-analysis roofline
+    gauges (`roofline_mfu{kind="train"}`,
+    `roofline_hbm_utilization{kind="train"}` — no flops_per_step
+    needed) publish automatically each epoch; set `ZOO_ROOFLINE=0` to
+    skip the one-time per-signature lowering they cost.
     `auto_resume=True` (needs `model.set_checkpoint(...)`) scans the
     checkpoint root for the newest INTACT epoch-boundary checkpoint
     before training and continues from it: params, optimizer state,
@@ -938,13 +1085,83 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if resume_meta is not None:
         telemetry.resumes.inc()
 
+    # cost-analysis roofline (ISSUE 6): XLA-counted FLOPs/bytes per step
+    # signature, accounted per epoch — the MFU/HBM gauges without a
+    # hand-supplied flops_per_step
+    cost_tracker = None
+    if os.environ.get("ZOO_ROOFLINE", "1") != "0":
+        memo_root = getattr(model, "_roofline_cost_memo", None)
+        if memo_root is None:
+            memo_root = model._roofline_cost_memo = {}
+        # sub-dict per train-step program, under the SAME cache_key the
+        # step cache memoizes on: two fits that share an executable
+        # share harvested costs, two that don't cannot alias
+        cost_tracker = _StepCostTracker(train_step,
+                                        memo_root.setdefault(cache_key, {}))
+        try:
+            from analytics_zoo_tpu.observability.roofline import \
+                get_accountant
+            get_accountant().reset("train")
+        except Exception:  # noqa: BLE001 — telemetry only
+            cost_tracker = None
+
+    # on-demand profiler window (ISSUE 6): capture iterations
+    # [start, stop) into a bounded, rotated artifact dir
+    profiler = None
+    profile_state = {"active": False, "done": False}
+    if profile_steps is not None:
+        p_start, p_stop = (int(profile_steps[0]), int(profile_steps[1]))
+        if not (0 <= p_start < p_stop):
+            raise ValueError(
+                f"profile_steps={profile_steps!r} must be (start, stop) "
+                "with 0 <= start < stop")
+        from analytics_zoo_tpu.observability.capture import ProfileCapture
+        profiler = ProfileCapture(
+            profile_dir or os.environ.get("ZOO_PROFILE_DIR")
+            or "zoo_profiles")
+
+    def _profile_tick(it: int):
+        """Crossing-edge profiler control: start when the iteration
+        counter reaches `start`, stop once it reaches `stop` (multi-step
+        runs cross in jumps of k — the window rounds up to run
+        boundaries, same granularity trade as every trigger)."""
+        if profiler is None or profile_state["done"]:
+            return
+        try:
+            if not profile_state["active"] and it >= p_start:
+                profiler.start(tag=f"fit-it{it}")
+                profile_state["active"] = True
+            elif profile_state["active"] and it >= p_stop:
+                manifest = profiler.stop()
+                profile_state["active"] = False
+                profile_state["done"] = True
+                history.setdefault("profile_artifacts", []).append(
+                    manifest["dir"])
+                log.info("profiler capture written to %s (%d files)",
+                         manifest["dir"], len(manifest["files"]))
+        except Exception as e:  # noqa: BLE001 — profiling must never
+            # take down the fit it watches
+            log.warning("profiler capture failed: %s: %s",
+                        type(e).__name__, e)
+            profile_state["done"] = True
+
     def _call_step(*step_args):
         """Every branch's train_step dispatch funnels through the step
         watchdog (retries + optional timeout); with step_retries=0 and
-        no timeout this is a plain call."""
-        return _step_with_watchdog(train_step, step_args, step_retries,
-                                   step_timeout_s, telemetry.step_retries,
-                                   iteration)
+        no timeout this is a plain call. Roofline cost harvest and the
+        profiler edge-check run first — both need the pre-dispatch
+        (donation-alive) view."""
+        if cost_tracker is not None:
+            cost_tracker.before(step_args)
+        _profile_tick(iteration)
+        out = _step_with_watchdog(train_step, step_args, step_retries,
+                                  step_timeout_s, telemetry.step_retries,
+                                  iteration)
+        if cost_tracker is not None:
+            # post-call: a just-built AOT executable answers
+            # cost_analysis directly; only the plain-jit path lowers
+            cost_tracker.after()
+        return out
 
     def _ckpt_extra(ep: int, finished: bool) -> Dict[str, Any]:
         """Checkpoint sidecar: everything auto-resume needs for bitwise
@@ -1045,6 +1262,19 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
           throughput = n_seen / max(dt, 1e-9)
           step_ms = telemetry.epoch(iteration - it0, n_seen, dt, mean_loss,
                                     flops_per_step=flops_per_step)
+          if cost_tracker is not None and cost_tracker.calls:
+              # dt is device wall time (the _materialize above synced),
+              # so achieved = XLA-counted work / measured epoch seconds.
+              # The harvested cost is PER-STEP (cost analysis counts a
+              # scan body once — see _StepCostTracker), so scale the
+              # per-call mean by the iterations this epoch ran: exact
+              # for single-step, multi-step (steps_per_run) and
+              # device-cache epoch programs alike.
+              steps_done = max(iteration - it0, cost_tracker.calls)
+              scale = steps_done / cost_tracker.calls
+              telemetry.roofline(cost_tracker.flops * scale,
+                                 cost_tracker.bytes * scale, dt)
+              cost_tracker.reset_epoch()
           if writer:
               writer.scalar("Loss", mean_loss, iteration)
               writer.scalar("Throughput", throughput, iteration)
@@ -1109,6 +1339,15 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         model.params = _as_tree(params)
         if isinstance(batches, _Prefetcher):
             batches.close()
+        if profiler is not None and profile_state["active"]:
+            # a fit that ends (or dies) inside the window still leaves
+            # a finished, loadable artifact behind
+            try:
+                manifest = profiler.stop()
+                history.setdefault("profile_artifacts", []).append(
+                    manifest["dir"])
+            except Exception:  # noqa: BLE001 — already tearing down
+                pass
         if reporter is not None:
             reporter.stop()   # logs a final digest (before writer closes)
         if writer:

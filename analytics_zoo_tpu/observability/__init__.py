@@ -1,5 +1,6 @@
-"""Unified observability layer (ISSUE 2): one metrics registry, one
-tracer, one exposition path for serving AND training.
+"""Unified observability layer (ISSUE 2 + ISSUE 6): one metrics
+registry, one tracer, one exposition path for serving AND training —
+plus the deep-profiling layer that makes the stack self-measuring.
 
 - `MetricsRegistry` / `get_registry()` — labeled Counter/Gauge/Histogram
   families; the Histogram is the log-bucketed streaming histogram from
@@ -8,9 +9,28 @@ tracer, one exposition path for serving AND training.
   HTTP frontend's `GET /metrics` under `Accept: text/plain`.
 - `Tracer` — request-scoped spans with Chrome trace-event JSON export
   (Perfetto-viewable), threaded through the serving pipeline.
-- `MetricsReporter` — periodic one-line digest thread.
+- `MetricsReporter` — periodic one-line digest thread (optionally
+  evaluating an `SLOTracker` each report).
+- `RooflineAccountant` / `cost_of` / `set_session_roofline` — hardware
+  utilization (achieved TFLOP/s, MFU, HBM GB/s vs the measured session
+  roofline) derived from XLA cost analysis, no hand-supplied FLOPs.
+- `ProfileCapture` / `StackSampler` — bounded on-demand `jax.profiler`
+  captures (`POST /profile`, `fit_keras(profile_steps=...)`) and a
+  host-side stack-sampling profiler for the pipeline threads.
+- `DeviceMemoryWatcher` / `leak_check` — per-device live/peak HBM
+  gauges and a leak assertion for tests.
+- `SLOObjectives` / `SLOTracker` — declarative latency/availability
+  objectives with burn-rate gauges and the `/healthz` readiness input.
 """
 
+from analytics_zoo_tpu.observability.capture import (CaptureActiveError,
+                                                     ProfileCapture,
+                                                     StackSampler,
+                                                     load_trace_events)
+from analytics_zoo_tpu.observability.memwatch import (DeviceMemoryLeak,
+                                                      DeviceMemoryWatcher,
+                                                      device_memory_snapshot,
+                                                      leak_check)
 from analytics_zoo_tpu.observability.prometheus import (CONTENT_TYPE,
                                                         render_prometheus)
 from analytics_zoo_tpu.observability.registry import (Counter, Gauge,
@@ -19,11 +39,23 @@ from analytics_zoo_tpu.observability.registry import (Counter, Gauge,
                                                       MetricsRegistry,
                                                       get_registry)
 from analytics_zoo_tpu.observability.reporter import MetricsReporter, digest
+from analytics_zoo_tpu.observability.roofline import (ExecCost,
+                                                      RooflineAccountant,
+                                                      cost_of,
+                                                      get_accountant,
+                                                      session_roofline,
+                                                      set_session_roofline)
+from analytics_zoo_tpu.observability.slo import SLOObjectives, SLOTracker
 from analytics_zoo_tpu.observability.tracing import (Span, Tracer,
                                                      span_coverage)
 
 __all__ = [
-    "CONTENT_TYPE", "Counter", "Gauge", "Histogram", "LogHistogram",
-    "MetricsRegistry", "MetricsReporter", "Span", "Tracer", "digest",
-    "get_registry", "render_prometheus", "span_coverage",
+    "CONTENT_TYPE", "CaptureActiveError", "Counter", "DeviceMemoryLeak",
+    "DeviceMemoryWatcher", "ExecCost", "Gauge", "Histogram",
+    "LogHistogram", "MetricsRegistry", "MetricsReporter",
+    "ProfileCapture", "RooflineAccountant", "SLOObjectives", "SLOTracker",
+    "Span", "StackSampler", "Tracer", "cost_of", "device_memory_snapshot",
+    "digest", "get_accountant", "get_registry", "leak_check",
+    "load_trace_events", "render_prometheus", "session_roofline",
+    "set_session_roofline", "span_coverage",
 ]

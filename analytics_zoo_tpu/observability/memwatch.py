@@ -1,0 +1,213 @@
+"""Device-memory telemetry (ISSUE 6 tentpole, part 3).
+
+HBM is the scarcest resource on a chip and nothing in PR 1-5 watched
+it: a leaked executable table or an un-dropped device reference shows
+up today as an OOM three hours into a run. This module publishes
+per-device live/peak byte gauges and gives tests a leak-check
+assertion.
+
+Sources, best first:
+
+- `device.memory_stats()` (real TPU runtimes): `bytes_in_use`,
+  `peak_bytes_in_use`, `bytes_limit`.
+- fallback (CPU/forced-host backends return None there): sum of
+  `jax.live_arrays()` nbytes grouped by committed device, with the peak
+  tracked by the watcher across samples. Same gauges either way, so
+  dashboards don't care which backend is under them.
+
+`DeviceMemoryWatcher` is the periodic publisher (a daemon thread, like
+`MetricsReporter`); `sample()` is the one-shot used by the watcher, the
+`/healthz` payload, and `leak_check()` — the context manager tests wrap
+around a workload to assert it returns device memory to baseline.
+"""
+
+from __future__ import annotations
+
+import gc
+import logging
+import threading
+from typing import Dict, Optional
+
+log = logging.getLogger("analytics_zoo_tpu.observability")
+
+
+def _device_label(d) -> str:
+    return f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', 0)}"
+
+
+def device_memory_snapshot(devices=None) -> Dict[str, Dict[str, float]]:
+    """{device label: {live_bytes, peak_bytes?, limit_bytes?, source}}.
+    Never raises: a backend without either source reports live_bytes=0
+    with source "none"."""
+    import jax
+    devs = list(devices) if devices is not None else jax.local_devices()
+    out: Dict[str, Dict[str, float]] = {}
+    live_fallback: Optional[Dict[int, float]] = None
+    for d in devs:
+        label = _device_label(d)
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without the API
+            stats = None
+        if stats:
+            entry = {"live_bytes": float(stats.get("bytes_in_use", 0.0)),
+                     "source": "memory_stats"}
+            if "peak_bytes_in_use" in stats:
+                entry["peak_bytes"] = float(stats["peak_bytes_in_use"])
+            if "bytes_limit" in stats:
+                entry["limit_bytes"] = float(stats["bytes_limit"])
+            out[label] = entry
+            continue
+        if live_fallback is None:
+            live_fallback = {}
+            try:
+                for a in jax.live_arrays():
+                    for shard_dev in getattr(a, "devices", lambda: [])():
+                        key = getattr(shard_dev, "id", 0)
+                        # a sharded array's bytes split across devices
+                        live_fallback[key] = live_fallback.get(key, 0.0) \
+                            + a.nbytes / max(1, len(a.devices()))
+            except Exception:  # noqa: BLE001 — diagnostics only
+                live_fallback = {}
+        out[label] = {"live_bytes": live_fallback.get(
+            getattr(d, "id", 0), 0.0), "source": "live_arrays"}
+    return out
+
+
+class DeviceMemoryWatcher:
+    """Daemon thread publishing per-device memory gauges every
+    `interval_s`:
+
+    - `device_memory_live_bytes{device}` — bytes in use now
+    - `device_memory_peak_bytes{device}` — high-water mark (runtime's
+      when available, else the max this watcher has observed)
+    - `device_memory_limit_bytes{device}` — capacity, when the runtime
+      reports one
+
+    `sample()` publishes once and returns the snapshot, so the watcher
+    is equally usable one-shot (healthz, bench teardown)."""
+
+    def __init__(self, interval_s: float = 10.0, registry=None,
+                 devices=None):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        from analytics_zoo_tpu.observability.registry import get_registry
+        self.registry = registry if registry is not None else get_registry()
+        self.interval_s = float(interval_s)
+        self.devices = devices
+        self._peaks: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample(self) -> Dict[str, Dict[str, float]]:
+        snap = device_memory_snapshot(self.devices)
+        live_g = self.registry.gauge(
+            "device_memory_live_bytes",
+            "device memory in use, per device (memory_stats or live "
+            "array accounting)")
+        peak_g = self.registry.gauge(
+            "device_memory_peak_bytes",
+            "device memory high-water mark, per device")
+        limit_g = self.registry.gauge(
+            "device_memory_limit_bytes",
+            "device memory capacity, per device (when the runtime "
+            "reports it)")
+        for label, entry in snap.items():
+            live = entry["live_bytes"]
+            live_g.set(live, device=label)
+            peak = entry.get("peak_bytes")
+            if peak is None:
+                # fallback source: track the max WE have seen
+                peak = max(self._peaks.get(label, 0.0), live)
+                entry["peak_bytes"] = peak
+            self._peaks[label] = max(self._peaks.get(label, 0.0), peak)
+            peak_g.set(self._peaks[label], device=label)
+            if "limit_bytes" in entry:
+                limit_g.set(entry["limit_bytes"], device=label)
+        return snap
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception as e:  # noqa: BLE001 — the watcher must
+                # outlive any backend hiccup it is watching
+                log.debug("memory sample failed: %s: %s",
+                          type(e).__name__, e)
+
+    def start(self) -> "DeviceMemoryWatcher":
+        if self._thread is not None:
+            raise RuntimeError("watcher already started")
+        self.sample()                       # gauges exist from t0
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="device-memory-watcher",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "DeviceMemoryWatcher":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class DeviceMemoryLeak(AssertionError):
+    """Raised by `leak_check` when live device bytes grew past the
+    tolerance — an AssertionError so pytest renders it as a failure."""
+
+
+class leak_check:  # noqa: N801 — context-manager, used like a function
+    """Assert a workload returns device memory to baseline:
+
+        with leak_check(tolerance_bytes=1 << 20):
+            model.predict(batch)           # everything it allocates
+                                           # must be released again
+
+    Live bytes are measured (after a `gc.collect()` — dropped Python
+    refs must not read as device leaks) before and after; growth beyond
+    `tolerance_bytes` raises `DeviceMemoryLeak` naming the per-device
+    deltas. The `grew` attribute carries the measured growth either
+    way, for tests that want the number."""
+
+    def __init__(self, tolerance_bytes: float = 1 << 20, devices=None):
+        self.tolerance_bytes = float(tolerance_bytes)
+        self.devices = devices
+        self.before: Dict[str, float] = {}
+        self.grew: Dict[str, float] = {}
+
+    @staticmethod
+    def _live(devices) -> Dict[str, float]:
+        gc.collect()
+        return {label: e["live_bytes"]
+                for label, e in device_memory_snapshot(devices).items()}
+
+    def __enter__(self) -> "leak_check":
+        self.before = self._live(self.devices)
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is not None:
+            return False               # the workload failed; report THAT
+        after = self._live(self.devices)
+        self.grew = {label: after.get(label, 0.0) - b
+                     for label, b in self.before.items()
+                     if after.get(label, 0.0) - b > 0}
+        leaked = {label: g for label, g in self.grew.items()
+                  if g > self.tolerance_bytes}
+        if leaked:
+            detail = ", ".join(f"{label}: +{g:,.0f} B"
+                               for label, g in sorted(leaked.items()))
+            raise DeviceMemoryLeak(
+                f"device memory grew past the {self.tolerance_bytes:,.0f}"
+                f" B tolerance ({detail})")
+        return False

@@ -160,9 +160,27 @@ class Counter(_Metric):
 class Gauge(_Metric):
     """Last-write-wins scalar. `set_function` installs a zero-argument
     callable evaluated at snapshot time — live views (queue depths,
-    pool sizes) without a writer thread."""
+    pool sizes) without a writer thread.
+
+    Callback hardening (ISSUE 6 satellite): a raising callback can
+    never propagate out of `snapshot()`, `value()`, the Prometheus
+    render, or the reporter digest — the series reads NaN for that
+    evaluation and the failure is counted
+    (`observability_gauge_errors_total{gauge=...}` via the registry's
+    `_on_error` hook), so one bad gauge degrades to one bad series
+    instead of killing every scrape."""
 
     kind = "gauge"
+    _on_error: Optional[Callable[[str], None]] = None   # registry hook
+
+    def _callback_failed(self, exc: BaseException):
+        hook = self._on_error
+        if hook is None:
+            return
+        try:
+            hook(self.name)
+        except Exception:  # noqa: BLE001 — error accounting must never
+            pass           # become a second error
 
     def set(self, value: float, **labels):
         with self._lock:
@@ -209,7 +227,14 @@ class Gauge(_Metric):
     def value(self, **labels) -> float:
         with self._lock:
             v = self._series.get(_label_key(labels), 0.0)
-        return float(v()) if callable(v) else v
+        if not callable(v):
+            return v
+        try:
+            return float(v())
+        except Exception as e:  # noqa: BLE001 — same contract as
+            # snapshot: a raising provider reads NaN, never raises
+            self._callback_failed(e)
+            return float("nan")
 
     def _series_snapshot(self):
         with self._lock:
@@ -219,8 +244,10 @@ class Gauge(_Metric):
             if callable(v):
                 try:
                     v = float(v())
-                except Exception:  # noqa: BLE001 — a dead provider (e.g.
-                    # a stopped server's queue) must not break snapshots
+                except Exception as e:  # noqa: BLE001 — a dead provider
+                    # (e.g. a stopped server's queue) must not break
+                    # snapshots; counted so the failure is visible
+                    self._callback_failed(e)
                     v = float("nan")
             out.append({"labels": dict(k), "value": v})
         return out
@@ -314,8 +341,21 @@ class MetricsRegistry:
                         f"{existing.kind}, requested {cls.kind}")
                 return existing
             m = cls(name, description, **kwargs)
+            if cls is Gauge:
+                m._on_error = self._count_gauge_error
             self._metrics[name] = m
             return m
+
+    def _count_gauge_error(self, gauge_name: str):
+        """One bad callback = one counted error, not a dead scrape. The
+        counter itself is get-or-create, so it exists from the first
+        failure on (and survives a test's clear())."""
+        if gauge_name == "observability_gauge_errors_total":
+            return          # never recurse into our own accounting
+        self.counter(
+            "observability_gauge_errors_total",
+            "gauge callbacks that raised during evaluation (the series "
+            "read NaN for that snapshot)").inc(gauge=gauge_name)
 
     def counter(self, name: str, description: str = "") -> Counter:
         if not name.endswith(_COUNTER_SUFFIX):

@@ -66,19 +66,37 @@ class MetricsReporter:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  interval_s: float = 30.0,
                  logger: Optional[logging.Logger] = None,
-                 writer=None):
+                 writer=None, slo=None):
+        """`slo`: an `observability.slo.SLOTracker` — evaluated on every
+        report BEFORE the digest, so the burn-rate/`slo_met` gauges are
+        fresh in the logged line and for any scrape that follows the
+        same cadence. The tracker itself owns the one-WARNING-per-
+        (met → violated)-edge logging, so it fires whichever driver
+        evaluates first."""
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
         self.registry = registry if registry is not None else get_registry()
         self.interval_s = interval_s
         self.log = logger or log
         self.writer = writer       # optional tensorboard SummaryWriter
+        self.slo = slo
         self._prev: Optional[Dict[str, Dict[str, Any]]] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._step = 0
 
+    def _evaluate_slo(self):
+        if self.slo is None:
+            return
+        try:
+            self.slo.evaluate()
+        except Exception as e:  # noqa: BLE001 — SLO math must never
+            # take down the digest thread it rides on
+            self.log.debug("slo evaluation failed: %s: %s",
+                           type(e).__name__, e)
+
     def _report(self):
+        self._evaluate_slo()
         snap = self.registry.snapshot()
         d = self.registry.delta(self._prev) if self._prev else None
         self.log.info("metrics: %s", digest(snap, d, self.interval_s))

@@ -1,0 +1,266 @@
+"""Roofline accounting from XLA cost analysis (ISSUE 6 tentpole, part 1).
+
+PR 2 gave latencies and counts; this module answers *hardware
+utilization*: how many FLOPs and HBM bytes did each executable actually
+move per second, against what the chip can do. The FLOP/byte counts come
+from XLA itself — `compiled.cost_analysis()` on the executables the
+serving warmup, the trainer step, and the AOT compile cache already hold
+— so no hand-supplied `flops_per_step` is needed and the numbers track
+the REAL program (fusion included), not an analytic model.
+
+Two layers:
+
+- `cost_of(stages_obj)` — harvest `{flops, bytes}` from a
+  `jax.stages.Compiled` or `Lowered` (the two agree on this backend; a
+  deserialized AOT executable works too). Returns None when the backend
+  exposes no cost model — every caller degrades to "no roofline gauges",
+  never an error.
+- `RooflineAccountant` — per-`kind` ("serving", "train") accumulation of
+  (flops, bytes, busy-seconds) publishing both cumulative counters and
+  live derived gauges: achieved TFLOP/s, achieved HBM GB/s, MFU, and HBM
+  utilization as a fraction of the **session roofline**.
+
+The session roofline is the *measured* achievable bound
+(`bench.py session_hbm_gbps` / `session_mxu_tflops`, the Adam-shaped
+sweep + chained-matmul calibration in `bench_ncf.py`), installed via
+`set_session_roofline(...)` or the `ZOO_SESSION_HBM_GBPS` /
+`ZOO_SESSION_TFLOPS` env vars; absent those it falls back to the
+nameplate peaks in `utils/roofline.py`. That makes the BENCH r05
+"NCF at 33% of achievable bound" number a live gauge
+(`roofline_hbm_utilization{kind="train"}`) instead of one-off analysis,
+and — per the ROADMAP NCF item — measured against the session yardstick
+so tunnel noise can't fake progress.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+log = logging.getLogger("analytics_zoo_tpu.observability")
+
+
+class ExecCost:
+    """FLOPs and HBM bytes one call of an executable performs, per XLA's
+    own cost analysis."""
+
+    __slots__ = ("flops", "bytes")
+
+    def __init__(self, flops: float, bytes_: float):
+        self.flops = float(flops)
+        self.bytes = float(bytes_)
+
+    def __repr__(self):
+        return f"ExecCost(flops={self.flops:g}, bytes={self.bytes:g})"
+
+
+def cost_of(stages_obj) -> Optional[ExecCost]:
+    """Harvest per-call FLOPs / bytes-accessed from a `jax.stages`
+    Compiled or Lowered object (cost_analysis returns a list of one dict
+    on this jax, a plain dict on newer ones). None — never a raise —
+    when the backend has no cost model or the numbers are empty: the
+    roofline layer is telemetry, and telemetry must not take down the
+    path it measures.
+
+    Caveat: XLA's HLO cost analysis counts a While-loop body ONCE, not
+    times its trip count — a `lax.scan`/`fori_loop` program reports one
+    iteration's cost. The trainer exploits this (the per-step cost is
+    exactly what it scales by the iteration count); a model whose
+    FORWARD hides work inside a loop will have its serving cost
+    understated by the trip count."""
+    if stages_obj is None:
+        return None
+    try:
+        ca = stages_obj.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return None
+        flops = float(ca.get("flops") or 0.0)
+        bytes_ = float(ca.get("bytes accessed") or 0.0)
+    except Exception as e:  # noqa: BLE001 — experimental backends throw
+        log.debug("cost_analysis unavailable: %s: %s", type(e).__name__, e)
+        return None
+    if flops <= 0.0 and bytes_ <= 0.0:
+        return None
+    return ExecCost(flops, bytes_)
+
+
+# ---------------------------------------------------------------------------
+# Session roofline: the measured achievable bound (falls back to nameplate)
+# ---------------------------------------------------------------------------
+_session_lock = threading.Lock()
+_session: Dict[str, Optional[float]] = {"hbm_gbps": None, "tflops": None}
+
+
+def set_session_roofline(hbm_gbps: Optional[float] = None,
+                         tflops: Optional[float] = None,
+                         registry=None) -> None:
+    """Install the session's MEASURED achievable bounds (the bench
+    calibration sweeps) as the roofline denominator, and publish them as
+    gauges so every scrape shows what "100%" meant."""
+    from analytics_zoo_tpu.observability.registry import get_registry
+    reg = registry if registry is not None else get_registry()
+    with _session_lock:
+        if hbm_gbps is not None:
+            _session["hbm_gbps"] = float(hbm_gbps)
+        if tflops is not None:
+            _session["tflops"] = float(tflops)
+    if hbm_gbps is not None:
+        reg.gauge("roofline_session_hbm_gbps",
+                  "measured achievable HBM GB/s this session (the "
+                  "utilization denominator; nameplate when unset)"
+                  ).set(float(hbm_gbps))
+    if tflops is not None:
+        reg.gauge("roofline_session_tflops",
+                  "measured achievable bf16 TFLOP/s this session (the "
+                  "MFU denominator; nameplate when unset)"
+                  ).set(float(tflops))
+
+
+def session_roofline(device=None) -> Tuple[float, float]:
+    """(HBM bytes/s, FLOP/s) roofline denominators: the measured session
+    bound when installed (`set_session_roofline` / env
+    ZOO_SESSION_HBM_GBPS / ZOO_SESSION_TFLOPS), else the nameplate peak
+    of `device` (default: device 0)."""
+    with _session_lock:
+        hbm_gbps = _session["hbm_gbps"]
+        tflops = _session["tflops"]
+    if hbm_gbps is None:
+        env = os.environ.get("ZOO_SESSION_HBM_GBPS")
+        hbm_gbps = float(env) if env else None
+    if tflops is None:
+        env = os.environ.get("ZOO_SESSION_TFLOPS")
+        tflops = float(env) if env else None
+    if hbm_gbps is not None and tflops is not None:
+        return hbm_gbps * 1e9, tflops * 1e12
+    from analytics_zoo_tpu.utils.roofline import peak_flops, peak_hbm
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    return (hbm_gbps * 1e9 if hbm_gbps is not None else peak_hbm(device),
+            tflops * 1e12 if tflops is not None else peak_flops(device))
+
+
+# ---------------------------------------------------------------------------
+# The accountant
+# ---------------------------------------------------------------------------
+class RooflineAccountant:
+    """Per-kind (flops, bytes, busy-seconds) accumulation → registry.
+
+    `account(kind, flops, bytes, seconds)` is the single entry point:
+    the serving predict path calls it per materialized batch (with the
+    batch's measured dispatch+materialize seconds), the trainer once per
+    epoch (with the epoch's device wall time). Counters accumulate
+    forever (the Prometheus model); the derived gauges are computed from
+    THIS call's window — the latest batch / latest epoch — so a cold
+    fit's compile-laden first epoch depresses only its own reading and
+    the gauges recover to the true steady-state rate from the next
+    window on (cumulative-since-reset rates would stay diluted for the
+    whole run). `snapshot(kind)` still reports the accumulation since
+    the last `reset(kind)` — a model reload or a fresh fit resets its
+    kind so the bench-facing averages describe the CURRENT program.
+
+    Never raises out of `account` — one bad division must not take down
+    a dispatch path."""
+
+    def __init__(self, registry=None):
+        from analytics_zoo_tpu.observability.registry import get_registry
+        self._registry = registry if registry is not None else get_registry()
+        self._lock = threading.Lock()
+        # kind -> [flops, bytes, seconds] since last reset(kind)
+        self._acc: Dict[str, list] = {}
+
+    # registration is get-or-create and therefore safe to repeat per
+    # call: it also heals after a test's registry.clear()
+    def _reg(self):
+        reg = self._registry
+        return (
+            reg.counter("roofline_flops_total",
+                        "FLOPs executed, per XLA cost analysis, by kind"),
+            reg.counter("roofline_hbm_bytes_total",
+                        "HBM bytes accessed, per XLA cost analysis, by "
+                        "kind"),
+            reg.counter("roofline_busy_seconds_total",
+                        "measured busy wall seconds the flops/bytes "
+                        "counters were accumulated over, by kind"),
+            reg.gauge("roofline_achieved_tflops",
+                      "achieved TFLOP/s since the kind's last reset "
+                      "(cost-analysis FLOPs / measured seconds)"),
+            reg.gauge("roofline_achieved_hbm_gbps",
+                      "achieved HBM GB/s since the kind's last reset"),
+            reg.gauge("roofline_mfu",
+                      "achieved FLOP/s over the session FLOP roofline "
+                      "(cost-analysis MFU; no flops_per_step needed)"),
+            reg.gauge("roofline_hbm_utilization",
+                      "achieved HBM bytes/s over the session HBM "
+                      "roofline (the %-of-achievable-bound gauge)"),
+        )
+
+    def account(self, kind: str, flops: float, bytes_: float,
+                seconds: float, device=None) -> None:
+        try:
+            if seconds <= 0.0 or (flops <= 0.0 and bytes_ <= 0.0):
+                return
+            with self._lock:
+                acc = self._acc.setdefault(kind, [0.0, 0.0, 0.0])
+                acc[0] += flops
+                acc[1] += bytes_
+                acc[2] += seconds
+            (c_flops, c_bytes, c_secs, g_tflops, g_gbps, g_mfu,
+             g_hbm) = self._reg()
+            c_flops.inc(flops, kind=kind)
+            c_bytes.inc(bytes_, kind=kind)
+            c_secs.inc(seconds, kind=kind)
+            # gauges from THIS window: the latest epoch/batch rate
+            g_tflops.set(flops / seconds / 1e12, kind=kind)
+            g_gbps.set(bytes_ / seconds / 1e9, kind=kind)
+            hbm_roof, flops_roof = session_roofline(device)
+            if flops_roof > 0:
+                g_mfu.set(flops / seconds / flops_roof, kind=kind)
+            if hbm_roof > 0:
+                g_hbm.set(bytes_ / seconds / hbm_roof, kind=kind)
+        except Exception as e:  # noqa: BLE001 — telemetry must not raise
+            log.debug("roofline accounting failed: %s: %s",
+                      type(e).__name__, e)
+
+    def reset(self, kind: Optional[str] = None) -> None:
+        """Zero the rate accumulators (counters keep accumulating): a
+        reloaded serving model / a fresh fit starts its gauges clean."""
+        with self._lock:
+            if kind is None:
+                self._acc.clear()
+            else:
+                self._acc.pop(kind, None)
+
+    def snapshot(self, kind: str) -> Dict[str, float]:
+        """The kind's accumulators since its last reset (bench JSON)."""
+        with self._lock:
+            f, b, s = self._acc.get(kind, (0.0, 0.0, 0.0))
+        out: Dict[str, Any] = {"flops": f, "bytes": b, "seconds": s}
+        if s > 0:
+            out["achieved_tflops"] = f / s / 1e12
+            out["achieved_hbm_gbps"] = b / s / 1e9
+            try:
+                hbm_roof, flops_roof = session_roofline()
+                out["mfu"] = f / s / flops_roof
+                out["hbm_utilization"] = b / s / hbm_roof
+            except Exception:  # noqa: BLE001 — no device, no roofline
+                pass
+        return out
+
+
+_default_accountant: Optional[RooflineAccountant] = None
+_default_lock = threading.Lock()
+
+
+def get_accountant() -> RooflineAccountant:
+    """The process-wide accountant on the default registry — serving and
+    training both publish here, like `get_registry()`."""
+    global _default_accountant
+    with _default_lock:
+        if _default_accountant is None:
+            _default_accountant = RooflineAccountant()
+        return _default_accountant

@@ -50,7 +50,10 @@ def cmd_start(args) -> int:
             tokens_per_second=cfg.tokens_per_second,
             token_acquire_timeout_ms=cfg.token_acquire_timeout_ms,
             tls_certfile=cfg.tls_certfile,
-            tls_keyfile=cfg.tls_keyfile).start()
+            tls_keyfile=cfg.tls_keyfile,
+            profile_dir=cfg.profile_dir,
+            profile_max_artifacts=cfg.profile_max_artifacts,
+            profile_enabled=cfg.profile_enabled).start()
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     model = cfg.build_model(broker=broker)
@@ -102,9 +105,20 @@ def cmd_start(args) -> int:
                              .breaker_failure_threshold,
                              breaker_reset_s=cfg.breaker_reset_s,
                              sink_buffer_batches=cfg
-                             .sink_buffer_batches).start()
+                             .sink_buffer_batches,
+                             slo=cfg.build_slo()).start()
     if frontend is not None:
         frontend._srv.serving = serving
+    if serving.slo is not None:
+        obj = serving.slo.objectives
+        parts = []
+        if obj.latency_ms is not None:
+            parts.append(f"latency p{obj.latency_quantile * 100:g}"
+                         f"<={obj.latency_ms:g}ms")
+        if obj.availability is not None:
+            parts.append(f"availability>={obj.availability:g}")
+        print(f"slo: {' '.join(parts)} over {obj.window_s:g}s "
+              "(watch slo_burn_rate; /healthz aggregates)", flush=True)
     print("cluster serving started", flush=True)
 
     def shutdown():

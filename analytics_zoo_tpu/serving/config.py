@@ -114,6 +114,20 @@ class ServingConfig:
     # dumps Chrome trace JSON (Perfetto-viewable) on shutdown
     trace: bool = False
     trace_path: Optional[str] = None
+    # SLO objectives (ISSUE 6, `observability/slo.py`): a params.slo
+    # block — latency_ms (target at latency_quantile), availability
+    # (non-degraded fraction), window_s. Evaluated by the engine's
+    # SLOTracker; feeds /healthz and the slo_burn_rate gauges.
+    slo_latency_ms: Optional[float] = None
+    slo_latency_quantile: float = 0.95
+    slo_availability: Optional[float] = None
+    slo_window_s: float = 300.0
+    # on-demand profiler capture (POST /profile): artifact root +
+    # rotation bound; profile_enabled: false turns the endpoint off
+    # (404). Default root is <tmp>/zoo_profiles.
+    profile_dir: Optional[str] = None
+    profile_max_artifacts: int = 8
+    profile_enabled: bool = True
     http_port: Optional[int] = None
     # secure block (`ClusterServingHelper.scala:121-134` — model_encrypted
     # gates the wait-for-secret/salt flow before weights load)
@@ -189,6 +203,29 @@ class ServingConfig:
         cfg.warmup_dtype = str(params.get("warmup_dtype", "float32"))
         cfg.trace = bool(params.get("trace", False))
         cfg.trace_path = params.get("trace_path")
+        slo = params.get("slo", {}) or {}
+        if not isinstance(slo, dict):
+            raise ValueError(
+                f"params.slo={slo!r} must be a map (latency_ms, "
+                "latency_quantile, availability, window_s)")
+        if slo.get("latency_ms") is not None:
+            cfg.slo_latency_ms = float(slo["latency_ms"])
+        if slo.get("latency_quantile") is not None:
+            cfg.slo_latency_quantile = float(slo["latency_quantile"])
+        if slo.get("availability") is not None:
+            cfg.slo_availability = float(slo["availability"])
+        if slo.get("window_s") is not None:
+            cfg.slo_window_s = float(slo["window_s"])
+        cfg.build_slo()          # objective errors fail the load, like
+        #                          placement — not the supervisor thread
+        cfg.profile_dir = params.get("profile_dir")
+        cfg.profile_enabled = bool(params.get("profile_enabled", True))
+        cfg.profile_max_artifacts = int(
+            params.get("profile_max_artifacts", 8))
+        if cfg.profile_max_artifacts < 1:
+            raise ValueError(
+                f"params.profile_max_artifacts="
+                f"{cfg.profile_max_artifacts} must be >= 1")
         if raw.get("http_port") is not None:
             cfg.http_port = int(raw["http_port"])
         secure = raw.get("secure", {}) or {}
@@ -288,6 +325,19 @@ class ServingConfig:
                     "params.compile_cache_max_bytes is set but "
                     "params.compile_cache_dir is not; the budget bounds "
                     "the cache directory")
+
+    def build_slo(self):
+        """The `SLOObjectives` this config declares, validated (None
+        when no objective is set); `cmd_start` hands it to
+        `ClusterServing(slo=...)`."""
+        if self.slo_latency_ms is None and self.slo_availability is None:
+            return None
+        from analytics_zoo_tpu.observability.slo import SLOObjectives
+        return SLOObjectives(
+            latency_ms=self.slo_latency_ms,
+            latency_quantile=self.slo_latency_quantile,
+            availability=self.slo_availability,
+            window_s=self.slo_window_s).validate()
 
     def build_compile_cache(self, registry=None):
         """The `CompileCache` this config names (None when caching is

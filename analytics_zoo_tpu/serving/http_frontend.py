@@ -25,6 +25,7 @@ broker."""
 from __future__ import annotations
 
 import json
+import os
 import ssl
 import threading
 import time
@@ -51,8 +52,8 @@ MODEL_SECURED_SALT = "salt"
 # route tables: a known route hit with the wrong method answers 405 with
 # an Allow header (silent 404s made method typos indistinguishable from
 # wrong URLs); unknown paths stay 404
-ROUTES_GET = ("/", "/metrics", "/trace")
-ROUTES_POST = ("/predict", "/model-secure")
+ROUTES_GET = ("/", "/metrics", "/trace", "/healthz")
+ROUTES_POST = ("/predict", "/model-secure", "/profile")
 
 
 class TokenBucket:
@@ -148,6 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._metrics()
         elif path == "/trace":
             self._trace()
+        elif path == "/healthz":
+            self._healthz()
         elif path in ROUTES_POST:
             self._method_not_allowed("POST")
         else:
@@ -162,6 +165,17 @@ class _Handler(BaseHTTPRequestHandler):
         alongside)."""
         accept = self.headers.get("Accept", "") or ""
         registry: MetricsRegistry = self.server.registry
+        # freshen the SLO gauges before ANY exposition: a Prometheus-only
+        # deployment (text scrape) must see slo_burn_rate/slo_met move
+        # without anything polling /healthz (the tracker rate-limits
+        # itself, so per-scrape evaluation is one window sample)
+        slo = getattr(self.server.serving, "slo", None) \
+            if self.server.serving else None
+        if slo is not None:
+            try:
+                slo.evaluate()
+            except Exception:  # noqa: BLE001 — scrape must answer
+                pass
         if "text/plain" in accept or "openmetrics" in accept:
             self._send_bytes(200, render_prometheus(registry).encode(),
                              PROMETHEUS_CONTENT_TYPE)
@@ -172,6 +186,67 @@ class _Handler(BaseHTTPRequestHandler):
             timers.update(serving.metrics())
         timers["registry"] = registry.snapshot()
         self._send(200, timers)
+
+    def _healthz(self):
+        """Readiness probe (ISSUE 6): aggregates the engine's
+        supervisor/quarantine state, breaker state, and SLO status via
+        `ClusterServing.health()` — 200 while the engine can accept
+        traffic, 503 (with Retry-After on a quarantined pool) when it
+        cannot. A frontend with no engine attached answers 200 with
+        `engine: null` — it is alive as a gateway; readiness of an
+        engine it doesn't have is not its claim to make."""
+        serving = self.server.serving
+        health_fn = getattr(serving, "health", None) if serving else None
+        if not callable(health_fn):
+            self._send(200, {"ready": True, "engine": None})
+            return
+        try:
+            h = health_fn()
+        except Exception as e:  # noqa: BLE001 — a probe must answer
+            self._send(503, {"ready": False,
+                             "reason": f"{type(e).__name__}: {e}"})
+            return
+        if h.get("ready"):
+            self._send(200, h)
+        else:
+            retry_s = getattr(serving, "retry_after_s", 1)
+            self._send(503, h,
+                       extra_headers={"Retry-After": str(retry_s)})
+
+    def _profile(self):
+        """`POST /profile?seconds=N` (ISSUE 6): one bounded jax.profiler
+        capture into the frontend's rotated artifact dir, with the
+        host-side stack-sampler report for the serving pipeline threads
+        alongside. Single-flight: a second POST while one runs gets 409
+        (two concurrent profiler sessions would corrupt each other).
+        Blocks the requesting connection for the capture window — that
+        is the point; other requests ride their own handler threads."""
+        from analytics_zoo_tpu.observability.capture import (
+            MAX_CAPTURE_SECONDS, CaptureActiveError)
+        qs = parse_qs(self.path.partition("?")[2])
+        try:
+            seconds = float(qs.get("seconds", ["2"])[0])
+        except ValueError:
+            self._send(400, {"error": "seconds must be a number"})
+            return
+        if not (0 < seconds <= MAX_CAPTURE_SECONDS):
+            self._send(400, {"error": f"seconds must be in "
+                                      f"(0, {MAX_CAPTURE_SECONDS:g}]"})
+            return
+        capture = self.server.profile_capture
+        if capture is None:
+            self._send(404, {"error": "profiling disabled "
+                                      "(params.profile_enabled: false)"})
+            return
+        try:
+            manifest = capture.capture(seconds, tag="http")
+        except CaptureActiveError as e:
+            self._send(409, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — frontend must not die
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(200, manifest)
 
     def _trace(self):
         """Chrome trace-event JSON of the serving pipeline's spans
@@ -192,6 +267,9 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path == "/model-secure":
             self._model_secure()
+            return
+        if path == "/profile":
+            self._profile()
             return
         if path != "/predict":
             if path in ROUTES_GET:
@@ -316,7 +394,10 @@ class FrontEnd:
                  token_acquire_timeout_ms: float = 100.0,
                  tls_certfile: Optional[str] = None,
                  tls_keyfile: Optional[str] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 profile_dir: Optional[str] = None,
+                 profile_max_artifacts: int = 8,
+                 profile_enabled: bool = True):
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self._srv = _FrontEndServer((host, port), _Handler)
@@ -334,6 +415,22 @@ class FrontEnd:
             "http_request_ms", "frontend /predict round-trip duration")
         self._srv.request_timer.add_observer(
             lambda s: req_hist.observe(s * 1e3))
+        # on-demand profiler capture (POST /profile): bounded + rotated
+        # under one root; inert (zero request-path cost) until a capture
+        # request arrives. `profile_enabled=False` (config:
+        # params.profile_enabled) leaves the endpoint answering 404 —
+        # a capture pins a handler thread for its whole window, which an
+        # internet-facing frontend may not want to offer
+        self._srv.profile_capture = None
+        if profile_enabled:
+            import tempfile
+            from analytics_zoo_tpu.observability.capture import \
+                ProfileCapture
+            root = profile_dir or os.environ.get("ZOO_PROFILE_DIR") \
+                or os.path.join(tempfile.gettempdir(), "zoo_profiles")
+            self._srv.profile_capture = ProfileCapture(
+                root, max_artifacts=profile_max_artifacts,
+                registry=self.registry)
         self._srv.timeout_s = timeout_s
         self._srv.rate_limiter = (
             TokenBucket(tokens_per_second, token_bucket_capacity)

@@ -75,12 +75,14 @@ class PendingPrediction:
     both touch it."""
 
     def __init__(self, out, valid_n: int, timer=None,
-                 dispatch_s: float = 0.0, replica: int = 0):
+                 dispatch_s: float = 0.0, replica: int = 0,
+                 roofline_cb: Optional[Callable[[float], None]] = None):
         self._out = out
         self._n = valid_n
         self._timer = timer
         self._dispatch_s = dispatch_s
         self.replica = replica        # which model replica computed this
+        self._roofline_cb = roofline_cb
         self._result = None
         self._done = False
         self._lock = threading.Lock()
@@ -113,13 +115,17 @@ class PendingPrediction:
                 self._out = None            # free device refs promptly
                 self._result = out
                 self._done = True
+                busy_s = self._dispatch_s + time.perf_counter() - t0
                 if self._timer is not None:
                     # model time = dispatch + materialize wait; time the
                     # handle sat unmaterialized (e.g. behind a slow sink
                     # queue) is excluded, so /metrics "predict" doesn't
                     # misattribute a broker stall to the device
-                    self._timer.record(
-                        self._dispatch_s + time.perf_counter() - t0)
+                    self._timer.record(busy_s)
+                if self._roofline_cb is not None:
+                    # utilization accounting rides the same measured
+                    # window (accountant.account never raises)
+                    self._roofline_cb(busy_s)
         return self._result
 
 
@@ -132,11 +138,13 @@ class _RoutedPending:
     error)."""
 
     def __init__(self, valid_n: int, timer=None, replica: int = 0,
-                 on_done: Optional[Callable[[], None]] = None):
+                 on_done: Optional[Callable[[], None]] = None,
+                 roofline_cb: Optional[Callable[[float], None]] = None):
         self._n = valid_n
         self._timer = timer
         self.replica = replica
         self._on_done = on_done
+        self._roofline_cb = roofline_cb
         self._event = threading.Event()
         self._out = None
         self._exc: Optional[BaseException] = None
@@ -185,10 +193,12 @@ class _RoutedPending:
                             lambda a: np.asarray(a)[:self._n], self._out)
                         self._out = None
                         self._result = out
+                        busy_s = self._dispatch_s \
+                            + time.perf_counter() - t0
                         if self._timer is not None:
-                            self._timer.record(
-                                self._dispatch_s
-                                + time.perf_counter() - t0)
+                            self._timer.record(busy_s)
+                        if self._roofline_cb is not None:
+                            self._roofline_cb(busy_s)
                 except Exception as e:  # noqa: BLE001 — keep for re-raise
                     self._exc = e
                 finally:
@@ -368,6 +378,12 @@ class InferenceModel:
         # empty ⇒ every predict path is byte-for-byte the legacy jit
         self._aot: Dict[tuple, Any] = {}
         self._model_fp: Optional[str] = None
+        # roofline accounting (ISSUE 6): per-bucket XLA cost-analysis
+        # FLOPs/bytes harvested at warmup, charged per materialized
+        # batch against the measured predict time. Empty until warmup
+        # runs — an unwarmed model pays nothing on the predict path.
+        self._exec_cost: Dict[tuple, Any] = {}
+        self._roofline = None
 
     # -- loaders (`doLoad*`, InferenceModel.scala:76-318) ------------------
     def load_keras(self, model, params=None,
@@ -474,7 +490,65 @@ class InferenceModel:
         self.warmup_report = {}
         self.warmup_source = {}
         self.warmed_buckets = set()
+        # fresh program, fresh roofline: the live serving gauges must
+        # describe THIS model, not whatever was loaded before
+        self._exec_cost = {}
+        try:
+            from analytics_zoo_tpu.observability.roofline import \
+                get_accountant
+            self._roofline = get_accountant()
+            self._roofline.reset("serving")
+        except Exception:  # noqa: BLE001 — telemetry only
+            self._roofline = None
         return self
+
+    # -- roofline accounting (observability/roofline.py) -------------------
+    @staticmethod
+    def _cost_key(x) -> tuple:
+        """Per-batch cost-table key: leaf shapes/dtypes only (the params
+        side is fixed per model) — cheap enough for the dispatch path.
+        The shared `compile_cache.key.cheap_signature` so this can never
+        drift from the AOT cache's spelling."""
+        from analytics_zoo_tpu.compile_cache.key import cheap_signature
+        return cheap_signature(x)
+
+    def _record_cost(self, batch, stages_obj):
+        """Harvest per-call FLOPs/bytes from a Compiled/Lowered for this
+        batch shape; silently absent when the backend has no cost model."""
+        try:
+            key = self._cost_key(batch)
+            if key in self._exec_cost:
+                return
+            from analytics_zoo_tpu.observability.roofline import cost_of
+            c = cost_of(stages_obj)
+            if c is not None:
+                self._exec_cost[key] = c
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
+    def _harvest_jit_cost(self, params, batch):
+        """Jit-path warmup harvest: lowering is cheap next to the XLA
+        compile warmup is already paying, and `Lowered.cost_analysis()`
+        matches the compiled numbers on this backend."""
+        if self._cost_key(batch) in self._exec_cost:
+            return
+        try:
+            low = self._jit.lower(params, batch)
+        except Exception:  # noqa: BLE001 — telemetry only
+            return
+        self._record_cost(batch, low)
+
+    def _roofline_cb(self, x):
+        """The per-batch accounting callback for a pending, or None when
+        this batch shape has no harvested cost (e.g. no warmup ran)."""
+        if not self._exec_cost or self._roofline is None:
+            return None
+        cost = self._exec_cost.get(self._cost_key(x))
+        if cost is None:
+            return None
+        acct = self._roofline
+        return lambda secs, _c=cost, _a=acct: _a.account(
+            "serving", _c.flops, _c.bytes, secs)
 
     # -- persistent compile cache (compile_cache/) -------------------------
     @staticmethod
@@ -524,6 +598,9 @@ class InferenceModel:
                 # rather than rejecting the hit
                 ex = serialization.retree_call(ex, stored)
             self._aot[(replica_idx, sig)] = ex
+            # AOT-cache loads are a harvest point too: deserialized
+            # executables still answer cost_analysis()
+            self._record_cost(batch, ex)
             return "cached"
         t0 = time.perf_counter()
         # module-attribute call: serialization.compile_lowered is THE
@@ -532,6 +609,7 @@ class InferenceModel:
         self.compile_cache.put(  # blocking-ok: disk cache write, not a queue
             key, ex, compile_ms=(time.perf_counter() - t0) * 1e3)
         self._aot[(replica_idx, sig)] = ex
+        self._record_cost(batch, ex)
         return "compiled"
 
     def _replica_loop(self, rep: _Replica):
@@ -903,6 +981,7 @@ class InferenceModel:
                         [jnp.asarray(a),
                          jnp.broadcast_to(jnp.asarray(a)[-1:],
                                           (pad,) + a.shape[1:])]), x)
+            rcb = self._roofline_cb(x)
             if self._replicas is not None:
                 # replica pool: route to the least-loaded device and
                 # return immediately — its worker thread dispatches.
@@ -915,7 +994,8 @@ class InferenceModel:
                     pending = _RoutedPending(
                         valid_n, timer=self.timer, replica=rep.index,
                         on_done=lambda rep=rep:
-                            self._release_replica(rep))
+                            self._release_replica(rep),
+                        roofline_cb=rcb)
                     rep.work_q.put_nowait((x, pending, t0))
                 return pending
             if self._batch_sharding is not None:
@@ -938,7 +1018,8 @@ class InferenceModel:
                 self._sema.release()
         # recorded once at result(): dispatch cost + materialize wait
         return PendingPrediction(out, valid_n, timer=self.timer,
-                                 dispatch_s=time.perf_counter() - t0)
+                                 dispatch_s=time.perf_counter() - t0,
+                                 roofline_cb=rcb)
 
     def predict_batches(self, xs: List) -> List:
         return [self.predict(x) for x in xs]
@@ -992,6 +1073,7 @@ class InferenceModel:
                 # straight through the jit (not predict): warmup must
                 # not pollute the serving timer percentiles
                 jax.block_until_ready(self._jit(self._params, batch))
+                self._harvest_jit_cost(self._params, batch)
             rkey = f"{tag}:b{b}"
             self.warmup_report[rkey] = round(time.perf_counter() - t0, 4)
             self.warmup_source[rkey] = src
@@ -1047,6 +1129,9 @@ class InferenceModel:
             batch = jax.tree_util.tree_map(
                 lambda a, _b=b: np.ascontiguousarray(
                     np.broadcast_to(a[None], (_b,) + a.shape)), sample)
+            # one harvest per bucket (every replica runs the same
+            # program; replica 0's params stand in for all)
+            self._harvest_jit_cost(self._replicas[0].params, batch)
             for rep in self._replicas:
                 pending = _RoutedPending(b, timer=None, replica=rep.index)
                 # t0=None: the worker stamps its own start, so the report

@@ -119,7 +119,8 @@ class ClusterServing:
                  latency_floor_ms: float = 50.0,
                  breaker_failure_threshold: int = 3,
                  breaker_reset_s: float = 1.0,
-                 sink_buffer_batches: int = 256):
+                 sink_buffer_batches: int = 256,
+                 slo=None):
         """Fault-tolerance knobs (ISSUE 5; the rest is PR 1-4 surface):
         `supervise` starts a `ReplicaSupervisor` over a replica pool
         (quarantine after `failure_threshold` consecutive failures or
@@ -128,7 +129,12 @@ class ClusterServing:
         The engine's reader/sink broker connections wear a circuit
         breaker (`breaker_*`), and failed sink writebacks buffer up to
         `sink_buffer_batches` before the oldest is shed (shed records
-        stay unacked and redeliver)."""
+        stay unacked and redeliver).
+
+        `slo` (ISSUE 6): declarative objectives — an
+        `observability.slo.SLOObjectives` — evaluated over the engine's
+        own latency/outcome metrics; the tracker feeds `health()` / the
+        frontend's `/healthz` and publishes burn-rate gauges."""
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
@@ -201,6 +207,14 @@ class ClusterServing:
         self._sink_down = False
         self.probe_interval_s = probe_interval_s
         self._wire_registry()
+        self.slo = None
+        if slo is not None:
+            from analytics_zoo_tpu.observability.slo import (SLOObjectives,
+                                                             SLOTracker)
+            objectives = slo if isinstance(slo, SLOObjectives) \
+                else SLOObjectives(**slo)
+            if not objectives.empty:
+                self.slo = SLOTracker(objectives, registry=self.registry)
         self.supervisor = None
         if supervise and self._multi_replica:
             from analytics_zoo_tpu.serving.supervisor import \
@@ -311,10 +325,56 @@ class ClusterServing:
         probe cadence, so retrying sooner than that is wasted."""
         return max(1, int(round(self.probe_interval_s + 0.5)))
 
+    def health(self) -> dict:
+        """Readiness aggregation for `/healthz` (ISSUE 6): the engine is
+        READY when its stage threads run, at least one replica accepts
+        work, and neither broker breaker is open. SLO status rides along
+        in the payload (a burning error budget is an alarm, not a
+        reason to eject the pod from rotation — operators page on
+        `slo_burn_rate`, load balancers act on `ready`)."""
+        healthy = self.healthy_replicas()
+        replicas_ok = healthy is None or healthy > 0
+        breakers = {}
+        breakers_ok = True
+        for role, br in (("reader", self.reader_broker),
+                         ("sink", self.sink_broker)):
+            breaker = getattr(br, "breaker", None)
+            if breaker is not None:
+                state = breaker.state
+                breakers[role] = state
+                breakers_ok = breakers_ok and state != "open"
+        running = bool(self._threads) and not self._stop.is_set() \
+            and self.is_alive()
+        out = {
+            "ready": bool(running and replicas_ok and breakers_ok),
+            "running": running,
+            "healthy_replicas": healthy,
+            "breakers": breakers,
+        }
+        if not running:
+            out["reason"] = "engine not running"
+        elif not replicas_ok:
+            out["reason"] = "every model replica is quarantined"
+        elif not breakers_ok:
+            out["reason"] = "broker circuit open"
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.stats()
+        if self.slo is not None:
+            try:
+                out["slo"] = self.slo.evaluate()
+            except Exception:  # noqa: BLE001 — health must always answer
+                out["slo"] = None
+        return out
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ClusterServing":
         if self.supervisor is not None:
             self.supervisor.start()
+        if self.slo is not None:
+            # self-driving evaluation: violation detection must not
+            # depend on an external scrape happening more often than
+            # the SLO window
+            self.slo.start_auto()
         if self.pipelined:
             specs = [("serving-reader", self._reader_loop)]
             specs += [(f"serving-decode-{i}", self._decode_loop)
@@ -341,6 +401,8 @@ class ClusterServing:
         feeding it has exited, so work already read from the broker flows
         through to the sink before shutdown."""
         self._stop.set()
+        if self.slo is not None:
+            self.slo.stop_auto()
         if self.supervisor is not None:
             # first: a mid-drain revival would reshuffle routing under
             # the draining dispatcher for no benefit
@@ -745,6 +807,12 @@ class ClusterServing:
         with self._counter_lock:
             self.records_served += len(mapping)
         self._records_total.inc(len(mapping), outcome="served")
+        # NaN-degraded records count as "failed" alongside (not instead
+        # of) "served" — the SLO availability window reads
+        # (served - failed) / served
+        nan_n = sum(1 for v in mapping.values() if v == "NaN")
+        if nan_n:
+            self._records_total.inc(nan_n, outcome="failed")
         self.batch_timer.record(t_end - t0)
         return True
 
@@ -855,6 +923,8 @@ class ClusterServing:
         by_shape, failed = self._decode_records(records)
         for _rid, uri in failed:
             self.broker.hset(self.result_key, uri, "NaN")
+        if failed:
+            self._records_total.inc(len(failed), outcome="failed")
         for shape, items in by_shape.items():
             batch = np.stack([a for _, _, a in items])
             try:
@@ -879,6 +949,7 @@ class ClusterServing:
                 log.error("inference failure for batch %s: %s", shape, e)
                 for _rid, uri, _ in items:
                     self.broker.hset(self.result_key, uri, "NaN")
+                self._records_total.inc(len(items), outcome="failed")
 
     # -- metrics (`/metrics`, FrontEndApp.scala:241) -----------------------
     def metrics(self) -> dict:
@@ -913,6 +984,11 @@ class ClusterServing:
         if self.supervisor is not None:
             ft["supervisor"] = self.supervisor.stats()
         m["fault_tolerance"] = ft
+        if self.slo is not None:
+            try:
+                m["slo"] = self.slo.evaluate()
+            except Exception:  # noqa: BLE001 — metrics must always answer
+                m["slo"] = None
         size_fn = getattr(self.model, "compile_cache_size", None)
         if size_fn is not None:
             # per-(replica, bucket) executable count, plus persistent-

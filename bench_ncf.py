@@ -76,6 +76,23 @@ def main():
                   flat_optimizer=os.environ.get("BENCH_FLATOPT", "0") == "1")
 
     est.fit((x, y), **fit_kw)          # warmup: compile + first epoch
+
+    # BENCH_CALIBRATE=1: measure the session's ACHIEVED bandwidth/MXU
+    # rate BEFORE the timed fits and install it as the session roofline
+    # (observability/roofline.py) — the live
+    # `roofline_hbm_utilization{kind="train"}` gauge the timed fits
+    # publish is then %-of-ACHIEVABLE, the same yardstick as the manual
+    # pct_of_achievable_bound math below, with no byte model
+    achieved_gbps = achieved_tflops = None
+    if os.environ.get("BENCH_CALIBRATE") == "1":
+        n_params_cal = sum(int(np.prod(np.shape(p))) for p in
+                           jax.tree_util.tree_leaves(ncf.model.params))
+        achieved_gbps = _calibrate_hbm(n_params_cal)
+        achieved_tflops = _calibrate_mxu()
+        from analytics_zoo_tpu.observability import set_session_roofline
+        set_session_roofline(hbm_gbps=achieved_gbps,
+                             tflops=achieved_tflops)
+
     dt = float("inf")
     for _ in range(1 if tiny else 3):  # best-of-3 (tunnel variance)
         t0 = time.perf_counter()
@@ -109,21 +126,30 @@ def main():
                 else (bytes_step * steps / dt) / peak_hbm(dev))
     mfu = (flops_step * steps / dt) / peak_flops(dev)
 
-    # BENCH_CALIBRATE=1: measure the session's ACHIEVED bandwidth with an
-    # Adam-shaped 7-pass sweep (the tunnel chip swings 0.3-1x of
-    # nameplate day to day; docs/ROOFLINE.md round-5 NCF section) so the
-    # bound can be judged against what the chip can actually stream.
-    achieved_gbps = pct_achievable = achieved_tflops = None
-    if os.environ.get("BENCH_CALIBRATE") == "1":
-        # the sweep itself needs no analytic byte model — run it even in
-        # lazy mode so the session yardstick (bench.py session_hbm_gbps)
-        # survives A/B configurations; only the bound comparison needs
-        # bytes_step
-        achieved_gbps = _calibrate_hbm(n_params)
-        achieved_tflops = _calibrate_mxu()
-        if bytes_step is not None:
-            floor_s = bytes_step / (achieved_gbps * 1e9)
-            pct_achievable = round(100 * floor_s / (dt / steps), 1)
+    # calibration ran pre-fit (so the live gauges saw the session
+    # roofline); here only the manual bound comparison remains
+    pct_achievable = None
+    if achieved_gbps is not None and bytes_step is not None:
+        floor_s = bytes_step / (achieved_gbps * 1e9)
+        pct_achievable = round(100 * floor_s / (dt / steps), 1)
+
+    # the LIVE version of the same number (ISSUE 6): the trainer's
+    # roofline_hbm_utilization{kind="train"} gauge — XLA-counted bytes
+    # over the calibrated session roofline, zero manual math. The
+    # analytic pct above and this should roughly agree; where they
+    # split, XLA's count includes traffic the 7-pass model ignores,
+    # and the timing bases differ (the live number covers the LAST
+    # timed fit, the manual one the best of 3 — worth ±(tunnel noise)).
+    live_pct = live_gbps = None
+    try:
+        from analytics_zoo_tpu.observability import get_accountant
+        live = get_accountant().snapshot("train")
+        if live.get("hbm_utilization") is not None:
+            live_pct = round(live["hbm_utilization"] * 100, 1)
+        if live.get("achieved_hbm_gbps") is not None:
+            live_gbps = round(live["achieved_hbm_gbps"], 1)
+    except Exception:  # noqa: BLE001 — headline must survive
+        pass
 
     print(json.dumps({
         "metric": "ncf_train_samples_per_sec_via_estimator_fit",
@@ -143,6 +169,8 @@ def main():
         "achieved_hbm_gbps": achieved_gbps,
         "achieved_mxu_tflops": achieved_tflops,
         "pct_of_achievable_bound": pct_achievable,
+        "ncf_pct_of_achievable_bound_live": live_pct,
+        "ncf_achieved_hbm_gbps_live": live_gbps,
         "final_loss": float(hist["loss"][-1]),
     }))
 

@@ -15,6 +15,9 @@ violation fails CI before it ever runs:
   the whole codebase (get-or-create from several sites is fine — that
   is the convergence the registry exists for — but the same name as
   both a counter and a gauge is a collision Prometheus would reject)
+- docs drift (ISSUE 6 satellite): every REQUIRED family must appear in
+  `docs/ProgrammingGuide/observability.md`, so a new load-bearing
+  family (profiler, SLO, memory, roofline) cannot ship undocumented
 
 Exit code 0 when clean; 1 with one line per violation otherwise.
 
@@ -63,7 +66,25 @@ REQUIRED = {
     "serving_broker_breaker_state": "gauge",
     "training_resumes_total": "counter",
     "training_step_retries_total": "counter",
+    # deep-profiling layer (ISSUE 6): roofline accounting, on-demand
+    # capture, device-memory telemetry, SLO health — the families the
+    # bench JSON, /healthz, and the docs tables read
+    "roofline_flops_total": "counter",
+    "roofline_hbm_bytes_total": "counter",
+    "roofline_achieved_tflops": "gauge",
+    "roofline_achieved_hbm_gbps": "gauge",
+    "roofline_mfu": "gauge",
+    "roofline_hbm_utilization": "gauge",
+    "profile_captures_total": "counter",
+    "device_memory_live_bytes": "gauge",
+    "device_memory_peak_bytes": "gauge",
+    "slo_burn_rate": "gauge",
+    "slo_met": "gauge",
+    "observability_gauge_errors_total": "counter",
 }
+
+OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",
+                                 "observability.md")
 
 
 def iter_sources(roots) -> List[str]:
@@ -134,7 +155,25 @@ def check(roots=DEFAULT_ROOTS) -> List[str]:
                 errors.append(
                     f"required metric {name!r} must be a {kind}, found "
                     f"{got[0]} at {got[1]}:{got[2]}")
+        errors.extend(check_docs())
     return errors
+
+
+def check_docs(doc_path: str = OBSERVABILITY_DOC,
+               required=None) -> List[str]:
+    """Docs-drift pass: every REQUIRED family must be mentioned in the
+    observability guide. The match is a plain substring — a table row, a
+    prose mention, or a code block all count; what cannot happen is a
+    load-bearing family shipping with no documentation at all."""
+    required = REQUIRED if required is None else required
+    if not os.path.exists(doc_path):
+        return [f"{doc_path}: observability guide missing — required "
+                "metric families have nowhere to be documented"]
+    with open(doc_path, encoding="utf-8") as fh:
+        text = fh.read()
+    return [f"{doc_path}: required metric {name!r} is not documented "
+            "(docs drift — add it to the guide's tables)"
+            for name in sorted(required) if name not in text]
 
 
 def main(argv=None) -> int:
