@@ -98,6 +98,13 @@ class ZooConfig:
     log_level: str = "INFO"
     log_output: bool = False
     seed: int = 0
+    # GSPMD-sharded training by default: fit_keras shards params and
+    # optimizer state over the mesh's fsdp axis with the default
+    # transformer rule table (the same table serving's sharded placement
+    # uses). Equivalent to fit_keras(sharding_rules=True); the env
+    # spelling is ZOO_SHARDED_FIT=1. Pair with a MeshConfig whose fsdp
+    # axis is > 1 (e.g. ZOO_MESH_DATA=1 ZOO_MESH_FSDP=-1).
+    sharded_fit: bool = False
     default_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     # pandas_read_backend flag of the reference (`nncontext.py:269`)

@@ -35,11 +35,15 @@ class AOTFunctionCache:
     dict hit + the executable call."""
 
     def __init__(self, jit_fn: Callable, cache, model_fp: str,
-                 kind: str = "train"):
+                 kind: str = "train", sharding: str = ""):
         self._jit = jit_fn
         self._cache = cache
         self._model_fp = model_fp
         self._kind = kind
+        # mesh-axis + rule-table descriptor for GSPMD-sharded steps: the
+        # argument SHAPES of a replicated and an fsdp-sharded step can
+        # coincide exactly, so the disk key must carry the layout too
+        self._sharding = sharding
         self._execs: Dict[Tuple, Any] = {}    # cheap sig -> executable
         self._failed: Set[Tuple] = set()
         self.sources: Dict[Tuple, str] = {}   # sig -> cached|compiled|jit
@@ -80,7 +84,8 @@ class AOTFunctionCache:
 
     def _build(self, csig, args):
         sig = abstract_signature(args)
-        key = make_key(self._kind, self._model_fp, sig, placement="train")
+        key = make_key(self._kind, self._model_fp, sig, placement="train",
+                       sharding=self._sharding)
         try:
             ex = self._cache.load(key)
             if ex is not None and serialization.args_treedef(ex) \

@@ -28,7 +28,12 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, Tuple
 
-FORMAT_VERSION = 1
+# Bump to invalidate every persisted executable when layout SEMANTICS
+# change: v2 = ISSUE 7's ShardingRules.spec_for fsdp fallback for
+# matched-but-untrimmable rules (the same table now resolves different
+# placements on data×fsdp meshes, and a stale sharded executable would
+# reject — or silently reshard — its inputs).
+FORMAT_VERSION = 2
 
 _MAX_DEPTH = 5
 _MAX_ITEMS = 64

@@ -46,6 +46,43 @@ class CorruptCheckpointError(RuntimeError):
 # ---------------------------------------------------------------------------
 # Pytree <-> flat ndarray dict
 # ---------------------------------------------------------------------------
+def gather_leaf(a: Any) -> np.ndarray:
+    """Host copy of one checkpoint leaf, correct for sharded
+    `jax.Array`s (the GSPMD fit's params/opt_state):
+
+    - fully replicated → read ONE addressable shard; a bare np.asarray
+      would be correct too but this makes the single-fetch explicit;
+    - sharded but fully addressable (single-process mesh) → one
+      device_get assembles every shard exactly once (np.asarray funnels
+      through jax's single-gather conversion — shards are not fetched
+      per-element or twice);
+    - not fully addressable (multi-process) → actionable error: saving
+      would silently write this host's partial view.
+
+    Everything else (numpy, scalars) converts as before."""
+    try:
+        import jax
+        if isinstance(a, jax.Array):
+            if a.is_fully_replicated:
+                return np.asarray(a.addressable_data(0))
+            if not a.is_fully_addressable:
+                raise NotImplementedError(
+                    "checkpointing a cross-host sharded array: this "
+                    "process cannot address every shard; gather to "
+                    "host (e.g. multihost_utils.process_allgather) "
+                    "before saving")
+    except ImportError:          # jax-less tooling reading numpy trees
+        pass
+    return np.asarray(a)
+
+
+def gather_tree(tree: Any) -> Any:
+    """`gather_leaf` over a pytree — the host view a checkpoint
+    stores."""
+    import jax
+    return jax.tree_util.tree_map(gather_leaf, tree)
+
+
 def _walk(tree: Any, path: List[List[Any]], paths: List[Any],
           leaves: List[np.ndarray]) -> None:
     """Record every node: leaves carry data; empty containers carry a marker
@@ -65,7 +102,7 @@ def _walk(tree: Any, path: List[List[Any]], paths: List[Any],
             _walk(v, path + [["i", i]], paths, leaves)
     else:
         paths.append({"path": path, "leaf": len(leaves)})
-        leaves.append(np.asarray(tree))
+        leaves.append(gather_leaf(tree))
 
 
 def save_pytree(path: str, tree: Any) -> None:
@@ -357,8 +394,11 @@ def load_checkpoint(path: str, version: Optional[int] = None,
 
 
 def _optstate_to_tree(opt_state: Any) -> Any:
-    """Optax states are namedtuple pytrees; store leaves + paths only."""
-    return jax.tree_util.tree_map(np.asarray, opt_state)
+    """Optax states are namedtuple pytrees; store leaves + paths only.
+    Routed through `gather_leaf` so a GSPMD fit's sharded optimizer
+    moments gather correctly (addressable shards fetched exactly
+    once)."""
+    return jax.tree_util.tree_map(gather_leaf, opt_state)
 
 
 def restore_opt_state(template: Any, tree: Any) -> Any:

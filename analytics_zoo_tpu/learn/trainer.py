@@ -84,15 +84,20 @@ class _TrainingMetrics:
             self.mfu.set(flops_per_step * steps / max(dt, 1e-9) / peak)
         return step_ms
 
-    def roofline(self, flops: float, bytes_: float, dt: float):
+    def roofline(self, flops: float, bytes_: float, dt: float,
+                 n_devices: int = 1):
         """Cost-analysis roofline for one epoch (ISSUE 6): publishes
         `roofline_mfu{kind="train"}` / `roofline_hbm_utilization` etc.
         from the XLA-counted FLOPs/bytes over the epoch's device wall
         time — no hand-supplied flops_per_step, and HBM utilization
-        against the measured session roofline."""
+        against the measured session roofline. `flops`/`bytes_` are
+        GLOBAL (all participating devices); `n_devices` is the step
+        program's device span, scaling the roofline denominator so a
+        sharded fit's MFU reads against the whole slice's peak."""
         from analytics_zoo_tpu.observability.roofline import get_accountant
         get_accountant().account("train", flops, bytes_, dt,
-                                 device=jax.devices()[0])
+                                 device=jax.devices()[0],
+                                 n_devices=n_devices)
 
 
 # ---------------------------------------------------------------------------
@@ -138,8 +143,19 @@ def iter_batches(x, y=None, batch_size: int = 32, shuffle: bool = False,
         yield xb, yb, real
 
 
-def check_global_batch(batch_size: int, dp: int) -> None:
+def check_global_batch(batch_size: int, dp: int, fsdp: int = 1) -> None:
+    """`dp` is the full batch-splitting extent (data × fsdp — BOTH are
+    batch axes, `common/mesh.BATCH_AXES`); `fsdp` names the fsdp part so
+    the error can say which axis the caller actually configured."""
     if batch_size % dp != 0:
+        if fsdp > 1:
+            raise ValueError(
+                f"global batch_size ({batch_size}) must be a multiple of "
+                f"the batch-splitting extent {dp} = data ({dp // fsdp}) × "
+                f"fsdp ({fsdp}) — the fsdp axis splits the batch too "
+                f"(ZeRO-style sharding rides the data path). Use a "
+                f"batch_size that is a multiple of {dp}, or shrink the "
+                f"fsdp axis to a divisor of your batch.")
         raise ValueError(
             f"global batch_size ({batch_size}) must be a multiple of the "
             f"data-parallel size ({dp}) — the reference's total-core-number "
@@ -283,7 +299,18 @@ class _StepCostTracker:
     and the single-step program trivially reports one step's. So the
     accumulated `flops`/`bytes` are PER-STEP costs × `calls`; the
     epoch accounting in `fit_keras` scales the per-call mean by the
-    epoch's iteration count, which is exact for every program shape."""
+    epoch's iteration count, which is exact for every program shape.
+
+    Basis: harvested costs are the LOGICAL GLOBAL cost of one step
+    (the ExecCost contract — model work counted once). A partitioned
+    executable's `cost_analysis()` counts its per-device module, and
+    per-device × span over-counts work that replicates across a mesh
+    axis, so for multi-device programs the tracker ALWAYS harvests by
+    lowering the SDS skeleton (one trace per signature, no compile);
+    the zero-lowering executable fast path is kept for single-device
+    programs, where the two bases agree. `self.devices` records the
+    program span for the accountant's roofline denominator — classic
+    MFU: model flops over the participating slice's peak."""
 
     def __init__(self, train_step, memo: Dict):
         self._step = train_step
@@ -292,6 +319,8 @@ class _StepCostTracker:
         self.flops = 0.0
         self.bytes = 0.0
         self.calls = 0
+        self.devices = 1
+        self._span_known = False
 
     def reset_epoch(self):
         self.flops = 0.0
@@ -338,6 +367,13 @@ class _StepCostTracker:
 
     def before(self, args):
         try:
+            if not self._span_known:
+                # one walk per fit: the step program's device span is
+                # fixed by the (mesh, placement) the fit chose
+                from analytics_zoo_tpu.observability.roofline import \
+                    device_span
+                self.devices = device_span(args)
+                self._span_known = True
             key = self._sig(args)
             if key in self._memo:
                 self._accumulate(self._memo[key])
@@ -368,11 +404,17 @@ class _StepCostTracker:
         step = self._step
         try:
             execs_fn = getattr(step, "executables", None)
-            if execs_fn is not None:
+            if execs_fn is not None and self.devices == 1:
+                # single-device: the executable answers directly (no
+                # lowering at all on a warm AOT re-run)
                 cost = cost_of(execs_fn().get(sig))
                 if cost is not None:
                     return cost
             fn = getattr(step, "wrapped", step)
+            # multi-device (and the plain-jit fallback): the lowered,
+            # UNPARTITIONED module is the logical basis — a partitioned
+            # executable's per-device count can't be scaled back
+            # exactly (see ExecCost)
             return cost_of(fn.lower(*sds_args))
         except Exception as e:  # noqa: BLE001 — telemetry only
             log.debug("step cost harvest failed: %s: %s",
@@ -473,6 +515,42 @@ def _stack_group(group, mesh):
             real, len(group))
 
 
+def _resolve_sharding_rules(sharding_rules, ctx):
+    """Normalize the fit's `sharding_rules` knob: None consults the
+    config passthrough (`ZooConfig.sharded_fit` / env ZOO_SHARDED_FIT),
+    True means the default transformer table, a `ShardingRules` passes
+    through. Returns a ShardingRules or None (replicated fit)."""
+    if sharding_rules is None and ctx is not None \
+            and getattr(ctx.config, "sharded_fit", False):
+        sharding_rules = True
+    if sharding_rules is True:
+        from analytics_zoo_tpu.parallel.sharding import TRANSFORMER_RULES
+        return TRANSFORMER_RULES
+    if sharding_rules is False:
+        return None
+    return sharding_rules
+
+
+def _step_shardings(mesh, param_shardings, opt_shardings):
+    """The layout dict `_jit_donated` pins into the step/run programs."""
+    return {"params": param_shardings, "opt": opt_shardings,
+            "batch": mesh.batch_sharding(),
+            "stacked": mesh.stacked_batch_sharding(),
+            "rep": mesh.replicated()}
+
+
+def _put_with_shardings(tree, shardings):
+    """device_put every leaf onto its rule-derived NamedSharding. A
+    leaf already carrying the target sharding passes through as the
+    same buffer, so re-placing live sharded state is free; a host leaf
+    (checkpoint restore) lands DIRECTLY on the sharded layout — the
+    host array goes to device_put as-is (an eager jnp.asarray would
+    first materialize the FULL leaf on the default device, OOMing
+    exactly the bigger-than-one-chip model this path exists for)."""
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, s), tree, shardings)
+
+
 def _put_replicated(tree, mesh):
     if mesh is None:
         return jax.tree_util.tree_map(lambda a: jax.device_put(a), tree)
@@ -561,28 +639,49 @@ def _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
     return one_step
 
 
+def _jit_donated(fn, shardings, batch_key: str, n_extra_out: int):
+    """jit with donated (params, opt_state) buffers. `shardings` (a
+    sharded fit's rule-derived layout dict, see `_step_shardings`) pins
+    explicit in/out shardings: params and opt_state arrive AND leave on
+    the rule table's NamedShardings (so GSPMD cannot re-layout them and
+    donation stays an in-place buffer reuse — in == out is the donation
+    contract), the batch on the mesh's batch axes, rng and losses
+    replicated. Without it, behavior is byte-for-byte the old jit."""
+    if shardings is None:
+        return jax.jit(fn, donate_argnums=(0, 1))
+    bsh = shardings[batch_key]
+    rep = shardings["rep"]
+    in_sh = (shardings["params"], shardings["opt"], bsh, bsh, rep)
+    out_sh = (shardings["params"], shardings["opt"]) + (rep,) * n_extra_out
+    return jax.jit(fn, donate_argnums=(0, 1),
+                   in_shardings=in_sh, out_shardings=out_sh)
+
+
 def build_train_step(apply_fn: Callable, loss_fn: Callable,
                      optimizer: optax.GradientTransformation,
                      apply_and_state_fn: Optional[Callable] = None,
                      mixed_precision: bool = False,
-                     lazy_specs=None, flat_spec=None) -> Callable:
+                     lazy_specs=None, flat_spec=None,
+                     shardings=None) -> Callable:
     """One iteration as a pure function. jit + sharded inputs → GSPMD emits
     the gradient all-reduce; donation reuses parameter buffers in HBM.
     Stateful layers (BatchNorm moving stats) return updates through the aux
     channel and are merged outside the gradient path.
     mixed_precision=True keeps f32 master params and runs the fwd/bwd
-    matmuls in bf16 (MXU-native)."""
+    matmuls in bf16 (MXU-native). `shardings` (from `_step_shardings`)
+    pins the fsdp-sharded layout explicitly — the GSPMD fit."""
     one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
                               apply_and_state_fn, mixed_precision,
                               lazy_specs, flat_spec)
-    return jax.jit(one_step, donate_argnums=(0, 1))
+    return _jit_donated(one_step, shardings, "batch", 1)
 
 
 def build_train_run(apply_fn: Callable, loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
                     apply_and_state_fn: Optional[Callable] = None,
                     mixed_precision: bool = False,
-                    lazy_specs=None, flat_spec=None) -> Callable:
+                    lazy_specs=None, flat_spec=None,
+                    shardings=None) -> Callable:
     """Multi-step variant: one jit'd program `lax.scan`s over a
     (k, batch, ...) stack of batches, so k steps cost ONE dispatch and ONE
     loss readback. This is the framework's hot path — the analogue of the
@@ -604,7 +703,7 @@ def build_train_run(apply_fn: Callable, loss_fn: Callable,
             body, (params, opt_state, rng), (xs, ys))
         return params, opt_state, rng, losses
 
-    return jax.jit(train_run, donate_argnums=(0, 1))
+    return _jit_donated(train_run, shardings, "stacked", 2)
 
 
 def build_device_epoch_run(apply_fn: Callable, loss_fn: Callable,
@@ -612,7 +711,8 @@ def build_device_epoch_run(apply_fn: Callable, loss_fn: Callable,
                            apply_and_state_fn: Optional[Callable] = None,
                            mixed_precision: bool = False,
                            lazy_specs=None, flat_spec=None, steps: int = 1,
-                           batch: int = 1, shuffle: bool = True) -> Callable:
+                           batch: int = 1, shuffle: bool = True,
+                           shardings=None) -> Callable:
     """Whole-epoch program over a DEVICE-RESIDENT dataset: shuffle
     (on-device permutation), batch (on-device gather) and all `steps`
     train steps run inside ONE `lax.scan` dispatch. Eliminates every
@@ -644,7 +744,7 @@ def build_device_epoch_run(apply_fn: Callable, loss_fn: Callable,
             body, (params, opt_state, step_rng0), idx)
         return params, opt_state, losses
 
-    return jax.jit(epoch_run, donate_argnums=(0, 1))
+    return _jit_donated(epoch_run, shardings, "batch", 1)
 
 
 def _epoch_safe_trigger(trigger) -> bool:
@@ -748,6 +848,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               lazy_embeddings: bool = False,
               device_cache: Optional[bool] = None,
               flat_optimizer: bool = False,
+              sharding_rules=None,
               flops_per_step: Optional[float] = None,
               metrics_report_s: Optional[float] = None,
               compile_cache_dir: Optional[str] = None,
@@ -786,6 +887,24 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     consistent; per-tensor checkpoints won't resume under it) and
     tree-structure-dependent transforms (e.g. `optax.masked` decay
     masks) don't survive repacking. Ignored with `lazy_embeddings`.
+    `sharding_rules` turns the fit into a GSPMD-sharded pjit program
+    (the training twin of serving's sharded placement): params and
+    optimizer state shard over the mesh's `fsdp` axis per the SAME
+    regex→PartitionSpec table serving consumes (`parallel/sharding.
+    ShardingRules`; pass True for the default transformer table, or a
+    ShardingRules instance; `ZooConfig.sharded_fit` / env
+    ZOO_SHARDED_FIT=1 is the config spelling), the batch stays split
+    over the (data × fsdp) batch axes, and explicit in/out shardings
+    pin the rule layout through the donated step/run programs — XLA
+    inserts the just-in-time all-gathers and gradient reduce-scatters
+    (GSPMD + ZeRO-3). Per-device params+opt_state drop to ≈ 1/fsdp of
+    the replicated footprint, which is what lets a model larger than
+    one chip's HBM train at all. Checkpoints save in the ordinary
+    gathered host layout and restore DIRECTLY onto the rule-derived
+    shardings, so a sharded fit's checkpoint loads into serving's
+    sharded placement with zero resharding. Incompatible with
+    `flat_optimizer`/`lazy_embeddings` (both re-pack the param tree
+    the rule table describes) and multi-process fits (for now).
     `compile_cache_dir` (or env `ZOO_COMPILE_CACHE_DIR`) enables the
     persistent compilation cache: the jitted step/run executables are
     AOT-serialized per input signature (`compile_cache/`), so a trainer
@@ -818,7 +937,38 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     ctx = get_context()
     mesh = ctx.mesh if distributed else None
     dp = mesh.data_parallel_size if mesh else 1
-    check_global_batch(batch_size, dp)
+    shard_rules = _resolve_sharding_rules(sharding_rules, ctx)
+    if shard_rules is not None:
+        if mesh is None:
+            if sharding_rules is None:
+                # config-driven default (ZooConfig.sharded_fit) quietly
+                # steps aside for an explicitly non-distributed fit;
+                # only the explicit kwarg is a hard contradiction
+                shard_rules = None
+            else:
+                raise ValueError(
+                    "sharding_rules needs distributed=True (the rule "
+                    "table shards over the context mesh); drop "
+                    "distributed=False or the rules")
+    if shard_rules is not None:
+        if flat_optimizer or lazy_embeddings:
+            raise NotImplementedError(
+                "sharding_rules is incompatible with flat_optimizer/"
+                "lazy_embeddings: both re-pack the parameter tree the "
+                "rule table is written against")
+        if mesh.size("fsdp") == 1 and mesh.size("tensor") == 1:
+            # every rule trims to replication on such a mesh: the fit
+            # runs, but fully replicated — say so instead of letting a
+            # sharded_fit=True config silently deliver none of the
+            # 1/fsdp memory it was turned on for
+            log.warning(
+                "sharding_rules requested but the mesh has fsdp=1 and "
+                "tensor=1 (%s): params/opt_state will be fully "
+                "replicated. Set the fsdp axis (e.g. "
+                "init_orca_context(data=1, fsdp=-1) or ZOO_MESH_FSDP) "
+                "to actually shard state.", mesh)
+    check_global_batch(batch_size, dp,
+                       fsdp=mesh.size("fsdp") if mesh else 1)
     if steps_per_run < 1:
         raise ValueError(f"steps_per_run must be >=1, got {steps_per_run}")
 
@@ -841,6 +991,15 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 "Multi-process fit currently supports pure data-parallel "
                 "meshes (data×fsdp covering all devices); got "
                 f"dp={dp} of {jax.device_count()} devices")
+        if shard_rules is not None:
+            # rule-sharded state would live partly on non-addressable
+            # devices; checkpoint gather + resume re-shard are
+            # single-process for now
+            raise NotImplementedError(
+                "sharding_rules is single-process for now: sharded "
+                "params span non-addressable devices under "
+                "multi-process, which the checkpoint gather/restore "
+                "paths do not handle yet")
         if batch_iter_factory is not None:
             # lazy/streaming datasets batch at the GLOBAL size per process
             # and (worse) every process would stream the same records —
@@ -954,7 +1113,20 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 "(epoch %d, iteration %d)",
                 run_dir, version, start_epoch, iteration)
 
-    params = _put_replicated(model.params, mesh)
+    param_shardings = step_shardings = None
+    if shard_rules is not None:
+        from analytics_zoo_tpu.parallel.sharding import (
+            check_fsdp_divisibility, tree_shardings)
+        # fail at config time, not at OOM time: a large param that
+        # can't shard over fsdp would silently replicate everywhere
+        check_fsdp_divisibility(model.params, mesh, shard_rules)
+        param_shardings = tree_shardings(model.params, mesh, shard_rules)
+        # host params (fresh build or checkpoint restore) land DIRECTLY
+        # on the rule layout — the resume path never materializes a
+        # replicated copy
+        params = _put_with_shardings(model.params, param_shardings)
+    else:
+        params = _put_replicated(model.params, mesh)
     lazy_specs = None
     if lazy_embeddings:
         from analytics_zoo_tpu.learn.lazy_embedding import resolve_specs
@@ -983,10 +1155,24 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         model.params hand-off need the tree form of the flat carry."""
         return flat_spec.unravel_device(p) if flat_spec is not None else p
 
+    opt_shardings = None
     if lazy_specs:
         from analytics_zoo_tpu.learn.lazy_embedding import init_state
         opt_state = _put_replicated(
             init_state(params, lazy_specs, optimizer), mesh)
+    elif shard_rules is not None:
+        # eager init on sharded params: elementwise leaves (Adam moments)
+        # inherit their param's sharding; the explicit re-put mirrors the
+        # rule table onto EVERY leaf (step counters and any moment the
+        # propagation missed land replicated / rule-sharded exactly) —
+        # the match_partition_rules pattern: one table resolves params
+        # and optimizer state
+        opt_state = optimizer.init(params)
+        from analytics_zoo_tpu.parallel.sharding import tree_shardings
+        opt_shardings = tree_shardings(opt_state, mesh, shard_rules)
+        opt_state = _put_with_shardings(opt_state, opt_shardings)
+        step_shardings = _step_shardings(mesh, param_shardings,
+                                         opt_shardings)
     else:
         opt_state = _put_replicated(optimizer.init(params), mesh)
     if resume_opt_tree is not None:
@@ -999,9 +1185,13 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 f"{saved_layout!r} but this fit would build "
                 f"{live_layout!r} (flat_optimizer toggled between "
                 "runs?); re-run with the original setting")
-        opt_state = _put_replicated(
-            restore_opt_state(jax.device_get(opt_state),
-                              resume_opt_tree), mesh)
+        restored = restore_opt_state(jax.device_get(opt_state),
+                                     resume_opt_tree)
+        # sharded resume: saved host leaves re-shard DIRECTLY onto the
+        # rule-derived layout (no replicate-then-reshard hop)
+        opt_state = _put_with_shardings(restored, opt_shardings) \
+            if opt_shardings is not None else _put_replicated(restored,
+                                                              mesh)
 
     # Cache the jitted step on the model: repeated fit calls (warm restarts,
     # per-round loops) must hit the compile cache, not rebuild a fresh
@@ -1010,15 +1200,28 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     dc_steps = (_tree_len(x) // local_batch) if use_device_cache else 0
     cc_dir = compile_cache_dir if compile_cache_dir is not None \
         else os.environ.get("ZOO_COMPILE_CACHE_DIR") or None
+    # sharding descriptor: mesh axis extents + the rule table's content
+    # hash. Part of BOTH the in-process step memo key and the on-disk
+    # AOT key — a replicated fit and an fsdp-sharded fit (or two
+    # different rule tables / mesh factorizations) are different
+    # programs and must never share an executable. Stable across
+    # processes (no id()), so a sharded re-fit in a fresh process still
+    # hits its own entries.
+    shard_desc = ""
+    if shard_rules is not None:
+        from analytics_zoo_tpu.parallel.sharding import sharding_descriptor
+        shard_desc = sharding_descriptor(mesh, shard_rules)
     if use_device_cache:
         cache_key = (id(optimizer), id(model.loss), "devcache",
                      mixed_precision, lazy_embeddings, dc_steps,
                      local_batch, shuffle,
-                     flat_spec.uid if flat_spec else None, cc_dir)
+                     flat_spec.uid if flat_spec else None, cc_dir,
+                     shard_desc)
     else:
         cache_key = (id(optimizer), id(model.loss), multi,
                      mixed_precision, lazy_embeddings,
-                     flat_spec.uid if flat_spec else None, cc_dir)
+                     flat_spec.uid if flat_spec else None, cc_dir,
+                     shard_desc)
     cached = getattr(model, "_train_cache", None)
     if cached is not None and cached[0] == cache_key:
         train_step = cached[1]
@@ -1033,7 +1236,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             model.apply, model.loss, optimizer,
             apply_and_state_fn=getattr(model, "apply_and_state", None),
             mixed_precision=mixed_precision, lazy_specs=lazy_specs,
-            flat_spec=flat_spec)
+            flat_spec=flat_spec, shardings=step_shardings)
         if cc_dir:
             # persistent compilation cache: AOT-serialize the step/run
             # executable per input signature — a re-run in a fresh
@@ -1055,9 +1258,9 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 [model, model.loss, optimizer.update, mixed_precision,
                  lazy_embeddings, multi, bool(use_device_cache), dc_steps,
                  shuffle if use_device_cache else None,
-                 flat_spec.uid if flat_spec else None])
+                 flat_spec.uid if flat_spec else None, shard_desc])
             train_step = AOTFunctionCache(train_step, get_cache(cc_dir),
-                                          step_fp)
+                                          step_fp, sharding=shard_desc)
         model._train_cache = (cache_key, train_step)
     x_dev = y_dev = None
     if use_device_cache:
@@ -1065,7 +1268,8 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
 
     ckpt_mgr = None
     if model._checkpoint_path:
-        from analytics_zoo_tpu.learn.checkpoint import CheckpointManager
+        from analytics_zoo_tpu.learn.checkpoint import (CheckpointManager,
+                                                        gather_tree)
         ckpt_mgr = CheckpointManager(model._checkpoint_path)
         if checkpoint_trigger is None:
             checkpoint_trigger = tg.EveryEpoch()
@@ -1236,8 +1440,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                     # the sidecar records which (plus the resume
                     # cursors/RNG), so a future restore can't silently
                     # structurally mismatch the two
-                    ckpt_mgr.save(iteration, jax.device_get(_as_tree(params)),
-                                  jax.device_get(opt_state),
+                    # gather_tree, not bare device_get: correct (and
+                    # actionably failing cross-host) for sharded leaves
+                    ckpt_mgr.save(iteration, gather_tree(_as_tree(params)),
+                                  gather_tree(opt_state),
                                   extra=_ckpt_extra(epoch, False))
                 if end_trigger and end_trigger(
                         tg.TriggerState(epoch=epoch, iteration=iteration,
@@ -1273,7 +1479,8 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               steps_done = max(iteration - it0, cost_tracker.calls)
               scale = steps_done / cost_tracker.calls
               telemetry.roofline(cost_tracker.flops * scale,
-                                 cost_tracker.bytes * scale, dt)
+                                 cost_tracker.bytes * scale, dt,
+                                 n_devices=cost_tracker.devices)
               cost_tracker.reset_epoch()
           if writer:
               writer.scalar("Loss", mean_loss, iteration)
@@ -1298,8 +1505,8 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
           if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
                   tg.TriggerState(epoch=epoch + 1, iteration=iteration,
                                   epoch_finished=True)):
-              ckpt_mgr.save(iteration, jax.device_get(_as_tree(params)),
-                            jax.device_get(opt_state),
+              ckpt_mgr.save(iteration, gather_tree(_as_tree(params)),
+                            gather_tree(opt_state),
                             extra=_ckpt_extra(epoch + 1, True))
           if end_trigger and end_trigger(
                   tg.TriggerState(epoch=epoch + 1, iteration=iteration,
@@ -1319,9 +1526,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             # emergency save would demote a boundary checkpoint's
             # metadata to mid-epoch for identical params)
             try:
+                from analytics_zoo_tpu.learn.checkpoint import gather_tree
                 ckpt_mgr.save(iteration,
-                              jax.device_get(_as_tree(params)),
-                              jax.device_get(opt_state),
+                              gather_tree(_as_tree(params)),
+                              gather_tree(opt_state),
                               extra=dict(_ckpt_extra(epoch, False),
                                          emergency=True))
                 log.warning("emergency checkpoint written at iteration "

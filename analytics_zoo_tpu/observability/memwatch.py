@@ -63,15 +63,58 @@ def device_memory_snapshot(devices=None) -> Dict[str, Dict[str, float]]:
             live_fallback = {}
             try:
                 for a in jax.live_arrays():
-                    for shard_dev in getattr(a, "devices", lambda: [])():
-                        key = getattr(shard_dev, "id", 0)
-                        # a sharded array's bytes split across devices
-                        live_fallback[key] = live_fallback.get(key, 0.0) \
-                            + a.nbytes / max(1, len(a.devices()))
+                    # per-device bytes come from the array's ACTUAL
+                    # shards: a replicated array stores a FULL copy on
+                    # every device (N × nbytes total), an fsdp-sharded
+                    # one stores nbytes/N per device — dividing nbytes
+                    # evenly (the old accounting) made those two read
+                    # identical, hiding exactly the footprint the
+                    # sharded fit exists to shrink
+                    # per-array staging dict, merged only on success:
+                    # a shard read that fails partway (e.g. a buffer
+                    # donated mid-sample by a concurrent train step)
+                    # must not leave half the array counted AND then be
+                    # fully re-added by the fallback
+                    per_array: Dict[int, float] = {}
+                    try:
+                        for sh in a.addressable_shards:
+                            key = getattr(sh.device, "id", 0)
+                            per_array[key] = per_array.get(key, 0.0) \
+                                + sh.data.nbytes
+                    except Exception:  # noqa: BLE001 — no shards API
+                        per_array = {}
+                        for shard_dev in getattr(a, "devices",
+                                                 lambda: [])():
+                            key = getattr(shard_dev, "id", 0)
+                            per_array[key] = per_array.get(key, 0.0) \
+                                + a.nbytes / max(1, len(a.devices()))
+                    for key, b in per_array.items():
+                        live_fallback[key] = live_fallback.get(
+                            key, 0.0) + b
             except Exception:  # noqa: BLE001 — diagnostics only
                 live_fallback = {}
         out[label] = {"live_bytes": live_fallback.get(
             getattr(d, "id", 0), 0.0), "source": "live_arrays"}
+    return out
+
+
+def tree_device_bytes(tree) -> Dict[str, float]:
+    """Exact per-device bytes of one pytree's leaves, from their ACTUAL
+    shards: {device label: bytes}. A replicated leaf contributes its
+    full nbytes to every device it lives on; an fsdp-sharded leaf
+    contributes nbytes/fsdp per device. This is the focused footprint
+    probe the sharded-training bench/tests assert 1/fsdp memory with —
+    `device_memory_snapshot` reports the whole process, this reports
+    one tree."""
+    import jax
+    out: Dict[str, float] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            continue                       # host leaf: no device bytes
+        for sh in shards:
+            label = _device_label(sh.device)
+            out[label] = out.get(label, 0.0) + sh.data.nbytes
     return out
 
 

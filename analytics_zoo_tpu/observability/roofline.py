@@ -44,7 +44,23 @@ log = logging.getLogger("analytics_zoo_tpu.observability")
 
 class ExecCost:
     """FLOPs and HBM bytes one call of an executable performs, per XLA's
-    own cost analysis."""
+    own cost analysis.
+
+    Basis contract: an ExecCost is the LOGICAL GLOBAL cost of one call
+    — the model's work counted once, however many devices execute it.
+    XLA reports two different bases depending on what you ask:
+    `Lowered.cost_analysis()` runs on the UNPARTITIONED module (the
+    logical basis), while `Compiled.cost_analysis()` on a
+    GSPMD-partitioned executable runs on the per-device module — and
+    per-device × span is NOT the logical cost, because work that
+    replicates across a mesh axis (e.g. the optimizer update across
+    the data axis of a data×fsdp mesh) is counted once per device
+    (measured factors 2–8× on an 8-device mesh depending on the
+    program). Classic MFU divides MODEL flops by peak, so harvesters
+    use the lowered module for any multi-device program (one trace per
+    signature, no compile) and executables only where the two agree
+    (single-device), then pass `account(..., n_devices=span)` so the
+    denominator covers the devices that did the work."""
 
     __slots__ = ("flops", "bytes")
 
@@ -86,6 +102,28 @@ def cost_of(stages_obj) -> Optional[ExecCost]:
     if flops <= 0.0 and bytes_ <= 0.0:
         return None
     return ExecCost(flops, bytes_)
+
+
+def device_span(tree) -> int:
+    """The SPMD partition count of a program called with `tree` as (part
+    of) its arguments: the largest device set any leaf is committed to.
+    1 for single-device programs; the mesh size for GSPMD programs whose
+    params/batch are NamedSharding'd over a mesh. Used to convert XLA's
+    per-device executable cost to the global basis (see ExecCost)."""
+    span = 1
+    try:
+        import jax
+        for leaf in jax.tree_util.tree_leaves(tree):
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None:
+                continue
+            try:
+                span = max(span, len(sharding.device_set))
+            except Exception:  # noqa: BLE001 — exotic sharding object
+                continue
+    except Exception:  # noqa: BLE001 — telemetry only
+        return span
+    return span
 
 
 # ---------------------------------------------------------------------------
@@ -200,15 +238,21 @@ class RooflineAccountant:
         )
 
     def account(self, kind: str, flops: float, bytes_: float,
-                seconds: float, device=None) -> None:
+                seconds: float, device=None, n_devices: int = 1) -> None:
+        """`flops`/`bytes_` are GLOBAL (see ExecCost); `n_devices` is
+        how many devices the program spanned, scaling the MFU/HBM
+        denominators to the roofline of the participating slice —
+        per-chip session bounds × n. The achieved_* gauges stay global
+        (what the whole mesh delivered)."""
         try:
             if seconds <= 0.0 or (flops <= 0.0 and bytes_ <= 0.0):
                 return
             with self._lock:
-                acc = self._acc.setdefault(kind, [0.0, 0.0, 0.0])
+                acc = self._acc.setdefault(kind, [0.0, 0.0, 0.0, 1])
                 acc[0] += flops
                 acc[1] += bytes_
                 acc[2] += seconds
+                acc[3] = max(acc[3], max(1, int(n_devices)))
             (c_flops, c_bytes, c_secs, g_tflops, g_gbps, g_mfu,
              g_hbm) = self._reg()
             c_flops.inc(flops, kind=kind)
@@ -218,10 +262,11 @@ class RooflineAccountant:
             g_tflops.set(flops / seconds / 1e12, kind=kind)
             g_gbps.set(bytes_ / seconds / 1e9, kind=kind)
             hbm_roof, flops_roof = session_roofline(device)
+            n = max(1, int(n_devices))
             if flops_roof > 0:
-                g_mfu.set(flops / seconds / flops_roof, kind=kind)
+                g_mfu.set(flops / seconds / (flops_roof * n), kind=kind)
             if hbm_roof > 0:
-                g_hbm.set(bytes_ / seconds / hbm_roof, kind=kind)
+                g_hbm.set(bytes_ / seconds / (hbm_roof * n), kind=kind)
         except Exception as e:  # noqa: BLE001 — telemetry must not raise
             log.debug("roofline accounting failed: %s: %s",
                       type(e).__name__, e)
@@ -236,17 +281,21 @@ class RooflineAccountant:
                 self._acc.pop(kind, None)
 
     def snapshot(self, kind: str) -> Dict[str, float]:
-        """The kind's accumulators since its last reset (bench JSON)."""
+        """The kind's accumulators since its last reset (bench JSON).
+        `devices` is the largest program span accounted in the window;
+        mfu/hbm_utilization divide by that many chips' roofline, like
+        the live gauges."""
         with self._lock:
-            f, b, s = self._acc.get(kind, (0.0, 0.0, 0.0))
-        out: Dict[str, Any] = {"flops": f, "bytes": b, "seconds": s}
+            f, b, s, n = self._acc.get(kind, (0.0, 0.0, 0.0, 1))
+        out: Dict[str, Any] = {"flops": f, "bytes": b, "seconds": s,
+                               "devices": n}
         if s > 0:
             out["achieved_tflops"] = f / s / 1e12
             out["achieved_hbm_gbps"] = b / s / 1e9
             try:
                 hbm_roof, flops_roof = session_roofline()
-                out["mfu"] = f / s / flops_roof
-                out["hbm_utilization"] = b / s / hbm_roof
+                out["mfu"] = f / s / (flops_roof * n)
+                out["hbm_utilization"] = b / s / (hbm_roof * n)
             except Exception:  # noqa: BLE001 — no device, no roofline
                 pass
         return out

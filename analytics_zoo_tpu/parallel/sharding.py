@@ -37,7 +37,12 @@ class ShardingRules:
     """Ordered (path-regex, PartitionSpec) table; first match wins.
 
     A parameter's path is its key chain joined with "/", e.g.
-    "bert_1/bert_1_block0/attn/qkv_kernel".
+    "bert_1/bert_1_block0/attn/qkv_kernel". The same table annotates an
+    OPTIMIZER state tree: optax state leaves flatten with paths that end
+    in their parameter's path ("0/.mu/bert/.../qkv_kernel"), so each
+    param's spec mirrors onto its moments and scalar leaves (step
+    counters) fall through to replication — the match_partition_rules
+    pattern, one table for params and opt_state, training and serving.
     """
 
     def __init__(self, rules: Sequence[Tuple[str, P]],
@@ -49,10 +54,31 @@ class ShardingRules:
                  mesh: DeviceMesh) -> P:
         for pat, spec in self.rules:
             if pat.search(path):
-                return _trim_spec(spec, shape, mesh)
+                trimmed = _trim_spec(spec, shape, mesh)
+                if (len(spec) > 0
+                        and not any(ax is not None for ax in trimmed)
+                        and self.fsdp_fallback and mesh.size("fsdp") > 1):
+                    # The rule WANTED this leaf sharded but none of its
+                    # axes survived on this mesh (e.g. the embedding
+                    # rule's 'tensor' axis on a pure data×fsdp mesh):
+                    # fall through to ZeRO-style fsdp sharding rather
+                    # than silently replicating a large table. An
+                    # explicit P() rule (norm scales) stays replicated.
+                    return _fsdp_spec(shape, mesh)
+                return trimmed
         if self.fsdp_fallback and mesh.size("fsdp") > 1:
             return _fsdp_spec(shape, mesh)
         return P()
+
+    def fingerprint(self) -> str:
+        """Stable-across-processes content hash of the table — cache
+        keys fold this in so two fits under different rule tables (or
+        a replicated vs an fsdp fit) can never share an executable.
+        Hashes the raw patterns + specs, NOT object identity."""
+        import hashlib
+        blob = ";".join(f"{pat.pattern}->{spec}" for pat, spec in self.rules)
+        blob += f";fallback={self.fsdp_fallback}"
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
 def _trim_spec(spec: P, shape: Tuple[int, ...], mesh: DeviceMesh) -> P:
@@ -122,14 +148,81 @@ def param_specs(params, mesh: DeviceMesh,
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def sharding_descriptor(mesh: DeviceMesh,
+                        rules: "ShardingRules" = None,
+                        devices=None) -> str:
+    """Canonical layout string for compile-cache keys: mesh axis
+    extents + the rule table's content fingerprint (+ device ids when
+    the caller's executables pin a device assignment). ONE spelling for
+    the trainer's step key and serving's forward key, so what counts as
+    "the layout" can never drift between the two stacks."""
+    rules = rules if rules is not None else TRANSFORMER_RULES
+    desc = (repr(sorted(mesh.axis_sizes.items()))
+            + "|rules=" + rules.fingerprint())
+    if devices is not None:
+        desc += f"|dev={sorted(d.id for d in devices)}"
+    return desc
+
+
+def tree_shardings(tree, mesh: DeviceMesh,
+                   rules: ShardingRules = TRANSFORMER_RULES):
+    """Pytree of NamedSharding matching `tree`, per the rule table.
+    Works on parameter trees AND optimizer states (see ShardingRules:
+    optax leaf paths carry the param path, so moments mirror their
+    param's spec) — the layout contract shared by `fit_keras`'s sharded
+    placement and serving's sharded placement."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh.mesh, s),
+        param_specs(tree, mesh, rules))
+
+
 def shard_params(params, mesh: DeviceMesh,
                  rules: ShardingRules = TRANSFORMER_RULES):
-    """device_put each parameter with its rule's NamedSharding."""
+    """device_put each parameter with its rule's NamedSharding. A leaf
+    that already carries the target sharding passes through device_put
+    as the SAME buffer — a checkpoint restored straight onto the rule
+    layout (or a live sharded fit's params) loads with zero resharding
+    transfers. Host leaves go to device_put as-is: an eager
+    jnp.asarray would materialize the full leaf on the default device
+    first, defeating the bigger-than-one-chip case."""
     specs = param_specs(params, mesh, rules)
     return jax.tree_util.tree_map(
-        lambda p, s: jax.device_put(jnp.asarray(p),
-                                    NamedSharding(mesh.mesh, s)),
+        lambda p, s: jax.device_put(p, NamedSharding(mesh.mesh, s)),
         params, specs)
+
+
+def check_fsdp_divisibility(params, mesh: DeviceMesh,
+                            rules: ShardingRules = TRANSFORMER_RULES,
+                            min_size: int = 4096) -> None:
+    """Validate that every LARGE parameter actually shards over the
+    fsdp axis. The largest-dim fallback (`_fsdp_spec`) silently
+    replicates a leaf none of whose dims divide `fsdp` — correct but
+    defeating the 1/fsdp memory goal, so a big offender should fail
+    loudly at config time, not OOM three layers later. Leaves smaller
+    than `min_size` elements (biases, norm scales) legitimately
+    replicate and are skipped."""
+    n = mesh.size("fsdp")
+    if n <= 1:
+        return
+    offenders: List[Tuple[str, Tuple[int, ...]]] = []
+    for path, leaf in _tree_paths_and_leaves(params):
+        shape = tuple(int(d) for d in np.shape(leaf))
+        if not shape or int(np.prod(shape)) < max(min_size, n):
+            continue
+        spec = rules.spec_for(path, shape, mesh)
+        if any(ax is not None for ax in spec):
+            continue                      # sharded on some axis
+        offenders.append((path, shape))
+    if offenders:
+        detail = ", ".join(f"{p} {s}" for p, s in offenders[:8])
+        more = f" (+{len(offenders) - 8} more)" if len(offenders) > 8 else ""
+        raise ValueError(
+            f"{len(offenders)} large parameter(s) cannot shard over the "
+            f"fsdp axis (size {n}) and would replicate on every device: "
+            f"{detail}{more}. Fix by choosing an fsdp size that divides "
+            "a dimension of each (e.g. a power of two matching the "
+            "hidden size), padding the offending dimension, or adding "
+            "an explicit ShardingRules entry for it.")
 
 
 def shard_batch(batch, mesh: DeviceMesh, sequence_dim: Optional[int] = None):

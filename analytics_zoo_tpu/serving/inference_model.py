@@ -512,9 +512,21 @@ class InferenceModel:
         from analytics_zoo_tpu.compile_cache.key import cheap_signature
         return cheap_signature(x)
 
+    def _program_span(self) -> int:
+        """Devices one forward call spans: the whole mesh for sharded
+        placement, one device otherwise (each replica runs its own
+        single-device program)."""
+        if self.placement == "sharded" and self.mesh is not None:
+            return self.mesh.n_devices
+        return 1
+
     def _record_cost(self, batch, stages_obj):
         """Harvest per-call FLOPs/bytes from a Compiled/Lowered for this
-        batch shape; silently absent when the backend has no cost model."""
+        batch shape; silently absent when the backend has no cost
+        model. Callers hand a partitioned (sharded-placement)
+        EXECUTABLE to `_harvest_jit_cost` instead: its cost analysis
+        counts one device's per-device module, not the logical model
+        cost (`roofline.ExecCost` basis contract)."""
         try:
             key = self._cost_key(batch)
             if key in self._exec_cost:
@@ -547,8 +559,9 @@ class InferenceModel:
         if cost is None:
             return None
         acct = self._roofline
-        return lambda secs, _c=cost, _a=acct: _a.account(
-            "serving", _c.flops, _c.bytes, secs)
+        span = self._program_span()
+        return lambda secs, _c=cost, _a=acct, _n=span: _a.account(
+            "serving", _c.flops, _c.bytes, secs, n_devices=_n)
 
     # -- persistent compile cache (compile_cache/) -------------------------
     @staticmethod
@@ -562,8 +575,17 @@ class InferenceModel:
         from analytics_zoo_tpu.compile_cache import make_key
         sharding = ""
         if self.placement == "sharded" and self.mesh is not None:
-            sharding = repr(sorted(self.mesh.axis_sizes.items())) + \
-                f"/dev{sorted(d.id for d in self.devices)}"
+            # the RULE TABLE is part of the layout, not just the mesh:
+            # two tables (or two versions of the default table) can
+            # place the same params differently on the same mesh, and a
+            # persisted executable embeds its input layout. ONE
+            # canonical spelling shared with the trainer's step key
+            # (parallel/sharding.sharding_descriptor), plus the device
+            # ids this executable's assignment is pinned to.
+            from analytics_zoo_tpu.parallel.sharding import \
+                sharding_descriptor
+            sharding = sharding_descriptor(self.mesh,
+                                           devices=self.devices)
         return make_key("serving", self._model_fp or "", sig,
                         placement=self.placement, sharding=sharding)
 
@@ -599,8 +621,13 @@ class InferenceModel:
                 ex = serialization.retree_call(ex, stored)
             self._aot[(replica_idx, sig)] = ex
             # AOT-cache loads are a harvest point too: deserialized
-            # executables still answer cost_analysis()
-            self._record_cost(batch, ex)
+            # executables still answer cost_analysis(). A sharded
+            # (partitioned) executable reports per-device cost — the
+            # logical basis needs the lowered module instead
+            if self._program_span() > 1:
+                self._harvest_jit_cost(params, batch)
+            else:
+                self._record_cost(batch, ex)
             return "cached"
         t0 = time.perf_counter()
         # module-attribute call: serialization.compile_lowered is THE
@@ -609,7 +636,10 @@ class InferenceModel:
         self.compile_cache.put(  # blocking-ok: disk cache write, not a queue
             key, ex, compile_ms=(time.perf_counter() - t0) * 1e3)
         self._aot[(replica_idx, sig)] = ex
-        self._record_cost(batch, ex)
+        if self._program_span() > 1:
+            self._harvest_jit_cost(params, batch)
+        else:
+            self._record_cost(batch, ex)
         return "compiled"
 
     def _replica_loop(self, rep: _Replica):

@@ -191,6 +191,160 @@ def _longseq_child():
     }))
 
 
+def fit_scaling_summary(n_devices: int, counts=None, n_samples: int = 256,
+                        batch_size: int = 64, hidden: int = 128,
+                        seq_len: int = 32, n_block: int = 2) -> dict:
+    """Training analogue of `bench_serving.multidevice_summary` (ISSUE 7):
+    a data-parallel BERT fit scaling curve over 1→n devices — one GLOBAL
+    batch split across the mesh's data axis, samples/sec per device
+    count, per-device peak HBM from memwatch sampled during the timed
+    fit — plus an fsdp-sharded fit of the same model recording the
+    1/fsdp per-device params+opt_state footprint next to the replicated
+    one. `host_cores`/`efficiency_vs_host_cores` report the forced-host
+    ceiling exactly as the serving curve does: an M-core box caps
+    scaling near M× regardless of virtual device count; on a real pod
+    the ceiling is the chip count. Requires `len(jax.devices()) >=
+    n_devices` (see `__graft_entry__.dryrun_multichip` for the re-exec
+    wrapper)."""
+    from analytics_zoo_tpu.common.config import MeshConfig
+    from analytics_zoo_tpu.common.context import get_context
+    from analytics_zoo_tpu.common.mesh import DeviceMesh
+    from analytics_zoo_tpu.learn import trainer
+    from analytics_zoo_tpu.observability.memwatch import DeviceMemoryWatcher
+    from analytics_zoo_tpu.ops import objectives
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from __graft_entry__ import _build_bert_classifier
+
+    devs = jax.devices()[:n_devices]
+    assert len(devs) == n_devices, (
+        f"need {n_devices} devices, have {len(devs)}")
+    counts = sorted({c for c in (counts or [1, 2, n_devices])
+                     if 1 <= c <= n_devices and batch_size % c == 0})
+
+    rs = np.random.RandomState(0)
+    x = {"ids": rs.randint(0, 128, (n_samples, seq_len)).astype(np.int32),
+         "mask": np.ones((n_samples, seq_len), np.float32)}
+    y = rs.randint(0, 2, (n_samples,)).astype(np.int32)
+    loss_obj = objectives.get("sparse_categorical_crossentropy",
+                              from_logits=True)
+
+    def make_model():
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        forward, params = _build_bert_classifier(
+            vocab=128, hidden=hidden, n_block=n_block, n_head=4,
+            seq_len=seq_len, intermediate=2 * hidden, n_classes=2,
+            rng=jax.random.PRNGKey(0))
+
+        def apply_fn(p, xb, training=False, rng=None):
+            return forward(p, xb["ids"], xb["mask"], training=training,
+                           rng=rng)
+
+        est = Estimator.from_fn(apply_fn, lambda r, s: params, loss_obj,
+                                optax.adam(1e-3))
+        est.model.params = params
+        return est.model
+
+    def timed_fit(model, **kw):
+        """One warm fit (compiles off the clock; the model's step memo
+        carries to the next call), then the measured fit under a
+        fast-sampling memory watcher."""
+        trainer.fit_keras(model, x, y, batch_size=batch_size, epochs=1,
+                          device_cache=False, seed=0, **kw)
+        watcher = DeviceMemoryWatcher(interval_s=0.02,
+                                      devices=devs).start()
+        t0 = time.perf_counter()
+        trainer.fit_keras(model, x, y, batch_size=batch_size, epochs=1,
+                          device_cache=False, seed=1, **kw)
+        dt = time.perf_counter() - t0
+        snap = watcher.sample()
+        watcher.stop()
+        peaks = {label: e.get("peak_bytes", e["live_bytes"])
+                 for label, e in snap.items()}
+        steps = n_samples // batch_size
+        return steps * batch_size / dt, peaks
+
+    def state_footprint(mesh, rules):
+        """Deterministic per-device params+opt_state bytes under a
+        layout: place a fresh model's params (replicated or
+        rule-sharded) plus an Adam state exactly as fit_keras would,
+        and read the ACTUAL shard bytes (`memwatch.tree_device_bytes`)."""
+        from analytics_zoo_tpu.learn.trainer import (_put_replicated,
+                                                     _put_with_shardings)
+        from analytics_zoo_tpu.observability.memwatch import \
+            tree_device_bytes
+        from analytics_zoo_tpu.parallel.sharding import tree_shardings
+        model = make_model()
+        opt = optax.adam(1e-3)
+        if rules is not None:
+            params = _put_with_shardings(
+                model.params, tree_shardings(model.params, mesh, rules))
+            opt_state = opt.init(params)
+            opt_state = _put_with_shardings(
+                opt_state, tree_shardings(opt_state, mesh, rules))
+        else:
+            params = _put_replicated(model.params, mesh)
+            opt_state = _put_replicated(opt.init(params), mesh)
+        per_dev = tree_device_bytes((params, opt_state))
+        return round(max(per_dev.values()))
+
+    ctx = get_context()
+    prev_mesh = ctx.mesh
+    sps, peak_by_count = {}, {}
+    try:
+        for c in counts:
+            ctx.mesh = DeviceMesh(MeshConfig(data=c), devs[:c])
+            rate, peaks = timed_fit(make_model())
+            sps[str(c)] = round(rate, 1)
+            peak_by_count[str(c)] = round(max(peaks.values()))
+        # fsdp-sharded fit on the full mesh: same model, params +
+        # opt_state at ~1/fsdp per device (the footprint the replicated
+        # rows above pay in full)
+        full_mesh = DeviceMesh(MeshConfig(data=1, fsdp=n_devices), devs)
+        ctx.mesh = full_mesh
+        srate, speaks = timed_fit(make_model(), sharding_rules=True)
+        from analytics_zoo_tpu.parallel.sharding import TRANSFORMER_RULES
+        state_replicated = state_footprint(full_mesh, None)
+        state_sharded = state_footprint(full_mesh, TRANSFORMER_RULES)
+    finally:
+        ctx.mesh = prev_mesh
+
+    base = sps[str(counts[0])]
+    speedup = sps[str(counts[-1])] / max(base, 1e-9)
+    cores = os.cpu_count() or 1
+    return {
+        "metric": "fit_scaling",
+        "devices": n_devices,
+        "host_cores": cores,
+        "global_batch": batch_size,
+        "samples_per_sec": sps,
+        "scaling_speedup": round(speedup, 2),
+        "scaling_efficiency": round(speedup / max(counts[-1], 1), 3),
+        # forced-host devices burn real cores (see multidevice_summary):
+        # the honest ceiling on an M-core box is min(devices, M)
+        "efficiency_vs_host_cores": round(
+            speedup / min(counts[-1], cores), 3),
+        "per_device_peak_hbm_bytes": peak_by_count,
+        "sharded_fsdp": {
+            "fsdp": n_devices,
+            "samples_per_sec": round(srate, 1),
+            "per_device_peak_hbm_bytes": round(max(speaks.values())),
+            # exact params+opt_state shard bytes per device, replicated
+            # vs rule-sharded on the SAME mesh — the 1/fsdp memory claim
+            # as a number (whole-process peaks above include batches,
+            # prefetch copies and transients)
+            "params_opt_bytes_per_device_replicated": state_replicated,
+            "params_opt_bytes_per_device_sharded": state_sharded,
+            "params_opt_shrink": round(
+                state_replicated / max(state_sharded, 1), 2),
+        },
+        "note": ("forced-host devices share the host's cores: fit "
+                 f"scaling here caps near {min(n_devices, cores)}x; on "
+                 "a real pod each chip computes off-host, so the "
+                 "ceiling is the device count"),
+    }
+
+
 def main():
     from analytics_zoo_tpu import init_orca_context
 

@@ -39,6 +39,26 @@ def main():
     print("eval:", mse)
     stop_orca_context()
 
+    # GSPMD-sharded fit (ISSUE 7): params + optimizer state shard over
+    # the fsdp axis with the same rule table serving's sharded placement
+    # uses — per-device state ≈ 1/fsdp, batch still splits over every
+    # device (docs/ProgrammingGuide/distributed-training.md)
+    if n_dev > 1:
+        ctx = init_orca_context(cluster_mode="local", data=1, fsdp=n_dev)
+        print(f"sharded-fit mesh: {ctx.mesh}")
+        model = Sequential([
+            L.Dense(64, input_shape=(16,), activation="relu"),
+            L.Dense(64, activation="relu"),
+            L.Dense(1),
+        ])
+        model.compile("adam", "mse")
+        est = Estimator.from_keras(model)
+        est.fit({"x": x, "y": y}, epochs=3, batch_size=16 * n_dev,
+                sharding_rules=True)
+        leaf = jax.tree_util.tree_leaves(model.params)[0]
+        print("param sharding after sharded fit:", leaf.sharding.spec)
+        stop_orca_context()
+
 
 if __name__ == "__main__":
     main()

@@ -524,6 +524,71 @@ class TestTrainerAOT:
         assert set(step2.sources.values()) == {"cached"}
         assert np.isfinite(h2["loss"][0]) and np.isfinite(h1["loss"][0])
 
+    def test_sharded_and_replicated_fits_never_collide(
+            self, tmp_path, compile_spy, jax_cache_config):
+        """ISSUE 7 satellite: the trainer AOT key folds in the mesh
+        axis sizes + sharding-rule fingerprint. A replicated fit and an
+        fsdp-sharded fit of the SAME model with IDENTICAL argument
+        shapes are different programs — one cache dir must hold both
+        (two compiles), and a sharded re-fit in a fresh process (step
+        memo dropped) must load ITS entry with zero compiles."""
+        from analytics_zoo_tpu.common import context as ctx_mod
+        from analytics_zoo_tpu.learn.trainer import fit_keras
+        prev = ctx_mod._GLOBAL["context"]
+        try:
+            ctx_mod.init_zoo_context(data=2, fsdp=4)
+            import optax
+            m = Sequential([L.Dense(8, input_shape=(4,)), L.Dense(4)])
+            m.compile(optimizer=optax.sgd(1e-2), loss="mse")
+            x = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+            y = np.random.RandomState(1).rand(32, 4).astype(np.float32)
+            kw = dict(batch_size=16, epochs=1, device_cache=False,
+                      prefetch=False, compile_cache_dir=str(tmp_path))
+
+            fit_keras(m, x, y, sharding_rules=True, **kw)
+            assert len(compile_spy) == 1
+            m._train_cache = None
+            fit_keras(m, x, y, **kw)               # replicated, same shapes
+            assert len(compile_spy) == 2, \
+                "replicated fit silently reused the sharded executable"
+            m._train_cache = None
+            compile_spy.clear()
+            fit_keras(m, x, y, sharding_rules=True, **kw)
+            assert len(compile_spy) == 0, \
+                "cross-process sharded re-fit must compile nothing"
+            assert set(m._train_cache[1].sources.values()) == {"cached"}
+        finally:
+            ctx_mod._GLOBAL["context"] = prev
+
+    def test_mesh_factorization_is_part_of_the_key(
+            self, tmp_path, compile_spy, jax_cache_config):
+        """data=2×fsdp=4 and data=1×fsdp=8 cover the same 8 devices
+        with the same arg shapes but different layouts: distinct
+        entries."""
+        from analytics_zoo_tpu.common import context as ctx_mod
+        from analytics_zoo_tpu.learn.trainer import fit_keras
+        prev = ctx_mod._GLOBAL["context"]
+        try:
+            import optax
+            m = Sequential([L.Dense(8, input_shape=(4,)), L.Dense(4)])
+            m.compile(optimizer=optax.sgd(1e-2), loss="mse")
+            x = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+            y = np.random.RandomState(1).rand(32, 4).astype(np.float32)
+            kw = dict(batch_size=16, epochs=1, device_cache=False,
+                      prefetch=False, sharding_rules=True,
+                      compile_cache_dir=str(tmp_path))
+            ctx_mod.init_zoo_context(data=2, fsdp=4)
+            fit_keras(m, x, y, **kw)
+            n1 = len(compile_spy)
+            assert n1 == 1
+            m._train_cache = None
+            ctx_mod.init_zoo_context(data=1, fsdp=8)
+            fit_keras(m, x, y, **kw)
+            assert len(compile_spy) == 2, \
+                "a different mesh factorization hit the old entry"
+        finally:
+            ctx_mod._GLOBAL["context"] = prev
+
     def test_aot_step_matches_plain_jit(self, tmp_path, jax_cache_config):
         """Same data, same seed: a cache-backed fit reproduces the plain
         fit's losses exactly."""

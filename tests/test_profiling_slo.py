@@ -224,12 +224,16 @@ class TestTrainerRoofline:
     def test_hbm_utilization_is_live_fraction_of_session_roofline(self):
         """The BENCH-r05-style number with zero manual math: install a
         session roofline, fit, and the gauge must equal XLA bytes /
-        measured seconds / roofline."""
+        measured seconds / the participating slice's roofline (per-chip
+        bound × the step program's device span — the fit runs data-
+        parallel on the conftest 8-device mesh, ISSUE 7)."""
         set_session_roofline(hbm_gbps=50.0, tflops=5.0)
         self._fit_mlp(2)
         snap = get_accountant().snapshot("train")
         g = get_registry().get("roofline_hbm_utilization")
-        expected = snap["bytes"] / snap["seconds"] / (50.0 * 1e9)
+        expected = snap["bytes"] / snap["seconds"] \
+            / (50.0 * 1e9 * snap["devices"])
+        assert snap["devices"] == jax.device_count()
         assert g.value(kind="train") == pytest.approx(expected, rel=1e-6)
         assert expected > 0
 
