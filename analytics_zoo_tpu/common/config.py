@@ -105,6 +105,15 @@ class ZooConfig:
     # spelling is ZOO_SHARDED_FIT=1. Pair with a MeshConfig whose fsdp
     # axis is > 1 (e.g. ZOO_MESH_DATA=1 ZOO_MESH_FSDP=-1).
     sharded_fit: bool = False
+    # Fused Pallas optimizer kernels (ISSUE 9): fit_keras swaps a
+    # default-hyperparameter adam/adamw compile spec for the one-HBM-pass
+    # fused update (`pallas/fused_adam.py`; with lazy_embeddings the
+    # declared tables take the sparse segment path). Equivalent to
+    # fit_keras(fused_optimizer=True); env spellings ZOO_FUSED_OPTIMIZER=1
+    # (this field) or ZOO_FUSED_OPT=1 (short form, read at fit time).
+    # Off-path optimizers and non-lowering backends degrade to plain
+    # optax with one WARNING, so this is safe to set fleet-wide.
+    fused_optimizer: bool = False
     default_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     # pandas_read_backend flag of the reference (`nncontext.py:269`)

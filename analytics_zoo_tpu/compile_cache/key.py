@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import types
 from dataclasses import dataclass, field
 from typing import Any, Dict, Tuple
 
@@ -53,6 +54,16 @@ def fingerprint(obj: Any, depth: int = 0) -> str:
         return "deep"
     if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
         return repr(obj)
+    # modules and classes identify by NAME, never by attribute walk: a
+    # function closing over `import jax` would otherwise deep-walk the
+    # whole package namespace (and trip over class-level `shape`/`dtype`
+    # PROPERTIES masquerading as array attrs — jax.Array did exactly
+    # that once the fused optimizer's update closed over the module)
+    if isinstance(obj, types.ModuleType):
+        return _h(["module", obj.__name__,
+                   str(getattr(obj, "__version__", ""))])
+    if isinstance(obj, type):
+        return _h(["type", obj.__module__, obj.__qualname__])
     # bound methods: underlying function + owner structure
     owner = getattr(obj, "__self__", None)
     func = getattr(obj, "__func__", None)

@@ -162,7 +162,11 @@ class Estimator:
         mesh's fsdp axis with the same rule table serving's sharded
         placement consumes (`ZooConfig.sharded_fit` / ZOO_SHARDED_FIT=1
         is the config spelling; see
-        docs/ProgrammingGuide/distributed-training.md).
+        docs/ProgrammingGuide/distributed-training.md),
+        `fused_optimizer=True` swaps a stock adam/adamw for the fused
+        Pallas update kernels (`ZooConfig.fused_optimizer` /
+        ZOO_FUSED_OPT=1; one HBM pass per leaf, sparse segment path for
+        declared embedding tables under `lazy_embeddings=True`).
         Step/loss/throughput telemetry lands in the process-wide
         `MetricsRegistry` either way (`observability/`)."""
         ds = to_dataset(data, batch_size=batch_size or 32,

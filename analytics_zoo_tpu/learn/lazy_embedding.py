@@ -44,13 +44,23 @@ class LazyEmbeddingSpec(NamedTuple):
     compiled with the stock 'adam' string" — `resolve_specs` verifies
     that and fills optax.adam defaults; any other compiled optimizer
     must set the row-Adam hyperparameters here explicitly (the row
-    updates are SparseAdam, independent of the dense-path optax chain)."""
+    updates are SparseAdam, independent of the dense-path optax chain).
+
+    `set_ids_fn(xb, new_ids) -> xb` is the write twin of `ids_fn`: it
+    rewrites the batch input so the model's gather reads `new_ids`
+    instead. Declaring it unlocks the fully-sparse fused backward
+    (`pallas/segment_update.py`): the trainer gathers the touched rows
+    OUTSIDE the differentiated function and points the model at them
+    through rewritten position ids, so a vocab-sized cotangent never
+    materializes. Without it the fused path still does the in-place
+    row-wise kernel update, but over a dense-materialized gradient."""
     path: Tuple[str, ...]                 # e.g. ("embedding_1", "embeddings")
     ids_fn: Callable                      # xb -> [B] int ids
     lr: float = None
     b1: float = 0.9
     b2: float = 0.999
     eps: float = 1e-8
+    set_ids_fn: Callable = None           # (xb, [B] ids) -> xb
 
 
 def _get(tree, path):

@@ -17,6 +17,7 @@ per-device `batch_per_thread`.
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import time
@@ -68,6 +69,12 @@ class _TrainingMetrics:
         self.step_retries = reg.counter(
             "training_step_retries_total",
             "failed/hung training steps retried by the step watchdog")
+        self.fused_update_ms = reg.histogram(
+            "training_fused_update_ms",
+            "measured wall time of one fused-kernel optimizer sweep "
+            "over the model's parameter tree (observed once per "
+            "model/step-program build, not per fit — warm re-fits "
+            "skip the probe)")
 
     def epoch(self, steps: int, n_seen: int, dt: float, mean_loss: float,
               flops_per_step: Optional[float] = None):
@@ -321,6 +328,11 @@ class _StepCostTracker:
         self.calls = 0
         self.devices = 1
         self._span_known = False
+        # per-step ExecCost DELTA for Pallas kernel regions (ISSUE 9):
+        # cost analysis cannot see inside a pallas_call (Mosaic reports
+        # ~0; the interpreter emulation over-counts), so the fit adds
+        # (analytic − XLA-counted) for the fused sweep here
+        self.correction = None
 
     def reset_epoch(self):
         self.flops = 0.0
@@ -361,8 +373,11 @@ class _StepCostTracker:
 
     def _accumulate(self, cost, calls=1):
         if cost is not None:
-            self.flops += cost.flops * calls
-            self.bytes += cost.bytes * calls
+            corr = self.correction
+            cf = corr.flops if corr is not None else 0.0
+            cb = corr.bytes if corr is not None else 0.0
+            self.flops += max(cost.flops + cf, 0.0) * calls
+            self.bytes += max(cost.bytes + cb, 0.0) * calls
             self.calls += calls
 
     def before(self, args):
@@ -586,19 +601,103 @@ def _cast_tree(tree, dtype, only=jnp.float32):
         lambda a: a.astype(dtype) if a.dtype == only else a, tree)
 
 
+def _shard_mapped_fused(fused_apply, shardings):
+    """Run the fused optimizer sweep on fsdp-LOCAL shards: the whole
+    `fused_apply` call goes through one `shard_map` whose specs are the
+    rule table's own PartitionSpecs, so each device's kernels walk only
+    its 1/fsdp slice of (params, moments, grads) and GSPMD never
+    gathers state around the Pallas custom calls. The update is
+    elementwise per leaf, so any partitioning is numerically exact;
+    grads arrive already reduced across the batch axes (GSPMD inserts
+    the all-reduce upstream to satisfy the entry specs)."""
+    from jax.experimental.shard_map import shard_map
+    p_specs = jax.tree_util.tree_map(lambda s: s.spec, shardings["params"])
+    o_specs = jax.tree_util.tree_map(lambda s: s.spec, shardings["opt"])
+    mesh = jax.tree_util.tree_leaves(shardings["params"])[0].mesh
+    return shard_map(fused_apply, mesh=mesh,
+                     in_specs=(p_specs, o_specs, p_specs),
+                     out_specs=(p_specs, o_specs), check_rep=False)
+
+
+def _fused_kernel_correction(optimizer, lazy_specs, params, opt_state,
+                             shardings, batch: int):
+    """Per-step ExecCost DELTA (analytic − XLA-counted) of the fused
+    Pallas regions, for `_StepCostTracker.correction` (ISSUE 9).
+
+    HLO cost analysis cannot see inside a `pallas_call`: a Mosaic
+    custom call reports ~0 bytes, and the CPU interpreter's emulated
+    block walk over-counts them ~10×. Each kernel carries the analytic
+    `cost_estimate` (`fused_adam.update_cost` / `segment_adam_cost`),
+    but the tracker harvests the WHOLE step module — so the honest
+    count is: harvested − (what XLA counted for the kernel region
+    alone) + (the analytic model). This lowers each kernel region once
+    per fit (a trace, no compile) to get the subtraction term; any
+    failure returns None and the gauges keep the uncorrected count."""
+    from analytics_zoo_tpu.observability.roofline import ExecCost, cost_of
+
+    def lowered(fn, *args):
+        sds = _StepCostTracker._skeleton(args)
+        return cost_of(jax.jit(fn).lower(*sds))
+
+    flops = bytes_ = 0.0
+    try:
+        if lazy_specs:
+            from analytics_zoo_tpu.learn.lazy_embedding import _get, _key
+            from analytics_zoo_tpu.pallas.segment_update import (
+                kernel_apply, segment_adam_cost)
+            for s in lazy_specs:
+                table = _get(params, s.path)
+                mu, nu = opt_state["tables"][_key(s)]
+                dim = table.shape[1]
+                a_f, a_b = segment_adam_cost(batch, dim, table.dtype)
+                raw = lowered(
+                    functools.partial(kernel_apply, b1=s.b1, b2=s.b2),
+                    table, mu, nu, jnp.zeros((batch,), jnp.int32),
+                    jnp.zeros((batch,), jnp.int32),
+                    jnp.zeros((batch, dim), jnp.float32),
+                    jnp.zeros((3,), jnp.float32))
+                if raw is None:
+                    return None
+                flops += a_f - raw.flops
+                bytes_ += a_b - raw.bytes
+        fused_apply = getattr(optimizer, "fused_apply", None)
+        if fused_apply is not None:
+            from analytics_zoo_tpu.learn.lazy_embedding import split_rest
+            from analytics_zoo_tpu.pallas.fused_adam import update_cost
+            if lazy_specs:
+                sweep_params = split_rest(params, lazy_specs)
+                sweep_state = opt_state["rest"]
+            else:
+                sweep_params = params
+                sweep_state = opt_state
+            if shardings is not None:
+                fused_apply = _shard_mapped_fused(fused_apply, shardings)
+            a_f, a_b = update_cost(sweep_params)
+            raw = lowered(fused_apply, sweep_params, sweep_state,
+                          sweep_params)
+            if raw is None:
+                return None
+            flops += a_f - raw.flops
+            bytes_ += a_b - raw.bytes
+        return ExecCost(flops, bytes_)
+    except Exception as e:  # noqa: BLE001 — telemetry only
+        log.debug("fused roofline correction unavailable: %s: %s",
+                  type(e).__name__, e)
+        return None
+
+
 def _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
-                   mixed_precision, flat_spec=None):
+                   mixed_precision, shardings=None):
+    # fused-kernel optimizer (ISSUE 9): the transformation carries a
+    # `fused_apply(grads, state, params) -> (params, state)` fast path
+    # — the Pallas kernel writes new params/moments in place, so the
+    # optax updates tree (and its extra HBM passes) never exists
+    fused_apply = getattr(optimizer, "fused_apply", None)
+    if fused_apply is not None and shardings is not None:
+        fused_apply = _shard_mapped_fused(fused_apply, shardings)
+
     def one_step(params, opt_state, xb, yb, rng):
-        # flat mode: `params` is the tuple of shape-bucketed master
-        # buffers; the unravel happens INSIDE the differentiated
-        # function so gradients materialize directly in bucket form
-        # (the slice VJPs write each leaf's grad straight into its
-        # stack slot — a ravel after the fact re-copies every grad
-        # through dynamic-update-slice fusions, measured +32 ms/step
-        # on BERT-base seq 2048)
         def compute_loss(p):
-            if flat_spec is not None:
-                p = flat_spec.unravel(p)
             if mixed_precision:
                 p = _cast_tree(p, jnp.bfloat16)
                 # inputs are NOT cast here: float-encoded integer id
@@ -626,14 +725,12 @@ def _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
             # picks up bf16 leaves (dtype drift + donation mismatch)
             state_upd = _cast_tree(state_upd, jnp.float32,
                                    only=jnp.bfloat16)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        if flat_spec is not None:
-            if jax.tree_util.tree_leaves(state_upd):
-                params = flat_spec.ravel(_merge_state(
-                    flat_spec.unravel(params), state_upd))
+        if fused_apply is not None:
+            params, opt_state = fused_apply(grads, opt_state, params)
         else:
-            params = _merge_state(params, state_upd)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        params = _merge_state(params, state_upd)
         return params, opt_state, loss
 
     return one_step
@@ -661,7 +758,7 @@ def build_train_step(apply_fn: Callable, loss_fn: Callable,
                      optimizer: optax.GradientTransformation,
                      apply_and_state_fn: Optional[Callable] = None,
                      mixed_precision: bool = False,
-                     lazy_specs=None, flat_spec=None,
+                     lazy_specs=None, fused: bool = False,
                      shardings=None) -> Callable:
     """One iteration as a pure function. jit + sharded inputs → GSPMD emits
     the gradient all-reduce; donation reuses parameter buffers in HBM.
@@ -669,10 +766,12 @@ def build_train_step(apply_fn: Callable, loss_fn: Callable,
     channel and are merged outside the gradient path.
     mixed_precision=True keeps f32 master params and runs the fwd/bwd
     matmuls in bf16 (MXU-native). `shardings` (from `_step_shardings`)
-    pins the fsdp-sharded layout explicitly — the GSPMD fit."""
+    pins the fsdp-sharded layout explicitly — the GSPMD fit. `fused`
+    selects the Pallas fused-update paths (ISSUE 9): the segment
+    one-step for declared embedding tables, `fused_apply` for the rest."""
     one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
                               apply_and_state_fn, mixed_precision,
-                              lazy_specs, flat_spec)
+                              lazy_specs, fused, shardings)
     return _jit_donated(one_step, shardings, "batch", 1)
 
 
@@ -680,7 +779,7 @@ def build_train_run(apply_fn: Callable, loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
                     apply_and_state_fn: Optional[Callable] = None,
                     mixed_precision: bool = False,
-                    lazy_specs=None, flat_spec=None,
+                    lazy_specs=None, fused: bool = False,
                     shardings=None) -> Callable:
     """Multi-step variant: one jit'd program `lax.scan`s over a
     (k, batch, ...) stack of batches, so k steps cost ONE dispatch and ONE
@@ -688,7 +787,7 @@ def build_train_run(apply_fn: Callable, loss_fn: Callable,
     reference engine owning its hot loop (`Topology.scala:1160-1337`)."""
     one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
                               apply_and_state_fn, mixed_precision,
-                              lazy_specs, flat_spec)
+                              lazy_specs, fused, shardings)
 
     def train_run(params, opt_state, xs, ys, rng):
         def body(carry, batch):
@@ -710,7 +809,8 @@ def build_device_epoch_run(apply_fn: Callable, loss_fn: Callable,
                            optimizer: optax.GradientTransformation,
                            apply_and_state_fn: Optional[Callable] = None,
                            mixed_precision: bool = False,
-                           lazy_specs=None, flat_spec=None, steps: int = 1,
+                           lazy_specs=None, fused: bool = False,
+                           steps: int = 1,
                            batch: int = 1, shuffle: bool = True,
                            shardings=None) -> Callable:
     """Whole-epoch program over a DEVICE-RESIDENT dataset: shuffle
@@ -722,7 +822,7 @@ def build_device_epoch_run(apply_fn: Callable, loss_fn: Callable,
     breakdown)."""
     one_step = _pick_one_step(apply_fn, loss_fn, optimizer,
                               apply_and_state_fn, mixed_precision,
-                              lazy_specs, flat_spec)
+                              lazy_specs, fused, shardings)
 
     def epoch_run(params, opt_state, x, y, rng):
         n = _tree_len(x)
@@ -818,13 +918,20 @@ def _device_cached_data(model, x, y, mesh):
 
 
 def _pick_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
-                   mixed_precision, lazy_specs, flat_spec=None):
+                   mixed_precision, lazy_specs, fused=False,
+                   shardings=None):
     if lazy_specs:
+        if fused:
+            from analytics_zoo_tpu.pallas.segment_update import \
+                make_fused_one_step
+            return make_fused_one_step(apply_fn, loss_fn, optimizer,
+                                       lazy_specs, apply_and_state_fn,
+                                       mixed_precision)
         from analytics_zoo_tpu.learn.lazy_embedding import make_lazy_one_step
         return make_lazy_one_step(apply_fn, loss_fn, optimizer, lazy_specs,
                                   apply_and_state_fn, mixed_precision)
     return _make_one_step(apply_fn, loss_fn, optimizer, apply_and_state_fn,
-                          mixed_precision, flat_spec=flat_spec)
+                          mixed_precision, shardings=shardings)
 
 
 def build_eval_step(apply_fn: Callable, metrics: Sequence) -> Callable:
@@ -848,6 +955,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               lazy_embeddings: bool = False,
               device_cache: Optional[bool] = None,
               flat_optimizer: bool = False,
+              fused_optimizer: Optional[bool] = None,
               sharding_rules=None,
               flops_per_step: Optional[float] = None,
               metrics_report_s: Optional[float] = None,
@@ -876,17 +984,21 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     that interval. Step/throughput/loss telemetry always publishes to
     the process-wide `MetricsRegistry` (and mirrors to TensorBoard when
     `set_tensorboard` is on).
-    `flat_optimizer=True` runs the optimizer sweep over shape-bucketed
-    stacked parameter buffers (`ops/flat_optimizer.py`) instead of
-    per-tensor updates — the TPU analogue of the reference's flat
-    `AllReduceParameter` (`Topology.scala:1204`). On BERT-base the
-    per-tensor sweep measured 153 separate ~9 MB fusions at 83 GB/s
-    effective; bucketed it streams at HBM rate (net effect is workload-
-    dependent — see docs/ROOFLINE.md round 5). Opt-in because it changes
-    the optimizer-state pytree (checkpoints within a run stay
-    consistent; per-tensor checkpoints won't resume under it) and
-    tree-structure-dependent transforms (e.g. `optax.masked` decay
-    masks) don't survive repacking. Ignored with `lazy_embeddings`.
+    `fused_optimizer=True` (config `ZooConfig.fused_optimizer` / env
+    `ZOO_FUSED_OPT=1`; None consults those) swaps a default-
+    hyperparameter `adam`/`adamw` compile spec for the fused Pallas
+    kernels (`pallas/fused_adam.py`): the whole Adam sweep becomes one
+    blocked read-(g,m,v,p)/write-(m,v,p) HBM pass per leaf, in place.
+    With `lazy_embeddings=True` the declared tables additionally take
+    the sparse segment path (`pallas/segment_update.py`): batch row
+    grads are segment-summed and ONLY the touched rows are read or
+    written — no dense table gradient is ever materialized. An
+    optimizer with no fused twin, or a backend where the kernels fail
+    to lower, degrades to the plain optax path with one WARNING.
+    (`flat_optimizer`, the earlier structural-repacking experiment, is
+    retired — passing True raises with a pointer here; see
+    docs/ROOFLINE.md round 5 for why repacking could not beat the
+    per-pass cost the kernels remove.)
     `sharding_rules` turns the fit into a GSPMD-sharded pjit program
     (the training twin of serving's sharded placement): params and
     optimizer state shard over the mesh's `fsdp` axis per the SAME
@@ -903,8 +1015,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     gathered host layout and restore DIRECTLY onto the rule-derived
     shardings, so a sharded fit's checkpoint loads into serving's
     sharded placement with zero resharding. Incompatible with
-    `flat_optimizer`/`lazy_embeddings` (both re-pack the param tree
-    the rule table describes) and multi-process fits (for now).
+    `lazy_embeddings` (the per-table state re-packs the param tree
+    the rule table describes) and multi-process fits (for now);
+    `fused_optimizer` composes — the kernels run on the fsdp-local
+    shards via `shard_map`, so the 1/fsdp state footprint is kept.
     `compile_cache_dir` (or env `ZOO_COMPILE_CACHE_DIR`) enables the
     persistent compilation cache: the jitted step/run executables are
     AOT-serialized per input signature (`compile_cache/`), so a trainer
@@ -934,6 +1048,12 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     watchdog thread so a hung dispatch surfaces as TimeoutError.
     After fit, `model.params` holds DEVICE arrays (no gratuitous
     device→host pull; save/checkpoint paths transfer on demand)."""
+    if flat_optimizer:
+        raise ValueError(
+            "flat_optimizer was retired by ISSUE 9: the bucket-packed "
+            "sweep is superseded by the fused Pallas optimizer kernels "
+            "— use fused_optimizer=True (config fused_optimizer / "
+            "ZOO_FUSED_OPT=1) instead")
     ctx = get_context()
     mesh = ctx.mesh if distributed else None
     dp = mesh.data_parallel_size if mesh else 1
@@ -951,10 +1071,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                     "table shards over the context mesh); drop "
                     "distributed=False or the rules")
     if shard_rules is not None:
-        if flat_optimizer or lazy_embeddings:
+        if lazy_embeddings:
             raise NotImplementedError(
-                "sharding_rules is incompatible with flat_optimizer/"
-                "lazy_embeddings: both re-pack the parameter tree the "
+                "sharding_rules is incompatible with lazy_embeddings: "
+                "the per-table state re-packs the parameter tree the "
                 "rule table is written against")
         if mesh.size("fsdp") == 1 and mesh.size("tensor") == 1:
             # every rule trims to replication on such a mesh: the fit
@@ -1131,30 +1251,59 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if lazy_embeddings:
         from analytics_zoo_tpu.learn.lazy_embedding import resolve_specs
         lazy_specs = resolve_specs(model)
-    flat_spec = None
-    if flat_optimizer and not lazy_specs:
-        # carry the master params as shape-bucketed stacked buffers:
-        # the optimizer sweep becomes a few streaming fusions (vs 153
-        # per-tensor programs at 83 GB/s on BERT-base) and the tree view
-        # only exists as dim-0 slices fused into the forward pass
-        from analytics_zoo_tpu.ops.flat_optimizer import ParamSpec
-        spec_memo = getattr(model, "_flat_spec_memo", None)
-        # keyed on structure AND shapes: reloading differently-shaped
-        # weights into the same model object must rebuild the buckets
-        key = (jax.tree_util.tree_structure(params),
-               tuple(tuple(l.shape)
-                     for l in jax.tree_util.tree_leaves(params)))
-        if spec_memo is None or spec_memo[0] != key:
-            spec_memo = (key, ParamSpec.from_tree(params))
-            model._flat_spec_memo = spec_memo
-        flat_spec = spec_memo[1]
-        params = flat_spec.ravel_device(params)
+    # -- fused-kernel optimizer (ISSUE 9): one HBM pass per leaf ----------
+    fused = fused_optimizer
+    if fused is None:
+        fused = bool(getattr(getattr(ctx, "config", None),
+                             "fused_optimizer", False)) \
+            or os.environ.get("ZOO_FUSED_OPT", "0") == "1"
+    fused = bool(fused)
+    if fused:
+        from analytics_zoo_tpu.pallas.fused_adam import fused_available
+        if not fused_available():
+            # the probe logged the one WARNING; plain optax from here
+            fused = False
+        else:
+            from analytics_zoo_tpu.ops.optimizers import as_fused
+            # the twin memoizes on the model: a fresh transformation per
+            # fit would change id(optimizer) in the step cache key and
+            # re-jit every warm restart
+            spec = getattr(model, "_optimizer_spec", None)
+            tkey = (id(optimizer), str(spec))
+            twin = getattr(model, "_fused_twin_cache", None)
+            if twin is not None and twin[0] == tkey:
+                fused_opt, warn = twin[1], False
+            else:
+                fused_opt, warn = as_fused(optimizer, spec), True
+                model._fused_twin_cache = (tkey, fused_opt)
+            if fused_opt is not None:
+                optimizer = fused_opt
+            elif lazy_specs:
+                # the declared tables still take the sparse fused path;
+                # only the rest-of-model sweep stays plain optax. One
+                # WARNING per model (the no-twin result is cached): a
+                # fleet-wide ZOO_FUSED_OPT=1 retrain loop must not log
+                # per fit
+                if warn:
+                    log.warning(
+                        "fused_optimizer: compiled optimizer %r has no "
+                        "exact fused twin; embedding tables take the "
+                        "fused segment path, the rest stays on plain "
+                        "optax", spec)
+            else:
+                if warn:
+                    log.warning(
+                        "fused_optimizer requested but the compiled "
+                        "optimizer (%r) has no exact fused twin (only "
+                        "default-hyperparameter adam/adamw specs map); "
+                        "keeping the plain optax path", spec)
+                fused = False
 
-    def _as_tree(p):
-        """Touch-point view: checkpoints, validation and the final
-        model.params hand-off need the tree form of the flat carry."""
-        return flat_spec.unravel_device(p) if flat_spec is not None else p
-
+    # the layout marker auto-resume uses to refuse a structurally
+    # mismatched restore: a fused fit's state tree (FusedAdamState /
+    # fused rest) differs from the stock optax chain's
+    opt_layout = "fused" if getattr(optimizer, "fused_apply", None) \
+        is not None else "tree"
     opt_shardings = None
     if lazy_specs:
         from analytics_zoo_tpu.learn.lazy_embedding import init_state
@@ -1178,12 +1327,11 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if resume_opt_tree is not None:
         from analytics_zoo_tpu.learn.checkpoint import restore_opt_state
         saved_layout = (resume_meta or {}).get("opt_state_layout", "tree")
-        live_layout = "flat_bucketed" if flat_spec is not None else "tree"
-        if saved_layout != live_layout:
+        if saved_layout != opt_layout:
             raise ValueError(
                 f"auto_resume: checkpoint optimizer state is "
                 f"{saved_layout!r} but this fit would build "
-                f"{live_layout!r} (flat_optimizer toggled between "
+                f"{opt_layout!r} (fused_optimizer toggled between "
                 "runs?); re-run with the original setting")
         restored = restore_opt_state(jax.device_get(opt_state),
                                      resume_opt_tree)
@@ -1214,13 +1362,11 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     if use_device_cache:
         cache_key = (id(optimizer), id(model.loss), "devcache",
                      mixed_precision, lazy_embeddings, dc_steps,
-                     local_batch, shuffle,
-                     flat_spec.uid if flat_spec else None, cc_dir,
+                     local_batch, shuffle, fused, cc_dir,
                      shard_desc)
     else:
         cache_key = (id(optimizer), id(model.loss), multi,
-                     mixed_precision, lazy_embeddings,
-                     flat_spec.uid if flat_spec else None, cc_dir,
+                     mixed_precision, lazy_embeddings, fused, cc_dir,
                      shard_desc)
     cached = getattr(model, "_train_cache", None)
     if cached is not None and cached[0] == cache_key:
@@ -1236,7 +1382,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             model.apply, model.loss, optimizer,
             apply_and_state_fn=getattr(model, "apply_and_state", None),
             mixed_precision=mixed_precision, lazy_specs=lazy_specs,
-            flat_spec=flat_spec, shardings=step_shardings)
+            fused=fused, shardings=step_shardings)
         if cc_dir:
             # persistent compilation cache: AOT-serialize the step/run
             # executable per input signature — a re-run in a fresh
@@ -1254,11 +1400,14 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             # steps_per_run itself stays OUT: the run program scans
             # the leading axis, so k only lives in the arg shapes and
             # a tail group may legitimately hit another run's entry.
+            # `fused` is an explicit key component (ISSUE 9): the fused
+            # and plain programs share every arg shape, so WITHOUT it a
+            # toggle could load the other mode's stale executable
             step_fp = fingerprint(
                 [model, model.loss, optimizer.update, mixed_precision,
                  lazy_embeddings, multi, bool(use_device_cache), dc_steps,
                  shuffle if use_device_cache else None,
-                 flat_spec.uid if flat_spec else None, shard_desc])
+                 fused, shard_desc])
             train_step = AOTFunctionCache(train_step, get_cache(cc_dir),
                                           step_fp, sharding=shard_desc)
         model._train_cache = (cache_key, train_step)
@@ -1300,14 +1449,55 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         # sub-dict per train-step program, under the SAME cache_key the
         # step cache memoizes on: two fits that share an executable
         # share harvested costs, two that don't cannot alias
-        cost_tracker = _StepCostTracker(train_step,
-                                        memo_root.setdefault(cache_key, {}))
+        step_memo = memo_root.setdefault(cache_key, {})
+        cost_tracker = _StepCostTracker(train_step, step_memo)
         try:
             from analytics_zoo_tpu.observability.roofline import \
                 get_accountant
             get_accountant().reset("train")
         except Exception:  # noqa: BLE001 — telemetry only
             cost_tracker = None
+        if cost_tracker is not None and fused:
+            # Pallas regions are invisible to HLO cost analysis — patch
+            # the tracker with the analytic kernel model so the MFU/HBM
+            # gauges stay honest (memoized beside the sig-keyed costs;
+            # string key cannot collide with signature tuples)
+            if "__fused_correction__" not in step_memo:
+                step_memo["__fused_correction__"] = \
+                    _fused_kernel_correction(optimizer, lazy_specs, params,
+                                             opt_state, step_shardings,
+                                             local_batch)
+            cost_tracker.correction = step_memo["__fused_correction__"]
+
+    if fused and not lazy_specs \
+            and getattr(optimizer, "fused_apply", None) is not None:
+        # one measured fused sweep, compile excluded: the direct A/B
+        # lever benches read against the unfused update's share of step
+        # time. Observed only when the probe is built (once per
+        # model/cache_key, NOT per fit): a warm re-fit re-timing it
+        # would add two full sweeps of HBM traffic inside the very
+        # bench loops the histogram exists to explain
+        try:
+            sw_cached = getattr(model, "_fused_sweep_cache", None)
+            if sw_cached is None or sw_cached[0] != cache_key:
+                # under a sharded fit the probe must time the SAME
+                # shard_mapped sweep the step runs — a bare jit would
+                # replicate the full params/moments on every device
+                # (the memory blow-up the sharded fit exists to avoid)
+                fa = optimizer.fused_apply
+                if step_shardings is not None:
+                    fa = _shard_mapped_fused(fa, step_shardings)
+                sweep = jax.jit(fa)
+                model._fused_sweep_cache = (cache_key, sweep)
+                zg = jax.tree_util.tree_map(jnp.zeros_like, params)
+                jax.block_until_ready(sweep(zg, opt_state, params))
+                t_sw = time.time()
+                jax.block_until_ready(sweep(zg, opt_state, params))
+                telemetry.fused_update_ms.observe(
+                    (time.time() - t_sw) * 1e3)
+        except Exception as e:  # noqa: BLE001 — telemetry only
+            log.debug("fused sweep timing skipped: %s: %s",
+                      type(e).__name__, e)
 
     # on-demand profiler window (ISSUE 6): capture iterations
     # [start, stop) into a bounded, rotated artifact dir
@@ -1374,8 +1564,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         return {"epoch": ep, "iteration": iteration,
                 "epoch_finished": finished,
                 "rng": np.asarray(jax.device_get(rng)).ravel().tolist(),
-                "opt_state_layout": "flat_bucketed"
-                if flat_spec is not None else "tree"}
+                "opt_state_layout": opt_layout}
 
     history: Dict[str, List[float]] = {"loss": []}
     batches = None
@@ -1435,14 +1624,13 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
                         tg.TriggerState(epoch=epoch, iteration=iteration,
                                         loss=last_loss)):
-                    # params save in TREE layout (unraveled) but a flat
-                    # run's opt_state stays in bucketed-tuple layout:
-                    # the sidecar records which (plus the resume
-                    # cursors/RNG), so a future restore can't silently
-                    # structurally mismatch the two
+                    # the sidecar records the opt-state layout (plus
+                    # the resume cursors/RNG), so a future restore
+                    # can't silently structurally mismatch a fused
+                    # fit's state against a plain one
                     # gather_tree, not bare device_get: correct (and
                     # actionably failing cross-host) for sharded leaves
-                    ckpt_mgr.save(iteration, gather_tree(_as_tree(params)),
+                    ckpt_mgr.save(iteration, gather_tree(params),
                                   gather_tree(opt_state),
                                   extra=_ckpt_extra(epoch, False))
                 if end_trigger and end_trigger(
@@ -1491,7 +1679,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
 
           if validation_data is not None:
               vx, vy = validation_data
-              model.params = _as_tree(params)  # device-resident hand-off
+              model.params = params  # device-resident hand-off
               val = evaluate_keras(model, vx, vy,
                                    batch_per_thread=max(batch_size // dp, 1))
               for k, v in val.items():
@@ -1505,7 +1693,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
           if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
                   tg.TriggerState(epoch=epoch + 1, iteration=iteration,
                                   epoch_finished=True)):
-              ckpt_mgr.save(iteration, gather_tree(_as_tree(params)),
+              ckpt_mgr.save(iteration, gather_tree(params),
                             gather_tree(opt_state),
                             extra=_ckpt_extra(epoch + 1, True))
           if end_trigger and end_trigger(
@@ -1528,7 +1716,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             try:
                 from analytics_zoo_tpu.learn.checkpoint import gather_tree
                 ckpt_mgr.save(iteration,
-                              gather_tree(_as_tree(params)),
+                              gather_tree(params),
                               gather_tree(opt_state),
                               extra=dict(_ckpt_extra(epoch, False),
                                          emergency=True))
@@ -1544,7 +1732,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         # model never points at donated/deleted buffers): repeated
         # fit/evaluate/predict chains stay in HBM; save/checkpoint
         # paths device_get on demand.
-        model.params = _as_tree(params)
+        model.params = params
         if isinstance(batches, _Prefetcher):
             batches.close()
         if profiler is not None and profile_state["active"]:

@@ -125,9 +125,18 @@ class NeuralCF(Recommender):
         def ids_fn(c):
             return lambda xb: jnp.asarray(xb[..., c], jnp.int32)
 
+        def set_ids_fn(c):
+            # write twin for the fused sparse backward (segment_update):
+            # rewrite the id column so the model's gather reads
+            # positions 0..B into a pre-gathered rows array instead of
+            # vocabulary ids (B < 2^24, exact in the f32 input)
+            return lambda xb, ids: xb.at[..., c].set(
+                ids.astype(xb.dtype))
+
         from analytics_zoo_tpu.learn.lazy_embedding import LazyEmbeddingSpec
         model.lazy_embedding_specs = [
-            LazyEmbeddingSpec((n, "embeddings"), ids_fn(col[n]))
+            LazyEmbeddingSpec((n, "embeddings"), ids_fn(col[n]),
+                              set_ids_fn=set_ids_fn(col[n]))
             for n in table_names]
         return model
 

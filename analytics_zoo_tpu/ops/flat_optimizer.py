@@ -1,118 +1,52 @@
-"""Bucket-packed optimizer sweep (the TPU analogue of the reference's
-flat ``AllReduceParameter`` gradient/weight storage, `Topology.scala:1204`
-— few big contiguous buffers swept by the optimizer instead of one small
-update program per tensor).
+"""RETIRED (ISSUE 9): bucket-packed optimizer sweep, superseded by the
+fused Pallas kernels in `analytics_zoo_tpu/pallas/fused_adam.py`.
 
-``ParamSpec`` is the shipped mechanism: `learn/trainer.py` uses it when
-``fit(..., flat_optimizer=True)`` to carry the master parameters as one
-stacked ``[count, *shape]`` f32 buffer per distinct leaf shape and to
-differentiate with respect to those buckets. See the class docstring for
-the measured design history (including the two rejected flat-vector
-layouts and why ``optax.flatten`` compile-OOMs on TPU at BERT scale).
+This module was the TPU analogue of the reference's flat
+``AllReduceParameter`` storage (`Topology.scala:1204`): master params
+carried as one stacked ``[count, *shape]`` f32 buffer per distinct leaf
+shape, so the Adam phase became a few big streaming fusions instead of
+one small program per tensor (BERT-base: 153 leaves → 9 buffers,
+sweep 37.4 → 4.6 ms/step).
+
+Measured design history, kept for the record (docs/ROOFLINE.md round 5):
+
+- a 1-D concat ravel (``optax.flatten`` shape) compiles on TPU to a
+  ``reshape`` of the vector into ``f32[N/2,2]`` whose (8,128)-tiled
+  layout pads the minor dim 2→128 — a 64×, 28 GB allocation and a
+  compile-time OOM;
+- a tile-exact ``[rows,128]`` packing collapses the sweep but restoring
+  weight-shaped views is a physical tile shuffle (+32 ms/step of
+  bitcast_convert fusions) — net zero;
+- shape-bucketed stacking (the shipped design) kept the sweep collapse
+  and the zero-cost views — but the per-step total did not move: the
+  extra HBM passes are BETWEEN optax's materialized trees (new mu, new
+  nu, the updates tree, apply_updates), not between tensors, so no
+  structural repacking can remove them.
+
+The fused kernels remove the passes themselves — one blocked
+read-(g,m,v,p)/write-(m,v,p) HBM pass per leaf, in place — which is why
+``fit(..., flat_optimizer=True)`` now raises in the trainer and this
+module is a shim. Use ``fit(..., fused_optimizer=True)`` (config
+`ZooConfig.fused_optimizer` / env ``ZOO_FUSED_OPT=1``) instead.
 """
 
 from __future__ import annotations
 
-import itertools
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-_spec_uids = itertools.count()
-
 
 class ParamSpec:
-    """Static description of a parameter pytree for bucket-packed training.
+    """Retired. The bucket-packed parameter carrier for the former
+    ``flat_optimizer=True`` fit mode; see the module docstring for the
+    design history and `pallas/fused_adam.py` for the replacement."""
 
-    The trainer's flat mode carries parameters as ONE stacked
-    ``[count, *shape]`` f32 buffer per DISTINCT leaf shape (BERT-base:
-    153 leaves -> 9 buffers), so the optimizer phase is a handful of big
-    streaming fusions instead of one small program per tensor.
-    ``unravel`` hands each consumer a dim-0 slice of its bucket — a pure
-    view with the leaf's exact layout, so the bf16 operand casts keep
-    fusing into the forward pass.
+    _RETIRED = ("ops.flat_optimizer.ParamSpec was retired by ISSUE 9: "
+                "the bucket-packed sweep is superseded by the fused "
+                "Pallas optimizer kernels — use "
+                "fit(..., fused_optimizer=True) "
+                "(ZooConfig.fused_optimizer / ZOO_FUSED_OPT=1) instead")
 
-    Two rejected designs, both measured on BERT-base (110.7 M params):
-    a 1-D concat ravel (``optax.flatten`` shape) compiles on TPU to a
-    ``reshape`` of the vector into ``f32[N/2,2]`` whose (8,128)-tiled
-    layout pads the minor dim 2->128 — a 64x, 28 GB allocation,
-    compile-time OOM; a tile-exact ``[rows,128]`` packing compiles and
-    collapses the Adam sweep 37.4 -> 4.6 ms/step, but reshaping row
-    blocks back to ``[768,3072]``-style weight shapes is a physical
-    tile shuffle (+32 ms/step of bitcast_convert fusions) — net zero.
-    Shape-bucketed stacking keeps the sweep collapse AND the zero-cost
-    views. All leaves must be float32 (mixed precision keeps f32
-    masters, so this is the trainer's steady state)."""
-
-    def __init__(self, treedef, shapes):
-        self.treedef = treedef
-        self.shapes = shapes
-        # bucket leaves by exact shape; order within a bucket = leaf
-        # order. One pass with a per-group running counter: each leaf's
-        # position IS the group's current count (BERT-scale trees have
-        # hundreds of leaves — the old rescan-per-leaf was O(n²))
-        by_shape: dict = {}
-        self.slots = []                      # per leaf: (group, pos)
-        counts: list = []                    # running per-group counters
-        for s in shapes:
-            g = by_shape.setdefault(s, len(by_shape))
-            if g == len(counts):
-                counts.append(0)
-            self.slots.append((g, counts[g]))
-            counts[g] += 1
-        self.group_shapes = list(by_shape)   # insertion-ordered
-        self.group_counts = counts
-        self.n = sum(int(np.prod(s)) if s else 1 for s in shapes)
-        self._unravel_jit = None
-        self._ravel_jit = None
-        # monotonic identity for compile-cache keys: id() of a replaced
-        # spec can be recycled by the allocator after GC
-        self.uid = next(_spec_uids)
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(self._RETIRED)
 
     @classmethod
-    def from_tree(cls, tree) -> "ParamSpec":
-        leaves, treedef = jax.tree_util.tree_flatten(tree)
-        bad = [tuple(l.shape) for l in leaves if l.dtype != jnp.float32]
-        if bad:
-            raise ValueError(
-                f"flat-parameter training needs all-f32 leaves; got "
-                f"non-f32 shapes {bad[:3]}")
-        return cls(treedef, [tuple(l.shape) for l in leaves])
-
-    def ravel(self, tree):
-        """Pack the tree into one stacked [count, *shape] buffer per
-        distinct shape (singleton buckets stay unstacked: zero-copy)."""
-        leaves = jax.tree_util.tree_leaves(tree)
-        groups: list = [[] for _ in self.group_shapes]
-        for leaf, (g, _pos) in zip(leaves, self.slots):
-            groups[g].append(leaf)
-        return tuple(ls[0] if len(ls) == 1 else jnp.stack(ls)
-                     for ls in groups)
-
-    def unravel(self, buffers):
-        leaves = []
-        for (g, pos), shape in zip(self.slots, self.shapes):
-            buf = buffers[g]
-            if self.group_counts[g] == 1:
-                leaves.append(buf)
-            else:
-                leaves.append(jax.lax.index_in_dim(buf, pos, axis=0,
-                                                   keepdims=False))
-        return jax.tree_util.tree_unflatten(self.treedef, leaves)
-
-    def unravel_device(self, flat2d):
-        """jit'd unravel for host-side touch points (checkpoint save,
-        validation hand-off) — compiled once per spec."""
-        if self._unravel_jit is None:
-            self._unravel_jit = jax.jit(self.unravel)
-        return self._unravel_jit(flat2d)
-
-    def ravel_device(self, tree):
-        """jit'd ravel, compiled once per spec: warm-restart fit calls
-        must hit the compile cache, not re-trace the packing program
-        (a fresh jax.jit wrapper per call would be keyed on itself)."""
-        if self._ravel_jit is None:
-            self._ravel_jit = jax.jit(self.ravel)
-        return self._ravel_jit(tree)
+    def from_tree(cls, tree):
+        raise NotImplementedError(cls._RETIRED)

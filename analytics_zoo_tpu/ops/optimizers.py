@@ -14,9 +14,12 @@ state (`docs/docs/wp-bigdl.md:150-166`).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+import logging
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import optax
+
+log = logging.getLogger("analytics_zoo_tpu.ops")
 
 
 # ---------------------------------------------------------------------------
@@ -82,6 +85,112 @@ def adam_weight_decay(lr: float = 1e-3,
                        weight_decay=weight_decay, mask=mask)
 
 
+# ---------------------------------------------------------------------------
+# Fused-kernel optimizer (ISSUE 9): the one-HBM-pass Adam sweep
+# ---------------------------------------------------------------------------
+class FusedAdamState(NamedTuple):
+    """Mirrors `optax.ScaleByAdamState` field-for-field (count, mu, nu)
+    so sharding rule tables and checkpoint layouts treat the fused
+    state exactly like the stock Adam state: the mu/nu trees flatten
+    with paths ending in each parameter's path, so
+    `parallel.sharding.tree_shardings` mirrors the param specs onto
+    the moments and replicates the scalar count."""
+
+    count: Any
+    mu: Any
+    nu: Any
+
+
+class FusedGradientTransformation(NamedTuple):
+    """An optax-shaped (init, update) pair plus the fused fast path.
+
+    `update` keeps the standard optax contract — it returns an updates
+    tree for `optax.apply_updates` — so any generic consumer works,
+    at the cost of one extra subtract/add pass. Hot paths (the
+    trainer's one-step) call `fused_apply(grads, state, params) ->
+    (new_params, new_state)` instead: the Pallas kernel writes the new
+    parameters in place and no updates tree ever exists."""
+
+    init: Callable
+    update: Callable
+    fused_apply: Callable
+
+
+def fused_adam(learning_rate: Any = 1e-3, b1: float = 0.9,
+               b2: float = 0.999, eps: float = 1e-8,
+               weight_decay: float = 0.0,
+               interpret: Optional[bool] = None
+               ) -> FusedGradientTransformation:
+    """Adam/AdamW as ONE blocked Pallas kernel pass over each leaf
+    (`pallas/fused_adam.py`): read (grad, m, v, param), write
+    (m, v, param), bias correction folded, decoupled weight decay,
+    fp32 moments with f32/bf16 params. `learning_rate` may be a float
+    or an optax schedule (called with the pre-increment step count,
+    matching `optax.scale_by_learning_rate`).
+
+    jax imports stay INSIDE each nested function (module globals, not
+    closure cells): `compile_cache.key.fingerprint` walks closure cells
+    for the persistent step key, and a captured module would drag the
+    whole package namespace into the walk."""
+
+    def init_fn(params):
+        import jax
+        import jax.numpy as jnp
+        zeros = lambda p: jnp.zeros(jnp.shape(p), jnp.float32)  # noqa: E731
+        return FusedAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree_util.tree_map(zeros, params),
+            nu=jax.tree_util.tree_map(zeros, params))
+
+    def _step(grads, state, params):
+        from analytics_zoo_tpu.pallas.fused_adam import fused_adam_step
+        if params is None:
+            raise ValueError(
+                "fused_adam is a params-aware transformation; call "
+                "update(grads, state, params) with the parameter tree")
+        lr = learning_rate(state.count) if callable(learning_rate) \
+            else learning_rate
+        count = state.count + 1
+        new_p, new_mu, new_nu = fused_adam_step(
+            params, state.mu, state.nu, grads, count, lr=lr, b1=b1, b2=b2,
+            eps=eps, weight_decay=weight_decay, interpret=interpret)
+        return new_p, FusedAdamState(count, new_mu, new_nu)
+
+    def update_fn(grads, state, params=None):
+        import jax
+        new_p, new_state = _step(grads, state, params)
+        updates = jax.tree_util.tree_map(lambda n, p: n - p, new_p, params)
+        return updates, new_state
+
+    return FusedGradientTransformation(init_fn, update_fn, _step)
+
+
+# String-spec → fused equivalent: EXACTLY the hyperparameters the
+# registry entry would have compiled, so toggling the config flag
+# changes the kernels, never the math. Only default-hyperparameter
+# specs map — a warmup/decay `adam_weight_decay(...)` instance carries
+# its schedule in closures we cannot (and must not guess to) replicate.
+_FUSED_EQUIV: Dict[str, Callable[[], FusedGradientTransformation]] = {
+    "adam": lambda: fused_adam(learning_rate=0.001),
+    "adamw": lambda: fused_adam(learning_rate=0.001, eps=1e-6,
+                                weight_decay=0.01),
+    "adam_weight_decay": lambda: fused_adam(learning_rate=0.001, eps=1e-6,
+                                            weight_decay=0.01),
+}
+
+
+def as_fused(optimizer: Any, spec: Any) -> Optional[Any]:
+    """The fused twin of a compiled optimizer, or None when no exact
+    twin exists (the caller then logs ONE warning and keeps the plain
+    path). `spec` is the model's compile string (`_optimizer_spec`);
+    an already-fused transformation passes through."""
+    if getattr(optimizer, "fused_apply", None) is not None:
+        return optimizer
+    key = str(spec).lower() if spec is not None else None
+    maker = _FUSED_EQUIV.get(key)
+    return maker() if maker is not None else None
+
+
 # Registry — exact strings + defaults of `KerasUtils.toBigDLOptimMethod`
 # (`KerasUtils.scala:207-216`).
 _REGISTRY: Dict[str, Callable[[], optax.GradientTransformation]] = {
@@ -98,8 +207,11 @@ _REGISTRY: Dict[str, Callable[[], optax.GradientTransformation]] = {
 
 def get(optimizer: Any) -> optax.GradientTransformation:
     """Resolve an optimizer compile string (or pass a GradientTransformation
-    through). Unknown strings raise, matching the reference."""
-    if isinstance(optimizer, optax.GradientTransformation):
+    through — duck-typed on (init, update) so the fused transformations
+    qualify). Unknown strings raise, matching the reference."""
+    if isinstance(optimizer, optax.GradientTransformation) or (
+            callable(getattr(optimizer, "init", None))
+            and callable(getattr(optimizer, "update", None))):
         return optimizer
     key = str(optimizer).lower()
     if key not in _REGISTRY:

@@ -44,6 +44,17 @@ import jax
 import jax.numpy as jnp
 
 
+def _tpu_params(**kwargs):
+    """`pltpu.CompilerParams(...)` across the jax rename: jax ≤0.4.x
+    spells it `TPUCompilerParams`, newer trees `CompilerParams` — the
+    pre-rename spelling raised AttributeError on this jaxlib and took
+    every Pallas kernel (and its tier-1 tests) down with it."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
 def _dropout_threshold(rate: float) -> int:
     """keep iff bits >= threshold (uint32 compare) — the keep-rule of the
     full-width Pallas kernel below (`impl=pallas`)."""
@@ -116,6 +127,7 @@ def _apply(x2d, seed, rate, interpret):
 
     M, C = x2d.shape
     bm = _tile_rows(M, C)
+    item = jnp.dtype(x2d.dtype).itemsize
     return pl.pallas_call(
         functools.partial(_kernel, rate),
         grid=(M // bm,),
@@ -125,7 +137,13 @@ def _apply(x2d, seed, rate, interpret):
         ],
         out_specs=pl.BlockSpec((bm, C), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((M, C), x2d.dtype),
-        compiler_params=pltpu.CompilerParams(
+        # analytic roofline model (check_pallas_cost lint): one read +
+        # one write of x, ~3 elementwise ops (threshold/scale/select) —
+        # the PRNG bits never touch HBM
+        cost_estimate=pl.CostEstimate(flops=3.0 * M * C,
+                                      bytes_accessed=float(2 * M * C * item),
+                                      transcendentals=0),
+        compiler_params=_tpu_params(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x2d, seed)

@@ -36,7 +36,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from analytics_zoo_tpu.pallas.dropout import _byte_threshold
+from analytics_zoo_tpu.pallas.dropout import _byte_threshold, _tpu_params
 
 
 def _reference_attention(q, k, v, mask=None, dropout_rate: float = 0.0,
@@ -255,6 +255,26 @@ def _fwd_kernel(rate, scale, n_qb, n_kb, q_ref, k_ref, v_ref, m_ref, s_ref,
         lse_ref[0] = m_sc[...] + jnp.log(l_sc[...])        # [bq, 1]
 
 
+def _attn_cost(n_matmuls, q, extra_f32_out_elems=0):
+    """Analytic roofline model for one attention kernel over [B,H,T,D]
+    (check_pallas_cost lint: HLO cost analysis sees ~0 inside a Mosaic
+    call). `n_matmuls` counts the T×T×D matmul-shaped products the
+    kernel runs per head (2 flops each); bytes are the O(T·D) streams —
+    q/k/v-sized reads and writes — NOT the O(T²) scores, which is the
+    IO-aware point of flash attention; exp() is one per score."""
+    from jax.experimental import pallas as pl
+
+    B, H, T, D = q.shape
+    bh = B * H
+    item = jnp.dtype(q.dtype).itemsize
+    streams = 4 + n_matmuls  # rough: q,k,v(+dout...) in, grads/out out
+    return pl.CostEstimate(
+        flops=2.0 * n_matmuls * bh * T * T * D,
+        bytes_accessed=float(bh * T * D * item * streams
+                             + extra_f32_out_elems * 4),
+        transcendentals=float(bh * T * T))
+
+
 def _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -290,8 +310,10 @@ def _flash_fwd(q, k, v, mask, seed, rate, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=_attn_cost(2, q,                    # QKᵀ + PV
+                                 extra_f32_out_elems=B * H * T),
         interpret=interpret,
     )(qf, kf, vf, mf, seed)
     out = out.reshape(B, H, T, D)
@@ -500,8 +522,13 @@ def _flash_bwd(rate, _fwd_block_q, _fwd_block_k, block_q, block_k, interpret,
                 pltpu.VMEM((block_k, D), jnp.float32),
                 pltpu.VMEM((block_k, D), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_tpu_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
+            # scores, dv, dw, dq-partial, dk matmuls; the dqp partials
+            # buffer is an extra n_kb×T×D f32 write stream
+            cost_estimate=_attn_cost(5, q,
+                                     extra_f32_out_elems=B * H * n_kb
+                                     * T * D),
             interpret=interpret,
         )(qf, kf, vf, mf, seed, dof, lse, delta)
         # the transposed-order accumulation, done where it is cheap: n_kb
@@ -527,8 +554,9 @@ def _flash_bwd(rate, _fwd_block_q, _fwd_block_k, block_q, block_k, interpret,
                                    lambda b, i, j: (b, i, 0)),
             out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_tpu_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
+            cost_estimate=_attn_cost(3, q),   # scores, dw/ds, dq
             interpret=interpret,
         )(qf, kf, vf, mf, seed, dof, lse, delta)
         dk, dv = pl.pallas_call(
@@ -556,8 +584,9 @@ def _flash_bwd(rate, _fwd_block_q, _fwd_block_k, block_q, block_k, interpret,
                 pltpu.VMEM((block_k, D), jnp.float32),
                 pltpu.VMEM((block_k, D), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_tpu_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
+            cost_estimate=_attn_cost(4, q),   # scores, dv, ds, dk
             interpret=interpret,
         )(qf, kf, vf, mf, seed, dof, lse, delta)
 

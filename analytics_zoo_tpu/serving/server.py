@@ -90,9 +90,11 @@ def _record_uris(records) -> List[str]:
 class _Batch:
     """One shape-homogeneous unit of pipeline work."""
 
-    __slots__ = ("ids", "uris", "arrays", "t0", "pending", "nan", "t_enq")
+    __slots__ = ("ids", "uris", "arrays", "t0", "pending", "nan", "t_enq",
+                 "stacked", "valid_n")
 
-    def __init__(self, ids, uris, arrays, t0, nan=False):
+    def __init__(self, ids, uris, arrays, t0, nan=False, stacked=None,
+                 valid_n=None):
         self.ids = ids            # broker record ids (for the batched ack)
         self.uris = uris          # result-hash fields
         self.arrays = arrays      # decoded host arrays (None once stacked)
@@ -100,6 +102,8 @@ class _Batch:
         self.pending = None       # PendingPrediction after dispatch
         self.nan = nan            # failure batch: sink writes "NaN"
         self.t_enq = t0           # last enqueue timestamp (queue-wait spans)
+        self.stacked = stacked    # bucket-shaped buffer (zero-copy decode)
+        self.valid_n = valid_n    # real rows in `stacked` (rest is pad)
 
 
 class ClusterServing:
@@ -120,7 +124,7 @@ class ClusterServing:
                  breaker_failure_threshold: int = 3,
                  breaker_reset_s: float = 1.0,
                  sink_buffer_batches: int = 256,
-                 slo=None):
+                 slo=None, zero_copy_decode: bool = True):
         """Fault-tolerance knobs (ISSUE 5; the rest is PR 1-4 surface):
         `supervise` starts a `ReplicaSupervisor` over a replica pool
         (quarantine after `failure_threshold` consecutive failures or
@@ -134,7 +138,13 @@ class ClusterServing:
         `slo` (ISSUE 6): declarative objectives — an
         `observability.slo.SLOObjectives` — evaluated over the engine's
         own latency/outcome metrics; the tracker feeds `health()` / the
-        frontend's `/healthz` and publishes burn-rate gauges."""
+        frontend's `/healthz` and publishes burn-rate gauges.
+
+        `zero_copy_decode` (ISSUE 9): decode writes records straight
+        into preallocated bucket-shaped batch buffers (no per-record
+        ndarray allocation, no dispatch-stage np.stack). False restores
+        the per-record decode + stack path — kept ONLY as the
+        bench_serving A/B baseline."""
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
@@ -180,6 +190,7 @@ class ClusterServing:
         self.batch_timeout_ms = batch_timeout_ms
         self.consumer = new_consumer_name()
         self.pipelined = pipelined
+        self.zero_copy_decode = zero_copy_decode
         self.decode_workers = max(1, decode_workers)
         self.queue_depth = max(1, queue_depth)
         self._stop = threading.Event()
@@ -554,12 +565,26 @@ class ClusterServing:
 
     # -- stage: decode -----------------------------------------------------
     def _decode_records(self, records):
-        """Per-record decode + shape grouping, shared by the pipelined
-        decode stage and the legacy synchronous loop. Returns
-        ``(by_shape, failed)``: shape → [(rid, uri, array)] plus the
-        [(rid, uri)] records that failed to decode (degrade to "NaN")."""
-        from analytics_zoo_tpu.serving.pre_post import decode_record_field
-        by_shape: dict = {}
+        """Per-record decode straight into PREALLOCATED bucket-shaped
+        batch buffers, shared by the pipelined decode stage and the
+        legacy synchronous loop (ISSUE 9 serving satellite).
+
+        Records group by (shape, dtype) read off the codec HEADER —
+        no payload decode yet — then each group sizes ONE
+        ``[bucket, *shape]`` buffer (`_next_bucket`, padding included)
+        and every payload decodes directly into its row
+        (`pre_post.decode_record_into`): the hot path allocates zero
+        per-record ndarrays and the dispatch stage's separate np.stack
+        pass is gone. Headerless codecs (arrow/image/list) decode
+        first and pay one row copy — same cost as the old path.
+
+        Returns ``(batches, failed)``: [(ids, uris, buf, n_real)] with
+        rows [n_real:] pre-padded, plus the [(rid, uri)] records that
+        failed to decode (degrade to "NaN")."""
+        from analytics_zoo_tpu.serving.pre_post import (decode_record_field,
+                                                        decode_record_into,
+                                                        record_meta)
+        groups: dict = {}
         failed = []
         for rid, rec in records:
             try:
@@ -567,14 +592,75 @@ class ClusterServing:
                 # single-tensor fast path: field "t" or "image"
                 field = "t" if "t" in data else (
                     "image" if "image" in data else next(iter(data)))
-                arr = decode_record_field(data[field])
-                by_shape.setdefault(arr.shape, []).append(
-                    (rid, rec["uri"], arr))
+                value = data[field]
+                meta = record_meta(value)
+                if meta is None:
+                    value = decode_record_field(value)
+                    meta = (value.shape, value.dtype.str)
+                groups.setdefault(meta, []).append((rid, rec["uri"],
+                                                    value))
             except Exception as e:  # noqa: BLE001 — degrade per record
                 # rec itself may be malformed (a foreign producer can
                 # XADD any JSON): the failure path must not raise, or one
                 # poison record would drop its whole read batch into a
                 # redeliver loop
+                uri = rec.get("uri", rid) if isinstance(rec, dict) \
+                    else str(rid)
+                log.warning("decode failure for %s: %s", uri, e)
+                failed.append((rid, uri))
+        batches = []
+        for (shape, dtype), items in groups.items():
+            bucket = _next_bucket(len(items), self.model.buckets)
+            try:
+                # header shape/dtype are UNTRUSTED producer input (a
+                # foreign client can XADD shape [-1] or an absurd dim):
+                # an allocation failure degrades THIS group to NaN —
+                # well-formed records in other groups must still serve
+                buf = np.empty((max(bucket, len(items)),) + tuple(shape),
+                               np.dtype(dtype))
+            except Exception as e:  # noqa: BLE001 — degrade per group
+                for rid, uri, _ in items:
+                    log.warning("decode failure for %s: %s", uri, e)
+                    failed.append((rid, uri))
+                continue
+            ids, uris = [], []
+            for rid, uri, value in items:
+                try:
+                    # rows compact on failure: the row cursor advances
+                    # only when a payload lands
+                    if isinstance(value, np.ndarray):
+                        buf[len(ids)] = value
+                    else:
+                        decode_record_into(value, buf[len(ids)])
+                except Exception as e:  # noqa: BLE001 — degrade per rec
+                    log.warning("decode failure for %s: %s", uri, e)
+                    failed.append((rid, uri))
+                    continue
+                ids.append(rid)
+                uris.append(uri)
+            if not ids:
+                continue
+            buf[len(ids):] = buf[len(ids) - 1]   # stack-free bucket pad
+            batches.append((ids, uris, buf, len(ids)))
+        return batches, failed
+
+    def _decode_records_legacy(self, records):
+        """The pre-ISSUE-9 per-record decode (one ndarray allocation per
+        record; the dispatch stage stacks). Kept ONLY as the
+        `zero_copy_decode=False` baseline the bench_serving decode A/B
+        measures against. Returns ``(by_shape, failed)``."""
+        from analytics_zoo_tpu.serving.pre_post import decode_record_field
+        by_shape: dict = {}
+        failed = []
+        for rid, rec in records:
+            try:
+                data = rec["data"]
+                field = "t" if "t" in data else (
+                    "image" if "image" in data else next(iter(data)))
+                arr = decode_record_field(data[field])
+                by_shape.setdefault(arr.shape, []).append(
+                    (rid, rec["uri"], arr))
+            except Exception as e:  # noqa: BLE001 — degrade per record
                 uri = rec.get("uri", rid) if isinstance(rec, dict) \
                     else str(rid)
                 log.warning("decode failure for %s: %s", uri, e)
@@ -598,16 +684,25 @@ class ClusterServing:
                             cat="serving.queue", trace_ids=uris)
             try:
                 t_work = time.perf_counter()
-                by_shape, failed = self._decode_records(records)
+                if self.zero_copy_decode:
+                    batches, failed = self._decode_records(records)
+                else:
+                    by_shape, failed = self._decode_records_legacy(records)
+                    batches = None
                 if failed:
                     self._enqueue(self._sink_q, _Batch(
                         [rid for rid, _ in failed],
                         [uri for _, uri in failed], None, t0, nan=True))
-                for items in by_shape.values():
-                    self._enqueue(self._dispatch_q, _Batch(
-                        [rid for rid, _, _ in items],
-                        [uri for _, uri, _ in items],
-                        [a for _, _, a in items], t0))
+                if batches is not None:
+                    for ids, uris, buf, n in batches:
+                        self._enqueue(self._dispatch_q, _Batch(
+                            ids, uris, None, t0, stacked=buf, valid_n=n))
+                else:
+                    for items in by_shape.values():
+                        self._enqueue(self._dispatch_q, _Batch(
+                            [rid for rid, _, _ in items],
+                            [uri for _, uri, _ in items],
+                            [a for _, _, a in items], t0))
                 t_end = time.perf_counter()
                 self.decode_timer.record(t_end - t_work)
                 if tr is not None:
@@ -631,16 +726,23 @@ class ClusterServing:
                             trace_ids=batch.uris)
             try:
                 t_work = time.perf_counter()
-                n = len(batch.arrays)
-                bucket = _next_bucket(n, self.model.buckets)
-                arrs = batch.arrays
-                if bucket > n:
-                    # stack straight to the bucket: padding costs
-                    # nothing extra (the stack copies anyway) and
-                    # predict_async skips its device-side pad
-                    arrs = arrs + [arrs[-1]] * (bucket - n)
-                stacked = np.stack(arrs)
-                batch.arrays = None
+                if batch.stacked is not None:
+                    # zero-copy decode already assembled the
+                    # bucket-shaped buffer — nothing to stack here
+                    n = batch.valid_n
+                    stacked = batch.stacked
+                    batch.stacked = None
+                else:
+                    n = len(batch.arrays)
+                    bucket = _next_bucket(n, self.model.buckets)
+                    arrs = batch.arrays
+                    if bucket > n:
+                        # stack straight to the bucket: padding costs
+                        # nothing extra (the stack copies anyway) and
+                        # predict_async skips its device-side pad
+                        arrs = arrs + [arrs[-1]] * (bucket - n)
+                    stacked = np.stack(arrs)
+                    batch.arrays = None
                 # async: returns before the device finishes — the
                 # sink materializes while we stack the next batch.
                 # With EVERY replica quarantined the router fails fast;
@@ -674,6 +776,7 @@ class ClusterServing:
                 log.error("dispatch failure for batch of %d: %s",
                           len(batch.uris), e)
                 batch.arrays = None
+                batch.stacked = None
                 batch.nan = True
                 self._enqueue(self._sink_q, batch)
 
@@ -920,16 +1023,22 @@ class ClusterServing:
     def _process(self, records):
         # per-record decode failure -> NaN without killing the batch; one
         # forward per shape-homogeneous sub-batch
-        by_shape, failed = self._decode_records(records)
+        if self.zero_copy_decode:
+            batches, failed = self._decode_records(records)
+        else:
+            by_shape, failed = self._decode_records_legacy(records)
+            batches = [([rid for rid, _, _ in items],
+                        [uri for _, uri, _ in items],
+                        np.stack([a for _, _, a in items]), len(items))
+                       for items in by_shape.values()]
         for _rid, uri in failed:
             self.broker.hset(self.result_key, uri, "NaN")
         if failed:
             self._records_total.inc(len(failed), outcome="failed")
-        for shape, items in by_shape.items():
-            batch = np.stack([a for _, _, a in items])
+        for _ids, uris, buf, n in batches:
             try:
-                preds = self.model.predict(batch)
-                for (_rid, uri, _), pred in zip(items, preds):
+                preds = self.model.predict(buf[:n])
+                for uri, pred in zip(uris, preds):
                     if self.output_filter:
                         from analytics_zoo_tpu.serving.pre_post import \
                             apply_filter
@@ -946,10 +1055,11 @@ class ClusterServing:
                 # quarantine contract
                 raise
             except Exception as e:  # noqa: BLE001 — stream must survive
-                log.error("inference failure for batch %s: %s", shape, e)
-                for _rid, uri, _ in items:
+                log.error("inference failure for batch of %d (%s): %s",
+                          n, tuple(buf.shape[1:]), e)
+                for uri in uris:
                     self.broker.hset(self.result_key, uri, "NaN")
-                self._records_total.inc(len(items), outcome="failed")
+                self._records_total.inc(len(uris), outcome="failed")
 
     # -- metrics (`/metrics`, FrontEndApp.scala:241) -----------------------
     def metrics(self) -> dict:

@@ -77,11 +77,11 @@ def _measure_bert(dev, *, vocab, hidden, n_block, n_head, seq_len, inter,
             "y": rs.randint(0, 2, (n,)).astype(np.int32)}
     fit_kw = dict(epochs=1, batch_size=batch, steps_per_run=steps_per_run,
                   mixed_precision=True,
-                  # bucketed optimizer sweep: collapses the per-tensor
-                  # Adam phase 37->4 ms/step, but regrouping the grads
-                  # costs an equal pass — net inside session noise on
-                  # BERT (docs/ROOFLINE.md round 5), so off by default
-                  flat_optimizer=os.environ.get("BENCH_FLATOPT", "0") == "1")
+                  # fused Pallas optimizer sweep (ISSUE 9): one HBM
+                  # pass per leaf instead of optax's materialized-tree
+                  # chain; BERT is compute-bound so the delta here is
+                  # small — the A/B knob exists for the record
+                  fused_optimizer=os.environ.get("BENCH_FUSED", "0") == "1")
 
     est.fit(data, **fit_kw)                 # warmup: compile + first epoch
     # Best of 3 timed epochs: the dev-tunnel chip's minute-to-minute

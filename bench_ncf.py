@@ -12,6 +12,15 @@ achieved HBM bytes/s over the chip's peak bandwidth, alongside the
 samples/sec/chip yardstick (no absolute CPU number exists in the
 reference tree — BASELINE.md).
 
+Since ISSUE 9 the bench is an A/B: the plain optax sweep is timed
+first, then the fused Pallas optimizer kernels
+(`fit(fused_optimizer=True)`, the default leg) — `step_ms` /
+`step_ms_unfused` / `fused_step_speedup` record the gap, and
+`ncf_pct_of_achievable_bound_live` reads the trainer's roofline gauge
+for the FUSED program (target ≥60 under BENCH_CALIBRATE=1 on a real
+chip). BENCH_FUSED=0 turns leg B back into a second unfused run;
+BENCH_LAZY=1 adds the sparse segment path for the tables.
+
     python bench_ncf.py            # real chip
     BENCH_TINY=1 python bench_ncf.py
 """
@@ -62,20 +71,20 @@ def main():
     x = np.stack([rs.randint(1, users, n), rs.randint(1, items, n)],
                  axis=1).astype(np.int32)
     y = rs.randint(0, 2, n).astype(np.int32)
-    # BENCH_LAZY=1 switches to row-sparse embedding updates
-    # (learn/lazy_embedding.py). Measured SLOWER here: XLA's large-table
-    # set-scatter is not in-place (full-table copies), and at MovieLens
-    # density (8192 ids / 138k rows = 6%) even ideal row updates touch
-    # nearly every 128-row tile — the dense streaming sweep is
-    # near-optimal on TPU (docs/ROOFLINE.md round-4 note).
+    # BENCH_LAZY=1 additionally routes the tables through the sparse
+    # path. UNFUSED lazy measured SLOWER than dense (XLA set-scatter
+    # copies the full table — docs/ROOFLINE.md round-4 note); the FUSED
+    # segment kernel (pallas/segment_update.py) removes exactly that
+    # copy plus the dense-grad materialization, so lazy is worth
+    # re-measuring under BENCH_LAZY=1 BENCH on real chips.
     lazy = os.environ.get("BENCH_LAZY", "0") == "1"
-    fit_kw = dict(epochs=1, batch_size=batch, steps_per_run=spr,
-                  lazy_embeddings=lazy,
-                  # bucket the 4 tables into 2 stacked buffers so the
-                  # Adam sweeps stop serializing per table (A/B knob)
-                  flat_optimizer=os.environ.get("BENCH_FLATOPT", "0") == "1")
+    base_kw = dict(epochs=1, batch_size=batch, steps_per_run=spr,
+                   lazy_embeddings=lazy)
 
-    est.fit((x, y), **fit_kw)          # warmup: compile + first epoch
+    # warmup leg A: pinned unfused — base_kw must not resolve against a
+    # fleet-wide ZOO_FUSED_OPT=1, or the timed unfused leg below would
+    # pay its full compile inside the measurement
+    est.fit((x, y), **base_kw, fused_optimizer=False)
 
     # BENCH_CALIBRATE=1: measure the session's ACHIEVED bandwidth/MXU
     # rate BEFORE the timed fits and install it as the session roofline
@@ -93,11 +102,29 @@ def main():
         set_session_roofline(hbm_gbps=achieved_gbps,
                              tflops=achieved_tflops)
 
-    dt = float("inf")
-    for _ in range(1 if tiny else 3):  # best-of-3 (tunnel variance)
-        t0 = time.perf_counter()
-        hist = est.fit((x, y), **fit_kw)
-        dt = min(dt, time.perf_counter() - t0)
+    def timed_fit(estimator, **kw):
+        best = float("inf")
+        h = None
+        for _ in range(1 if tiny else 3):  # best-of-3 (tunnel variance)
+            t0 = time.perf_counter()
+            h = estimator.fit((x, y), **kw)
+            best = min(best, time.perf_counter() - t0)
+        return best, h
+
+    # A/B (ISSUE 9): the plain optax sweep first, then the fused Pallas
+    # kernels LAST so the live roofline gauges read the fused program.
+    # Fresh models per leg: the fused toggle changes the opt-state tree
+    # and must not warm-start from the other leg's params.
+    dt_unfused, _ = timed_fit(est, **base_kw, fused_optimizer=False)
+
+    ncf = NeuralCF(user_count=users, item_count=items, class_num=2,
+                   mf_embed=64, user_embed=64, item_embed=64,
+                   hidden_layers=(128, 64, 32))
+    est = Estimator.from_keras(ncf.model, optimizer="adam",
+                               loss="sparse_categorical_crossentropy")
+    fused = os.environ.get("BENCH_FUSED", "1") == "1"
+    est.fit((x, y), **base_kw, fused_optimizer=fused)      # warmup leg B
+    dt, hist = timed_fit(est, **base_kw, fused_optimizer=fused)
     steps = n // batch
     samples_s = steps * batch / dt
     dev = jax.devices()[0]
@@ -110,16 +137,18 @@ def main():
                 for k, p in jax.tree_util.tree_leaves_with_path(params)
                 if "embed" in str(k).lower())
     n_matmul = n_params - n_emb
-    # dense Adam: read grad + read/write each of p, m, v = 7 f32 passes
+    # Adam floor: read grad + read/write each of p, m, v = 7 f32 passes
     # over EVERY parameter per step, PLUS the dense embedding-gradient
     # materialization the round-5 xplane profile showed is a first-class
     # cost (docs/ROOFLINE.md NCF breakdown): a zeros broadcast + a
     # scatter-add output, each a full write pass over every embedding
     # table = 2 more passes over n_emb. Per-sample activation traffic is
-    # noise next to either at MovieLens scale.
-    # lazy mode has no analytic byte count worth reporting: XLA's
-    # set-scatter materializes full-table copies (docs/ROOFLINE.md), so
-    # the idealized touched-rows figure would be off ~4x
+    # noise next to either at MovieLens scale. The fused kernels hit
+    # this floor by construction (one blocked pass); the unfused optax
+    # chain runs 10-12 passes against it — that gap IS the A/B.
+    # lazy mode has no dense-sweep byte count worth reporting: the
+    # fused segment path touches only batch rows (a different, far
+    # smaller floor), the unfused one copies whole tables.
     bytes_step = None if lazy else 4 * (7 * n_params + 2 * n_emb)
     flops_step = 6 * n_matmul * batch
     hbm_util = (None if bytes_step is None
@@ -151,21 +180,38 @@ def main():
     except Exception:  # noqa: BLE001 — headline must survive
         pass
 
+    from analytics_zoo_tpu.observability import get_registry
+    fused_ms = None
+    try:
+        fs = get_registry().snapshot().get("training_fused_update_ms")
+        if fs and fs.get("series"):
+            fused_ms = round(fs["series"][0]["p50"], 3)
+    except Exception:  # noqa: BLE001 — headline must survive
+        pass
+
     print(json.dumps({
         "metric": "ncf_train_samples_per_sec_via_estimator_fit",
         "value": round(samples_s, 1),
         "unit": "samples/s",
         "vs_baseline": round(samples_s / 100_000.0, 4),
         "step_ms": round(dt / steps * 1e3, 3),
+        "step_ms_unfused": round(dt_unfused / steps * 1e3, 3),
+        "fused_optimizer": fused,
+        "fused_step_speedup": round(dt_unfused / dt, 3),
+        "fused_update_ms": fused_ms,
         "hbm_utilization_pct": (None if hbm_util is None
                                 else round(hbm_util * 100, 2)),
         "mfu_pct": round(mfu * 100, 3),
-        "bound": ("memory (lazy row-sparse embedding updates)" if lazy
-                  else "memory (dense-Adam sweep + dense-grad "
+        "bound": ("memory (row-sparse embedding updates)" if lazy
+                  else "memory (Adam sweep + dense-grad "
                        "materialization; see docs/ROOFLINE.md NCF "
                        "per-op breakdown)"),
         "lazy_embeddings": lazy,
         "device": getattr(dev, "device_kind", str(dev)),
+        # CPU-rig runs: the step-time ratio is a host-core measurement,
+        # not a chip one (interpret-mode kernels; see PRs 3/7 caveat)
+        "host_cores": (None if jax.default_backend() == "tpu"
+                       else os.cpu_count()),
         "achieved_hbm_gbps": achieved_gbps,
         "achieved_mxu_tflops": achieved_tflops,
         "pct_of_achievable_bound": pct_achievable,

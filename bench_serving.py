@@ -218,6 +218,65 @@ def _measure_drain(infer, broker_kind: str, total: int = 480,
     return ndone / dt
 
 
+def _measure_decode_ab(infer, total: int = 480, rounds: int = 3):
+    """Decode-share A/B (ISSUE 9 satellite): the ~0.24 ms host-side gap
+    between `serving_p50_ms` and wire-only p50 is decode + dispatch
+    work; zero-copy decode writes each record straight into a
+    preallocated bucket-shaped batch buffer (no per-record ndarray, no
+    dispatch-stage np.stack). Engine-limited drain per mode, reading
+    each ENGINE'S OWN stage timers (fresh per ClusterServing, so the
+    two modes can't contaminate each other's percentiles). Interleaved
+    rounds + per-mode MEDIAN, like the concurrent bench: a single
+    drain's percentiles ride whatever the host scheduler did that
+    second (first-round cold starts measured 2x on the 2-core rig)."""
+    from analytics_zoo_tpu.serving.client import RESULT_KEY, InputQueue
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    runs = {"legacy": [], "zero_copy": []}
+    for _ in range(rounds):
+        for label, zero_copy in (("legacy", False), ("zero_copy", True)):
+            serve_broker, (submit_br, poll_br), server = _setup_brokers(
+                "redis", 2)
+            inq = InputQueue(submit_br)
+            img = np.random.rand(32, 32, 3).astype(np.float32)
+            for _ in range(total):
+                inq.enqueue(t=img)
+            serving = ClusterServing(infer, broker=serve_broker,
+                                     batch_size=32, batch_timeout_ms=2,
+                                     pipelined=True,
+                                     zero_copy_decode=zero_copy).start()
+            t0 = time.perf_counter()
+            ndone = 0
+            deadline = time.time() + 120
+            while ndone < total and time.time() < deadline:
+                allr = poll_br.hgetall(RESULT_KEY)
+                if allr:
+                    poll_br.hdel_many(RESULT_KEY, list(allr))
+                    ndone += len(allr)
+                else:
+                    time.sleep(0.001)
+            dt = time.perf_counter() - t0
+            stages = {name: t.snapshot() for name, t in
+                      (("decode", serving.decode_timer),
+                       ("dispatch", serving.dispatch_timer))}
+            serving.stop()
+            _teardown_brokers(serve_broker, [submit_br, poll_br], server)
+            runs[label].append((ndone / dt, stages["decode"]["p50_ms"],
+                                stages["dispatch"]["p50_ms"]))
+    out = {}
+    for label, rows in runs.items():
+        out[label] = {
+            "drain_rps": round(float(np.median([r[0] for r in rows])), 1),
+            "decode_p50_ms": float(np.median([r[1] for r in rows])),
+            "dispatch_p50_ms": float(np.median([r[2] for r in rows])),
+        }
+    host = out["legacy"]["decode_p50_ms"] + out["legacy"]["dispatch_p50_ms"]
+    zc = (out["zero_copy"]["decode_p50_ms"]
+          + out["zero_copy"]["dispatch_p50_ms"])
+    out["decode_dispatch_p50_cut_ms"] = round(host - zc, 4)
+    return out
+
+
 def _warmup_probe(model, replicas: int = 3):
     """Fresh InferenceModel + warmup(): is the FIRST request's latency
     within noise of steady-state (i.e. no compile on the request path)?
@@ -986,6 +1045,10 @@ def main():
     drain_pipe = _measure_drain(infer, "redis", pipelined=True)
     drain_sync = _measure_drain(infer, "redis", pipelined=False)
 
+    # decode-share A/B (ISSUE 9): legacy per-record decode vs zero-copy
+    # into preallocated bucket buffers, per-stage timers per mode
+    decode_ab = _measure_decode_ab(infer)
+
     # snapshot utilization NOW: the probe/identity models below call
     # load_fn, which resets the "serving" roofline accumulators to
     # describe THEIR program — the JSON must describe the main model's
@@ -1025,6 +1088,10 @@ def main():
         "serving_drain_rps_sync": round(drain_sync, 1),
         "serving_drain_speedup": round(drain_pipe / max(drain_sync, 1e-9),
                                        2),
+        # host-side decode share: wire p50 vs end-to-end p50 is the
+        # budget; the A/B shows what zero-copy decode cut out of it
+        "serving_host_gap_p50_ms": round(p50 - wire_p50, 3),
+        "serving_decode_ab": decode_ab,
         "serving_warm_first_request_ms": round(first_ms, 3),
         "serving_steady_p50_ms": round(steady_p50, 3),
         # what each probe restart paid: buckets compiled fresh vs

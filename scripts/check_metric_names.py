@@ -81,6 +81,12 @@ REQUIRED = {
     "slo_burn_rate": "gauge",
     "slo_met": "gauge",
     "observability_gauge_errors_total": "counter",
+    # fused optimizer kernels (ISSUE 9): the A/B lever bench_ncf and
+    # the roofline docs read, plus the roofline counters the fused-step
+    # correction feeds (already REQUIRED above) — renaming any of these
+    # silently blinds the NCF bound tracking
+    "training_fused_update_ms": "histogram",
+    "roofline_busy_seconds_total": "counter",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",

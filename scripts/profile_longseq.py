@@ -61,7 +61,7 @@ def main():
             "y": rs.randint(0, 2, (n,)).astype(np.int32)}
     fit_kw = dict(epochs=1, batch_size=args.batch,
                   steps_per_run=args.steps, mixed_precision=True,
-                  flat_optimizer=os.environ.get("PROF_FLAT", "0") == "1")
+                  fused_optimizer=os.environ.get("PROF_FUSED", "0") == "1")
     est.fit(data, **fit_kw)
 
     trace_dir = tempfile.mkdtemp(prefix="longseq_prof_")

@@ -83,115 +83,15 @@ class TestMultiStepRun:
         np.testing.assert_allclose(pa, pb, rtol=1e-5, atol=1e-6)
 
 
-class TestFlatOptimizer:
-    def test_matches_per_tensor_numerics(self):
-        # the raveled sweep is the SAME math: one adam over a flat
-        # vector must reproduce the per-tensor path bit-for-bit modulo
-        # reduction order (fp-tolerance), including across epochs
-        x, y = _toy_data(128)
-        ma, mb = _toy_model(), _toy_model()
-        ha = ma.fit(x, y, batch_size=32, nb_epoch=3, shuffle=False, seed=7)
-        hb = mb.fit(x, y, batch_size=32, nb_epoch=3, shuffle=False, seed=7,
-                    flat_optimizer=True)
-        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=1e-5)
-        pa = np.asarray(ma.predict(x, batch_per_thread=32))
-        pb = np.asarray(mb.predict(x, batch_per_thread=32))
-        np.testing.assert_allclose(pa, pb, rtol=1e-5, atol=1e-6)
-
-    def test_global_norm_clip_matches_per_tensor(self):
-        # clipping couples elements ACROSS buckets (one global L2 over
-        # the whole tree): the bucketed sweep must see the same norm
-        import optax
-        from analytics_zoo_tpu.keras import Sequential
-        from analytics_zoo_tpu.keras import layers as L
-
-        def mk():
-            m = Sequential()
-            m.add(L.Dense(16, activation="relu", input_shape=(8,)))
-            m.add(L.Dense(1))
-            m.compile(optimizer=optax.chain(
-                optax.clip_by_global_norm(1e-3),   # always-active clip
-                optax.adam(1e-2)), loss="mse")
-            return m
-
-        x, y = _toy_data(128)
-        ma, mb = mk(), mk()
-        ha = ma.fit(x, y, batch_size=32, nb_epoch=2, shuffle=False, seed=7)
-        hb = mb.fit(x, y, batch_size=32, nb_epoch=2, shuffle=False, seed=7,
-                    flat_optimizer=True)
-        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=1e-5)
-        for a, b in zip(jax.tree_util.tree_leaves(ma.params),
-                        jax.tree_util.tree_leaves(mb.params)):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=1e-5, atol=1e-7)
-
-    def test_spec_rebuilt_when_shapes_change(self):
-        # same tree structure, different leaf shapes (weights reloaded
-        # wider) must rebuild BOTH the bucket spec and the cached jitted
-        # step (a train_step closed over the old spec would unravel with
-        # stale slots)
-        import optax
-        import jax.numpy as jnp
-        from analytics_zoo_tpu.keras import Sequential
-        from analytics_zoo_tpu.keras import layers as L
-        m = Sequential()
-        m.add(L.Dense(1, input_shape=(8,)))
-        m.compile(optimizer=optax.adam(1e-2), loss="mse")
-        x, y = _toy_data(128)
-        m.fit(x, y, batch_size=32, nb_epoch=1, flat_optimizer=True)
-        first_spec = m._flat_spec_memo[1]
-        first_cache = m._train_cache
-        m.fit(x, y, batch_size=32, nb_epoch=1, flat_optimizer=True)
-        assert m._flat_spec_memo[1] is first_spec  # unchanged -> reused
-        assert m._train_cache is first_cache
-        # "reload" wider weights: [8,1] -> [8,2] kernel, [1] -> [2] bias
-        # (mse broadcasts over the extra output column, so the refit
-        # actually runs through the new spec end-to-end)
-        m.params = jax.tree_util.tree_map(
-            lambda a: jnp.concatenate([a, a], axis=-1) if a.ndim == 2
-            else jnp.concatenate([a, a]), m.params)
-        h = m.fit(x, y, batch_size=32, nb_epoch=1, flat_optimizer=True)
-        assert np.isfinite(h["loss"]).all()
-        assert m._flat_spec_memo[1] is not first_spec
-        assert m._train_cache is not first_cache
-
-    def test_multistep_and_refit_hit_cache(self):
-        # the flatten wrapper is memoized per (model, optimizer): a
-        # second fit must reuse the jitted program, and steps_per_run
-        # composes with the flat sweep
-        x, y = _toy_data(128)
+class TestFlatOptimizerRetired:
+    def test_flag_raises_with_pointer(self):
+        # the bucket-packed sweep was superseded by the fused Pallas
+        # kernels (ISSUE 9): the flag fails fast with a migration hint
+        # instead of silently training a different program
+        x, y = _toy_data(64)
         m = _toy_model()
-        m.fit(x, y, batch_size=32, nb_epoch=1, shuffle=False, seed=7,
-              flat_optimizer=True, steps_per_run=2)
-        cached = m._train_cache
-        m.fit(x, y, batch_size=32, nb_epoch=1, shuffle=False, seed=7,
-              flat_optimizer=True, steps_per_run=2)
-        assert m._train_cache is cached
-        h = m.fit(x, y, batch_size=32, nb_epoch=10, flat_optimizer=True)
-        assert h["loss"][-1] < h["loss"][0]
-
-    def test_flat_ignored_with_lazy_embeddings(self):
-        # lazy row-sparse updates need the per-table tree; the flag must
-        # not break that path (documented as ignored)
-        from analytics_zoo_tpu.keras import Sequential
-        from analytics_zoo_tpu.keras import layers as L
-        from analytics_zoo_tpu.learn.lazy_embedding import LazyEmbeddingSpec
-        import jax.numpy as jnp
-        rs = np.random.RandomState(0)
-        x = rs.randint(0, 50, (64, 4)).astype(np.float32)
-        y = rs.randn(64, 4, 8).astype(np.float32)
-        m = Sequential()
-        emb = L.Embedding(50, 8, input_shape=(4,))
-        m.add(emb)
-        m.compile(optimizer="adam", loss="mse")
-        # auto-numbered layer names are a global counter — read the real
-        # name rather than assuming this test ran first
-        m.lazy_embedding_specs = [LazyEmbeddingSpec(
-            (emb.name, "embeddings"),
-            lambda xb: jnp.reshape(jnp.asarray(xb, jnp.int32), (-1,)))]
-        h = m.fit(x, y, batch_size=32, nb_epoch=2, flat_optimizer=True,
-                  lazy_embeddings=True)
-        assert len(h["loss"]) == 2
+        with pytest.raises(ValueError, match="fused_optimizer"):
+            m.fit(x, y, batch_size=32, nb_epoch=1, flat_optimizer=True)
 
 
 class TestMixedPrecision:

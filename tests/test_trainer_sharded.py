@@ -188,7 +188,9 @@ class TestShardedFit:
     def test_incompatible_flags_raise(self, fsdp_ctx):
         m = _model()
         x, y = _data()
-        with pytest.raises(NotImplementedError, match="flat_optimizer"):
+        with pytest.raises(ValueError, match="fused_optimizer"):
+            # flat_optimizer is retired outright (ISSUE 9) — the raise
+            # fires before any sharding compatibility checks
             fit_keras(m, x, y, epochs=1, sharding_rules=True,
                       flat_optimizer=True, **KW)
         with pytest.raises(ValueError, match="distributed"):

@@ -205,46 +205,40 @@ class TestFitOnChip:
         assert np.isfinite(h["loss"]).all()
         assert h["loss"][-1] <= h["loss"][0] + 0.1  # training, not diverging
 
-    def test_flat_optimizer_fit_on_chip(self):
-        """fit(flat_optimizer=True) ON the chip: the bucketed parameter
-        packing exists precisely because TPU layout assignment rejected
-        the naive flat-vector design (a ~110M-param model compiled a
-        f32[N/2,2] reshape whose tiled layout padded 64x to 28 GB —
-        compile-time OOM the CPU suite can never see). Keep a mixed
-        bucket spectrum: stacked matmuls, singleton embedding, biases."""
-        import optax
-
+    def test_fused_optimizer_fit_on_chip(self):
+        """fit(fused_optimizer=True) ON the chip: the Pallas fused-Adam
+        sweep lowers through Mosaic (the CPU suite only ever exercises
+        the interpreter), updates in place via input_output_aliases,
+        and must reproduce the plain optax path's losses. Mixed bucket
+        spectrum on purpose: embedding (singleton big leaf), stacked
+        matmuls, sub-tile biases."""
         from analytics_zoo_tpu.common.context import (init_orca_context,
                                                       stop_orca_context)
         from analytics_zoo_tpu.keras import Sequential
         from analytics_zoo_tpu.keras import layers as L
         stop_orca_context()
         init_orca_context(cluster_mode="local")
+
+        def mk():
+            m = Sequential()
+            m.add(L.Embedding(300, 32, input_shape=(8,)))
+            m.add(L.Flatten())
+            m.add(L.Dense(64, activation="relu"))
+            m.add(L.Dense(64, activation="relu"))
+            m.add(L.Dense(2))
+            m.compile(optimizer="adamw",
+                      loss="sparse_categorical_crossentropy")
+            return m
+
         rs = np.random.RandomState(0)
-        m = Sequential()
-        m.add(L.Embedding(300, 32, input_shape=(8,)))
-        m.add(L.Flatten())
-        m.add(L.Dense(64, activation="relu"))
-        m.add(L.Dense(64, activation="relu"))
-        m.add(L.Dense(2))
-        m.compile(optimizer=optax.adamw(1e-3),
-                  loss="sparse_categorical_crossentropy")
         x = rs.randint(0, 300, (256, 8)).astype(np.float32)
         y = rs.randint(0, 2, 256).astype(np.int32)
-        h = m.fit(x, y, batch_size=64, nb_epoch=2, flat_optimizer=True,
-                  mixed_precision=True, steps_per_run=2)
+        h = mk().fit(x, y, batch_size=64, nb_epoch=2, fused_optimizer=True,
+                     mixed_precision=True, steps_per_run=2)
         assert np.isfinite(h["loss"]).all()
-        # numerics must match the per-tensor path on the same chip
-        m2 = Sequential()
-        m2.add(L.Embedding(300, 32, input_shape=(8,)))
-        m2.add(L.Flatten())
-        m2.add(L.Dense(64, activation="relu"))
-        m2.add(L.Dense(64, activation="relu"))
-        m2.add(L.Dense(2))
-        m2.compile(optimizer=optax.adamw(1e-3),
-                   loss="sparse_categorical_crossentropy")
-        h2 = m2.fit(x, y, batch_size=64, nb_epoch=2,
-                    mixed_precision=True, steps_per_run=2)
+        # numerics must match the plain optax path on the same chip
+        h2 = mk().fit(x, y, batch_size=64, nb_epoch=2,
+                      mixed_precision=True, steps_per_run=2)
         np.testing.assert_allclose(h["loss"], h2["loss"], rtol=2e-3)
 
 
