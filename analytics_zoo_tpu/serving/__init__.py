@@ -30,6 +30,9 @@ _EXPORTS = {
     "CircuitBreaker": "analytics_zoo_tpu.serving.breaker",
     "ResilientBroker": "analytics_zoo_tpu.serving.breaker",
     "ReplicaSupervisor": "analytics_zoo_tpu.serving.supervisor",
+    "FleetTracker": "analytics_zoo_tpu.serving.fleet",
+    "HeartbeatPublisher": "analytics_zoo_tpu.serving.fleet",
+    "engines_key": "analytics_zoo_tpu.serving.fleet",
 }
 
 __all__ = list(_EXPORTS)

@@ -220,6 +220,16 @@ class ResilientBroker(Broker):
     def ack(self, stream, group, ids):
         return self._guard("ack", stream, group, ids)
 
+    def claim_stale(self, stream, group, consumer, min_idle_ms, count):
+        return self._guard("claim_stale", stream, group, consumer,
+                           min_idle_ms, count)
+
+    def pending_count(self, stream, group):
+        return self._guard("pending_count", stream, group)
+
+    def writeback(self, key, mapping, stream, group, ids):
+        return self._guard("writeback", key, mapping, stream, group, ids)
+
     def hset(self, key, field, value):
         return self._guard("hset", key, field, value)
 
@@ -231,6 +241,9 @@ class ResilientBroker(Broker):
 
     def hgetall(self, key):
         return self._guard("hgetall", key)
+
+    def hlen(self, key):
+        return self._guard("hlen", key)
 
     def hdel(self, key, field):
         return self._guard("hdel", key, field)

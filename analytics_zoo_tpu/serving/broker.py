@@ -66,22 +66,67 @@ class Broker:
     def ack(self, stream: str, group: str, ids: List[str]) -> None:
         raise NotImplementedError
 
-    def hset(self, key: str, field: str, value: str) -> None:
+    def claim_stale(self, stream: str, group: str, consumer: str,
+                    min_idle_ms: int, count: int
+                    ) -> List[Tuple[str, Dict]]:
+        """Claim pending (delivered-but-unacked) entries that have sat
+        idle for at least `min_idle_ms` — a dead consumer's in-flight
+        work — and hand them to `consumer` (XAUTOCLAIM on Redis). The
+        fleet's claim sweep: a killed engine's batches redeliver to a
+        live peer instead of rotting in the pending list. Claimed
+        entries restart their idle clock, so concurrent sweepers from
+        several engines split the backlog rather than all claiming the
+        same records."""
         raise NotImplementedError
 
-    def hset_many(self, key: str, mapping: Dict[str, str]) -> None:
+    def pending_count(self, stream: str, group: str) -> int:
+        """Entries delivered to the group but not yet acked (XPENDING
+        summary count) — what a crashed consumer may still owe."""
+        raise NotImplementedError
+
+    def hset(self, key: str, field: str, value: str) -> int:
+        """Returns the number of NEW fields created (0 when `field`
+        already existed — Redis HSET semantics). The sink uses this to
+        keep redelivered records from double-counting as served."""
+        raise NotImplementedError
+
+    def hset_many(self, key: str, mapping: Dict[str, str]) -> int:
         """Batched result writeback: ONE round trip for a whole batch of
         (field, value) pairs (`HSET key f1 v1 f2 v2 ...` on Redis) instead
         of one per record — the pipelined sink stage's write path.
+        Returns the number of NEW fields created (overwrites of an
+        already-written result — a redelivered record — don't count).
         Default loops hset for brokers without a cheaper path."""
+        added = 0
         for field, value in mapping.items():
-            self.hset(key, field, value)
+            added += self.hset(key, field, value) or 0
+        return added
+
+    def writeback(self, key: str, mapping: Dict[str, str], stream: str,
+                  group: str, ids: List[str]) -> int:
+        """The sink's whole batch commit — result HSET + XACK/XDEL — as
+        ONE broker interaction (RESP-pipelined on Redis, a single lock
+        acquisition on MemoryBroker, one RPC on TCPBroker). The sink
+        pays one round-trip latency per batch instead of three; under a
+        loaded host (or a real network) those round trips are what cap
+        sink throughput. Returns the number of NEW result fields, like
+        `hset_many` (the idempotent-writeback dedup). Default chains
+        the two calls for brokers without a fused path."""
+        added = self.hset_many(key, mapping)
+        self.ack(stream, group, ids)
+        return added
 
     def hget(self, key: str, field: str) -> Optional[str]:
         raise NotImplementedError
 
     def hgetall(self, key: str) -> Dict[str, str]:
         raise NotImplementedError
+
+    def hlen(self, key: str) -> int:
+        """Field count (HLEN) — how result-drain progress is polled
+        without serializing the whole hash over the wire each check.
+        Default falls back to hgetall for brokers without a cheap path."""
+        return len(self.hgetall(key))
 
     def hdel(self, key: str, field: str) -> None:
         raise NotImplementedError
@@ -98,7 +143,11 @@ class MemoryBroker(Broker):
     def __init__(self, redeliver_after_s: float = 30.0):
         self._lock = threading.Condition()
         self._streams: Dict[str, OrderedDict] = {}
-        self._pending: Dict[Tuple[str, str], Dict[str, float]] = {}
+        # pending entry ledger (the PEL): rid -> (consumer, delivered_at)
+        # per (stream, group) — the consumer attribution is what lets a
+        # claim sweep take over a DEAD peer's entries specifically
+        self._pending: Dict[Tuple[str, str],
+                            Dict[str, Tuple[str, float]]] = {}
         self._hashes: Dict[str, Dict[str, str]] = {}
         self._seq = 0
         self.redeliver_after_s = redeliver_after_s
@@ -125,8 +174,9 @@ class MemoryBroker(Broker):
                     taken = pend.get(rid)
                     # undelivered, or delivered-but-unacked past the
                     # redelivery window (consumer died: at-least-once)
-                    if taken is None or now - taken > self.redeliver_after_s:
-                        pend[rid] = now
+                    if taken is None \
+                            or now - taken[1] > self.redeliver_after_s:
+                        pend[rid] = (consumer, now)
                         out.append((rid, rec))
                 if out or time.time() >= deadline:
                     return out
@@ -140,15 +190,58 @@ class MemoryBroker(Broker):
                 s.pop(rid, None)
                 pend.pop(rid, None)
 
+    def writeback(self, key, mapping, stream, group, ids):
+        with self._lock:   # one acquisition for write + ack
+            h = self._hashes.setdefault(key, {})
+            added = sum(1 for f in mapping if f not in h)
+            h.update(mapping)
+            s = self._streams.get(stream, OrderedDict())
+            pend = self._pending.get((stream, group), {})
+            for rid in ids:
+                s.pop(rid, None)
+                pend.pop(rid, None)
+            self._lock.notify_all()
+            return added
+
+    def claim_stale(self, stream, group, consumer, min_idle_ms, count):
+        with self._lock:
+            s = self._streams.get(stream, OrderedDict())
+            pend = self._pending.setdefault((stream, group), {})
+            now = time.time()
+            out = []
+            for rid, (_owner, delivered) in list(pend.items()):
+                if len(out) >= count:
+                    break
+                if (now - delivered) * 1000.0 < min_idle_ms:
+                    continue
+                rec = s.get(rid)
+                if rec is None:
+                    # acked-and-trimmed elsewhere: drop the stale PEL row
+                    pend.pop(rid, None)
+                    continue
+                pend[rid] = (consumer, now)   # idle clock restarts
+                out.append((rid, rec))
+            return out
+
+    def pending_count(self, stream, group):
+        with self._lock:
+            return len(self._pending.get((stream, group), {}))
+
     def hset(self, key, field, value):
         with self._lock:
-            self._hashes.setdefault(key, {})[field] = value
+            h = self._hashes.setdefault(key, {})
+            added = 0 if field in h else 1
+            h[field] = value
             self._lock.notify_all()
+            return added
 
     def hset_many(self, key, mapping):
         with self._lock:  # one lock acquisition for the whole batch
-            self._hashes.setdefault(key, {}).update(mapping)
+            h = self._hashes.setdefault(key, {})
+            added = sum(1 for f in mapping if f not in h)
+            h.update(mapping)
             self._lock.notify_all()
+            return added
 
     def hget(self, key, field):
         with self._lock:
@@ -157,6 +250,10 @@ class MemoryBroker(Broker):
     def hgetall(self, key):
         with self._lock:
             return dict(self._hashes.get(key, {}))
+
+    def hlen(self, key):
+        with self._lock:
+            return len(self._hashes.get(key, {}))
 
     def hdel(self, key, field):
         with self._lock:
@@ -245,7 +342,7 @@ class TCPBroker(Broker):
         if not resp.get("ok"):
             raise RuntimeError(f"broker error: {resp.get('error')}")
         result = resp["result"]
-        if op == "read_group" and result is not None:
+        if op in ("read_group", "claim_stale") and result is not None:
             result = [tuple(item) for item in result]
         return result
 
@@ -259,6 +356,13 @@ class TCPBroker(Broker):
     def ack(self, stream, group, ids):
         return self._call("ack", stream, group, ids)
 
+    def claim_stale(self, stream, group, consumer, min_idle_ms, count):
+        return self._call("claim_stale", stream, group, consumer,
+                          min_idle_ms, count)
+
+    def pending_count(self, stream, group):
+        return self._call("pending_count", stream, group)
+
     def hset(self, key, field, value):
         return self._call("hset", key, field, value)
 
@@ -266,11 +370,18 @@ class TCPBroker(Broker):
         # one RPC round trip for the whole batch
         return self._call("hset_many", key, mapping)
 
+    def writeback(self, key, mapping, stream, group, ids):
+        # fused write + ack: one RPC instead of two
+        return self._call("writeback", key, mapping, stream, group, ids)
+
     def hget(self, key, field):
         return self._call("hget", key, field)
 
     def hgetall(self, key):
         return self._call("hgetall", key)
+
+    def hlen(self, key):
+        return self._call("hlen", key)
 
     def hdel(self, key, field):
         return self._call("hdel", key, field)
@@ -357,6 +468,43 @@ class _RESPClient:
                     except OSError:
                         pass
 
+    def pipeline(self, *cmds):
+        """Send several commands in ONE write and read all replies —
+        RESP pipelining. One network round trip (and, against a loaded
+        server host, one scheduling wakeup) instead of len(cmds). Every
+        reply is read even when an earlier one is an error, keeping the
+        connection synchronized; the first error then raises."""
+        out = []
+        for args in cmds:
+            out.append(b"*%d\r\n" % len(args))
+            for a in args:
+                data = a if isinstance(a, bytes) else str(a).encode()
+                out.append(b"$%d\r\n%s\r\n" % (len(data), data))
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            try:
+                self._sock.sendall(b"".join(out))
+                replies, err = [], None
+                for _ in cmds:
+                    try:
+                        replies.append(self._read_reply())
+                    except RESPError as e:
+                        replies.append(e)
+                        err = err or e
+                if err is not None:
+                    raise err
+                return replies
+            except socket.timeout:
+                self._close_locked()
+                raise ConnectionError(
+                    "redis pipeline timed out; connection closed to "
+                    "avoid reply desynchronization (next command "
+                    "reconnects)")
+            except (ConnectionError, OSError):
+                self._close_locked()
+                raise
+
     def _read_line(self) -> bytes:
         line = self._buf.readline()
         if not line.endswith(b"\r\n"):
@@ -442,17 +590,67 @@ class RedisBroker(Broker):
             self._r.command("XACK", stream, group, *ids)
             self._r.command("XDEL", stream, *ids)
 
+    def claim_stale(self, stream, group, consumer, min_idle_ms, count):
+        """XAUTOCLAIM (Redis >= 6.2): atomically scan the group's PEL
+        and claim entries idle past `min_idle_ms` for this consumer.
+        Reply is [next-cursor, entries] (7.0 appends a deleted-ids
+        array; ignored). Entries whose record was trimmed come back
+        nil and are skipped."""
+        self._ensure_group(stream, group)
+        resp = self._r.command(
+            "XAUTOCLAIM", stream, group, consumer, int(min_idle_ms),
+            "0-0", "COUNT", count)
+        entries = resp[1] if isinstance(resp, list) and len(resp) > 1 \
+            else []
+        out = []
+        for item in entries or []:
+            if not item:
+                continue
+            rid, fields = item
+            kv = dict(zip(fields[::2], fields[1::2]))
+            if "json" in kv:
+                out.append((rid, json.loads(kv["json"])))
+        return out
+
+    def pending_count(self, stream, group):
+        self._ensure_group(stream, group)
+        # XPENDING summary form: [count, min-id, max-id, consumers]
+        resp = self._r.command("XPENDING", stream, group)
+        return int(resp[0]) if isinstance(resp, list) and resp else 0
+
     def hset(self, key, field, value):
-        self._r.command("HSET", key, field, value)
+        return self._r.command("HSET", key, field, value)
 
     def hset_many(self, key, mapping):
         if not mapping:
-            return
-        # variadic HSET (Redis >= 4): one command, one round trip
+            return 0
+        # variadic HSET (Redis >= 4): one command, one round trip;
+        # the integer reply counts NEW fields (overwrites excluded)
         flat = []
         for field, value in mapping.items():
             flat.extend((field, value))
-        self._r.command("HSET", key, *flat)
+        return self._r.command("HSET", key, *flat)
+
+    def writeback(self, key, mapping, stream, group, ids):
+        # ONE pipelined round trip commits the whole batch: HSET the
+        # results, XACK + XDEL the stream entries. The sink's commit
+        # latency drops from 3 RTTs to 1 — on a busy host each RTT also
+        # costs a server-thread scheduling wakeup, which is what caps a
+        # fleet's per-engine sink throughput
+        cmds = []
+        if mapping:
+            flat = []
+            for field, value in mapping.items():
+                flat.extend((field, value))
+            cmds.append(("HSET", key, *flat))
+        if ids:
+            self._ensure_group(stream, group)
+            cmds.append(("XACK", stream, group, *ids))
+            cmds.append(("XDEL", stream, *ids))
+        if not cmds:
+            return 0
+        replies = self._r.pipeline(*cmds)
+        return int(replies[0]) if mapping else 0
 
     def hget(self, key, field):
         return self._r.command("HGET", key, field)
@@ -460,6 +658,9 @@ class RedisBroker(Broker):
     def hgetall(self, key):
         flat = self._r.command("HGETALL", key) or []
         return dict(zip(flat[::2], flat[1::2]))
+
+    def hlen(self, key):
+        return int(self._r.command("HLEN", key) or 0)
 
     def hdel(self, key, field):
         self._r.command("HDEL", key, field)

@@ -36,6 +36,12 @@ def cmd_start(args) -> int:
                              placement=getattr(args, "placement", None),
                              compile_cache_dir=getattr(
                                  args, "compile_cache_dir", None))
+    if getattr(args, "engine_id", None):
+        # fleet override (ISSUE 10): each process in a scale-out gets
+        # its own identity at launch ("auto" generates one)
+        cfg.engine_id = args.engine_id
+        cfg._validate_fleet()
+    engine_id = cfg.resolve_engine_id()
     if cfg.model_encrypted and cfg.http_port is None:
         raise SystemExit(
             "secure.model_encrypted needs http_port: the secret/salt "
@@ -53,7 +59,11 @@ def cmd_start(args) -> int:
             tls_keyfile=cfg.tls_keyfile,
             profile_dir=cfg.profile_dir,
             profile_max_artifacts=cfg.profile_max_artifacts,
-            profile_enabled=cfg.profile_enabled).start()
+            profile_enabled=cfg.profile_enabled,
+            # fleet mode: the frontend doubles as the fleet gateway
+            # (engine heartbeats -> /healthz + serving_engines_* gauges)
+            fleet_stream=cfg.stream if engine_id else None,
+            engine_ttl_s=cfg.engine_ttl_s).start()
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     model = cfg.build_model(broker=broker)
@@ -106,7 +116,15 @@ def cmd_start(args) -> int:
                              breaker_reset_s=cfg.breaker_reset_s,
                              sink_buffer_batches=cfg
                              .sink_buffer_batches,
-                             slo=cfg.build_slo()).start()
+                             slo=cfg.build_slo(),
+                             engine_id=engine_id,
+                             claim_min_idle_s=cfg.claim_min_idle_s,
+                             claim_interval_s=cfg.claim_interval_s,
+                             heartbeat_interval_s=cfg
+                             .heartbeat_interval_s).start()
+    if engine_id:
+        print(f"engine id {engine_id} (fleet member; claim window "
+              f"{cfg.claim_min_idle_s:g}s)", flush=True)
     if frontend is not None:
         frontend._srv.serving = serving
     if serving.slo is not None:
@@ -142,6 +160,29 @@ def _run_until_signal(stop_fn) -> int:
         time.sleep(0.5)
     stop_fn()
     return 0
+
+
+def cmd_gateway(args) -> int:
+    """Engine-less fleet gateway (ISSUE 10): an HTTP frontend that
+    tracks engine heartbeats on the broker and answers `/healthz` /
+    `/metrics` for the whole fleet — run it on the edge while N
+    `start --engine-id auto` engine processes drain the stream."""
+    from analytics_zoo_tpu.serving.broker import connect_broker
+    from analytics_zoo_tpu.serving.http_frontend import FrontEnd
+    if args.engine_ttl <= 0:
+        # same contract as the params path (_validate_fleet): a zero
+        # TTL flaps every beating engine dead — fail at launch
+        raise SystemExit(
+            f"--engine-ttl {args.engine_ttl:g} must be > 0")
+    frontend = FrontEnd(
+        connect_broker(args.broker), None, host=args.host,
+        port=args.port, fleet_stream=args.stream,
+        engine_ttl_s=args.engine_ttl,
+        tokens_per_second=args.tokens_per_second).start()
+    print(f"fleet gateway on :{frontend.port} "
+          f"(stream {args.stream}, engine ttl {args.engine_ttl:g}s)",
+          flush=True)
+    return _run_until_signal(frontend.stop)
 
 
 def cmd_broker(args) -> int:
@@ -193,7 +234,24 @@ def main(argv=None) -> int:
                     help="override params.compile_cache_dir: persistent "
                          "AOT executable cache directory (warm restarts "
                          "skip XLA compilation)")
+    ps.add_argument("--engine-id", default=None,
+                    help="fleet mode: this engine's identity as one of "
+                         "N co-consumers ('auto' generates a unique id; "
+                         "enables heartbeats + the claim sweep)")
     ps.set_defaults(fn=cmd_start)
+    pg = sub.add_parser("gateway", help="run an engine-less fleet "
+                                        "gateway frontend")
+    pg.add_argument("--broker", default="memory",
+                    help="broker url the fleet shares "
+                         "(tcp://h:p | redis://h:p)")
+    pg.add_argument("--host", default="0.0.0.0")
+    pg.add_argument("--port", type=int, default=10020)
+    pg.add_argument("--stream", default="serving_stream")
+    pg.add_argument("--engine-ttl", type=float, default=6.0,
+                    help="seconds without a heartbeat before an engine "
+                         "counts dead")
+    pg.add_argument("--tokens-per-second", type=float, default=None)
+    pg.set_defaults(fn=cmd_gateway)
     pb = sub.add_parser("broker", help="run a standalone TCP broker")
     pb.add_argument("--host", default="0.0.0.0")
     pb.add_argument("--port", type=int, default=6379)

@@ -96,6 +96,18 @@ class ServingConfig:
     breaker_failure_threshold: int = 3
     breaker_reset_s: float = 1.0
     sink_buffer_batches: int = 256
+    # fleet mode (ISSUE 10, docs/ProgrammingGuide/cluster-serving.md
+    # "Scaling out"): engine_id names this process as one of N
+    # co-consumers ("auto" generates a unique id); heartbeats publish
+    # to engines:<stream> every heartbeat_interval_s and the gateway
+    # counts an engine dead after engine_ttl_s without one; the claim
+    # sweep adopts a dead peer's unacked records once they sit idle
+    # claim_min_idle_s, checking every claim_interval_s
+    engine_id: Optional[str] = None
+    heartbeat_interval_s: float = 2.0
+    engine_ttl_s: float = 6.0
+    claim_min_idle_s: float = 30.0
+    claim_interval_s: float = 5.0
     # shape-bucket pre-warming: list of per-record shapes, e.g.
     # [[32, 32, 3]] (or the string "32x32x3,224x224x3" in bare-parser
     # YAML) — every bucket of each shape pre-compiles at load so no XLA
@@ -198,6 +210,15 @@ class ServingConfig:
         cfg.sink_buffer_batches = int(
             params.get("sink_buffer_batches", 256))
         cfg._validate_fault_tolerance()
+        engine_id = params.get("engine_id")
+        if engine_id is not None:
+            cfg.engine_id = str(engine_id)
+        cfg.heartbeat_interval_s = float(
+            params.get("heartbeat_interval_s", 2.0))
+        cfg.engine_ttl_s = float(params.get("engine_ttl_s", 6.0))
+        cfg.claim_min_idle_s = float(params.get("claim_min_idle_s", 30.0))
+        cfg.claim_interval_s = float(params.get("claim_interval_s", 5.0))
+        cfg._validate_fleet()
         cfg.warmup_shapes = _parse_warmup_shapes(
             params.get("warmup_shapes"))
         cfg.warmup_dtype = str(params.get("warmup_dtype", "float32"))
@@ -298,6 +319,38 @@ class ServingConfig:
             if value <= 0:
                 raise ValueError(
                     f"params.{name}={value} must be > 0")
+
+    def _validate_fleet(self):
+        """Fleet knobs fail at config load like the rest: a zero TTL or
+        a claim window shorter than the heartbeat cadence is an
+        operator error, not a runtime surprise."""
+        for name, value in (
+                ("heartbeat_interval_s", self.heartbeat_interval_s),
+                ("engine_ttl_s", self.engine_ttl_s),
+                ("claim_min_idle_s", self.claim_min_idle_s),
+                ("claim_interval_s", self.claim_interval_s)):
+            if value <= 0:
+                raise ValueError(f"params.{name}={value} must be > 0")
+        if self.engine_ttl_s <= self.heartbeat_interval_s:
+            raise ValueError(
+                f"params.engine_ttl_s={self.engine_ttl_s} must exceed "
+                f"heartbeat_interval_s={self.heartbeat_interval_s}: one "
+                "delayed beat would flap every engine dead")
+        if self.engine_id is not None and not str(self.engine_id).strip():
+            raise ValueError("params.engine_id must be a non-empty "
+                             "string, 'auto', or unset")
+
+    def resolve_engine_id(self) -> Optional[str]:
+        """The engine id `cmd_start` hands to ClusterServing: None when
+        fleet mode is off, a unique generated id for 'auto', the
+        configured string otherwise."""
+        if self.engine_id is None:
+            return None
+        if str(self.engine_id).lower() == "auto":
+            import os
+            import uuid
+            return f"engine-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        return str(self.engine_id)
 
     def _validate_compile_cache(self):
         """Cache-setting errors belong at config load, like placement:

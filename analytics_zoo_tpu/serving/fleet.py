@@ -1,0 +1,305 @@
+"""Fleet membership over the broker — heartbeats out, tracking in.
+
+Horizontal scale-out (ISSUE 10) runs N `ClusterServing` engine processes
+as co-consumers of one stream. The broker that already carries the data
+plane carries the control plane too: each engine HSETs a heartbeat row
+into `engines:<stream>` every `interval_s`, and the HTTP frontend — now
+a fleet gateway — reads that hash to answer `/healthz` for the whole
+fleet (200 while >= 1 engine is alive and ready, 503 + Retry-After when
+none are) and to export `serving_engines_alive` / `serving_engines_total`.
+
+No extra infrastructure: the reference platform leaned on Flink's
+jobmanager for this; here the same Redis that queues records is the
+membership registry, so a gateway and a fleet agree on liveness through
+the one component they both already depend on.
+
+Heartbeat row (JSON):
+
+    {"engine_id": ..., "ts": <epoch seconds>, "ready": bool,
+     "records_served": n, "records_read": n, "pid": n}
+
+Liveness = the row's `ts` was observed to CHANGE within the last
+`ttl_s` on the gateway's own monotonic clock — heartbeat PROGRESS, not
+wall-clock arithmetic, so cross-host clock skew between engines and
+the gateway can neither kill a healthy fleet nor keep a dead engine
+alive. The cost of clock independence: right after a gateway (re)start
+a crashed engine's leftover row reads as fresh for at most one TTL,
+then ages out like any silent engine — self-correcting, and far
+cheaper than 503ing a healthy skewed fleet. A cleanly stopping engine
+deletes its row (HDEL) so the gateway notices immediately; a SIGKILLed
+engine simply stops refreshing, ages out within the TTL — the same
+window after which its unacked records become claimable by live peers
+— and its dead row is purged from the hash once it sits 10x past the
+TTL, so crash/restart churn under `engine_id: auto` cannot grow the
+registry without bound.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from analytics_zoo_tpu.serving.broker import Broker
+
+log = logging.getLogger("analytics_zoo_tpu.serving.fleet")
+
+ENGINES_KEY_PREFIX = "engines:"
+
+
+def engines_key(stream: str) -> str:
+    """The broker hash that holds one heartbeat row per engine."""
+    return ENGINES_KEY_PREFIX + stream
+
+
+class HeartbeatPublisher:
+    """Periodic heartbeat HSET from one engine, on its own thread and
+    its own broker connection (the reader blocks in XREADGROUP windows
+    and the sink may be mid-writeback; a heartbeat must never queue
+    behind either). Publish failures are survived and logged once per
+    outage — a broker blip must not kill the engine's membership, the
+    next beat re-registers it."""
+
+    def __init__(self, broker: Broker, stream: str, engine_id: str,
+                 payload_fn: Callable[[], Dict], interval_s: float = 2.0,
+                 registry=None):
+        self.broker = broker
+        self.key = engines_key(stream)
+        self.engine_id = engine_id
+        self.payload_fn = payload_fn
+        self.interval_s = max(0.05, float(interval_s))
+        if registry is None:
+            from analytics_zoo_tpu.observability.registry import get_registry
+            registry = get_registry()
+        self._beats = registry.counter(
+            "serving_engine_heartbeats_total",
+            "fleet heartbeats successfully published to the broker, "
+            "by engine")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._down = False
+
+    def _publish_once(self) -> bool:
+        payload = {"engine_id": self.engine_id, "ts": time.time(),
+                   "pid": os.getpid()}
+        try:
+            payload.update(self.payload_fn() or {})
+        except Exception as e:  # noqa: BLE001 — a beat must still go out
+            payload["ready"] = False
+            payload["error"] = f"{type(e).__name__}: {e}"
+        try:
+            self.broker.hset(self.key, self.engine_id,
+                             json.dumps(payload))
+        except Exception as e:  # noqa: BLE001 — outage: next beat retries
+            if not self._down:
+                log.warning("heartbeat publish failed for %s (%s: %s); "
+                            "retrying each interval", self.engine_id,
+                            type(e).__name__, e)
+                self._down = True
+            return False
+        if self._down:
+            log.info("heartbeat publishing recovered for %s",
+                     self.engine_id)
+            self._down = False
+        self._beats.inc(engine=self.engine_id)
+        return True
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._publish_once()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HeartbeatPublisher":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serving-heartbeat-{self.engine_id}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, deregister: bool = True):
+        """Stop beating; with `deregister` (clean shutdown) the row is
+        deleted so the gateway drops this engine immediately instead of
+        waiting out the TTL."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if deregister:
+            try:
+                self.broker.hdel(self.key, self.engine_id)
+            except Exception:  # noqa: BLE001 — best-effort deregistration
+                pass
+
+
+class FleetTracker:
+    """The gateway's view of the fleet: polls `engines:<stream>` (rate-
+    limited — /healthz and /metrics scrapes share one poll per
+    `poll_min_interval_s`), classifies rows by heartbeat age, and
+    exports `serving_engines_alive` (gauge, live) plus
+    `serving_engines_total` (counter: distinct engines ever seen by
+    this gateway). `alive_count()` answers None when the broker itself
+    is unreachable — the gateway then has no claim about fleet health
+    and `/healthz` must say so (503), not guess."""
+
+    def __init__(self, broker: Broker, stream: str = "serving_stream",
+                 ttl_s: float = 6.0, registry=None,
+                 poll_min_interval_s: float = 0.25):
+        self.broker = broker
+        self.stream = stream
+        self.key = engines_key(stream)
+        self.ttl_s = float(ttl_s)
+        self.poll_min_interval_s = max(0.0, float(poll_min_interval_s))
+        if registry is None:
+            from analytics_zoo_tpu.observability.registry import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._last_poll = 0.0
+        self._engines: Dict[str, Dict] = {}
+        # eid -> (last ts VALUE seen, local monotonic when it changed):
+        # liveness is judged by locally-observed heartbeat progress, so
+        # cross-host wall-clock skew between an engine and the gateway
+        # can neither kill a healthy fleet nor keep a dead engine alive
+        self._last_change: Dict[str, tuple] = {}
+        self._broker_ok = True
+        self._polling = False      # single-flight guard for broker I/O
+        self._seen = set()
+        self._total = registry.counter(
+            "serving_engines_total",
+            "distinct serving engines that have registered a heartbeat "
+            "with this gateway")
+        self._alive_gauge = registry.gauge(
+            "serving_engines_alive",
+            "serving engines with a fresh heartbeat (live fleet size)")
+        self._alive_fn = self._alive_metric
+        self._alive_gauge.set_function(self._alive_fn)
+
+    # -- polling -----------------------------------------------------------
+    def poll(self, force: bool = False) -> Optional[Dict[str, Dict]]:
+        """Refresh (rate-limited) and return the engine table
+        {engine_id: row} with an `alive` bool per row; None when the
+        broker is unreachable.
+
+        Broker I/O happens OUTSIDE the tracker lock, single-flight: one
+        thread fetches while every concurrent /predict admission check,
+        /healthz, and /metrics gauge read answers instantly from cached
+        state — a stalled broker costs ONE thread a socket timeout, it
+        must not dam the whole gateway behind a lock (the gateway's job
+        at that moment is the fast 503)."""
+        with self._lock:
+            now = time.monotonic()
+            due = force or now - self._last_poll >= self.poll_min_interval_s
+            if due and not self._polling:
+                self._polling = True
+                self._last_poll = now
+            else:
+                return None if not self._broker_ok \
+                    else dict(self._engines)
+        try:
+            raw = self.broker.hgetall(self.key)
+        except Exception as e:  # noqa: BLE001 — report unknown
+            with self._lock:
+                if self._broker_ok:
+                    log.warning(
+                        "fleet poll failed (%s: %s); fleet state "
+                        "unknown until the broker answers",
+                        type(e).__name__, e)
+                self._broker_ok = False
+                self._polling = False
+            return None
+        purge = []
+        with self._lock:
+            self._broker_ok = True
+            self._polling = False
+            now = time.monotonic()
+            engines: Dict[str, Dict] = {}
+            wall = time.time()
+            for eid, blob in raw.items():
+                try:
+                    row = json.loads(blob)
+                except (TypeError, ValueError):
+                    row = {}
+                ts = row.get("ts", 0.0)
+                prev = self._last_change.get(eid)
+                if prev is None or prev[0] != ts:
+                    self._last_change[eid] = (ts, now)
+                    age = 0.0
+                else:
+                    age = now - prev[1]
+                row["age_s"] = round(age, 3)
+                # wall-clock age is informational only — liveness
+                # must not depend on two hosts' clocks agreeing
+                wall_age = wall - ts
+                row["wall_age_s"] = round(wall_age, 3) \
+                    if math.isfinite(wall_age) else None
+                row["alive"] = bool(age <= self.ttl_s)
+                if age > 10 * self.ttl_s:
+                    # bound the hash: under crash/restart churn with
+                    # engine_id=auto every crash strands a row forever,
+                    # growing every later poll and /metrics payload
+                    purge.append(eid)
+                    self._last_change.pop(eid, None)
+                    continue
+                engines[eid] = row
+                if eid not in self._seen:
+                    self._seen.add(eid)
+                    self._total.inc()
+            # rows HDEL'd elsewhere (clean stops) leave the ledger
+            for eid in list(self._last_change):
+                if eid not in raw:
+                    self._last_change.pop(eid, None)
+            self._engines = engines
+            out = dict(engines)
+        for eid in purge:       # broker I/O outside the lock, as above
+            try:
+                self.broker.hdel(self.key, eid)
+            except Exception:  # noqa: BLE001 — next poll retries
+                pass
+        if purge:
+            log.info("purged %d dead engine heartbeat row(s): %s",
+                     len(purge), sorted(purge)[:8])
+        return out
+
+    def alive_count(self) -> Optional[int]:
+        """Engines alive AND ready (an engine beating with ready=False —
+        every replica quarantined, breaker open — is present but not
+        servable capacity); None when the broker is unreachable."""
+        engines = self.poll()
+        if engines is None:
+            return None
+        return sum(1 for row in engines.values()
+                   if row.get("alive") and row.get("ready", True))
+
+    def _alive_metric(self) -> float:
+        n = self.alive_count()
+        return float("nan") if n is None else float(n)
+
+    @property
+    def retry_after_s(self) -> int:
+        """What a fleet-wide 503 tells clients: a replacement engine
+        shows up within one heartbeat TTL."""
+        return max(1, int(round(self.ttl_s)))
+
+    def summary(self) -> Dict:
+        """The /metrics JSON section."""
+        engines = self.poll()
+        if engines is None:
+            return {"broker": "unreachable", "alive": None,
+                    "engines_seen": len(self._seen)}
+        return {
+            "alive": sum(1 for r in engines.values() if r.get("alive")),
+            "ready": sum(1 for r in engines.values()
+                         if r.get("alive") and r.get("ready", True)),
+            "engines_seen": len(self._seen),
+            "engines": engines,
+        }
+
+    def close(self):
+        """Release the gauge closure so a stopped gateway does not pin
+        this tracker (and its broker connection) in the process-wide
+        registry."""
+        self._alive_gauge.release_function(self._alive_fn, freeze=True)

@@ -184,21 +184,47 @@ class _Handler(BaseHTTPRequestHandler):
         timers = {"frontend": self.server.request_timer.snapshot()}
         if serving is not None:
             timers.update(serving.metrics())
+        if self.server.fleet is not None:
+            # gateway view (ISSUE 10): per-engine heartbeat rows plus
+            # the alive/ready counts the `serving_engines_*` families
+            # export to Prometheus
+            timers["fleet"] = self.server.fleet.summary()
         timers["registry"] = registry.snapshot()
         self._send(200, timers)
 
     def _healthz(self):
-        """Readiness probe (ISSUE 6): aggregates the engine's
-        supervisor/quarantine state, breaker state, and SLO status via
+        """Readiness probe (ISSUE 6/10): with a LOCAL engine attached,
+        aggregates its supervisor/quarantine/breaker/SLO state via
         `ClusterServing.health()` — 200 while the engine can accept
         traffic, 503 (with Retry-After on a quarantined pool) when it
-        cannot. A frontend with no engine attached answers 200 with
-        `engine: null` — it is alive as a gateway; readiness of an
-        engine it doesn't have is not its claim to make."""
+        cannot. With FLEET tracking configured (the gateway role), the
+        claim is about the fleet: 200 while >= 1 engine heartbeats
+        alive+ready, 503 + Retry-After when none do — or when the
+        broker itself is unreachable, since then the gateway can
+        neither know the fleet nor move a record. Only a truly
+        standalone frontend (no engine, no fleet) keeps the legacy
+        unconditional 200 with `engine: null` — it is alive as a
+        gateway; readiness of engines it doesn't track is not its
+        claim to make."""
         serving = self.server.serving
+        fleet = self.server.fleet
         health_fn = getattr(serving, "health", None) if serving else None
         if not callable(health_fn):
-            self._send(200, {"ready": True, "engine": None})
+            if fleet is None:
+                self._send(200, {"ready": True, "engine": None})
+                return
+            summary = fleet.summary()
+            ready = summary.get("ready")
+            payload = {"ready": bool(ready), "engine": None,
+                       "fleet": summary}
+            if ready:
+                self._send(200, payload)
+                return
+            payload["reason"] = "broker unreachable" \
+                if summary.get("broker") == "unreachable" \
+                else "no serving engine alive"
+            self._send(503, payload, extra_headers={
+                "Retry-After": str(fleet.retry_after_s)})
             return
         try:
             h = health_fn()
@@ -206,6 +232,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(503, {"ready": False,
                              "reason": f"{type(e).__name__}: {e}"})
             return
+        if fleet is not None:
+            h["fleet"] = fleet.summary()
         if h.get("ready"):
             self._send(200, h)
         else:
@@ -296,6 +324,16 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(503, {"error": "every model replica is "
                                           "quarantined; retry shortly"},
                            extra_headers={"Retry-After": str(retry_s)})
+                return
+        elif self.server.fleet is not None:
+            # gateway role (ISSUE 10): with zero engines alive the
+            # record would sit in the stream until its client timeout —
+            # refuse admission up front, like the quarantined-pool 503
+            if not self.server.fleet.alive_count():
+                self._send(503, {"error": "no serving engine alive; "
+                                          "retry shortly"},
+                           extra_headers={"Retry-After": str(
+                               self.server.fleet.retry_after_s)})
                 return
         with self.server.request_timer.timing():
             try:
@@ -397,7 +435,17 @@ class FrontEnd:
                  registry: Optional[MetricsRegistry] = None,
                  profile_dir: Optional[str] = None,
                  profile_max_artifacts: int = 8,
-                 profile_enabled: bool = True):
+                 profile_enabled: bool = True,
+                 fleet_stream: Optional[str] = None,
+                 engine_ttl_s: float = 6.0):
+        """`fleet_stream` (ISSUE 10) turns the frontend into a fleet
+        gateway: a `FleetTracker` watches engine heartbeats on
+        `engines:<fleet_stream>`, `/healthz` answers for the FLEET
+        (200 while >= 1 engine is alive+ready, 503 + Retry-After when
+        none are), and `serving_engines_alive`/`serving_engines_total`
+        appear on `/metrics`. An engine is alive while its heartbeat
+        keeps progressing within `engine_ttl_s` (observed on this
+        host's clock — cross-host skew can't flap the fleet)."""
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self._srv = _FrontEndServer((host, port), _Handler)
@@ -431,6 +479,16 @@ class FrontEnd:
             self._srv.profile_capture = ProfileCapture(
                 root, max_artifacts=profile_max_artifacts,
                 registry=self.registry)
+        # fleet tracking (gateway role): reads heartbeats over the same
+        # broker the data plane uses — one shared dependency, no second
+        # membership service
+        self.fleet = None
+        if fleet_stream:
+            from analytics_zoo_tpu.serving.fleet import FleetTracker
+            self.fleet = FleetTracker(self.broker, fleet_stream,
+                                      ttl_s=engine_ttl_s,
+                                      registry=self.registry)
+        self._srv.fleet = self.fleet
         self._srv.timeout_s = timeout_s
         self._srv.rate_limiter = (
             TokenBucket(tokens_per_second, token_bucket_capacity)
@@ -452,3 +510,5 @@ class FrontEnd:
     def stop(self):
         self._srv.shutdown()
         self._srv.server_close()
+        if self.fleet is not None:
+            self.fleet.close()

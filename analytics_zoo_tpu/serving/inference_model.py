@@ -185,7 +185,9 @@ class _RoutedPending:
     def result(self):
         with self._lock:
             if not self._done:
-                self._event.wait()
+                # the worker sets the event on every exit path
+                # (_fulfill/_fail), and abandon() sets it too
+                self._event.wait()  # blocking-ok: always signalled
                 try:
                     if self._exc is None:
                         t0 = time.perf_counter()

@@ -37,7 +37,9 @@ NIL_ARRAY = object()
 
 class MiniRedisStore:
     """In-memory streams + hashes with consumer-group semantics: per-group
-    last-delivered cursor and pending-entries list (PEL)."""
+    last-delivered cursor and pending-entries list (PEL). The PEL keeps
+    per-entry consumer attribution and delivery time — what XAUTOCLAIM
+    (the fleet's stale-pending claim sweep) and XPENDING read."""
 
     def __init__(self):
         self.streams: Dict[str, List[Tuple[str, List[str]]]] = {}
@@ -81,23 +83,27 @@ class MiniRedisStore:
             self.streams[stream] = []
         if (stream, group) in self.groups:
             raise RESPError("BUSYGROUP Consumer Group name already exists")
-        self.groups[(stream, group)] = {"cursor": 0, "pel": set()}
+        # pel: rid -> [consumer, delivered_at_monotonic]
+        self.groups[(stream, group)] = {"cursor": 0, "pel": {}}
         return Simple("OK")
 
-    def _pop_new(self, stream: str, group: str, count: int):
+    def _pop_new(self, stream: str, group: str, consumer: str,
+                 count: int):
         g = self.groups.get((stream, group))
         if g is None:
             raise RESPError("NOGROUP No such consumer group")
         entries = self.streams.get(stream, [])
         new = entries[g["cursor"]:g["cursor"] + count]
         g["cursor"] += len(new)
-        g["pel"].update(rid for rid, _ in new)
+        now = time.monotonic()
+        for rid, _ in new:
+            g["pel"][rid] = [consumer, now]
         return new
 
     def cmd_xreadgroup(self, a):
         if a[0].upper() != "GROUP":
             raise RESPError("ERR XREADGROUP must start with GROUP")
-        group = a[1]
+        group, consumer = a[1], a[2]
         opts = [str(x).upper() for x in a[3:]]
         count = int(a[3 + opts.index("COUNT") + 1]) \
             if "COUNT" in opts else 10
@@ -113,7 +119,7 @@ class MiniRedisStore:
             None if block_ms == 0 else time.monotonic() + block_ms / 1e3)
         with self.lock:
             while True:
-                new = self._pop_new(stream, group, count)
+                new = self._pop_new(stream, group, consumer, count)
                 if new:
                     return [[stream,
                              [[rid, fields] for rid, fields in new]]]
@@ -131,10 +137,60 @@ class MiniRedisStore:
         g = self.groups.get((stream, group))
         n = 0
         for rid in ids:
-            if g and rid in g["pel"]:
-                g["pel"].discard(rid)
+            if g and g["pel"].pop(rid, None) is not None:
                 n += 1
         return n
+
+    def cmd_xautoclaim(self, a):
+        """XAUTOCLAIM stream group consumer min-idle-time start [COUNT n]:
+        claim PEL entries idle >= min-idle-time for `consumer`, restarting
+        their idle clock. Reply is the Redis 6.2 shape: [next-cursor,
+        [[rid, fields], ...]] — 7.0's third (deleted-ids) element is
+        omitted; the broker client ignores it either way. PEL rows whose
+        record was XDEL'd are dropped, as real Redis does."""
+        if len(a) < 5:
+            raise RESPError(
+                "ERR wrong number of arguments for 'xautoclaim' command")
+        stream, group, consumer = a[0], a[1], a[2]
+        min_idle_ms = int(a[3])
+        opts = [str(x).upper() for x in a[5:]]
+        count = int(a[5 + opts.index("COUNT") + 1]) \
+            if "COUNT" in opts else 100
+        g = self.groups.get((stream, group))
+        if g is None:
+            raise RESPError("NOGROUP No such consumer group")
+        by_id = dict(self.streams.get(stream, []))
+        now = time.monotonic()
+        claimed = []
+        for rid, owner in list(g["pel"].items()):
+            if len(claimed) >= count:
+                break
+            if (now - owner[1]) * 1000.0 < min_idle_ms:
+                continue
+            fields = by_id.get(rid)
+            if fields is None:
+                g["pel"].pop(rid, None)
+                continue
+            g["pel"][rid] = [consumer, now]
+            claimed.append([rid, list(fields)])
+        return ["0-0", claimed]
+
+    def cmd_xpending(self, a):
+        """Summary form only: [count, min-id, max-id,
+        [[consumer, count-str], ...]]."""
+        stream, group = a[0], a[1]
+        g = self.groups.get((stream, group))
+        if g is None:
+            raise RESPError("NOGROUP No such consumer group")
+        pel = g["pel"]
+        if not pel:
+            return [0, None, None, NIL_ARRAY]
+        ids = sorted(pel, key=lambda r: tuple(map(int, r.split("-"))))
+        per_consumer: Dict[str, int] = {}
+        for owner, _ts in pel.values():
+            per_consumer[owner] = per_consumer.get(owner, 0) + 1
+        return [len(pel), ids[0], ids[-1],
+                [[c, str(n)] for c, n in sorted(per_consumer.items())]]
 
     def cmd_xdel(self, a):
         stream, ids = a[0], set(a[1:])
@@ -171,6 +227,9 @@ class MiniRedisStore:
         for k, v in self.hashes.get(a[0], {}).items():
             out.extend([k, v])
         return out
+
+    def cmd_hlen(self, a):
+        return len(self.hashes.get(a[0], {}))
 
     def cmd_hdel(self, a):
         # variadic like real Redis: HDEL key f1 [f2 ...]

@@ -124,7 +124,11 @@ class ClusterServing:
                  breaker_failure_threshold: int = 3,
                  breaker_reset_s: float = 1.0,
                  sink_buffer_batches: int = 256,
-                 slo=None, zero_copy_decode: bool = True):
+                 slo=None, zero_copy_decode: bool = True,
+                 engine_id: Optional[str] = None,
+                 claim_min_idle_s: float = 30.0,
+                 claim_interval_s: float = 5.0,
+                 heartbeat_interval_s: float = 2.0):
         """Fault-tolerance knobs (ISSUE 5; the rest is PR 1-4 surface):
         `supervise` starts a `ReplicaSupervisor` over a replica pool
         (quarantine after `failure_threshold` consecutive failures or
@@ -144,7 +148,24 @@ class ClusterServing:
         into preallocated bucket-shaped batch buffers (no per-record
         ndarray allocation, no dispatch-stage np.stack). False restores
         the per-record decode + stack path — kept ONLY as the
-        bench_serving A/B baseline."""
+        bench_serving A/B baseline.
+
+        Fleet mode (ISSUE 10): `engine_id` names this engine as ONE of
+        N co-consumers of the stream. It becomes the consumer-group
+        consumer name, an `engine` label on the `serving_*` metric
+        series and pipeline spans, and the heartbeat identity published
+        to `engines:<stream>` every `heartbeat_interval_s` (the fleet
+        gateway's liveness source; a clean stop deregisters). The
+        reader additionally runs a stale-pending claim sweep every
+        `claim_interval_s`: entries another consumer read but never
+        acked — a killed peer's in-flight batches — become claimable
+        after `claim_min_idle_s` and redeliver HERE (XAUTOCLAIM on
+        Redis, window-parity on the in-process brokers), so an engine
+        crash costs capacity, never accepted records. The sweep runs
+        even with `engine_id=None` (single-engine redelivery after a
+        restart is the same mechanism); heartbeats and metric labels
+        are fleet-mode only, keeping the standalone metric schema
+        byte-identical."""
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
@@ -188,7 +209,16 @@ class ClusterServing:
         self.result_key = f"result:{stream}"
         self.batch_size = batch_size
         self.batch_timeout_ms = batch_timeout_ms
-        self.consumer = new_consumer_name()
+        # fleet identity: the engine id doubles as the consumer-group
+        # consumer name, so XPENDING/XAUTOCLAIM attribute in-flight work
+        # to a nameable engine (a fresh uuid per restart would orphan
+        # nothing — claims go by idle time — but operators read these)
+        self.engine_id = engine_id
+        self.consumer = engine_id or new_consumer_name()
+        self._labels = {"engine": engine_id} if engine_id else {}
+        self.claim_min_idle_s = float(claim_min_idle_s)
+        self.claim_interval_s = float(claim_interval_s)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.pipelined = pipelined
         self.zero_copy_decode = zero_copy_decode
         self.decode_workers = max(1, decode_workers)
@@ -216,6 +246,13 @@ class ClusterServing:
         self.sink_buffer_batches = max(1, int(sink_buffer_batches))
         self._wb_buffer: "collections.deque" = collections.deque()
         self._sink_down = False
+        # record ids this engine has read/claimed but not yet acked:
+        # the claim sweep (and the in-process brokers' redelivery
+        # window) must not hand the engine its OWN in-flight work back
+        # while a slow batch computes. Reader adds, sink removes on ack
+        # — and on shed, where redelivery (to a peer) is the contract.
+        self._inflight_ids: set = set()
+        self._inflight_lock = threading.Lock()
         self.probe_interval_s = probe_interval_s
         self._wire_registry()
         self.slo = None
@@ -236,6 +273,29 @@ class ClusterServing:
                 latency_floor_ms=latency_floor_ms,
                 probe_interval_s=probe_interval_s,
                 registry=self.registry)
+        # fleet heartbeat (ISSUE 10): its own broker connection — the
+        # reader sits in XREADGROUP block windows and the sink may be
+        # mid-writeback; membership must never queue behind either
+        self.heartbeat = None
+        if engine_id is not None and self.heartbeat_interval_s > 0:
+            from analytics_zoo_tpu.serving.fleet import HeartbeatPublisher
+            base = self.broker.inner \
+                if isinstance(self.broker, ResilientBroker) else self.broker
+            self.heartbeat = HeartbeatPublisher(
+                base.clone(), self.stream, engine_id,
+                self._heartbeat_payload,
+                interval_s=self.heartbeat_interval_s,
+                registry=self.registry)
+
+    def _heartbeat_payload(self) -> dict:
+        """What each beat tells the gateway: readiness (the same
+        aggregation /healthz would compute locally) plus the throughput
+        counters a fleet dashboard sums."""
+        h = self.health()
+        return {"ready": bool(h.get("ready")),
+                "healthy_replicas": h.get("healthy_replicas"),
+                "records_served": self.records_served,
+                "records_read": self.records_read}
 
     def _wire_registry(self):
         """Mirror the engine's private Timers into the process-wide
@@ -273,12 +333,19 @@ class ClusterServing:
                 replica_gauge.set_function(fn, replica=str(i))
                 self._gauge_installs.append(
                     (replica_gauge, fn, {"replica": str(i)}, False))
+        # fleet mode threads the engine id through every serving series
+        # (self._labels is {} standalone, so the default schema is
+        # byte-identical); a fleet-aggregate view is the label-summed
+        # family, a per-engine view is one series
+        labels = self._labels
         for timer, stage in ((self.decode_timer, "decode"),
                              (self.dispatch_timer, "dispatch"),
                              (self.sink_timer, "sink")):
             timer.add_observer(
-                lambda s, _st=stage: stage_hist.observe(s * 1e3, stage=_st))
-        self.batch_timer.add_observer(lambda s: batch_hist.observe(s * 1e3))
+                lambda s, _st=stage: stage_hist.observe(
+                    s * 1e3, stage=_st, **labels))
+        self.batch_timer.add_observer(
+            lambda s: batch_hist.observe(s * 1e3, **labels))
         # the model (and its predict Timer) may outlive/be shared across
         # ClusterServing instances — attach the mirror exactly once
         if not getattr(self.model.timer, "_registry_mirrored", False):
@@ -294,6 +361,12 @@ class ClusterServing:
             # frozen (not removed) on stop: post-run readers (the bench)
             # still see the drained depths
             self._gauge_installs.append((qd, fn, {"queue": q}, True))
+        # fleet telemetry (ISSUE 10): cross-engine redelivery + the
+        # idempotent-writeback duplicate ledger
+        self._claimed_records = reg.counter(
+            "serving_claimed_records_total",
+            "stale pending records claimed from dead peer consumers by "
+            "this engine's claim sweep")
         # fault-tolerance telemetry (ISSUE 5)
         self._reconnects = reg.counter(
             "serving_broker_reconnects_total",
@@ -400,6 +473,10 @@ class ClusterServing:
             t = threading.Thread(target=self.run, daemon=True)
             t.start()
             self._threads.append(t)
+        if self.heartbeat is not None:
+            # after the stage threads: the first beat already reports
+            # ready=True instead of a one-interval false negative
+            self.heartbeat.start()
         return self
 
     def is_alive(self) -> bool:
@@ -412,6 +489,10 @@ class ClusterServing:
         feeding it has exited, so work already read from the broker flows
         through to the sink before shutdown."""
         self._stop.set()
+        if self.heartbeat is not None:
+            # first: deregister from the fleet so the gateway routes
+            # around this engine before its drain even starts
+            self.heartbeat.stop(deregister=True)
         if self.slo is not None:
             self.slo.stop_auto()
         if self.supervisor is not None:
@@ -441,8 +522,10 @@ class ClusterServing:
             t.join(timeout=10)
         self._threads = []
         self._unwire_gauges()
-        for br in (self.reader_broker, self.sink_broker):
-            if br is not self.broker and hasattr(br, "close"):
+        hb_broker = self.heartbeat.broker if self.heartbeat else None
+        for br in (self.reader_broker, self.sink_broker, hb_broker):
+            if br is not None and br is not self.broker \
+                    and hasattr(br, "close"):
                 try:
                     br.close()
                 except Exception:  # noqa: BLE001 — shutdown best effort
@@ -493,6 +576,27 @@ class ClusterServing:
                         if abandon is not None:
                             abandon()
 
+    def _filter_inflight(self, records):
+        """Drop records this engine already holds un-acked (its own
+        slow in-flight work coming back through the claim sweep or a
+        redelivery window) and register the rest. The sink releases ids
+        on ack — and on shed, where redelivering (ideally to a peer)
+        is exactly the contract."""
+        if not records:
+            return []
+        out = []
+        with self._inflight_lock:
+            for rid, rec in records:
+                if rid in self._inflight_ids:
+                    continue
+                self._inflight_ids.add(rid)
+                out.append((rid, rec))
+        return out
+
+    def _release_inflight(self, ids):
+        with self._inflight_lock:
+            self._inflight_ids.difference_update(ids)
+
     # -- stage: reader -----------------------------------------------------
     def _reader_loop(self):
         # idle wait is LONG (an XADD wakes a blocked XREADGROUP
@@ -502,6 +606,7 @@ class ClusterServing:
         idle_block = max(self.batch_timeout_ms, 50)
         failures = 0
         last_logged = None         # (breaker state) at last warning
+        next_claim = time.monotonic() + self.claim_interval_s
         while not self._stop.is_set():
             try:
                 records = self.reader_broker.read_group(
@@ -515,6 +620,39 @@ class ClusterServing:
                              "attempt(s)", failures)
                     failures = 0
                     last_logged = None
+                if time.monotonic() >= next_claim:
+                    # stale-pending claim sweep (ISSUE 10): a killed
+                    # peer's delivered-but-unacked entries become this
+                    # engine's work once idle past the claim window.
+                    # Paced by the read block above (never a busy loop)
+                    # and its OWN failure domain, like the straggler
+                    # sweep: brokers without the claim op, or a claim
+                    # that dies mid-outage, must not cost the records
+                    # already in hand.
+                    next_claim = time.monotonic() + self.claim_interval_s
+                    try:
+                        claimed = self.reader_broker.claim_stale(
+                            self.stream, GROUP, self.consumer,
+                            int(self.claim_min_idle_s * 1000),
+                            self.batch_size)
+                    except NotImplementedError:
+                        claimed = []
+                    except Exception as e:  # noqa: BLE001 — keep batch
+                        claimed = []
+                        log.warning(
+                            "claim sweep failed (%s: %s); retrying next "
+                            "interval", type(e).__name__, e)
+                    if claimed:
+                        claimed = self._filter_inflight(claimed)
+                    if claimed:
+                        self._claimed_records.inc(len(claimed),
+                                                  **self._labels)
+                        log.info("claimed %d stale pending record(s) "
+                                 "from dead peer consumer(s)",
+                                 len(claimed))
+                else:
+                    claimed = []
+                records = claimed + self._filter_inflight(records)
                 if not records:
                     continue
                 if len(records) < self.batch_size \
@@ -527,10 +665,11 @@ class ClusterServing:
                     # the main read and the sweep must not drop the
                     # records already in hand into a redeliver loop
                     try:
-                        records += self.reader_broker.read_group(
-                            self.stream, GROUP, self.consumer,
-                            self.batch_size - len(records),
-                            block_ms=self.batch_timeout_ms)
+                        records += self._filter_inflight(
+                            self.reader_broker.read_group(
+                                self.stream, GROUP, self.consumer,
+                                self.batch_size - len(records),
+                                block_ms=self.batch_timeout_ms))
                     except Exception as e:  # noqa: BLE001 — keep batch
                         log.warning(
                             "straggler sweep failed (%s: %s); "
@@ -538,7 +677,8 @@ class ClusterServing:
                             type(e).__name__, e, len(records))
                 with self._counter_lock:
                     self.records_read += len(records)
-                self._records_total.inc(len(records), outcome="read")
+                self._records_total.inc(len(records), outcome="read",
+                                        **self._labels)
                 item = (time.perf_counter(), records)
                 while not self._stop.is_set():
                     try:
@@ -706,8 +846,13 @@ class ClusterServing:
                 t_end = time.perf_counter()
                 self.decode_timer.record(t_end - t_work)
                 if tr is not None:
-                    tr.add_span("decode", t_work, t_end, trace_ids=uris)
+                    tr.add_span("decode", t_work, t_end, trace_ids=uris,
+                                args=dict(self._labels) or None)
             except Exception as e:  # noqa: BLE001 — stage must survive
+                # the dropped batch stays unacked, so the broker WILL
+                # redeliver it — release its ids or _filter_inflight
+                # would suppress that redelivery forever
+                self._release_inflight([rid for rid, _ in records])
                 log.error("decode stage failed for a read batch: %s", e)
 
     # -- stage: dispatch ---------------------------------------------------
@@ -764,13 +909,15 @@ class ClusterServing:
                 if self._multi_replica and replica is not None:
                     self._replica_batches.inc(replica=str(replica))
                 if tr is not None:
-                    # replica tag only in multi-device mode: the default
-                    # single-replica trace schema stays unchanged
+                    # replica tag only in multi-device mode, engine tag
+                    # only in fleet mode: the default single-replica
+                    # standalone trace schema stays unchanged
+                    span_args = dict(self._labels)
+                    if self._multi_replica and replica is not None:
+                        span_args["replica"] = replica
                     tr.add_span("dispatch", t_work, t_end,
                                 trace_ids=batch.uris,
-                                args={"replica": replica}
-                                if self._multi_replica
-                                and replica is not None else None)
+                                args=span_args or None)
                 self._enqueue(self._sink_q, batch)
             except Exception as e:  # noqa: BLE001 — stream must survive
                 log.error("dispatch failure for batch of %d: %s",
@@ -878,17 +1025,25 @@ class ClusterServing:
         if self._wb_buffer or not self._write_entry(entry):
             self._buffer_writeback(entry)
 
-    def _write_entry(self, entry) -> bool:
+    def _write_entry(self, entry, own_retry: bool = False) -> bool:
         """One batched writeback + ack; False (no raise) on a broker
         failure. Counters/timers record only on success — a buffered
         batch records its FULL latency (outage included) when it
-        finally lands."""
+        finally lands. `own_retry` marks a flush of THIS engine's
+        buffered entry: an ambiguous partial commit (HSET applied,
+        reply lost, pipeline raised) leaves the fields present, so the
+        retry's new-field count reads 0 — but the records were served
+        exactly once by this engine's compute and must count as
+        served, not duplicate."""
         mapping, ids, t0, t_work = entry
         try:
-            # ONE pipelined broker write for the whole batch,
-            # then one batched ack — 2 round trips, not N+1
-            self.sink_broker.hset_many(self.result_key, mapping)
-            self.sink_broker.ack(self.stream, GROUP, ids)
+            # the whole batch commits as ONE broker interaction —
+            # results + ack in a single (pipelined) round trip, not
+            # N+1, not even 3: round-trip latency is what caps sink
+            # throughput when the broker host is loaded
+            added = self.sink_broker.writeback(
+                self.result_key, mapping, self.stream, GROUP, ids)
+            self._release_inflight(ids)
         except Exception as e:  # noqa: BLE001 — the buffer owns retries
             if not self._sink_down:
                 # one warning per outage, not per batch (the breaker
@@ -906,16 +1061,44 @@ class ClusterServing:
             # only blocking readback in the pipeline
             tr_ids = list(mapping)
             self.tracer.add_span("sink", t_work, t_end,
-                                 trace_ids=tr_ids)
+                                 trace_ids=tr_ids,
+                                 args=dict(self._labels) or None)
+        # idempotent writeback (ISSUE 10): HSET reports how many fields
+        # were NEW. A redelivered record whose result another engine (or
+        # an earlier life of this one) already wrote is an overwrite of
+        # the same deterministic value — correct data, but it must not
+        # double-count as served. The broker's own new-field count is
+        # the only dedup that works ACROSS engines. An own-buffered
+        # retry is the exception (see docstring): its records count as
+        # served regardless of the overwrite count. (If a peer ALSO
+        # claimed and wrote them during a long outage, the fleet sum
+        # over-counts that overlap — a double fault traded for not
+        # silently deflating every single-engine outage recovery.)
+        if own_retry:
+            added = len(mapping)
+        n_new = added if isinstance(added, int) else len(mapping)
+        n_dup = len(mapping) - n_new
         with self._counter_lock:
-            self.records_served += len(mapping)
-        self._records_total.inc(len(mapping), outcome="served")
+            self.records_served += n_new
+        if n_new:
+            self._records_total.inc(n_new, outcome="served",
+                                    **self._labels)
+        if n_dup:
+            self._records_total.inc(n_dup, outcome="duplicate",
+                                    **self._labels)
         # NaN-degraded records count as "failed" alongside (not instead
         # of) "served" — the SLO availability window reads
-        # (served - failed) / served
+        # (served - failed) / served. A fully-duplicate batch (a
+        # redelivery whose results were all already written) skips the
+        # count: its NaNs were counted by the first writer, and
+        # re-counting them would skew availability down on every
+        # crash-redelivery. (A partially-new batch counts all its NaNs
+        # — HSET's new-field total can't attribute WHICH fields were
+        # new, and the mixed case needs a mid-batch crash to occur.)
         nan_n = sum(1 for v in mapping.values() if v == "NaN")
-        if nan_n:
-            self._records_total.inc(nan_n, outcome="failed")
+        if nan_n and n_new:
+            self._records_total.inc(nan_n, outcome="failed",
+                                    **self._labels)
         self.batch_timer.record(t_end - t0)
         return True
 
@@ -928,6 +1111,10 @@ class ClusterServing:
         while len(self._wb_buffer) > self.sink_buffer_batches:
             shed = self._wb_buffer.popleft()
             self._shed_records.inc(len(shed[0]))
+            # shed records must be re-readable: release their ids so a
+            # redelivery (this engine or a claiming peer) isn't filtered
+            # out as already-in-flight
+            self._release_inflight(shed[1])
             log.warning(
                 "sink buffer overflow: shed a writeback of %d records "
                 "(unacked; the broker will redeliver)", len(shed[0]))
@@ -938,7 +1125,7 @@ class ClusterServing:
         fail while the circuit is open)."""
         flushed = False
         while self._wb_buffer:
-            if not self._write_entry(self._wb_buffer[0]):
+            if not self._write_entry(self._wb_buffer[0], own_retry=True):
                 return
             self._wb_buffer.popleft()
             flushed = True
@@ -1005,13 +1192,15 @@ class ClusterServing:
             return 0
         with self._counter_lock:
             self.records_read += len(records)
-        self._records_total.inc(len(records), outcome="read")
+        self._records_total.inc(len(records), outcome="read",
+                                **self._labels)
         t0 = time.perf_counter()
         self._process(records)
         self.broker.ack(self.stream, GROUP, [rid for rid, _ in records])
         with self._counter_lock:
             self.records_served += len(records)
-        self._records_total.inc(len(records), outcome="served")
+        self._records_total.inc(len(records), outcome="served",
+                                **self._labels)
         t_end = time.perf_counter()
         self.batch_timer.record(t_end - t0)
         if self.tracer is not None:
@@ -1034,7 +1223,8 @@ class ClusterServing:
         for _rid, uri in failed:
             self.broker.hset(self.result_key, uri, "NaN")
         if failed:
-            self._records_total.inc(len(failed), outcome="failed")
+            self._records_total.inc(len(failed), outcome="failed",
+                                    **self._labels)
         for _ids, uris, buf, n in batches:
             try:
                 preds = self.model.predict(buf[:n])
@@ -1059,7 +1249,8 @@ class ClusterServing:
                           n, tuple(buf.shape[1:]), e)
                 for uri in uris:
                     self.broker.hset(self.result_key, uri, "NaN")
-                self._records_total.inc(len(uris), outcome="failed")
+                self._records_total.inc(len(uris), outcome="failed",
+                                        **self._labels)
 
     # -- metrics (`/metrics`, FrontEndApp.scala:241) -----------------------
     def metrics(self) -> dict:
@@ -1070,6 +1261,10 @@ class ClusterServing:
             "batch": self.batch_timer.snapshot(),
             "predict": self.model.timer.snapshot(),
         }
+        if self.engine_id is not None:
+            m["engine_id"] = self.engine_id
+            m["claimed_records"] = int(
+                self._claimed_records.value(**self._labels))
         if self.pipelined:
             m["stages"] = {
                 "decode": self.decode_timer.snapshot(),

@@ -544,6 +544,38 @@ def main():
                         out["serving_chaos_" + key] = r3.get(key)
             else:
                 out["serving_chaos_record_loss"] = None
+        # fleet run (ISSUE 10): 2 engine PROCESSES co-consuming one
+        # stream over the RESP2 wire — drain scaling vs single-engine
+        # (host_cores caveat applies: engine processes burn real
+        # cores), zero-loss through a mid-drain SIGKILL, shared-cache
+        # cold-compile accounting
+        if os.environ.get("BENCH_FLEET", "1") == "1":
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            r4, _ = _run_sub([sys.executable,
+                              os.path.join(here, "bench_serving.py"),
+                              "--engines", "2"],
+                             timeout=900, env=env)
+            if r4:
+                for src, dst in (
+                        ("fleet_drain_rps", "serving_fleet_drain_rps"),
+                        ("fleet_speedup", "serving_fleet_speedup"),
+                        ("fleet_efficiency", "serving_fleet_efficiency"),
+                        ("efficiency_vs_host_cores",
+                         "serving_fleet_efficiency_vs_host_cores"),
+                        ("host_effective_parallelism",
+                         "serving_fleet_host_effective_parallelism"),
+                        ("fleet_zero_loss", "serving_fleet_zero_loss"),
+                        ("engine_kill_redelivery_ms",
+                         "serving_fleet_engine_kill_redelivery_ms"),
+                        ("cold_compiles_per_bucket",
+                         "serving_fleet_cold_compiles_per_bucket"),
+                        ("survivor_claimed_records",
+                         "serving_fleet_survivor_claimed_records")):
+                    if r4.get(src) is not None:
+                        out[dst] = r4.get(src)
+            else:
+                out["serving_fleet_drain_rps"] = None
 
     print(json.dumps(out))
 

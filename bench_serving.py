@@ -643,6 +643,389 @@ def _chaos_main(args) -> int:
     return 0
 
 
+# -- fleet: N engine processes behind one broker (ISSUE 10) ----------------
+
+def _fleet_child(args) -> int:
+    """One fleet engine, in its own process: build the compute-heavy
+    model, warm through the SHARED compile cache (engine 1 compiles,
+    the rest load — the fleet pays ~1 cold compile per bucket), report
+    readiness, hold at the start gate, then join the consumer group
+    under `--engine-id`, heartbeat, and drain until SIGTERM. SIGKILL
+    (the chaos leg) is the point of the exercise: no cleanup runs, the
+    PEL keeps this engine's unacked records, and a live peer's claim
+    sweep adopts them.
+
+    The ready-row/gate handshake (fleet:ready:<stream> /
+    fleet:gate:<stream>) lets the parent prefill the WHOLE backlog
+    before any engine consumes: without it the drain overlaps the
+    parent's sequential xadd loop, engines run starved 1-2 record
+    batches (predict p50 collapsed from 17 ms/8-rec batch to ~1.4 ms
+    micro-batches when measured), and the curve benchmarks the
+    prefill's contended xadd rate instead of fleet drain capacity."""
+    import signal
+
+    if args.pin_core is not None and hasattr(os, "sched_setaffinity"):
+        # one core per engine (BEFORE jax sizes its threadpools): the
+        # process-level analogue of forced-host devices — without it a
+        # single engine's intra-op XLA threads saturate every core and
+        # the fleet curve measures threadpool contention, not scaling
+        try:
+            os.sched_setaffinity(
+                0, {args.pin_core % (os.cpu_count() or 1)})
+        except OSError:
+            pass
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.compile_cache import CompileCache
+    from analytics_zoo_tpu.serving.broker import connect_broker
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    init_orca_context(cluster_mode="local")
+    # heavier per-record compute than the in-process multidevice bench:
+    # the engine must be the limiter, not the pure-python MiniRedis
+    # data plane (~2800 rec/s ceiling on this rig; a production Redis
+    # is far above the curve). NARROW matmuls on purpose: at width 256
+    # one execution stays on ONE thread (cpu/wall ~1.0 measured; 512
+    # already spreads ~1.4 threads), so a single engine can't absorb
+    # the whole host and fake the fleet baseline — essential where
+    # sched_setaffinity isn't enforced (gVisor-style sandboxes accept
+    # the call without binding). Same FLOPs/record as 512x256. The
+    # forward reduces to ONE scalar per record so the writeback side
+    # stays bytes-cheap too — drain scaling should measure compute,
+    # not RESP serialization of 512-float rows.
+    base_fn, W, sample = _md_model(width=256, iters=1024)
+
+    def fn(p, x):
+        return base_fn(p, x).mean(axis=-1)
+    cache = CompileCache(args.compile_cache_dir) \
+        if args.compile_cache_dir else None
+    im = InferenceModel(compile_cache=cache).load_fn(fn, W)
+    batch = args.fleet_batch
+    im.warmup(sample, buckets=[b for b in im.buckets if b <= batch]
+              or im.buckets[:1])
+    broker = connect_broker(args.broker_url)
+    # construct BEFORE the gate (connections, registry wiring, replica
+    # pool) so the timed drain window starts at reader-thread launch
+    serving = ClusterServing(
+        im, broker=broker, stream=args.stream,
+        batch_size=batch, batch_timeout_ms=2,
+        engine_id=args.engine_id,
+        claim_min_idle_s=args.claim_min_idle,
+        claim_interval_s=max(args.claim_min_idle / 4.0, 0.1),
+        heartbeat_interval_s=0.25)
+    broker.hset(f"fleet:ready:{args.stream}", args.engine_id, "1")
+    gate_deadline = time.time() + 600
+    while not broker.hget(f"fleet:gate:{args.stream}", "go"):
+        if time.time() > gate_deadline:
+            raise SystemExit("fleet start gate never opened")
+        time.sleep(0.02)
+    serving.start()
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.05)
+    serving.stop()
+    sources = {}
+    for v in im.warmup_source.values():
+        sources[v] = sources.get(v, 0) + 1
+    m = serving.metrics()
+    stages = {k: round(v.get("p50_ms", 0.0), 2)
+              for k, v in m.get("stages", {}).items()}
+    stages["predict"] = round(m["predict"].get("p50_ms", 0.0), 2)
+    n_batches = m.get("stages", {}).get("dispatch", {}).get("count", 0)
+    print(json.dumps({"engine_id": args.engine_id,
+                      "sources": sources,
+                      "records_served": serving.records_served,
+                      "stage_p50_ms": stages,
+                      "avg_read_batch": round(
+                          serving.records_read / n_batches, 2)
+                      if n_batches else None,
+                      "claimed_records": m.get("claimed_records", 0)}))
+    return 0
+
+
+def _fleet_spawn(k, stream, port, cache_dir, claim_min_idle, batch,
+                 start_idx=0):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)       # hermetic CPU children
+    procs = []
+    for i in range(start_idx, start_idx + k):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--fleet-child",
+             "--broker-url", f"redis://127.0.0.1:{port}",
+             "--stream", stream, "--engine-id", f"engine-{i}",
+             "--compile-cache-dir", cache_dir,
+             "--claim-min-idle", str(claim_min_idle),
+             "--fleet-batch", str(batch), "--pin-core", str(i)],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    return procs
+
+
+def _measure_host_parallelism(seconds: float = 2.0) -> float:
+    """Effective parallel speedup this host grants 2 CPU-bound
+    processes RIGHT NOW (2.0 = two real cores, ~1.0 = an oversubscribed
+    or one-core sandbox). Shared CI hosts swing between the two within
+    minutes (measured 1.96x and 0.82x on the same rig the same day),
+    and gVisor-style sandboxes accept sched_setaffinity without
+    binding — so the fleet curve records the capacity that actually
+    backed it instead of trusting os.cpu_count()."""
+    code = ("import time,sys\n"
+            "w0=time.perf_counter(); x=0\n"
+            "while time.perf_counter()-w0 < %f: x+=1\n"
+            "print(x)" % seconds)
+
+    def run(k):
+        procs = [subprocess.Popen([sys.executable, "-c", code],
+                                  stdout=subprocess.PIPE, text=True)
+                 for _ in range(k)]
+        total = 0
+        for p in procs:
+            out, _ = p.communicate(timeout=60 + seconds)
+            total += int(out)
+        return total
+
+    solo = run(1)
+    duo = run(2)
+    return round(duo / max(solo, 1), 2)
+
+
+def _fleet_wait_ready(broker, stream, procs, n, timeout_s=300.0):
+    """Wait until n engines have warmed and parked at the start gate."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        for p in procs:
+            if p.poll() is not None:
+                _, err = p.communicate()
+                raise SystemExit(
+                    f"fleet engine died during startup (rc="
+                    f"{p.returncode}):\n{err[-2000:]}")
+        if broker.hlen(f"fleet:ready:{stream}") >= n:
+            return
+        time.sleep(0.05)
+    raise SystemExit(f"fleet never reached {n} ready engine(s)")
+
+
+def _fleet_reports(procs, sig=None):
+    """Terminate (or leave killed) children and collect their exit
+    JSON; a SIGKILLed child reports nothing, by design."""
+    import signal as _signal
+    reports = []
+    for p in procs:
+        if p.poll() is None and sig is not False:
+            try:
+                p.send_signal(sig or _signal.SIGTERM)
+            except OSError:
+                pass
+    for p in procs:
+        try:
+            out, _err = p.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _err = p.communicate()
+        for line in (out or "").strip().splitlines()[::-1]:
+            try:
+                reports.append(json.loads(line))
+                break
+            except ValueError:
+                continue
+    return reports
+
+
+def _fleet_main(args) -> int:
+    """`--engines N`: the fleet scaling curve. One MiniRedis carries
+    the stream; 1 then N engine PROCESSES (forced-host CPU children,
+    one device each) drain the same pre-filled backlog; the chaos leg
+    re-runs with a mid-drain SIGKILL of one engine and asserts zero
+    accepted-record loss through the claim sweep.
+
+    Host-core honesty (the PR 3 caveat): engine processes burn real
+    cores, so an M-core box caps fleet scaling at ~M x regardless of N;
+    the JSON reports host_cores and efficiency_vs_host_cores so the
+    curve is legible on any rig."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import uuid
+
+    from analytics_zoo_tpu.serving.broker import (RedisBroker,
+                                                  encode_ndarray)
+    from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+
+    n = max(2, args.engines)
+    total = args.total
+    batch = 8
+    # same (width, iters) as the child engines build — the prefilled
+    # records must match the model's input width
+    _fn, _W, sample = _md_model(width=256, iters=1024)
+    encoded = encode_ndarray(np.asarray(sample))
+    cache_dir = tempfile.mkdtemp(prefix="zoo-fleet-cc-")
+    srv = MiniRedisServer().start()
+    curve = {}
+    reports = []
+    chaos = {}
+    try:
+        def prefill(broker, stream, count):
+            t0 = time.perf_counter()
+            for _ in range(count):
+                broker.xadd(stream, {"uri": uuid.uuid4().hex,
+                                     "data": {"t": encoded}})
+            return time.perf_counter() - t0
+
+        def drained(broker, stream, count, deadline_s=600.0):
+            # HLEN, not HGETALL: polling must not re-serialize the whole
+            # result hash over RESP each check — at 20 Hz that steals a
+            # measurable slice of the engines' (pinned) cores
+            result_key = f"result:{stream}"
+            deadline = time.time() + deadline_s
+            while time.time() < deadline:
+                got = broker.hlen(result_key)
+                if got >= count:
+                    return got
+                time.sleep(0.05)
+            return broker.hlen(result_key)
+
+        # -- scaling curve: 1 engine, then N, same backlog ----------------
+        host_par = {}
+        for k in sorted({1, n}):
+            stream = f"serving_stream_fleet{k}"
+            broker = RedisBroker(srv.host, srv.port)
+            # staggered start: engine 0 warms the shared cache alone
+            # (the ~1-cold-compile-per-bucket contract), the rest load
+            procs = _fleet_spawn(1, stream, srv.port, cache_dir, 30.0,
+                                 batch)
+            _fleet_wait_ready(broker, stream, procs, 1)
+            if k > 1:
+                procs += _fleet_spawn(k - 1, stream, srv.port,
+                                      cache_dir, 30.0, batch,
+                                      start_idx=1)
+                _fleet_wait_ready(broker, stream, procs, k)
+            # what the host can give 2 concurrent processes RIGHT
+            # BEFORE this leg's drain (engines idle at the gate) — a
+            # shared host's capacity swings minute to minute, so one
+            # probe at bench start would misstate the leg's ceiling
+            host_par[str(k)] = _measure_host_parallelism()
+            # the WHOLE backlog lands before the gate opens: the timed
+            # window measures fleet drain capacity, not the parent's
+            # (contended) sequential xadd rate
+            prefill(broker, stream, total)
+            broker.hset(f"fleet:gate:{stream}", "go", "1")
+            t0 = time.perf_counter()
+            got = drained(broker, stream, total)
+            dt = time.perf_counter() - t0
+            rate = got / dt
+            # best-of-2 (the multidevice precedent: single drains swing
+            # 2-3x with one-sided scheduler noise on shared rigs): a
+            # second backlog through the SAME live fleet; its prefill
+            # overlaps consumption, but engines idle-block until it
+            # starts so the backlog builds far faster than it drains
+            t0 = time.perf_counter()
+            prefill(broker, stream, total)
+            got2 = drained(broker, stream, 2 * total) - total
+            dt2 = time.perf_counter() - t0
+            rate = max(rate, got2 / dt2)
+            curve[str(k)] = round(rate, 1)
+            reports += _fleet_reports(procs)
+            broker.close()
+        host_parallelism = max(host_par.values())
+
+        # -- chaos leg: SIGKILL one of N mid-drain ------------------------
+        stream = "serving_stream_fleet_chaos"
+        broker = RedisBroker(srv.host, srv.port)
+        claim_idle = 1.0
+        procs = _fleet_spawn(1, stream, srv.port, cache_dir, claim_idle,
+                             batch)
+        _fleet_wait_ready(broker, stream, procs, 1)
+        procs += _fleet_spawn(n - 1, stream, srv.port, cache_dir,
+                              claim_idle, batch, start_idx=1)
+        _fleet_wait_ready(broker, stream, procs, n)
+        result_key = f"result:{stream}"
+        prefill(broker, stream, total)
+        broker.hset(f"fleet:gate:{stream}", "go", "1")
+        deadline = time.time() + 600
+        while broker.hlen(result_key) < total // 3 \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        # SIGKILL: no drain, no deregistration, unacked records strand
+        # in the dead engine's PEL until a peer's claim sweep
+        procs[0].send_signal(_signal.SIGKILL)
+        t_kill = time.perf_counter()
+        got = drained(broker, stream, total)
+        t_done = time.perf_counter()
+        pending_left = broker.pending_count(
+            stream, "serving_group")
+        chaos = {
+            "engines": n,
+            "killed": "engine-0",
+            "kill_at_fraction": 1 / 3,
+            "claim_min_idle_s": claim_idle,
+            "record_loss": total - got,
+            "zero_loss": got == total,
+            "pending_after_drain": pending_left,
+            "engine_kill_redelivery_ms": round(
+                (t_done - t_kill) * 1e3, 1),
+        }
+        reports += _fleet_reports(procs)
+        broker.close()
+    finally:
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    cores = os.cpu_count() or 1
+    base = curve.get("1", 0.0)
+    speedup = curve.get(str(n), 0.0) / max(base, 1e-9)
+    n_buckets = len([b for b in (1, 2, 4, 8) if b <= batch])
+    compiled = sum(r.get("sources", {}).get("compiled", 0)
+                   for r in reports)
+    survivors_claimed = sum(r.get("claimed_records", 0)
+                            for r in reports)
+    # the ceiling the curve was ACTUALLY measured under: nominal cores,
+    # capped by what the host granted 2 concurrent processes at bench
+    # time (shared CI hosts swing between ~1x and ~2x within minutes)
+    ceiling = min(float(n), float(cores), host_parallelism)
+    out = {
+        "metric": "serving_fleet_drain",
+        "engines": n,
+        "total_records": total,
+        "batch_size": batch,
+        "host_cores": cores,
+        "host_effective_parallelism": host_parallelism,
+        "host_effective_parallelism_per_leg": host_par,
+        "fleet_drain_rps": curve,
+        "fleet_speedup": round(speedup, 2),
+        "fleet_efficiency": round(speedup / n, 3),
+        # engine processes burn real cores: an M-core box caps the
+        # fleet at ~M x no matter how many engines run — and a shared
+        # box caps it at whatever slice the host is granting right now;
+        # a real pod's chips compute off-host and scale with the
+        # engine count
+        "efficiency_vs_host_cores": round(
+            speedup / max(ceiling, 1e-9), 3),
+        "note": ("engine compute is single-threaded by construction "
+                 "(narrow matmuls; sched_setaffinity is advisory in "
+                 "sandboxed CI), so the curve caps near "
+                 f"{ceiling:g}x here: min(engines, {cores} host cores, "
+                 f"measured {host_parallelism:g}x effective host "
+                 "parallelism at bench time); real engines on separate "
+                 "hosts scale with the engine count"),
+        "fleet_zero_loss": chaos.get("zero_loss"),
+        "engine_kill_redelivery_ms": chaos.get(
+            "engine_kill_redelivery_ms"),
+        "chaos": chaos,
+        # the shared-cache contract: every engine after the first warms
+        # from disk, so cold compiles per bucket stay ~1 across the
+        # whole fleet (3 staggered cold starts here: one per leg)
+        "cold_compiles_per_bucket": round(
+            compiled / max(n_buckets, 1), 2),
+        "survivor_claimed_records": survivors_claimed,
+        "engine_reports": reports,
+    }
+    print(json.dumps(out))
+    return 0
+
+
 # -- cold start: persistent compile cache across process restarts ----------
 
 def _cold_start_child(args) -> int:
@@ -985,9 +1368,33 @@ def main():
     ap.add_argument("--cold-start-child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--compile-cache-dir", default=None,
-                    help="cache dir for --cold-start (default: throwaway "
-                         "temp dir)")
+                    help="cache dir for --cold-start / the fleet's "
+                         "shared warmup (default: throwaway temp dir)")
+    ap.add_argument("--engines", type=int, default=None,
+                    help="fleet mode (ISSUE 10): spawn N engine "
+                         "processes behind one MiniRedis, report the "
+                         "drain scaling curve, and SIGKILL one engine "
+                         "mid-drain to prove zero-loss redelivery")
+    ap.add_argument("--fleet-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--broker-url", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--stream", default="serving_stream",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--engine-id", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--claim-min-idle", type=float, default=30.0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--fleet-batch", type=int, default=8,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--pin-core", type=int, default=None,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.fleet_child:
+        if not (args.broker_url and args.engine_id):
+            raise SystemExit("--fleet-child needs --broker-url and "
+                             "--engine-id")
+        return _fleet_child(args)
+    if args.engines:
+        return _fleet_main(args)
     if args.chaos:
         return _chaos_main(args)
     if args.devices:

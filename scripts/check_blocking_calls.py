@@ -16,6 +16,9 @@ over `analytics_zoo_tpu/serving/`:
   spelling.)
 - `.join()` with no timeout is banned (`"sep".join(...)` always has an
   argument, so only thread/process joins match).
+- `.wait()` with no arguments is banned (ISSUE 10: the heartbeat /
+  claim-sweep threads must never park forever on an Event or Condition
+  a dead peer will never signal — pass `wait(timeout)` in a loop).
 - `socket.create_connection(...)` must pass `timeout=`.
 
 And over the WHOLE `analytics_zoo_tpu/` package:
@@ -43,6 +46,7 @@ ALLOW_RE = re.compile(r"#\s*blocking-ok:\s*\S")
 BARE_EXCEPT_RE = re.compile(r"^\s*except\s*:", re.MULTILINE)
 GET_NOARG_RE = re.compile(r"\.get\(\s*\)")
 JOIN_NOARG_RE = re.compile(r"\.join\(\s*\)")
+WAIT_NOARG_RE = re.compile(r"\.wait\(\s*\)")
 PUT_RE = re.compile(r"\.put\(")
 CONNECT_RE = re.compile(r"\bcreate_connection\s*\(")
 
@@ -100,6 +104,12 @@ def check_file(path: str, serving: bool) -> List[str]:
             errors.append(
                 f"{path}:{_line_of(src, m.start())}: '.join()' with no "
                 "timeout can hang shutdown; pass join(timeout=...)")
+    for m in WAIT_NOARG_RE.finditer(src):
+        if not _allowed(src, m.start()):
+            errors.append(
+                f"{path}:{_line_of(src, m.start())}: '.wait()' with no "
+                "timeout parks forever on an event a dead peer may "
+                "never signal; pass wait(timeout) in a loop")
     for m in PUT_RE.finditer(src):
         # `put_nowait(` never matches `.put(`; this is a plain `.put(`
         args = _call_slice(src, m.end() - 1)

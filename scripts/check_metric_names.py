@@ -87,6 +87,14 @@ REQUIRED = {
     # silently blinds the NCF bound tracking
     "training_fused_update_ms": "histogram",
     "roofline_busy_seconds_total": "counter",
+    # fleet scale-out (ISSUE 10): the families the fleet gateway's
+    # /healthz contract, the fleet bench, and the redelivery zero-loss
+    # accounting read — renaming any of these silently blinds the
+    # fleet dashboard and the drain-curve JSON
+    "serving_engines_alive": "gauge",
+    "serving_engines_total": "counter",
+    "serving_engine_heartbeats_total": "counter",
+    "serving_claimed_records_total": "counter",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",

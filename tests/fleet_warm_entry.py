@@ -1,0 +1,70 @@
+"""Child entry for the concurrent compile-cache warm tests (ISSUE 10).
+
+One serving engine's warmup against a SHARED cache dir, in a real
+process: build a deterministic model fn, warm the given buckets through
+`CompileCache(cache_dir)`, and report what each bucket cost — "compiled"
+(fresh XLA compile, persisted) vs "cached" (loaded from another
+process's entry) — as one JSON line on stdout.
+
+With a sync dir the child also coordinates a genuine RACE: it drops a
+`ready-<pid>` marker once imports are done (the slow part), then spins
+until the parent's `go` marker appears, so two children hit
+warmup-on-one-cache-dir within the same few milliseconds.
+
+    python tests/fleet_warm_entry.py <cache_dir> <b1,b2,...> [sync_dir]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def model_fn(p, x):
+    import jax.numpy as jnp
+    return jnp.tanh(x @ p)
+
+
+def main() -> int:
+    cache_dir = sys.argv[1]
+    buckets = [int(b) for b in sys.argv[2].split(",")]
+    sync_dir = sys.argv[3] if len(sys.argv) > 3 else None
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from analytics_zoo_tpu.compile_cache import CompileCache
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    W = np.full((8, 8), 0.5, np.float32)
+    im = InferenceModel(
+        compile_cache=CompileCache(cache_dir)).load_fn(model_fn, W)
+
+    if sync_dir:
+        with open(os.path.join(sync_dir, f"ready-{os.getpid()}"), "w"):
+            pass
+        deadline = time.time() + 120
+        go = os.path.join(sync_dir, "go")
+        while not os.path.exists(go):
+            if time.time() > deadline:
+                print(json.dumps({"error": "sync timeout"}))
+                return 2
+            time.sleep(0.002)
+
+    im.warmup(np.zeros((8,), np.float32), buckets=buckets)
+    sources = {}
+    for v in im.warmup_source.values():
+        sources[v] = sources.get(v, 0) + 1
+    # prove the warmed model actually serves before reporting
+    out = im.predict(np.ones((buckets[0], 8), np.float32))
+    print(json.dumps({"sources": sources,
+                      "served_shape": list(np.asarray(out).shape),
+                      "cache": im.compile_cache.stats()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

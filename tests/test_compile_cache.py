@@ -7,7 +7,9 @@ validation, the maintenance tool, and the trainer's AOT re-run path.
 
 All on tmp_path + the conftest 8-device CPU mesh; tier-1 fast."""
 
+import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -607,3 +609,127 @@ class TestTrainerAOT:
         cached = run(str(tmp_path / "cc"))
         again = run(str(tmp_path / "cc"))
         assert plain == cached == again
+
+
+class TestConcurrentProcesses:
+    """ISSUE 10: one compile-cache dir shared by a FLEET of engine
+    processes. The store's atomic write-then-rename + CRC discipline
+    must hold under real process-level races (not just threads), and a
+    staggered second engine must pay loads, not compiles."""
+
+    BUCKETS = "1,2,4"
+
+    def _spawn(self, cache_dir, sync_dir=None):
+        import subprocess
+        import sys
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("PALLAS_AXON_POOL_IPS", None)   # hermetic CPU child
+        args = [sys.executable,
+                os.path.join(here, "tests", "fleet_warm_entry.py"),
+                str(cache_dir), self.BUCKETS]
+        if sync_dir is not None:
+            args.append(str(sync_dir))
+        return subprocess.Popen(args, env=env, cwd=here,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+
+    @staticmethod
+    def _result(proc):
+        out, err = proc.communicate(timeout=300)
+        assert proc.returncode == 0, err
+        return json.loads(out.strip().splitlines()[-1])
+
+    def test_racing_writers_leave_one_valid_entry_per_bucket(
+            self, tmp_path):
+        """Two real processes warm the same cache dir at the same
+        instant (sync-dir start gun fires after both finish imports):
+        both serve, the store stays CRC-valid, and exactly one
+        persisted executable per bucket survives."""
+        from analytics_zoo_tpu.compile_cache import store as ccstore
+        cache_dir = tmp_path / "cc"
+        sync_dir = tmp_path / "sync"
+        sync_dir.mkdir()
+        procs = [self._spawn(cache_dir, sync_dir) for _ in range(2)]
+        deadline = time.time() + 240
+        while len([f for f in os.listdir(sync_dir)
+                   if f.startswith("ready-")]) < 2:
+            assert time.time() < deadline, "children never became ready"
+            time.sleep(0.05)
+        (sync_dir / "go").write_text("")        # the start gun
+        results = [self._result(p) for p in procs]
+        for r in results:
+            assert r["served_shape"] == [1, 8], r
+        entries = ccstore.scan_dir(str(cache_dir))
+        n_buckets = len(self.BUCKETS.split(","))
+        assert len(entries) == n_buckets, \
+            f"expected one entry per bucket, got {entries}"
+        for e in entries:
+            assert "corrupt" not in e, e
+            # full payload CRC verification, not just the header
+            ccstore.read_entry(os.path.join(str(cache_dir), e["file"]))
+        # no stray temp files from either writer
+        assert not [f for f in os.listdir(cache_dir)
+                    if f.startswith(".tmp-")]
+        # the store is LOADABLE after the race: a fresh in-process
+        # warmup pays zero compiles
+        from tests.fleet_warm_entry import model_fn
+        im = InferenceModel(
+            compile_cache=CompileCache(str(cache_dir))
+        ).load_fn(model_fn, np.full((8, 8), 0.5, np.float32))
+        im.warmup(np.zeros((8,), np.float32), buckets=[1, 2, 4])
+        assert set(im.warmup_source.values()) == {"cached"}
+
+    def test_staggered_second_engine_loads_not_compiles(self, tmp_path):
+        """The fleet cold-start contract: engine 1 pays the compiles,
+        engine 2 (started after) loads — total cold compiles per bucket
+        is 1."""
+        cache_dir = tmp_path / "cc"
+        first = self._result(self._spawn(cache_dir))
+        n_buckets = len(self.BUCKETS.split(","))
+        assert first["sources"] == {"compiled": n_buckets}, first
+        second = self._result(self._spawn(cache_dir))
+        assert second["sources"] == {"cached": n_buckets}, second
+        assert second["cache"]["entries"] == n_buckets
+
+    def test_reader_survives_concurrent_eviction(self, tmp_path):
+        """A reader loading while another party prunes/rewrites the dir
+        gets hits or misses — never an exception, never a torn entry."""
+        import threading
+        model = make_model()
+        cache = CompileCache(str(tmp_path))
+        im = InferenceModel(compile_cache=cache).load_keras(model)
+        im.warmup(np.zeros((4,), np.float32), buckets=[1, 2])
+        keys = list(im._aot)
+        assert keys
+        from analytics_zoo_tpu.compile_cache import make_key
+        stop = threading.Event()
+        errors = []
+
+        def evictor():
+            while not stop.is_set():
+                cache.prune(0)                 # evict everything
+                im2 = InferenceModel(
+                    compile_cache=cache).load_keras(model)
+                im2.warmup(np.zeros((4,), np.float32), buckets=[1])
+
+        def reader():
+            sample = np.zeros((1, 4), np.float32)
+            key = make_key(im._fn, im._params, sample,
+                           abstract_signature(sample))
+            deadline = time.time() + 2.0
+            while time.time() < deadline:
+                try:
+                    cache.load(key)            # hit or None, never raise
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    errors.append(e)
+
+        t_e = threading.Thread(target=evictor)
+        t_r = threading.Thread(target=reader)
+        t_e.start()
+        t_r.start()
+        t_r.join(timeout=30)
+        stop.set()
+        t_e.join(timeout=30)
+        assert not errors, errors

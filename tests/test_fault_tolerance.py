@@ -269,8 +269,9 @@ class TestBrokerOutage:
             im, broker, breaker_failure_threshold=2, breaker_reset_s=0.05)
         try:
             sink_only = faults.Fault(match=lambda c: c["role"] == "sink")
-            faults.inject("broker.hset_many", sink_only)
-            faults.inject("broker.ack", sink_only)
+            # the sink commits through the fused writeback op (results
+            # HSET + ack in one round trip) — that is the op to fail
+            faults.inject("broker.writeback", sink_only)
             inq = InputQueue(broker)
             uris = [inq.enqueue(t=np.full((4,), i, np.float32))
                     for i in range(12)]
@@ -278,8 +279,7 @@ class TestBrokerOutage:
             # into the bounded sink buffer
             _wait_until(lambda: len(serving._wb_buffer) > 0,
                         msg="sink writebacks buffering")
-            faults.clear("broker.hset_many")
-            faults.clear("broker.ack")
+            faults.clear("broker.writeback")
             out = OutputQueue(broker)
             results = {}
 
@@ -315,8 +315,9 @@ class TestBrokerOutage:
             breaker_failure_threshold=2, breaker_reset_s=0.05)
         try:
             sink_only = faults.Fault(match=lambda c: c["role"] == "sink")
-            faults.inject("broker.hset_many", sink_only)
-            faults.inject("broker.ack", sink_only)
+            # the sink commits through the fused writeback op (results
+            # HSET + ack in one round trip) — that is the op to fail
+            faults.inject("broker.writeback", sink_only)
             inq = InputQueue(broker)
             uris = [inq.enqueue(t=np.full((4,), i, np.float32))
                     for i in range(8)]
@@ -324,8 +325,7 @@ class TestBrokerOutage:
                 lambda: _counter_value("serving_sink_shed_records_total")
                 > shed_before,
                 msg="shed counter increment")
-            faults.clear("broker.hset_many")
-            faults.clear("broker.ack")
+            faults.clear("broker.writeback")
             out = OutputQueue(broker)
             _wait_until(
                 lambda: all(out.query(u) is not None for u in uris),

@@ -36,7 +36,7 @@ class TestRedisBrokerProtocol:
                              count=8, block_ms=1) == []
         br.ack("serving_stream", "serving", ["1-0"])
         assert redis_server.store.groups[("serving_stream", "serving")][
-            "pel"] == set()
+            "pel"] == {}
         assert redis_server.store.streams["serving_stream"] == []
 
     def test_group_create_idempotent(self, redis_server):

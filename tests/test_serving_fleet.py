@@ -1,0 +1,550 @@
+"""Horizontal scale-out (ISSUE 10): N serving engines behind one broker.
+
+- Redelivery conformance, ONE suite over all broker transports
+  (MemoryBroker in-process, TCPBroker over its server, RedisBroker over
+  the in-package MiniRedis — the real RESP2 wire with XAUTOCLAIM /
+  XPENDING): a dead consumer's delivered-but-unacked records are
+  claimable by a live peer after the idle window, acked records are
+  not, claims restart the idle clock, and HSET reports new-vs-overwrite
+  so redelivered results never double-count.
+- Engine claim sweep: a ClusterServing engine adopts a killed peer's
+  pending records with zero accepted-record loss, and never re-claims
+  its own in-flight work.
+- Two co-consuming engines drain one stream: every record served
+  exactly once, per-engine `engine` labels on the serving metrics.
+- Fleet gateway: heartbeats through the broker drive /healthz (200
+  while >= 1 engine alive+ready, 503 + Retry-After when none; legacy
+  200 only for a truly standalone frontend) and the
+  serving_engines_alive / serving_engines_total families.
+- Fleet config/CLI knob validation.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.observability.registry import MetricsRegistry
+from analytics_zoo_tpu.serving.broker import (MemoryBroker, RedisBroker,
+                                              TCPBroker, TCPBrokerServer)
+from analytics_zoo_tpu.serving.client import InputQueue
+from analytics_zoo_tpu.serving.fleet import (FleetTracker,
+                                             HeartbeatPublisher,
+                                             engines_key)
+from analytics_zoo_tpu.serving.http_frontend import FrontEnd
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+from analytics_zoo_tpu.serving.server import GROUP, ClusterServing
+
+STREAM = "serving_stream"
+RESULT_KEY = f"result:{STREAM}"
+
+
+@pytest.fixture(params=["memory", "tcp", "redis"])
+def broker_pair(request):
+    """(broker_a, broker_b, kind): two independent connections to one
+    backing store — the two-consumer setup every redelivery test needs.
+    Covers all four broker components: MemoryBroker, TCPBroker(Server),
+    and RedisBroker against MiniRedis over the real RESP2 wire."""
+    kind = request.param
+    if kind == "memory":
+        br = MemoryBroker()
+        yield br, br, kind
+        return
+    if kind == "tcp":
+        srv = TCPBrokerServer().start()
+        a, b = (TCPBroker(srv.host, srv.port) for _ in range(2))
+        yield a, b, kind
+        srv.stop()
+        return
+    srv = MiniRedisServer().start()
+    a, b = (RedisBroker(srv.host, srv.port) for _ in range(2))
+    yield a, b, kind
+    a.close()
+    b.close()
+    srv.stop()
+
+
+def _xadd_n(broker, n, stream=STREAM):
+    rids = []
+    for i in range(n):
+        rids.append(broker.xadd(stream, {"uri": f"u{i}",
+                                         "data": {"v": i}}))
+    return rids
+
+
+class TestRedeliveryConformance:
+    """The shared contract all transports must satisfy for cross-engine
+    redelivery to be safe."""
+
+    def test_dead_consumer_records_claimable(self, broker_pair):
+        a, b, _ = broker_pair
+        _xadd_n(a, 8)
+        dead = a.read_group(STREAM, "g", "dead", 5, block_ms=50)
+        assert len(dead) == 5
+        assert a.pending_count(STREAM, "g") == 5
+        # peer claims the dead consumer's work (idle window elapsed)
+        claimed = b.claim_stale(STREAM, "g", "live", 0, 10)
+        assert sorted(rid for rid, _ in claimed) == \
+            sorted(rid for rid, _ in dead)
+        # record payloads survive the claim intact
+        assert {rec["uri"] for _, rec in claimed} == \
+            {rec["uri"] for _, rec in dead}
+        # the remaining 3 are still NEW records for the group
+        fresh = b.read_group(STREAM, "g", "live", 10, block_ms=50)
+        assert len(fresh) == 3
+        b.ack(STREAM, "g", [rid for rid, _ in claimed + fresh])
+        assert b.pending_count(STREAM, "g") == 0
+        # zero loss: every uri delivered exactly once overall
+        uris = [rec["uri"] for _, rec in claimed + fresh]
+        assert sorted(uris) == [f"u{i}" for i in range(8)]
+
+    def test_min_idle_window_respected(self, broker_pair):
+        a, b, _ = broker_pair
+        _xadd_n(a, 3)
+        a.read_group(STREAM, "g", "c1", 3, block_ms=50)
+        # freshly delivered: not idle long enough to claim
+        assert b.claim_stale(STREAM, "g", "c2", 60_000, 10) == []
+        assert a.pending_count(STREAM, "g") == 3
+
+    def test_claim_restarts_idle_clock(self, broker_pair):
+        a, b, _ = broker_pair
+        _xadd_n(a, 2)
+        a.read_group(STREAM, "g", "c1", 2, block_ms=50)
+        assert len(b.claim_stale(STREAM, "g", "c2", 0, 10)) == 2
+        # just claimed by c2 -> idle clock restarted, a third sweeper
+        # with a real window gets nothing (no claim ping-pong)
+        assert b.claim_stale(STREAM, "g", "c3", 60_000, 10) == []
+
+    def test_acked_records_not_claimable(self, broker_pair):
+        a, b, _ = broker_pair
+        _xadd_n(a, 4)
+        got = a.read_group(STREAM, "g", "c1", 4, block_ms=50)
+        a.ack(STREAM, "g", [rid for rid, _ in got])
+        assert b.claim_stale(STREAM, "g", "c2", 0, 10) == []
+        assert b.pending_count(STREAM, "g") == 0
+
+    def test_hset_many_reports_new_fields_only(self, broker_pair):
+        a, b, _ = broker_pair
+        assert a.hset_many("h", {"u1": "r1", "u2": "r2"}) == 2
+        # a redelivered batch overwrites u2 and adds u3: ONE new field
+        assert b.hset_many("h", {"u2": "r2", "u3": "r3"}) == 1
+        assert a.hset("h", "u1", "r1b") == 0
+        assert a.hgetall("h") == {"u1": "r1b", "u2": "r2", "u3": "r3"}
+
+    def test_writeback_commits_results_and_acks_atomically(
+            self, broker_pair):
+        """The sink's fused commit: results HSET + ack in one broker
+        interaction, with the same new-field dedup count as hset_many
+        — on every transport."""
+        a, b, _ = broker_pair
+        rids = _xadd_n(a, 4)
+        got = a.read_group(STREAM, "g", "c1", 4, block_ms=50)
+        assert a.writeback("h", {"u0": "r0", "u1": "r1"},
+                           STREAM, "g", [rid for rid, _ in got[:2]]) == 2
+        assert a.pending_count(STREAM, "g") == 2
+        # redelivered overlap: only the new field counts
+        assert b.writeback("h", {"u1": "r1", "u2": "r2"},
+                           STREAM, "g", [rid for rid, _ in got[2:]]) == 1
+        assert b.pending_count(STREAM, "g") == 0
+        assert b.hgetall("h") == {"u0": "r0", "u1": "r1", "u2": "r2"}
+        # acked records are gone for good: nothing left to claim
+        assert b.claim_stale(STREAM, "g", "c2", 0, 10) == []
+        assert rids  # all four delivered exactly once above
+
+    def test_hlen_counts_without_serializing(self, broker_pair):
+        """Drain-progress polling reads HLEN: counts must agree with
+        hgetall on every transport (and overwrites must not inflate)."""
+        a, b, _ = broker_pair
+        assert a.hlen("h") == 0
+        a.hset_many("h", {"u1": "r1", "u2": "r2"})
+        a.hset("h", "u1", "r1b")                    # overwrite
+        assert b.hlen("h") == 2 == len(b.hgetall("h"))
+
+
+def _identity_engine(broker, engine_id=None, registry=None, **kw):
+    im = InferenceModel().load_fn(lambda p, x: x * 2.0, params=())
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("batch_timeout_ms", 2)
+    return ClusterServing(im, broker=broker, engine_id=engine_id,
+                          registry=registry or MetricsRegistry(), **kw)
+
+
+def _wait_results(broker, n, timeout_s=30.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        res = broker.hgetall(RESULT_KEY)
+        if len(res) >= n:
+            return res
+        time.sleep(0.01)
+    return broker.hgetall(RESULT_KEY)
+
+
+class TestEngineClaimSweep:
+    def test_dead_peer_records_served_zero_loss(self):
+        """An engine's claim sweep adopts a killed peer's unacked
+        records: every accepted record produces a result."""
+        broker = MemoryBroker(redeliver_after_s=60.0)
+        inq = InputQueue(broker)
+        for i in range(6):
+            inq.enqueue(uri=f"k{i}", t=np.full(3, float(i), np.float32))
+        # the "killed engine": reads into its PEL, never acks, vanishes
+        dead = broker.read_group(STREAM, GROUP, "dead-engine", 6,
+                                 block_ms=50)
+        assert len(dead) == 6
+        reg = MetricsRegistry()
+        s = _identity_engine(broker, engine_id="e-live", registry=reg,
+                             claim_min_idle_s=0.05, claim_interval_s=0.05,
+                             heartbeat_interval_s=0.05).start()
+        try:
+            res = _wait_results(broker, 6)
+            assert sorted(res) == [f"k{i}" for i in range(6)]
+            m = s.metrics()
+            assert m["claimed_records"] == 6
+            assert m["records_served"] == 6
+        finally:
+            s.stop()
+        assert broker.pending_count(STREAM, GROUP) == 0
+
+    def test_sweep_never_reclaims_own_inflight(self):
+        """Aggressive claim windows shorter than batch processing must
+        not make an engine re-read its own in-flight records."""
+        broker = MemoryBroker(redeliver_after_s=60.0)
+        dead = None
+        inq = InputQueue(broker)
+        for i in range(6):
+            inq.enqueue(uri=f"s{i}", t=np.full(3, float(i), np.float32))
+        dead = broker.read_group(STREAM, GROUP, "dead", 6, block_ms=50)
+        assert len(dead) == 6
+        s = _identity_engine(broker, engine_id="e1",
+                             claim_min_idle_s=0.02,
+                             claim_interval_s=0.02).start()
+        try:
+            _wait_results(broker, 6)
+            time.sleep(0.3)        # extra sweeps must stay empty
+            m = s.metrics()
+            assert m["claimed_records"] == 6, \
+                "own in-flight records re-claimed"
+            assert m["records_read"] == 6
+        finally:
+            s.stop()
+
+    def test_two_engines_drain_one_stream(self):
+        """Two co-consumers over the real RESP2 wire: zero loss, no
+        double-serving, per-engine metric labels."""
+        srv = MiniRedisServer().start()
+        total = 48
+        engines = []
+        try:
+            inq = InputQueue(RedisBroker(srv.host, srv.port))
+            for i in range(total):
+                inq.enqueue(uri=f"t{i}",
+                            t=np.full(3, float(i), np.float32))
+            regs = [MetricsRegistry(), MetricsRegistry()]
+            for i in range(2):
+                engines.append(_identity_engine(
+                    RedisBroker(srv.host, srv.port),
+                    engine_id=f"e{i}", registry=regs[i],
+                    batch_size=4, heartbeat_interval_s=0.1).start())
+            poll = RedisBroker(srv.host, srv.port)
+            res = _wait_results(poll, total)
+            assert sorted(res) == sorted(f"t{i}" for i in range(total))
+            served = sum(e.records_served for e in engines)
+            assert served == total, \
+                f"{served} served for {total} records (dup or loss)"
+            # both heartbeats registered under their engine ids
+            hb = poll.hgetall(engines_key(STREAM))
+            assert set(hb) == {"e0", "e1"}
+            # engine label rides the serving series
+            for i, reg in enumerate(regs):
+                fam = reg.get("serving_records_total")
+                series = fam.snapshot()["series"]
+                assert all(s["labels"].get("engine") == f"e{i}"
+                           for s in series), series
+        finally:
+            for e in engines:
+                e.stop()
+            srv.stop()
+
+
+class TestIdempotentWriteback:
+    def test_redelivered_writeback_counts_duplicate_not_served(self):
+        reg = MetricsRegistry()
+        broker = MemoryBroker()
+        s = _identity_engine(broker, engine_id="e1", registry=reg)
+        entry = ({"u1": "r1", "u2": "r2"}, ["1-1", "1-2"],
+                 time.perf_counter(), time.perf_counter())
+        assert s._write_entry(entry)
+        assert s.records_served == 2
+        # the same records come back (claimed after a fake crash):
+        # identical result values, but served must not double-count
+        entry2 = ({"u1": "r1", "u2": "r2"}, ["1-1", "1-2"],
+                  time.perf_counter(), time.perf_counter())
+        assert s._write_entry(entry2)
+        assert s.records_served == 2
+        fam = reg.get("serving_records_total")
+        assert fam.value(outcome="served", engine="e1") == 2
+        assert fam.value(outcome="duplicate", engine="e1") == 2
+        # result data unchanged (deterministic overwrite, no corruption)
+        assert broker.hgetall(RESULT_KEY) == {"u1": "r1", "u2": "r2"}
+
+    def test_own_buffered_retry_counts_served_not_duplicate(self):
+        """An ambiguous partial commit (HSET applied, reply lost) makes
+        the flush's new-field count read 0 — but this engine computed
+        and served those records exactly once: served, not duplicate."""
+        reg = MetricsRegistry()
+        broker = MemoryBroker()
+        s = _identity_engine(broker, engine_id="e1", registry=reg)
+        # simulate the partial commit: results landed, ack/reply lost
+        broker.hset_many(RESULT_KEY, {"u1": "r1", "u2": "r2"})
+        entry = ({"u1": "r1", "u2": "r2"}, ["1-1", "1-2"],
+                 time.perf_counter(), time.perf_counter())
+        s._wb_buffer.append(entry)
+        s._flush_writebacks()
+        assert not s._wb_buffer
+        assert s.records_served == 2
+        fam = reg.get("serving_records_total")
+        assert fam.value(outcome="served", engine="e1") == 2
+        assert fam.value(outcome="duplicate", engine="e1") == 0
+
+
+class TestFleetGateway:
+    def _get(self, url):
+        r = urllib.request.urlopen(url, timeout=5)
+        return r.status, json.load(r)
+
+    def test_standalone_frontend_stays_200(self):
+        fe = FrontEnd(MemoryBroker(), None, host="127.0.0.1", port=0,
+                      registry=MetricsRegistry()).start()
+        try:
+            code, body = self._get(
+                f"http://127.0.0.1:{fe.port}/healthz")
+            assert code == 200 and body["engine"] is None
+            assert "fleet" not in body
+        finally:
+            fe.stop()
+
+    def test_gateway_tracks_engine_lifecycle(self):
+        broker = MemoryBroker()
+        reg = MetricsRegistry()
+        fe = FrontEnd(broker, None, host="127.0.0.1", port=0,
+                      fleet_stream=STREAM, engine_ttl_s=1.0,
+                      registry=reg).start()
+        url = f"http://127.0.0.1:{fe.port}"
+        try:
+            # no engines yet: 503 + Retry-After, reason states it
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/healthz", timeout=5)
+            assert ei.value.code == 503
+            assert ei.value.headers["Retry-After"]
+            assert json.load(ei.value)["reason"] == \
+                "no serving engine alive"
+            # /predict refuses admission the same way
+            req = urllib.request.Request(
+                url + "/predict", data=b'{"instances": [[1.0]]}',
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 503
+
+            s = _identity_engine(broker, engine_id="e1",
+                                 heartbeat_interval_s=0.05).start()
+            time.sleep(0.3)
+            code, body = self._get(url + "/healthz")
+            assert code == 200 and body["fleet"]["ready"] == 1
+            assert body["fleet"]["engines"]["e1"]["alive"]
+            # /metrics: JSON fleet section + the gauge family
+            code, m = self._get(url + "/metrics")
+            assert m["fleet"]["alive"] == 1
+            assert reg.get("serving_engines_alive").value() == 1
+            assert reg.get("serving_engines_total").value() == 1
+
+            s.stop()               # clean stop deregisters immediately
+            fe.fleet.poll(force=True)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/healthz", timeout=5)
+            assert ei.value.code == 503
+            assert reg.get("serving_engines_alive").value() == 0
+            # total engines EVER seen stays 1 (a counter, not a gauge)
+            assert reg.get("serving_engines_total").value() == 1
+        finally:
+            fe.stop()
+
+    def test_killed_engine_ages_out_by_ttl(self):
+        """A SIGKILLed engine never deregisters — the gateway must drop
+        it once the heartbeat goes stale."""
+        broker = MemoryBroker()
+        reg = MetricsRegistry()
+        tracker = FleetTracker(broker, STREAM, ttl_s=0.25, registry=reg)
+        hb = HeartbeatPublisher(broker, STREAM, "doomed",
+                                lambda: {"ready": True},
+                                interval_s=0.05,
+                                registry=MetricsRegistry()).start()
+        try:
+            deadline = time.time() + 5
+            while tracker.alive_count() != 1 and time.time() < deadline:
+                time.sleep(0.02)
+            assert tracker.alive_count() == 1
+            hb.stop(deregister=False)          # the SIGKILL analogue
+            assert broker.hget(engines_key(STREAM), "doomed")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if tracker.poll(force=True) is not None \
+                        and tracker.alive_count() == 0:
+                    break
+                time.sleep(0.05)
+            assert tracker.alive_count() == 0
+        finally:
+            tracker.close()
+
+    def test_liveness_survives_cross_host_clock_skew(self):
+        """Liveness is locally-observed heartbeat PROGRESS: an engine
+        whose clock runs far ahead/behind the gateway's stays alive
+        while it beats, and ages out once it stops."""
+        broker = MemoryBroker()
+        tracker = FleetTracker(broker, STREAM, ttl_s=0.3,
+                               registry=MetricsRegistry(),
+                               poll_min_interval_s=0.0)
+        skew = -4000.0     # engine clock 4000 s behind the gateway
+        seq = [0]
+
+        def beat():
+            seq[0] += 1
+            broker.hset(engines_key(STREAM), "skewed", json.dumps(
+                {"engine_id": "skewed", "ready": True,
+                 "ts": time.time() + skew + 0.01 * seq[0]}))
+
+        beat()
+        assert tracker.poll(force=True)["skewed"]["alive"]
+        for _ in range(3):          # keeps beating -> stays alive
+            time.sleep(0.12)
+            beat()
+            assert tracker.alive_count() == 1, "skew killed a live engine"
+        deadline = time.time() + 5  # stops beating -> ages out by TTL
+        while tracker.alive_count() and time.time() < deadline:
+            time.sleep(0.05)
+        assert tracker.alive_count() == 0
+        tracker.close()
+
+    def test_dead_rows_purged_from_registry(self):
+        """A crashed engine's leftover row (never HDEL'd) must not grow
+        the hash forever: once long past the TTL it is purged."""
+        broker = MemoryBroker()
+        tracker = FleetTracker(broker, STREAM, ttl_s=0.05,
+                               registry=MetricsRegistry(),
+                               poll_min_interval_s=0.0)
+        # leftover from before this gateway: a frozen ts. First sight
+        # reads fresh (liveness is clock-skew-independent, so a new
+        # gateway can't tell a leftover from a skewed live engine for
+        # one TTL), then it ages out and is purged at 10x TTL.
+        broker.hset(engines_key(STREAM), "crashed-old", json.dumps(
+            {"engine_id": "crashed-old", "ts": time.time() - 3600}))
+        tracker.poll(force=True)
+        time.sleep(0.08)                      # > ttl: ages out
+        assert not tracker.poll(force=True)["crashed-old"]["alive"]
+        deadline = time.time() + 5
+        while broker.hget(engines_key(STREAM), "crashed-old") \
+                and time.time() < deadline:
+            time.sleep(0.02)
+            tracker.poll(force=True)
+        assert broker.hget(engines_key(STREAM), "crashed-old") is None
+        assert "crashed-old" not in (tracker.poll(force=True) or {})
+        tracker.close()
+
+    def test_engine_beating_not_ready_is_not_capacity(self):
+        broker = MemoryBroker()
+        tracker = FleetTracker(broker, STREAM, ttl_s=5.0,
+                               registry=MetricsRegistry())
+        hb = HeartbeatPublisher(broker, STREAM, "sick",
+                                lambda: {"ready": False},
+                                interval_s=0.05,
+                                registry=MetricsRegistry()).start()
+        try:
+            time.sleep(0.2)
+            assert tracker.poll(force=True)["sick"]["alive"]
+            assert tracker.alive_count() == 0
+            summary = tracker.summary()
+            assert summary["alive"] == 1 and summary["ready"] == 0
+        finally:
+            hb.stop()
+            tracker.close()
+
+    def test_local_engine_healthz_carries_fleet_section(self):
+        broker = MemoryBroker()
+        s = _identity_engine(broker, engine_id="e1",
+                             heartbeat_interval_s=0.05).start()
+        fe = FrontEnd(broker, s, host="127.0.0.1", port=0,
+                      fleet_stream=STREAM, engine_ttl_s=2.0,
+                      registry=MetricsRegistry()).start()
+        try:
+            time.sleep(0.2)
+            code, body = self._get(
+                f"http://127.0.0.1:{fe.port}/healthz")
+            assert code == 200 and body["ready"]
+            assert body["fleet"]["engines"]["e1"]["alive"]
+        finally:
+            fe.stop()
+            s.stop()
+
+    def test_unreachable_broker_is_503_not_200(self):
+        class DeadBroker(MemoryBroker):
+            def hgetall(self, key):
+                raise ConnectionError("broker down")
+
+        fe = FrontEnd(DeadBroker(), None, host="127.0.0.1", port=0,
+                      fleet_stream=STREAM,
+                      registry=MetricsRegistry()).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/healthz", timeout=5)
+            assert ei.value.code == 503
+            assert json.load(ei.value)["reason"] == "broker unreachable"
+        finally:
+            fe.stop()
+
+
+class TestFleetConfig:
+    def _load(self, tmp_path, params):
+        cfg_file = tmp_path / "config.yaml"
+        lines = ["model:", "  path: /tmp/nope", "params:"]
+        lines += [f"  {k}: {v}" for k, v in params.items()]
+        cfg_file.write_text("\n".join(lines) + "\n")
+        from analytics_zoo_tpu.serving.config import ServingConfig
+        return ServingConfig.load(str(cfg_file))
+
+    def test_fleet_params_parse(self, tmp_path):
+        cfg = self._load(tmp_path, {
+            "engine_id": "auto", "heartbeat_interval_s": 0.5,
+            "engine_ttl_s": 2, "claim_min_idle_s": 4,
+            "claim_interval_s": 1})
+        assert cfg.engine_id == "auto"
+        assert cfg.heartbeat_interval_s == 0.5
+        assert cfg.claim_min_idle_s == 4.0
+        eid = cfg.resolve_engine_id()
+        assert eid and eid.startswith("engine-")
+        assert cfg.resolve_engine_id() != eid    # unique per call
+
+    def test_explicit_engine_id_and_default_off(self, tmp_path):
+        cfg = self._load(tmp_path, {"engine_id": "edge-1"})
+        assert cfg.resolve_engine_id() == "edge-1"
+        cfg2 = self._load(tmp_path, {})
+        assert cfg2.engine_id is None
+        assert cfg2.resolve_engine_id() is None
+
+    def test_ttl_must_exceed_heartbeat(self, tmp_path):
+        with pytest.raises(ValueError, match="engine_ttl_s"):
+            self._load(tmp_path, {"heartbeat_interval_s": 5,
+                                  "engine_ttl_s": 2})
+
+    def test_non_positive_fleet_knobs_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="claim_interval_s"):
+            self._load(tmp_path, {"claim_interval_s": 0})
+
+    def test_gateway_cli_rejects_zero_ttl(self):
+        from analytics_zoo_tpu.serving.cli import main
+        with pytest.raises(SystemExit, match="engine-ttl"):
+            main(["gateway", "--engine-ttl", "0"])
