@@ -227,6 +227,9 @@ class ResilientBroker(Broker):
     def pending_count(self, stream, group):
         return self._guard("pending_count", stream, group)
 
+    def stream_depth(self, stream):
+        return self._guard("stream_depth", stream)
+
     def writeback(self, key, mapping, stream, group, ids):
         return self._guard("writeback", key, mapping, stream, group, ids)
 

@@ -84,6 +84,15 @@ class Broker:
         summary count) — what a crashed consumer may still owe."""
         raise NotImplementedError
 
+    def stream_depth(self, stream: str) -> int:
+        """Entries still in the stream (XLEN). The sink XDELs on ack, so
+        this is the live backlog: records enqueued but not yet committed
+        (undelivered + in-flight). The elastic layer's one load signal —
+        the admission controller's 429 threshold, the adaptive batcher's
+        light/heavy-load switch, and the autoscaler's scale trigger all
+        read it (ISSUE 11)."""
+        raise NotImplementedError
+
     def hset(self, key: str, field: str, value: str) -> int:
         """Returns the number of NEW fields created (0 when `field`
         already existed — Redis HSET semantics). The sink uses this to
@@ -227,6 +236,10 @@ class MemoryBroker(Broker):
         with self._lock:
             return len(self._pending.get((stream, group), {}))
 
+    def stream_depth(self, stream):
+        with self._lock:
+            return len(self._streams.get(stream, ()))
+
     def hset(self, key, field, value):
         with self._lock:
             h = self._hashes.setdefault(key, {})
@@ -362,6 +375,9 @@ class TCPBroker(Broker):
 
     def pending_count(self, stream, group):
         return self._call("pending_count", stream, group)
+
+    def stream_depth(self, stream):
+        return self._call("stream_depth", stream)
 
     def hset(self, key, field, value):
         return self._call("hset", key, field, value)
@@ -617,6 +633,9 @@ class RedisBroker(Broker):
         # XPENDING summary form: [count, min-id, max-id, consumers]
         resp = self._r.command("XPENDING", stream, group)
         return int(resp[0]) if isinstance(resp, list) and resp else 0
+
+    def stream_depth(self, stream):
+        return int(self._r.command("XLEN", stream) or 0)
 
     def hset(self, key, field, value):
         return self._r.command("HSET", key, field, value)

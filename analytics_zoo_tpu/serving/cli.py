@@ -63,7 +63,10 @@ def cmd_start(args) -> int:
             # fleet mode: the frontend doubles as the fleet gateway
             # (engine heartbeats -> /healthz + serving_engines_* gauges)
             fleet_stream=cfg.stream if engine_id else None,
-            engine_ttl_s=cfg.engine_ttl_s).start()
+            engine_ttl_s=cfg.engine_ttl_s,
+            # tiered admission (ISSUE 11): cheap early 429s per tier
+            admission=cfg.build_admission(broker),
+            admission_header=cfg.admission_header).start()
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     model = cfg.build_model(broker=broker)
@@ -121,7 +124,25 @@ def cmd_start(args) -> int:
                              claim_min_idle_s=cfg.claim_min_idle_s,
                              claim_interval_s=cfg.claim_interval_s,
                              heartbeat_interval_s=cfg
-                             .heartbeat_interval_s).start()
+                             .heartbeat_interval_s,
+                             batch_policy=cfg.batch_policy,
+                             deadline_ms=cfg.deadline_ms,
+                             batch_margin_ms=cfg.batch_margin_ms,
+                             admission_tiers=cfg.admission_tiers,
+                             admission_field=cfg.admission_field,
+                             shed_backlog=cfg.shed_backlog).start()
+    if cfg.batch_policy != "fixed":
+        print(f"batching: policy={cfg.batch_policy}"
+              + (f" deadline={cfg.deadline_ms:g}ms"
+                 if cfg.deadline_ms is not None else
+                 (f" deadline={cfg.slo_latency_ms:g}ms (from slo)"
+                  if cfg.slo_latency_ms is not None else "")),
+              flush=True)
+    if cfg.admission_tiers:
+        print(f"admission tiers (low->high): "
+              f"{','.join(cfg.admission_tiers)} "
+              f"(429 at {cfg.admission_max_backlog} backlog, shed at "
+              f"{cfg.shed_backlog})", flush=True)
     if engine_id:
         print(f"engine id {engine_id} (fleet member; claim window "
               f"{cfg.claim_min_idle_s:g}s)", flush=True)
@@ -166,23 +187,137 @@ def cmd_gateway(args) -> int:
     """Engine-less fleet gateway (ISSUE 10): an HTTP frontend that
     tracks engine heartbeats on the broker and answers `/healthz` /
     `/metrics` for the whole fleet — run it on the edge while N
-    `start --engine-id auto` engine processes drain the stream."""
+    `start --engine-id auto` engine processes drain the stream.
+
+    `--autoscale` (ISSUE 11) additionally runs a `FleetAutoscaler`
+    here: the gateway watches backlog depth and heartbeat-reported SLO
+    burn and spawns/retires `start --engine-id auto` engine processes
+    (children of this gateway) between `--min-engines` and
+    `--max-engines`, with hysteresis so a spike can't flap the fleet.
+    Retirement is a clean SIGTERM — the engine deregisters and drains,
+    and the claim sweep moves anything left to peers. Requires
+    `--engine-config`, the serving config the spawned engines run."""
+    import subprocess
+
     from analytics_zoo_tpu.serving.broker import connect_broker
+    from analytics_zoo_tpu.serving.config import ServingConfig
     from analytics_zoo_tpu.serving.http_frontend import FrontEnd
     if args.engine_ttl <= 0:
         # same contract as the params path (_validate_fleet): a zero
         # TTL flaps every beating engine dead — fail at launch
         raise SystemExit(
             f"--engine-ttl {args.engine_ttl:g} must be > 0")
+    engine_cfg = ServingConfig.load(args.engine_config) \
+        if args.engine_config else None
+    admission = None
+    admission_header = "X-Priority"
+    broker = connect_broker(args.broker)
+    if args.admission_tiers:
+        # explicit CLI tiers win over the config block
+        from analytics_zoo_tpu.serving.elastic import AdmissionController
+        tiers = [t.strip() for t in args.admission_tiers.split(",")
+                 if t.strip()]
+        admission = AdmissionController(
+            broker.clone(), args.stream, tiers,
+            max_backlog=args.admission_max_backlog)
+    elif engine_cfg is not None and engine_cfg.admission_tiers:
+        # the engine config's params.admission block IS the fleet's
+        # admission policy — the gateway must enforce the same tiers
+        # the engines schedule/shed by, or the documented early 429
+        # silently never engages. Sampled on THIS gateway's --stream
+        # (the stream the fleet actually drains).
+        from analytics_zoo_tpu.serving.elastic import AdmissionController
+        admission = AdmissionController(
+            broker.clone(), args.stream, engine_cfg.admission_tiers,
+            max_backlog=engine_cfg.admission_max_backlog)
+    if engine_cfg is not None:
+        admission_header = engine_cfg.admission_header
     frontend = FrontEnd(
-        connect_broker(args.broker), None, host=args.host,
+        broker, None, host=args.host,
         port=args.port, fleet_stream=args.stream,
         engine_ttl_s=args.engine_ttl,
-        tokens_per_second=args.tokens_per_second).start()
+        tokens_per_second=args.tokens_per_second,
+        admission=admission,
+        admission_header=admission_header).start()
     print(f"fleet gateway on :{frontend.port} "
           f"(stream {args.stream}, engine ttl {args.engine_ttl:g}s)",
           flush=True)
-    return _run_until_signal(frontend.stop)
+    import threading
+
+    scaler = None
+    children = []
+    retired = []        # SIGTERMed, still draining: shutdown reaps them
+    stopping = threading.Event()
+    if args.autoscale:
+        if engine_cfg is None:
+            raise SystemExit("--autoscale needs --engine-config (the "
+                             "serving config spawned engines run)")
+        # config knobs (params.autoscale) seed the defaults; explicit
+        # gateway flags override
+        knobs = dict(engine_cfg.autoscale or {})
+        knobs["min_engines"] = args.min_engines \
+            if args.min_engines is not None \
+            else knobs.get("min_engines", 1)
+        knobs["max_engines"] = args.max_engines \
+            if args.max_engines is not None \
+            else knobs.get("max_engines", 4)
+
+        def spawn():
+            if stopping.is_set():
+                # a tick wedged in broker I/O can outlive the 5 s join
+                # in scaler.stop() and fire after shutdown reaped the
+                # children — it must not orphan a fresh engine
+                return None
+            children.append(subprocess.Popen(
+                [sys.executable, "-m", "analytics_zoo_tpu.serving.cli",
+                 "start", "--config", args.engine_config,
+                 "--engine-id", "auto"]))
+            return children[-1]
+
+        def retire() -> bool:
+            # newest live child first: LIFO keeps long-lived engines'
+            # warm OS caches; a clean SIGTERM drains + deregisters.
+            # The retiree moves to `retired` (not dropped): shutdown
+            # must still wait on — and, if it wedges draining, kill —
+            # every child this gateway ever spawned
+            for p in reversed(children):
+                if p.poll() is None:
+                    p.terminate()
+                    children.remove(p)
+                    retired.append(p)
+                    return True
+            return False
+
+        from analytics_zoo_tpu.serving.fleet import FleetAutoscaler
+        scaler = FleetAutoscaler(
+            frontend.fleet, broker.clone(), args.stream, spawn, retire,
+            # an admission-enabled gateway already samples the stream
+            # depth on its own cadence: share the probe instead of
+            # running a second poller against the same stream (and
+            # flapping the shared serving_backlog_depth gauge)
+            backlog_fn=admission.backlog if admission is not None
+            else None,
+            **knobs).start()
+        print(f"autoscaler: engines [{scaler.min_engines}, "
+              f"{scaler.max_engines}], backlog "
+              f"{scaler.backlog_low:g}/{scaler.backlog_high:g} per "
+              f"engine, burn>={scaler.burn_high:g} scales up", flush=True)
+
+    def shutdown():
+        stopping.set()
+        if scaler is not None:
+            scaler.stop()
+        for p in children:
+            if p.poll() is None:
+                p.terminate()
+        for p in children + retired:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        frontend.stop()
+
+    return _run_until_signal(shutdown)
 
 
 def cmd_broker(args) -> int:
@@ -251,6 +386,23 @@ def main(argv=None) -> int:
                     help="seconds without a heartbeat before an engine "
                          "counts dead")
     pg.add_argument("--tokens-per-second", type=float, default=None)
+    pg.add_argument("--autoscale", action="store_true",
+                    help="run the SLO-driven engine autoscaler on this "
+                         "gateway (spawns/retires 'start --engine-id "
+                         "auto' children; needs --engine-config)")
+    pg.add_argument("--engine-config", default=None,
+                    help="serving config the autoscaler's spawned "
+                         "engines run (its params.autoscale block "
+                         "seeds the scaler's thresholds)")
+    pg.add_argument("--min-engines", type=int, default=None,
+                    help="autoscaler floor (default: config, else 1)")
+    pg.add_argument("--max-engines", type=int, default=None,
+                    help="autoscaler ceiling (default: config, else 4)")
+    pg.add_argument("--admission-tiers", default=None,
+                    help="comma-joined priority tiers, lowest first "
+                         "(enables tiered 429 admission on /predict)")
+    pg.add_argument("--admission-max-backlog", type=int, default=512,
+                    help="backlog at which even the top tier gets 429s")
     pg.set_defaults(fn=cmd_gateway)
     pb = sub.add_parser("broker", help="run a standalone TCP broker")
     pb.add_argument("--host", default="0.0.0.0")

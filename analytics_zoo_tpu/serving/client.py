@@ -30,8 +30,13 @@ class InputQueue:
             else connect_broker(broker)
         self.stream = stream
 
-    def enqueue(self, uri: Optional[str] = None, **data) -> str:
-        """`enqueue("uuid", t=ndarray)` or path/bytes via `image=`."""
+    def enqueue(self, uri: Optional[str] = None, tier: Optional[str] = None,
+                **data) -> str:
+        """`enqueue("uuid", t=ndarray)` or path/bytes via `image=`.
+        `tier` (ISSUE 11) names the record's priority class — the
+        engine's tiered scheduler dispatches higher tiers first and
+        sheds the lowest tier first under overload; records without one
+        rank lowest."""
         uri = uri or uuid.uuid4().hex
         payload: Dict = {}
         for name, value in data.items():
@@ -41,7 +46,10 @@ class InputQueue:
                 payload[name] = self._encode_image(value)
             else:
                 payload[name] = value
-        self.broker.xadd(self.stream, {"uri": uri, "data": payload})
+        record = {"uri": uri, "data": payload}
+        if tier is not None:
+            record["tier"] = str(tier)
+        self.broker.xadd(self.stream, record)
         return uri
 
     @staticmethod
@@ -53,11 +61,14 @@ class InputQueue:
         arr = load_image(value)
         return encode_ndarray(arr.astype(np.float32))
 
-    def predict(self, data: np.ndarray, timeout_s: float = 30.0) -> np.ndarray:
+    def predict(self, data: np.ndarray, timeout_s: float = 30.0,
+                tier: Optional[str] = None) -> np.ndarray:
         """Sync path (`client.py:199`): enqueue then poll the result."""
-        return self.predict_batch([np.asarray(data)], timeout_s)[0]
+        return self.predict_batch([np.asarray(data)], timeout_s,
+                                  tier=tier)[0]
 
-    def predict_batch(self, samples, timeout_s: float = 30.0) -> list:
+    def predict_batch(self, samples, timeout_s: float = 30.0,
+                      tier: Optional[str] = None) -> list:
         """Sync multi-record path: each sample is ONE serving record (the
         per-instance contract of the reference frontend — records batch up
         inside the serving loop, not inside one record). Results return in
@@ -68,7 +79,8 @@ class InputQueue:
         polls back off exponentially from 1 ms to a 50 ms cap instead of
         hammering the broker at a fixed tight interval; any progress
         resets the backoff so a streaming burst is drained promptly."""
-        uris = [self.enqueue(None, t=np.asarray(s)) for s in samples]
+        uris = [self.enqueue(None, tier=tier, t=np.asarray(s))
+                for s in samples]
         out = OutputQueue(self.broker, self.stream)
         results: dict = {}
         deadline = time.monotonic() + timeout_s
@@ -130,6 +142,8 @@ class OutputQueue:
     def _decode(raw: str):
         if raw == "NaN":   # per-record failure marker
             return float("nan")
+        if raw == "SHED":  # admission shed (ISSUE 11): an answered
+            return raw     # rejection — distinguishable from a failure
         if raw.startswith("["):  # filtered result string, e.g. topN(5)
             return raw
         return decode_ndarray(json.loads(raw))

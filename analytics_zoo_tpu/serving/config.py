@@ -108,6 +108,23 @@ class ServingConfig:
     engine_ttl_s: float = 6.0
     claim_min_idle_s: float = 30.0
     claim_interval_s: float = 5.0
+    # elastic serving (ISSUE 11, docs/ProgrammingGuide/cluster-serving.md
+    # "Elastic serving"): params.batching selects the reader's
+    # micro-batching policy (adaptive | fixed | static) and its deadline
+    # budget (defaults to slo.latency_ms when unset); params.admission
+    # declares priority tiers (lowest first), the HTTP header/record
+    # field that carries them, the gateway 429 threshold and the
+    # engine-side shed threshold; params.autoscale bounds and tunes the
+    # gateway's SLO-driven engine autoscaler
+    batch_policy: str = "adaptive"
+    deadline_ms: Optional[float] = None
+    batch_margin_ms: float = 2.0
+    admission_tiers: Optional[list] = None
+    admission_header: str = "X-Priority"
+    admission_field: str = "tier"
+    admission_max_backlog: int = 512
+    shed_backlog: Optional[int] = None
+    autoscale: Optional[Dict[str, Any]] = None
     # shape-bucket pre-warming: list of per-record shapes, e.g.
     # [[32, 32, 3]] (or the string "32x32x3,224x224x3" in bare-parser
     # YAML) — every bucket of each shape pre-compiles at load so no XLA
@@ -219,6 +236,54 @@ class ServingConfig:
         cfg.claim_min_idle_s = float(params.get("claim_min_idle_s", 30.0))
         cfg.claim_interval_s = float(params.get("claim_interval_s", 5.0))
         cfg._validate_fleet()
+        batching = params.get("batching", {}) or {}
+        if not isinstance(batching, dict):
+            raise ValueError(
+                f"params.batching={batching!r} must be a map (policy, "
+                "deadline_ms, margin_ms)")
+        cfg.batch_policy = str(batching.get("policy", "adaptive"))
+        if batching.get("deadline_ms") is not None:
+            cfg.deadline_ms = float(batching["deadline_ms"])
+        cfg.batch_margin_ms = float(batching.get("margin_ms", 2.0))
+        admission = params.get("admission", {}) or {}
+        if not isinstance(admission, dict):
+            raise ValueError(
+                f"params.admission={admission!r} must be a map (tiers, "
+                "header, field, max_backlog, shed_backlog)")
+        cfg.admission_tiers = _parse_tiers(admission.get("tiers"))
+        cfg.admission_header = str(admission.get("header", "X-Priority"))
+        cfg.admission_field = str(admission.get("field", "tier"))
+        cfg.admission_max_backlog = int(admission.get("max_backlog", 512))
+        if admission.get("shed_backlog") is not None:
+            cfg.shed_backlog = int(admission["shed_backlog"])
+        elif cfg.admission_tiers:
+            # default: the engine starts shedding at twice the gateway's
+            # hard 429 line — admission throttles first, shed is the
+            # backstop for producers that bypass the gateway
+            cfg.shed_backlog = 2 * cfg.admission_max_backlog
+        autoscale = params.get("autoscale", None)
+        if autoscale is not None and not isinstance(autoscale, dict):
+            raise ValueError(
+                f"params.autoscale={autoscale!r} must be a map "
+                "(min_engines, max_engines, backlog_high, backlog_low, "
+                "up_stable_s, down_stable_s, cooldown_s, interval_s, "
+                "burn_high)")
+        if autoscale is not None:
+            cfg.autoscale = {
+                "min_engines": int(autoscale.get("min_engines", 1)),
+                "max_engines": int(autoscale.get("max_engines", 4)),
+                "backlog_high": float(autoscale.get("backlog_high", 64)),
+                "backlog_low": float(autoscale.get("backlog_low", 8)),
+                "burn_high": float(autoscale.get("burn_high", 1.0)),
+                "up_stable_s": float(autoscale.get("up_stable_s", 2.0)),
+                "down_stable_s": float(
+                    autoscale.get("down_stable_s", 10.0)),
+                "cooldown_s": float(autoscale.get("cooldown_s", 5.0)),
+                "interval_s": float(autoscale.get("interval_s", 1.0)),
+                "spawn_grace_s": float(
+                    autoscale.get("spawn_grace_s", 30.0)),
+            }
+        cfg._validate_elastic()
         cfg.warmup_shapes = _parse_warmup_shapes(
             params.get("warmup_shapes"))
         cfg.warmup_dtype = str(params.get("warmup_dtype", "float32"))
@@ -339,6 +404,53 @@ class ServingConfig:
         if self.engine_id is not None and not str(self.engine_id).strip():
             raise ValueError("params.engine_id must be a non-empty "
                              "string, 'auto', or unset")
+
+    def _validate_elastic(self):
+        """Elastic knobs fail at config load like the rest (ISSUE 11):
+        a bad policy string, a non-positive deadline, duplicate tiers,
+        or inverted autoscaler thresholds are operator errors, not
+        runtime surprises inside the reader or the control loop."""
+        from analytics_zoo_tpu.serving.elastic import (
+            AdaptiveBatchController, TierTable)
+        if self.batch_policy not in AdaptiveBatchController.POLICIES:
+            raise ValueError(
+                f"params.batching.policy={self.batch_policy!r} is not "
+                f"one of {'/'.join(AdaptiveBatchController.POLICIES)}")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"params.batching.deadline_ms={self.deadline_ms} must "
+                "be > 0")
+        if self.batch_margin_ms < 0:
+            raise ValueError(
+                f"params.batching.margin_ms={self.batch_margin_ms} "
+                "must be >= 0")
+        if self.admission_tiers is not None:
+            TierTable(self.admission_tiers)   # raises on empty/dupes
+        if self.admission_max_backlog <= 0:
+            raise ValueError(
+                f"params.admission.max_backlog="
+                f"{self.admission_max_backlog} must be > 0")
+        if self.shed_backlog is not None and self.shed_backlog <= 0:
+            raise ValueError(
+                f"params.admission.shed_backlog={self.shed_backlog} "
+                "must be > 0")
+        if self.autoscale is not None:
+            # ONE validator, shared with FleetAutoscaler.__init__ —
+            # the bounds cannot drift between config load and the
+            # gateway's construction
+            from analytics_zoo_tpu.serving.fleet import validate_autoscale
+            validate_autoscale(self.autoscale,
+                               prefix="params.autoscale.")
+
+    def build_admission(self, broker, registry=None):
+        """The gateway-side `AdmissionController` this config declares
+        (None when no tiers are configured)."""
+        if not self.admission_tiers:
+            return None
+        from analytics_zoo_tpu.serving.elastic import AdmissionController
+        return AdmissionController(
+            broker, self.stream, self.admission_tiers,
+            max_backlog=self.admission_max_backlog, registry=registry)
 
     def resolve_engine_id(self) -> Optional[str]:
         """The engine id `cmd_start` hands to ClusterServing: None when
@@ -498,6 +610,17 @@ def _parse_bytes(raw) -> Optional[int]:
             pass
     raise ValueError(f"cannot parse byte count {raw!r} "
                      '(use an int, or "512K"/"128M"/"2G")')
+
+
+def _parse_tiers(raw) -> Optional[list]:
+    """Priority tiers from config, lowest first: a YAML list of names,
+    or (bare-parser friendly) one comma-joined string "batch,standard,
+    premium"."""
+    if raw is None:
+        return None
+    if isinstance(raw, str):
+        return [p.strip() for p in raw.split(",") if p.strip()] or None
+    return [str(t) for t in raw] or None
 
 
 def _parse_warmup_shapes(raw) -> Optional[list]:

@@ -303,3 +303,268 @@ class FleetTracker:
         this tracker (and its broker connection) in the process-wide
         registry."""
         self._alive_gauge.release_function(self._alive_fn, freeze=True)
+
+
+def validate_autoscale(knobs: Dict, prefix: str = "") -> None:
+    """Shared validation for the autoscaler's knob set — called by
+    `FleetAutoscaler.__init__` AND `ServingConfig._validate_elastic`
+    so the bounds cannot drift between config load and construction
+    (a config-accepted value the constructor rejects would crash
+    `cmd_gateway` after the frontend is already up). `prefix` names
+    the config spelling ("params.autoscale.") in load-time errors."""
+    if knobs["min_engines"] < 1:
+        raise ValueError(
+            f"{prefix}min_engines={knobs['min_engines']} must be >= 1")
+    if knobs["max_engines"] < knobs["min_engines"]:
+        raise ValueError(
+            f"{prefix}max_engines={knobs['max_engines']} must be >= "
+            f"min_engines={knobs['min_engines']}")
+    if knobs["backlog_low"] >= knobs["backlog_high"]:
+        raise ValueError(
+            f"{prefix}backlog_low={knobs['backlog_low']:g} must be "
+            f"below backlog_high={knobs['backlog_high']:g}: equal "
+            "thresholds flap")
+    for name in ("up_stable_s", "down_stable_s", "cooldown_s",
+                 "interval_s", "spawn_grace_s", "burn_high"):
+        if knobs[name] <= 0:
+            raise ValueError(
+                f"{prefix}{name}={knobs[name]:g} must be > 0")
+
+
+class FleetAutoscaler:
+    """SLO-driven engine autoscaling on the gateway (ISSUE 11).
+
+    A control loop that watches two signals and spawns/retires engine
+    processes through caller-supplied hooks:
+
+    - **backlog depth** — the broker's stream depth (undelivered plus
+      in-flight records; the sink XDELs on commit, so this is exactly
+      the unserved work). Scaling on queue depth instead of request
+      rate is what makes the loop model-free: an expensive model backs
+      the queue up at a request rate a cheap model would shrug off.
+    - **SLO burn rate** — the worst ``slo_burn`` any alive engine
+      reports in its heartbeat row (`ClusterServing._heartbeat_payload`
+      publishes it when objectives are configured): latency already
+      burning budget is a scale-up signal even while the backlog still
+      looks shallow.
+
+    Decisions are hysteretic: the overload signal must hold for
+    ``up_stable_s`` before a spawn, the idle signal for
+    ``down_stable_s`` before a retire, and any action starts a
+    ``cooldown_s`` window in which no further action fires — a spike
+    cannot flap the fleet, and scale-down is deliberately the slower
+    direction. Bounds are hard: never below ``min_engines``, never
+    above ``max_engines``.
+
+    Scale-up is cheap by construction: every engine warms from the
+    shared persistent compile cache (PR 10), so a new process costs
+    ~0 cold compiles. Scale-down is a CLEAN stop (`retire_fn` should
+    SIGTERM): the engine deregisters, drains, and whatever it had
+    in-flight redelivers to peers via the claim sweep — proven under
+    SIGKILL, so the graceful path is strictly safer.
+
+    `spawn_fn()` must start one engine; `retire_fn()` must stop one and
+    return True (False = nothing retirable, e.g. every child already
+    exited — the desired count is then reconciled downward). The
+    decision core is `tick(now)`, a pure function of the observed state
+    and the clock, so tests drive it without threads or sleeps; `start`
+    runs it on a daemon thread every `interval_s` (a timed Event.wait —
+    the control path never parks untimed, see
+    scripts/check_blocking_calls.py)."""
+
+    def __init__(self, tracker: FleetTracker, broker: Broker,
+                 stream: str, spawn_fn: Callable[[], object],
+                 retire_fn: Callable[[], bool],
+                 min_engines: int = 1, max_engines: int = 4,
+                 backlog_high: float = 64.0, backlog_low: float = 8.0,
+                 burn_high: float = 1.0,
+                 up_stable_s: float = 2.0, down_stable_s: float = 10.0,
+                 cooldown_s: float = 5.0, interval_s: float = 1.0,
+                 spawn_grace_s: float = 30.0, registry=None,
+                 backlog_fn: Optional[Callable[[], Optional[int]]]
+                 = None):
+        validate_autoscale({
+            "min_engines": min_engines, "max_engines": max_engines,
+            "backlog_high": backlog_high, "backlog_low": backlog_low,
+            "burn_high": burn_high, "up_stable_s": up_stable_s,
+            "down_stable_s": down_stable_s, "cooldown_s": cooldown_s,
+            "interval_s": interval_s, "spawn_grace_s": spawn_grace_s})
+        self.tracker = tracker
+        self.broker = broker
+        self.stream = stream
+        self.spawn_fn = spawn_fn
+        self.retire_fn = retire_fn
+        # a gateway that already samples the stream (the admission
+        # controller) shares its rate-limited probe via backlog_fn
+        # instead of this loop running a second poller on the same key
+        self.backlog_fn = backlog_fn
+        self.min_engines = int(min_engines)
+        self.max_engines = int(max_engines)
+        self.backlog_high = float(backlog_high)
+        self.backlog_low = float(backlog_low)
+        self.burn_high = float(burn_high)
+        self.up_stable_s = float(up_stable_s)
+        self.down_stable_s = float(down_stable_s)
+        self.cooldown_s = float(cooldown_s)
+        self.interval_s = float(interval_s)
+        self.spawn_grace_s = float(spawn_grace_s)
+        self.desired = 0            # engines this autoscaler has live
+        self._over_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_action = -float("inf")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if registry is None:
+            from analytics_zoo_tpu.observability.registry import get_registry
+            registry = get_registry()
+        self._target_gauge = registry.gauge(
+            "serving_engines_target",
+            "engine count the autoscaler is currently holding the "
+            "fleet at")
+        self._decisions = registry.counter(
+            "serving_autoscaler_decisions_total",
+            "autoscaler actions by kind (up, down, hold_min)")
+        self._backlog_gauge = registry.gauge(
+            "serving_backlog_depth",
+            "broker stream depth (enqueued records not yet committed) "
+            "as last sampled by the elastic layer")
+
+    # -- observed state ----------------------------------------------------
+    def _backlog(self) -> Optional[int]:
+        if self.backlog_fn is not None:
+            try:
+                return self.backlog_fn()
+            except Exception:  # noqa: BLE001 — unknown, not fatal
+                return None
+        try:
+            depth = int(self.broker.stream_depth(self.stream))
+        except Exception:  # noqa: BLE001 — unknown, not fatal
+            return None
+        self._backlog_gauge.set(float(depth))
+        return depth
+
+    def _fleet_view(self):
+        """(alive_ready_count, max_burn) from the heartbeat table; both
+        None when the broker is unreachable."""
+        engines = self.tracker.poll()
+        if engines is None:
+            return None, None
+        alive = [r for r in engines.values()
+                 if r.get("alive") and r.get("ready", True)]
+        burns = [r.get("slo_burn") for r in alive
+                 if isinstance(r.get("slo_burn"), (int, float))]
+        return len(alive), (max(burns) if burns else None)
+
+    # -- decision core (pure; tests drive it directly) ---------------------
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One control-loop pass; returns "up"/"down" when an action
+        fired, else None."""
+        now = time.monotonic() if now is None else now
+        alive, burn = self._fleet_view()
+        backlog = self._backlog()
+        # reconcile desired with reality: children that died (or were
+        # retired out from under us) must not leave the controller
+        # believing capacity exists that doesn't. Only after
+        # `spawn_grace_s`, though: a just-spawned engine needs process
+        # start + warmup + first heartbeat before its absence from the
+        # table means death — clamping sooner re-arms the spawn path
+        # and double-provisions every scale-up (observed: cooldown <
+        # engine cold-start spawned 3 engines for a 2-engine spike)
+        if alive is not None and alive < self.desired \
+                and now - self._last_action >= self.spawn_grace_s:
+            self.desired = alive
+        if self.desired < self.min_engines:
+            # floor: hold the fleet at min_engines unconditionally —
+            # also the initial ramp (desired starts at 0)
+            self.spawn_fn()
+            self.desired += 1
+            self._decisions.inc(kind="hold_min")
+            self._target_gauge.set(float(self.desired))
+            self._last_action = now
+            return "up"
+        self._target_gauge.set(float(self.desired))
+        if backlog is None and burn is None:
+            # blind: no broker, no heartbeats — hold, reset hysteresis
+            self._over_since = self._idle_since = None
+            return None
+        capacity = max(1, alive if alive is not None else self.desired)
+        overloaded = (backlog is not None
+                      and backlog > self.backlog_high * capacity) \
+            or (burn is not None and burn >= self.burn_high)
+        idle = (backlog is not None
+                and backlog <= self.backlog_low * capacity) \
+            and (burn is None or burn < self.burn_high / 2.0)
+        self._over_since = (self._over_since or now) if overloaded \
+            else None
+        self._idle_since = (self._idle_since or now) if idle else None
+        if now - self._last_action < self.cooldown_s:
+            return None
+        # while a previous spawn is still materializing (absent from the
+        # heartbeat table, within the grace window), don't stack another
+        # on the same overload signal — the backlog it was spawned for
+        # hasn't seen its capacity yet
+        spawn_pending = (alive is not None and alive < self.desired
+                         and now - self._last_action
+                         < self.spawn_grace_s)
+        if overloaded and not spawn_pending \
+                and self.desired < self.max_engines \
+                and now - self._over_since >= self.up_stable_s:
+            self.spawn_fn()
+            self.desired += 1
+            self._last_action = now
+            self._over_since = None
+            self._decisions.inc(kind="up")
+            self._target_gauge.set(float(self.desired))
+            log.info("autoscaler: scale UP to %d (backlog=%s burn=%s)",
+                     self.desired, backlog, burn)
+            return "up"
+        if idle and self.desired > self.min_engines \
+                and now - self._idle_since >= self.down_stable_s:
+            if not self.retire_fn():
+                # nothing retirable (children already exited on their
+                # own): no action happened — don't log/count a phantom
+                # scale-down or burn a cooldown on a no-op; the
+                # reconcile clamp above will square `desired` with the
+                # heartbeat table
+                self._idle_since = None
+                return None
+            self.desired -= 1
+            self._last_action = now
+            self._idle_since = None
+            self._decisions.inc(kind="down")
+            self._target_gauge.set(float(self.desired))
+            log.info("autoscaler: scale DOWN to %d (backlog=%s burn=%s)",
+                     self.desired, backlog, burn)
+            return "down"
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                log.warning("autoscaler tick failed (%s: %s); retrying "
+                            "next interval", type(e).__name__, e)
+
+    def start(self) -> "FleetAutoscaler":
+        if self._thread is None:
+            self._stop.clear()
+            # first tick inline: the min-engine floor must not wait one
+            # interval before the fleet exists
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — loop recovers
+                log.warning("autoscaler initial tick failed (%s: %s)",
+                            type(e).__name__, e)
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-autoscaler",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -311,6 +311,38 @@ class _Handler(BaseHTTPRequestHandler):
             # `FrontEndApp.scala:167` tryAcquire failure → reject
             self._send(429, {"error": "too many requests"})
             return
+        # tiered admission (ISSUE 11): the cheap early 429. The tier
+        # arrives in the header (wins) or the "tier" body field —
+        # "cheap" means the record never touches the broker and no
+        # engine capacity is spent; the body is parsed early ONLY when
+        # admission needs the field spelling (with no admission
+        # configured, the quarantine/dead-fleet 503 gates below keep
+        # answering without paying a body parse). Backlog past the
+        # requester's tier threshold → reject with a Retry-After; the
+        # expensive 503s below stay the last line, and a batch job's
+        # burst throttles long before a premium tenant feels it.
+        tier = self.headers.get(self.server.admission_header) or None
+        req = None
+        admission = self.server.admission
+        if admission is not None:
+            if tier is None:
+                try:
+                    req = json.loads(self._read_body())
+                except Exception as e:  # noqa: BLE001 — must not die
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                if isinstance(req, dict):
+                    tier = req.pop("tier", None)
+            ok, retry_s = admission.admit(tier)
+            if not ok:
+                self._send(429, {
+                    "error": "backlog over this tier's admission "
+                             "threshold; retry shortly",
+                    "tier": admission.tiers.name(
+                        admission.tiers.level(tier))},
+                    extra_headers={
+                        "Retry-After": str(max(1, int(round(retry_s))))})
+                return
         # every model replica quarantined (ISSUE 5): answer 503 +
         # Retry-After sized to the canary-probe cadence instead of
         # letting the request hang to its timeout behind a fully-sick
@@ -337,16 +369,27 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         with self.server.request_timer.timing():
             try:
-                req = json.loads(self._read_body())
+                if req is None:
+                    req = json.loads(self._read_body())
+                if tier is None and isinstance(req, dict):
+                    # field spelling still rides to the engine's tiered
+                    # scheduler even without gateway admission
+                    tier = req.pop("tier", None)
                 # {"instances": [[...], ...]} tf-serving-style (each
                 # instance is ONE serving record — they batch inside the
                 # serving loop), or {"b64","dtype","shape"} raw tensor
                 if "instances" in req:
                     arr = np.asarray(req["instances"], np.float32)
                     results = self.server.input_queue.predict_batch(
-                        arr, timeout_s=self.server.timeout_s)
-                    if any(isinstance(r, float) and np.isnan(r)
-                           for r in results):
+                        arr, timeout_s=self.server.timeout_s, tier=tier)
+                    if any(r == "SHED" for r in results
+                           if isinstance(r, str)):
+                        self._shed_response(
+                            shed=sum(1 for r in results if isinstance(
+                                r, str) and r == "SHED"),
+                            total=len(results))
+                    elif any(isinstance(r, float) and np.isnan(r)
+                             for r in results):
                         self._send(500, {"error": "inference failure (NaN)"})
                     else:
                         self._send(200, {"predictions": np.asarray(results)
@@ -355,14 +398,34 @@ class _Handler(BaseHTTPRequestHandler):
                 from analytics_zoo_tpu.serving.broker import decode_ndarray
                 arr = decode_ndarray(req)
                 result = self.server.input_queue.predict(
-                    arr, timeout_s=self.server.timeout_s)
-                if isinstance(result, float) and np.isnan(result):
+                    arr, timeout_s=self.server.timeout_s, tier=tier)
+                if isinstance(result, str) and result == "SHED":
+                    self._shed_response()
+                elif isinstance(result, float) and np.isnan(result):
                     self._send(500, {"error": "inference failure (NaN)"})
                 else:
                     self._send(200, {"predictions": np.asarray(result)
                                      .tolist()})
             except Exception as e:  # noqa: BLE001 — frontend must not die
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    def _shed_response(self, shed=None, total=None):
+        """The engine shed this record under overload (ISSUE 11): an
+        explicit 503 with Retry-After — the record was answered, not
+        lost, and the client should back off like any overload. For a
+        multi-instance request the shed/total counts say how much of
+        the batch was actually refused — a retry of the whole request
+        recomputes the served siblings too, so clients under overload
+        should shrink their batches (or raise their tier)."""
+        admission = self.server.admission
+        retry_s = admission.retry_after_s if admission is not None else 1
+        payload = {"error": "record shed under overload; retry shortly"}
+        if shed is not None:
+            payload["shed"] = shed
+            payload["total"] = total
+        self._send(503, payload,
+                   extra_headers={
+                       "Retry-After": str(max(1, int(round(retry_s))))})
 
     def _unsupported_method(self):
         path = self.path.split("?", 1)[0]
@@ -437,7 +500,9 @@ class FrontEnd:
                  profile_max_artifacts: int = 8,
                  profile_enabled: bool = True,
                  fleet_stream: Optional[str] = None,
-                 engine_ttl_s: float = 6.0):
+                 engine_ttl_s: float = 6.0,
+                 admission=None,
+                 admission_header: str = "X-Priority"):
         """`fleet_stream` (ISSUE 10) turns the frontend into a fleet
         gateway: a `FleetTracker` watches engine heartbeats on
         `engines:<fleet_stream>`, `/healthz` answers for the FLEET
@@ -445,7 +510,13 @@ class FrontEnd:
         none are), and `serving_engines_alive`/`serving_engines_total`
         appear on `/metrics`. An engine is alive while its heartbeat
         keeps progressing within `engine_ttl_s` (observed on this
-        host's clock — cross-host skew can't flap the fleet)."""
+        host's clock — cross-host skew can't flap the fleet).
+
+        `admission` (ISSUE 11): an `elastic.AdmissionController` for
+        tiered early 429s on `/predict` — the requester's priority
+        class arrives in the `admission_header` header (or a "tier"
+        body field) and is forwarded on the enqueued record for the
+        engine's tiered scheduler."""
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self._srv = _FrontEndServer((host, port), _Handler)
@@ -489,6 +560,9 @@ class FrontEnd:
                                       ttl_s=engine_ttl_s,
                                       registry=self.registry)
         self._srv.fleet = self.fleet
+        self.admission = admission
+        self._srv.admission = admission
+        self._srv.admission_header = admission_header
         self._srv.timeout_s = timeout_s
         self._srv.rate_limiter = (
             TokenBucket(tokens_per_second, token_bucket_capacity)

@@ -205,6 +205,9 @@ class MiniRedisStore:
         self.streams[stream] = [(r, f) for r, f in entries if r not in ids]
         return removed
 
+    def cmd_xlen(self, a):
+        return len(self.streams.get(a[0], ()))
+
     def cmd_hset(self, a):
         # variadic since Redis 4: HSET key f1 v1 [f2 v2 ...]
         if len(a) < 3 or len(a) % 2 == 0:

@@ -65,8 +65,7 @@ from analytics_zoo_tpu.serving.broker import (Broker, connect_broker,
                                               decode_ndarray, encode_ndarray,
                                               new_consumer_name)
 from analytics_zoo_tpu.serving.inference_model import (InferenceModel,
-                                                       NoHealthyReplicaError,
-                                                       _next_bucket)
+                                                       NoHealthyReplicaError)
 from analytics_zoo_tpu.serving.timer import Timer
 
 log = logging.getLogger("analytics_zoo_tpu.serving")
@@ -91,10 +90,10 @@ class _Batch:
     """One shape-homogeneous unit of pipeline work."""
 
     __slots__ = ("ids", "uris", "arrays", "t0", "pending", "nan", "t_enq",
-                 "stacked", "valid_n")
+                 "stacked", "valid_n", "shed", "bucket", "t_dispatch")
 
     def __init__(self, ids, uris, arrays, t0, nan=False, stacked=None,
-                 valid_n=None):
+                 valid_n=None, shed=False):
         self.ids = ids            # broker record ids (for the batched ack)
         self.uris = uris          # result-hash fields
         self.arrays = arrays      # decoded host arrays (None once stacked)
@@ -104,6 +103,9 @@ class _Batch:
         self.t_enq = t0           # last enqueue timestamp (queue-wait spans)
         self.stacked = stacked    # bucket-shaped buffer (zero-copy decode)
         self.valid_n = valid_n    # real rows in `stacked` (rest is pad)
+        self.shed = shed          # admission-shed batch: sink writes "SHED"
+        self.bucket = None        # dispatched bucket (cost-model key)
+        self.t_dispatch = None    # dispatch timestamp (cost-model base)
 
 
 class ClusterServing:
@@ -128,7 +130,13 @@ class ClusterServing:
                  engine_id: Optional[str] = None,
                  claim_min_idle_s: float = 30.0,
                  claim_interval_s: float = 5.0,
-                 heartbeat_interval_s: float = 2.0):
+                 heartbeat_interval_s: float = 2.0,
+                 batch_policy: str = "adaptive",
+                 deadline_ms: Optional[float] = None,
+                 batch_margin_ms: float = 2.0,
+                 admission_tiers=None,
+                 admission_field: str = "tier",
+                 shed_backlog: Optional[int] = None):
         """Fault-tolerance knobs (ISSUE 5; the rest is PR 1-4 surface):
         `supervise` starts a `ReplicaSupervisor` over a replica pool
         (quarantine after `failure_threshold` consecutive failures or
@@ -165,7 +173,26 @@ class ClusterServing:
         even with `engine_id=None` (single-engine redelivery after a
         restart is the same mechanism); heartbeats and metric labels
         are fleet-mode only, keeping the standalone metric schema
-        byte-identical."""
+        byte-identical.
+
+        Elastic serving (ISSUE 11): `batch_policy` selects the reader's
+        micro-batching controller — "adaptive" (default) plans each
+        dispatch from the live per-bucket cost model and the oldest
+        queued record's `deadline_ms` budget (no deadline configured ⇒
+        behaves exactly like the legacy policy; with `slo.latency_ms`
+        set the deadline defaults to it), "fixed" is the legacy
+        straggler sweep, "static" always pads to the largest reachable
+        bucket (the bench A/B strawman). `admission_tiers` (lowest
+        priority first) makes the reader tier-aware: records carry a
+        tier name in `admission_field`, higher tiers dispatch first,
+        and past `shed_backlog` stream depth the reader sheds
+        lowest-tier records with an explicit "SHED" result (committed
+        and acked — an answered rejection, never a silent drop; the
+        top tier is never shed). The stack's own producers (frontend,
+        `InputQueue`) always write the native "tier" record key;
+        `admission_field` points the reader at a FOREIGN producer's
+        spelling, with "tier" kept as the fallback so mixed traffic
+        never inverts priorities."""
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
@@ -263,6 +290,35 @@ class ClusterServing:
                 else SLOObjectives(**slo)
             if not objectives.empty:
                 self.slo = SLOTracker(objectives, registry=self.registry)
+        # adaptive micro-batching (ISSUE 11): the controller that
+        # replaces the fixed batch_size/batch_timeout_ms policy. With no
+        # explicit deadline the SLO latency objective (what the operator
+        # already promised) is the natural budget.
+        from analytics_zoo_tpu.serving.elastic import (
+            AdaptiveBatchController, TierTable)
+        if deadline_ms is None and self.slo is not None \
+                and self.slo.objectives.latency_ms is not None:
+            deadline_ms = self.slo.objectives.latency_ms
+        self.batcher = AdaptiveBatchController(
+            self.model.buckets, self.batch_size, self.batch_timeout_ms,
+            policy=batch_policy, deadline_ms=deadline_ms,
+            margin_ms=batch_margin_ms, registry=self.registry,
+            labels=self._labels)
+        # tiered admission (ISSUE 11): reader-side tier ordering + shed
+        self.admission_field = admission_field
+        self.tier_table = None
+        if admission_tiers:
+            self.tier_table = admission_tiers \
+                if isinstance(admission_tiers, TierTable) \
+                else TierTable(admission_tiers)
+        self.shed_backlog = int(shed_backlog) if shed_backlog else None
+        self._admission_out = self.registry.counter(
+            "serving_admission_total",
+            "admission decisions by outcome (accepted, rejected, shed) "
+            "and tier")
+        # rate-limited backlog probe (reader thread only)
+        self._backlog_cache: Optional[int] = None
+        self._backlog_t = 0.0
         self.supervisor = None
         if supervise and self._multi_replica:
             from analytics_zoo_tpu.serving.supervisor import \
@@ -290,12 +346,24 @@ class ClusterServing:
     def _heartbeat_payload(self) -> dict:
         """What each beat tells the gateway: readiness (the same
         aggregation /healthz would compute locally) plus the throughput
-        counters a fleet dashboard sums."""
+        counters a fleet dashboard sums — and, with SLO objectives
+        configured, the engine's current burn rate, which is the
+        autoscaler's scale-up signal (ISSUE 11: the gateway cannot see
+        this engine's latency histograms across the process boundary;
+        the heartbeat is the telemetry bus)."""
         h = self.health()
-        return {"ready": bool(h.get("ready")),
-                "healthy_replicas": h.get("healthy_replicas"),
-                "records_served": self.records_served,
-                "records_read": self.records_read}
+        out = {"ready": bool(h.get("ready")),
+               "healthy_replicas": h.get("healthy_replicas"),
+               "records_served": self.records_served,
+               "records_read": self.records_read}
+        slo = h.get("slo")
+        if isinstance(slo, dict):
+            burns = [v.get("burn_rate", 0.0) for v in slo.values()
+                     if isinstance(v, dict) and "burn_rate" in v]
+            if burns:
+                out["slo_burn"] = max(burns)
+            out["slo_met"] = bool(slo.get("met", True))
+        return out
 
     def _wire_registry(self):
         """Mirror the engine's private Timers into the process-wide
@@ -313,7 +381,8 @@ class ClusterServing:
             "writeback")
         self._records_total = reg.counter(
             "serving_records_total",
-            "records through the serving engine, by outcome (read, served)")
+            "records through the serving engine, by outcome (read, "
+            "served, failed, duplicate, shed)")
         # multi-device router telemetry: families register unconditionally
         # (stable /metrics schema); series appear only when a replica pool
         # is actually routing, so single-replica output stays unchanged
@@ -597,6 +666,72 @@ class ClusterServing:
         with self._inflight_lock:
             self._inflight_ids.difference_update(ids)
 
+    def _stream_backlog(self) -> Optional[int]:
+        """Rate-limited broker stream depth MINUS this engine's own
+        in-flight records (the stream keeps a record until sink commit,
+        so raw depth would read our own pipeline back as other
+        people's load and misclassify a light trickle as heavy — the
+        adaptive batcher would then re-add the padding wait it exists
+        to remove). Reader-thread only. None = unknown (transport
+        without XLEN, or a mid-outage read) — the controller then
+        plans conservatively."""
+        now = time.monotonic()
+        if now - self._backlog_t >= 0.2:
+            self._backlog_t = now
+            try:
+                depth = int(self.reader_broker.stream_depth(self.stream))
+            except Exception:  # noqa: BLE001 — load signal, not a fault
+                depth = None
+            self._backlog_cache = depth
+        if self._backlog_cache is None:
+            return None
+        with self._inflight_lock:
+            own = len(self._inflight_ids)
+        return max(0, self._backlog_cache - own)
+
+    def _tier_order_and_shed(self, records, t0):
+        """Tiered scheduling in the reader (ISSUE 11): higher-tier
+        records decode and dispatch first (a stable sort — FIFO within
+        a tier), and under overload (stream depth past `shed_backlog`)
+        the lowest-tier records in hand are shed with an explicit
+        "SHED" result — committed and acked through the normal sink
+        path, so the client gets an answer instead of a timeout and the
+        record never redelivers to eat capacity twice. The top tier is
+        never shed: a fleet drowning in premium traffic scales (the
+        autoscaler's job), it does not drop."""
+        levels = [self.tier_table.level(
+            (rec.get(self.admission_field) or rec.get("tier"))
+            if isinstance(rec, dict) else None)
+            for _rid, rec in records]
+        order = sorted(range(len(records)), key=lambda i: -levels[i])
+        records = [records[i] for i in order]
+        levels = [levels[i] for i in order]
+        if self.shed_backlog is None:
+            return records
+        backlog = self._stream_backlog()
+        if backlog is None or backlog <= self.shed_backlog:
+            return records
+        lowest = min(levels)
+        if lowest >= self.tier_table.top:
+            return records
+        keep, shed = [], []
+        for (rid, rec), lvl in zip(records, levels):
+            (shed if lvl == lowest else keep).append((rid, rec))
+        if shed:
+            tier = self.tier_table.name(lowest)
+            self._admission_out.inc(len(shed), outcome="shed",
+                                    tier=tier, **self._labels)
+            log.warning(
+                "overload (backlog %d > %d): shedding %d %r-tier "
+                "record(s) with SHED results", backlog,
+                self.shed_backlog, len(shed), tier)
+            self._enqueue(self._sink_q, _Batch(
+                [rid for rid, _ in shed],
+                [rec.get("uri", rid) if isinstance(rec, dict)
+                 else str(rid) for rid, rec in shed],
+                None, t0, shed=True))
+        return keep
+
     # -- stage: reader -----------------------------------------------------
     def _reader_loop(self):
         # idle wait is LONG (an XADD wakes a blocked XREADGROUP
@@ -655,31 +790,56 @@ class ClusterServing:
                 records = claimed + self._filter_inflight(records)
                 if not records:
                     continue
-                if len(records) < self.batch_size \
-                        and self.batch_timeout_ms > 0:
-                    # straggler sweep: requests from concurrent clients
-                    # land within ~ms of each other — waiting the SLO
-                    # budget builds full batches (fewer pipeline units,
-                    # one forward and one writeback for more records).
-                    # Its OWN failure domain: a broker that dies between
-                    # the main read and the sweep must not drop the
-                    # records already in hand into a redeliver loop
+                # adaptive accumulation (ISSUE 11; the straggler sweep,
+                # generalized): the controller plans how many records
+                # this dispatch should carry and how long the reader may
+                # keep collecting — under a tight deadline or an empty
+                # backlog that is "none, dispatch now"; under load it is
+                # "grow toward the throughput-optimal bucket". Collection
+                # reads run in their OWN failure domain: a broker that
+                # dies mid-sweep must not drop the records already in
+                # hand into a redeliver loop.
+                t_first = time.perf_counter()
+                plan = self.batcher.plan(len(records), 0.0,
+                                         self._stream_backlog())
+                sweep_deadline = t_first + plan.wait_ms / 1e3
+                while len(records) < plan.target:
+                    remaining_ms = (sweep_deadline
+                                    - time.perf_counter()) * 1e3
+                    if remaining_ms <= 0:
+                        break
                     try:
-                        records += self._filter_inflight(
+                        more = self._filter_inflight(
                             self.reader_broker.read_group(
                                 self.stream, GROUP, self.consumer,
-                                self.batch_size - len(records),
-                                block_ms=self.batch_timeout_ms))
+                                plan.target - len(records),
+                                block_ms=max(1, int(min(remaining_ms,
+                                                        50)))))
                     except Exception as e:  # noqa: BLE001 — keep batch
                         log.warning(
-                            "straggler sweep failed (%s: %s); "
+                            "batch-collection read failed (%s: %s); "
                             "continuing with %d record(s) in hand",
                             type(e).__name__, e, len(records))
+                        break
+                    if more:
+                        records += more
+                        # replan: the budget shrinks as the oldest
+                        # record ages, so this loop always terminates
+                        age_ms = (time.perf_counter() - t_first) * 1e3
+                        plan = self.batcher.plan(
+                            len(records), age_ms, self._stream_backlog())
+                        sweep_deadline = min(
+                            sweep_deadline,
+                            time.perf_counter() + plan.wait_ms / 1e3)
                 with self._counter_lock:
                     self.records_read += len(records)
                 self._records_total.inc(len(records), outcome="read",
                                         **self._labels)
-                item = (time.perf_counter(), records)
+                if self.tier_table is not None:
+                    records = self._tier_order_and_shed(records, t_first)
+                    if not records:
+                        continue
+                item = (t_first, records)
                 while not self._stop.is_set():
                     try:
                         self._decode_q.put(item, timeout=0.25)
@@ -711,7 +871,8 @@ class ClusterServing:
 
         Records group by (shape, dtype) read off the codec HEADER —
         no payload decode yet — then each group sizes ONE
-        ``[bucket, *shape]`` buffer (`_next_bucket`, padding included)
+        ``[bucket, *shape]`` buffer (`batcher.pad_bucket` — policy-aware
+        since ISSUE 11; padding included)
         and every payload decodes directly into its row
         (`pre_post.decode_record_into`): the hot path allocates zero
         per-record ndarrays and the dispatch stage's separate np.stack
@@ -750,7 +911,7 @@ class ClusterServing:
                 failed.append((rid, uri))
         batches = []
         for (shape, dtype), items in groups.items():
-            bucket = _next_bucket(len(items), self.model.buckets)
+            bucket = self.batcher.pad_bucket(len(items))
             try:
                 # header shape/dtype are UNTRUSTED producer input (a
                 # foreign client can XADD shape [-1] or an absurd dim):
@@ -879,7 +1040,7 @@ class ClusterServing:
                     batch.stacked = None
                 else:
                     n = len(batch.arrays)
-                    bucket = _next_bucket(n, self.model.buckets)
+                    bucket = self.batcher.pad_bucket(n)
                     arrs = batch.arrays
                     if bucket > n:
                         # stack straight to the bucket: padding costs
@@ -905,6 +1066,14 @@ class ClusterServing:
                         self._stop.wait(0.05)
                 t_end = time.perf_counter()
                 self.dispatch_timer.record(t_end - t_work)
+                # elastic telemetry (ISSUE 11): the chosen bucket and
+                # how much deadline budget queueing+batching consumed
+                # before this dispatch — what the controller's next
+                # plans and the bench's queue-age story read
+                batch.bucket = int(stacked.shape[0])
+                batch.t_dispatch = t_end
+                self.batcher.record_dispatch(
+                    batch.bucket, (t_end - batch.t0) * 1e3)
                 replica = getattr(batch.pending, "replica", 0)
                 if self._multi_replica and replica is not None:
                     self._replica_batches.inc(replica=str(replica))
@@ -1016,8 +1185,15 @@ class ClusterServing:
         failure mode is the broker, and the buffer owns that."""
         t_work = batch.t_enq
         values = self._materialize(batch)
+        if batch.bucket is not None and batch.t_dispatch is not None \
+                and not (batch.nan or batch.shed):
+            # feed the live cost model: dispatch → materialized is what
+            # a queued record pays once it boards this bucket
+            self.batcher.observe_service(
+                batch.bucket,
+                (time.perf_counter() - batch.t_dispatch) * 1e3)
         entry = (dict(zip(batch.uris, values)), list(batch.ids),
-                 batch.t0, t_work)
+                 batch.t0, t_work, batch.shed)
         if self._wb_buffer:
             # keep writeback order: flush the backlog first, and if any
             # of it still can't go out, queue behind it
@@ -1035,7 +1211,7 @@ class ClusterServing:
         retry's new-field count reads 0 — but the records were served
         exactly once by this engine's compute and must count as
         served, not duplicate."""
-        mapping, ids, t0, t_work = entry
+        mapping, ids, t0, t_work, shed = entry
         try:
             # the whole batch commits as ONE broker interaction —
             # results + ack in a single (pipelined) round trip, not
@@ -1078,6 +1254,17 @@ class ClusterServing:
             added = len(mapping)
         n_new = added if isinstance(added, int) else len(mapping)
         n_dup = len(mapping) - n_new
+        if shed:
+            # an answered rejection is NOT service (ISSUE 11): counting
+            # shed commits as "served" — and their near-zero commit
+            # times into the batch timer — would read overload as
+            # improved availability/latency and suppress the very SLO
+            # burn the autoscaler scales up on. Distinct outcome, no
+            # latency sample, no served count.
+            if n_new:
+                self._records_total.inc(n_new, outcome="shed",
+                                        **self._labels)
+            return True
         with self._counter_lock:
             self.records_served += n_new
         if n_new:
@@ -1138,6 +1325,12 @@ class ClusterServing:
         """Per-record encoded result strings for a batch; inference
         failure degrades the whole batch to "NaN" (the per-shape batch is
         the reference's failure unit, `ClusterServingInference.scala:71`)."""
+        if batch.shed:
+            # admission shed (ISSUE 11): an answered rejection — the
+            # client sees "SHED" (degrades like NaN in the decoders but
+            # is distinguishable on the wire), the ack keeps the broker
+            # from redelivering work the engine chose not to do
+            return ["SHED"] * len(batch.uris)
         if batch.nan:
             if batch.pending is not None:
                 # a batch can be marked nan AFTER routing succeeded (a
@@ -1280,6 +1473,18 @@ class ClusterServing:
                                           "replicated") == "sharded":
             m["placement"] = self.model.placement_info()
             m["replicas"] = self.model.replica_stats()
+        m["batching"] = {
+            "policy": self.batcher.policy,
+            "deadline_ms": self.batcher.deadline_ms,
+            "bucket_cost_ms": {str(b): round(c, 3) for b, c in
+                               self.batcher.cost.snapshot().items()},
+            "backlog": self._backlog_cache,
+        }
+        if self.tier_table is not None:
+            m["admission"] = {
+                "tiers": list(self.tier_table.names),
+                "shed_backlog": self.shed_backlog,
+            }
         ft = {"sink_buffered_batches": len(self._wb_buffer)}
         for role, br in (("reader", self.reader_broker),
                          ("sink", self.sink_broker)):

@@ -576,6 +576,30 @@ def main():
                         out[dst] = r4.get(src)
             else:
                 out["serving_fleet_drain_rps"] = None
+        # elastic replay (ISSUE 11): diurnal + spike trace against a
+        # static fleet vs the autoscaled one — chip-seconds ratio,
+        # per-phase p99 vs the declared SLO, light-load p50 A/B against
+        # pad-to-largest dispatch, zero-loss + cold-compile accounting
+        if os.environ.get("BENCH_ELASTIC", "1") == "1":
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            r5, _ = _run_sub([sys.executable,
+                              os.path.join(here, "bench_serving.py"),
+                              "--elastic"],
+                             timeout=900, env=env)
+            if r5:
+                out["serving_elastic_chip_seconds_ratio"] = \
+                    r5.get("chip_seconds_ratio")
+                out["serving_elastic_slo_held"] = \
+                    r5.get("elastic_slo_held")
+                out["serving_elastic_zero_loss"] = r5.get("zero_loss")
+                out["serving_elastic_scale_up_cold_compiles"] = \
+                    r5.get("scale_up_cold_compiles")
+                ab = r5.get("light_load_ab") or {}
+                out["serving_elastic_light_p50_improvement_pct"] = \
+                    ab.get("p50_improvement_pct")
+            else:
+                out["serving_elastic_chip_seconds_ratio"] = None
 
     print(json.dumps(out))
 

@@ -707,13 +707,20 @@ def _fleet_child(args) -> int:
     broker = connect_broker(args.broker_url)
     # construct BEFORE the gate (connections, registry wiring, replica
     # pool) so the timed drain window starts at reader-thread launch
+    slo = {"latency_ms": args.slo_latency_ms, "latency_quantile": 0.99,
+           "window_s": 10.0} if args.slo_latency_ms else None
     serving = ClusterServing(
         im, broker=broker, stream=args.stream,
-        batch_size=batch, batch_timeout_ms=2,
+        batch_size=batch, batch_timeout_ms=args.batch_timeout_ms,
         engine_id=args.engine_id,
         claim_min_idle_s=args.claim_min_idle,
         claim_interval_s=max(args.claim_min_idle / 4.0, 0.1),
-        heartbeat_interval_s=0.25)
+        heartbeat_interval_s=0.25,
+        # elastic knobs (ISSUE 11): the --elastic replay runs adaptive
+        # deadline-aware engines against "static" pad-to-largest ones
+        batch_policy=args.batch_policy,
+        deadline_ms=args.deadline_ms or None,
+        slo=slo)
     broker.hset(f"fleet:ready:{args.stream}", args.engine_id, "1")
     gate_deadline = time.time() + 600
     while not broker.hget(f"fleet:gate:{args.stream}", "go"):
@@ -746,7 +753,7 @@ def _fleet_child(args) -> int:
 
 
 def _fleet_spawn(k, stream, port, cache_dir, claim_min_idle, batch,
-                 start_idx=0):
+                 start_idx=0, extra_args=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)       # hermetic CPU children
@@ -758,7 +765,8 @@ def _fleet_spawn(k, stream, port, cache_dir, claim_min_idle, batch,
              "--stream", stream, "--engine-id", f"engine-{i}",
              "--compile-cache-dir", cache_dir,
              "--claim-min-idle", str(claim_min_idle),
-             "--fleet-batch", str(batch), "--pin-core", str(i)],
+             "--fleet-batch", str(batch), "--pin-core", str(i)]
+            + list(extra_args),
             env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
     return procs
@@ -1021,6 +1029,424 @@ def _fleet_main(args) -> int:
             compiled / max(n_buckets, 1), 2),
         "survivor_claimed_records": survivors_claimed,
         "engine_reports": reports,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+# -- elastic: diurnal + spike replay, static vs autoscaled fleet -----------
+# (ISSUE 11)
+
+def _percentile(samples, q):
+    """np.percentile, the same interpolated estimator every other
+    p50/p99 in this file uses — a nearest-rank variant here would make
+    the elastic replay's p99 a different statistic from the fleet and
+    drain benches' in the same JSON round."""
+    if not samples:
+        return None
+    return float(np.percentile(np.asarray(samples), q * 100))
+
+
+def _elastic_light_ab(srv, cache_dir, batch, n=40):
+    """Light-load p50 A/B: one engine at a trickle, adaptive
+    deadline-aware dispatch vs the 'static' pad-to-largest-bucket
+    strawman. Closed loop (one request in flight — there IS no queue;
+    that is the point), sync predict round trips."""
+    from analytics_zoo_tpu.serving.broker import RedisBroker
+    from analytics_zoo_tpu.serving.client import InputQueue
+
+    out = {}
+    for policy in ("static", "adaptive"):
+        stream = f"elastic_ab_{policy}"
+        broker = RedisBroker(srv.host, srv.port)
+        # a FAT straggler window (20 ms) for both engines: the fixed
+        # policy always waits it out at light load; adaptive skips it
+        # the moment the backlog reads empty
+        extra = ["--batch-policy", policy, "--batch-timeout-ms", "20",
+                 "--deadline-ms", "30"]
+        broker.hset(f"fleet:gate:{stream}", "go", "1")
+        procs = _fleet_spawn(1, stream, srv.port, cache_dir, 30.0,
+                             batch, extra_args=extra)
+        try:
+            _fleet_wait_ready(broker, stream, procs, 1)
+            q = InputQueue(RedisBroker(srv.host, srv.port), stream)
+            _fn, _W, sample = _md_model(width=256, iters=1024)
+            arr = np.asarray(sample)
+            lats = []
+            for i in range(n + 5):
+                t0 = time.perf_counter()
+                q.predict(arr, timeout_s=30.0)
+                dt = (time.perf_counter() - t0) * 1e3
+                if i >= 5:                  # settle the cost model
+                    lats.append(dt)
+                time.sleep(0.02)            # ~3 rps: genuinely light
+            out[policy] = {
+                "p50_ms": round(_percentile(lats, 0.50), 2),
+                "p99_ms": round(_percentile(lats, 0.99), 2),
+            }
+        finally:
+            _fleet_reports(procs)
+            broker.close()
+    imp = 1.0 - out["adaptive"]["p50_ms"] / max(
+        out["static"]["p50_ms"], 1e-9)
+    out["p50_improvement_pct"] = round(imp * 100, 1)
+    return out
+
+
+class _EngineLedger:
+    """Child engines with spawn/exit timestamps — the chip-seconds
+    accounting the static-vs-elastic comparison is about."""
+
+    def __init__(self, stream, port, cache_dir, batch, extra):
+        self.stream, self.port = stream, port
+        self.cache_dir, self.batch, self.extra = cache_dir, batch, extra
+        self.rows = []          # [proc, t_start, t_end|None]
+        self.next_idx = 0
+
+    def spawn(self):
+        p = _fleet_spawn(1, self.stream, self.port, self.cache_dir,
+                         5.0, self.batch, start_idx=self.next_idx,
+                         extra_args=self.extra)[0]
+        self.next_idx += 1
+        self.rows.append([p, time.perf_counter(), None])
+        return p
+
+    def retire(self):
+        import signal as _signal
+        for row in reversed(self.rows):
+            if row[2] is None and row[0].poll() is None:
+                row[0].send_signal(_signal.SIGTERM)
+                return True
+        return False
+
+    def reap(self):
+        """Stamp exit times for children that have finished draining."""
+        for row in self.rows:
+            if row[2] is None and row[0].poll() is not None:
+                row[2] = time.perf_counter()
+
+    def chip_seconds(self, t_end, t0=None):
+        """Engine-seconds in [t0, t_end]: rows spawned before t0 (the
+        static fleet's pre-replay cold start, which a production static
+        fleet paid long ago) are clamped to the replay window, so the
+        static-vs-elastic ratio compares serving commitment, not
+        process startup; an elastic MID-run spawn keeps its cold-start
+        cost — that lag is part of what elasticity pays."""
+        self.reap()
+        return sum((row[2] if row[2] is not None else t_end)
+                   - (row[1] if t0 is None else max(row[1], t0))
+                   for row in self.rows)
+
+    def live_procs(self):
+        return [row[0] for row in self.rows if row[0].poll() is None]
+
+    def all_procs(self):
+        return [row[0] for row in self.rows]
+
+
+def _elastic_replay(srv, cache_dir, batch, phases, mode, slo_p99_ms,
+                    max_engines):
+    """One diurnal+spike replay: an open-loop generator drives the
+    phase schedule while a closed-loop prober samples end-to-end
+    latency (~8 Hz, tagged by phase — millisecond resolution the
+    drain-poll cannot give). `mode` = "static" (max_engines for the
+    whole run) or "elastic" (FleetAutoscaler between 1 and
+    max_engines)."""
+    from analytics_zoo_tpu.serving.broker import RedisBroker, encode_ndarray
+    from analytics_zoo_tpu.serving.client import InputQueue
+    from analytics_zoo_tpu.serving.fleet import FleetAutoscaler, FleetTracker
+
+    stream = f"elastic_replay_{mode}"
+    # what the host grants 2 concurrent processes RIGHT before this
+    # leg (the PR 10 per-leg convention): a shared rig's grant swings
+    # 1.4-3.4x within minutes, and a spike sized when the host was
+    # generous can be unservable by the time this leg runs — the
+    # per-leg number makes any SLO miss legible as host starvation
+    # vs controller failure
+    leg_host_par = _measure_host_parallelism()
+    broker = RedisBroker(srv.host, srv.port)
+    broker.hset(f"fleet:gate:{stream}", "go", "1")   # no start gate here
+    _fn, _W, sample = _md_model(width=256, iters=1024)
+    encoded = encode_ndarray(np.asarray(sample))
+    arr = np.asarray(sample)
+    extra = ["--batch-policy", "adaptive", "--deadline-ms", "150",
+             "--batch-timeout-ms", "5",
+             "--slo-latency-ms", str(slo_p99_ms)]
+    ledger = _EngineLedger(stream, srv.port, cache_dir, batch, extra)
+    tracker = scaler = None
+    if mode == "static":
+        for _ in range(max_engines):
+            ledger.spawn()
+        _fleet_wait_ready(broker, stream, ledger.all_procs(),
+                          max_engines)
+    else:
+        tracker = FleetTracker(RedisBroker(srv.host, srv.port), stream,
+                               ttl_s=1.5)
+        # thresholds in RECORDS per alive engine; aggressive up, lazy
+        # down — scale-up must beat the spike, scale-down can wait out
+        # the tail
+        scaler = FleetAutoscaler(
+            tracker, RedisBroker(srv.host, srv.port), stream,
+            ledger.spawn, ledger.retire,
+            min_engines=1, max_engines=max_engines,
+            backlog_high=3.0 * batch, backlog_low=1.0 * batch,
+            up_stable_s=0.5, down_stable_s=4.0, cooldown_s=3.0,
+            # cover the child's cold start (python + jax import +
+            # cache-warm ~8s here): without the grace the reconcile
+            # clamp re-arms the spawn path mid-startup and every
+            # scale-up double-provisions
+            spawn_grace_s=45.0,
+            interval_s=0.25).start()
+        _fleet_wait_ready(broker, stream, ledger.all_procs(), 1)
+
+    samples = []             # (phase, latency_ms)
+    stop_probe = threading.Event()
+
+    def prober():
+        q = InputQueue(RedisBroker(srv.host, srv.port), stream)
+        while not stop_probe.is_set():
+            t0 = time.perf_counter()
+            try:
+                q.predict(arr, timeout_s=30.0)
+                samples.append((current_phase[0],
+                                (time.perf_counter() - t0) * 1e3))
+            except Exception:  # noqa: BLE001 — a lost probe, not a fault
+                samples.append((current_phase[0], 30000.0))
+            stop_probe.wait(0.12)
+
+    current_phase = ["warm"]
+    # two closed-loop probers: during an overload phase one prober's
+    # sampling rate collapses to 1/latency — the second keeps the
+    # spike-phase sample count meaningful for a p99
+    probe_threads = [threading.Thread(target=prober, daemon=True)
+                     for _ in range(2)]
+    for t in probe_threads:
+        t.start()
+
+    gen_broker = RedisBroker(srv.host, srv.port)
+    enqueued = 0
+    phase_t0 = {}
+    engines_seen = {}
+    t_run0 = time.perf_counter()
+    for name, dur_s, rps in phases:
+        current_phase[0] = name
+        phase_t0[name] = time.perf_counter()
+        period = 1.0 / max(rps, 1e-9)
+        t_next = time.perf_counter()
+        t_end = phase_t0[name] + dur_s
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                break
+            if now >= t_next:
+                gen_broker.xadd(stream, {"uri": f"{name}-{enqueued}",
+                                         "data": {"t": encoded}})
+                enqueued += 1
+                t_next += period
+            else:
+                time.sleep(min(t_next - now, 0.005))
+            ledger.reap()
+        engines_seen[name] = len(ledger.live_procs())
+    current_phase[0] = "drain"
+    # drain: every open-loop record must land a result (zero loss).
+    # hlen is the cheap progress gate, but the authoritative count
+    # filters to the generator's own phase-prefixed uris: the probers
+    # share this result hash (transient rows between engine HSET and
+    # client HDEL, plus a timed-out probe's orphan), and counting
+    # theirs could mask a genuinely lost generator record
+    result_key = f"result:{stream}"
+    phase_names = {name for name, _d, _r in phases}
+
+    def generator_results():
+        return sum(1 for u in broker.hgetall(result_key)
+                   if u.split("-", 1)[0] in phase_names)
+
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        ledger.reap()
+        if broker.hlen(result_key) >= enqueued \
+                and generator_results() >= enqueued:
+            break
+        time.sleep(0.1)
+    t_run_end = time.perf_counter()
+    stop_probe.set()
+    for t in probe_threads:
+        t.join(timeout=35)
+    if scaler is not None:
+        scaler.stop()
+    if tracker is not None:
+        tracker.close()
+    got = generator_results()
+    chip_seconds = ledger.chip_seconds(t_run_end, t0=t_run0)
+    reports = _fleet_reports(ledger.all_procs())
+    broker.close()
+
+    def phase_stats(name):
+        lats = [v for p, v in samples if p == name]
+        # steady-state view: the autoscaler's convergence transient
+        # (detection + engine cold start) is the first part of the
+        # phase; SLO attainment is judged on the settled second half
+        # (full-phase numbers are reported alongside)
+        k = max(1, int(len(lats) * 0.5))
+        steady = lats[k:] if len(lats) > k else lats
+        return {
+            "n": len(lats),
+            "p50_ms": round(_percentile(lats, 0.50), 1) if lats else None,
+            "p99_ms": round(_percentile(lats, 0.99), 1) if lats else None,
+            "steady_p99_ms": round(_percentile(steady, 0.99), 1)
+            if steady else None,
+            "engines_at_end": engines_seen.get(name),
+        }
+
+    compiled = sum(r.get("sources", {}).get("compiled", 0)
+                   for r in reports)
+    per_phase = {name: phase_stats(name) for name, _, _ in phases}
+    steady = [s["steady_p99_ms"] for s in per_phase.values()
+              if s["steady_p99_ms"] is not None]
+    return {
+        "mode": mode,
+        "host_parallelism_at_leg_start": leg_host_par,
+        "enqueued": enqueued,
+        "results": got,
+        "record_loss": enqueued - got,
+        "zero_loss": got >= enqueued,
+        "chip_seconds": round(chip_seconds, 1),
+        "wall_seconds": round(t_run_end - t_run0, 1),
+        "engines_spawned": ledger.next_idx,
+        "cold_compiled_buckets": compiled,
+        "phases": per_phase,
+        "slo_p99_ms": slo_p99_ms,
+        "slo_held_steady": bool(steady) and all(
+            v <= slo_p99_ms for v in steady),
+        "engine_reports": reports,
+    }
+
+
+def _elastic_main(args) -> int:
+    """`--elastic`: the ISSUE 11 acceptance run. One MiniRedis carries
+    everything; a diurnal + spike arrival trace replays twice — against
+    a static fleet (max engines, whole run) and against the autoscaled
+    elastic fleet — recording per-phase p50/p99, chip-seconds, record
+    loss, and cold compiles; plus the light-load adaptive-vs-static-pad
+    p50 A/B. Rates are set relative to a measured single-engine
+    capacity probe so the spike genuinely overloads one engine on any
+    rig. The JSON self-documents the host-parallelism ceiling (PR 3 /
+    PR 10 convention): on a shared 2-core box the second engine only
+    helps as much as the host actually grants."""
+    import shutil
+    import tempfile
+    import uuid
+
+    from analytics_zoo_tpu.serving.broker import RedisBroker, encode_ndarray
+    from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+
+    batch = 8
+    # static baseline = the pre-elastic operating mode: provisioned for
+    # peak PLUS one engine of headroom (N+1), up the whole day. The
+    # spike needs 2 engines; static runs 3 for the entire replay. The
+    # elastic fleet shares the same ceiling and earns its chip-seconds
+    # by only using what the backlog demands.
+    max_engines = 3
+    slo_p99_ms = 1500.0
+    cache_dir = tempfile.mkdtemp(prefix="zoo-elastic-cc-")
+    srv = MiniRedisServer().start()
+    try:
+        # -- capacity probe: one adaptive engine drains a backlog ------
+        stream = "elastic_cap"
+        broker = RedisBroker(srv.host, srv.port)
+        broker.hset(f"fleet:gate:{stream}", "go", "1")
+        procs = _fleet_spawn(
+            1, stream, srv.port, cache_dir, 30.0, batch,
+            extra_args=["--batch-policy", "adaptive",
+                        "--deadline-ms", "150"])
+        _fleet_wait_ready(broker, stream, procs, 1)
+        _fn, _W, sample = _md_model(width=256, iters=1024)
+        encoded = encode_ndarray(np.asarray(sample))
+        n_probe = 240
+        t0 = time.perf_counter()
+        for i in range(n_probe):
+            broker.xadd(stream, {"uri": uuid.uuid4().hex,
+                                 "data": {"t": encoded}})
+        deadline = time.time() + 120
+        while broker.hlen(f"result:{stream}") < n_probe \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        cap_rps = broker.hlen(f"result:{stream}") \
+            / (time.perf_counter() - t0)
+        _fleet_reports(procs)
+        broker.close()
+
+        # -- light-load p50 A/B ----------------------------------------
+        light_ab = _elastic_light_ab(srv, cache_dir, batch)
+
+        # host ceiling measured AFTER the probes, right before the
+        # replays that the spike sizing has to survive — a probe taken
+        # a minute earlier routinely misstates what the replays get
+        host_par = _measure_host_parallelism()
+
+        # -- diurnal + spike replay, static then elastic ---------------
+        # the diurnal shape: most of the day is light/moderate (one
+        # engine's worth), the spike is brief — exactly the regime
+        # where static peak-provisioning burns chips doing nothing.
+        # The spike must overload ONE engine but stay inside what the
+        # scaled-out fleet can absorb on THIS host: on a real pod that
+        # is engines x chip, here it is the measured host-parallelism
+        # ceiling (a shared 2-core box sometimes grants only ~1.2x —
+        # sizing the spike to nominal capacity would then demand the
+        # impossible of any autoscaler and measure the rig, not the
+        # controller). The factor is recorded in the JSON.
+        # 0.7x the granted ceiling: the grant itself swings between the
+        # sizing probe and the (later) elastic leg, and a spike sized
+        # at the ceiling's edge turns any downswing into an unservable
+        # trace — the per-leg host_parallelism_at_leg_start fields make
+        # that legible when it still happens
+        spike_factor = min(1.25, max(1.05, 0.7 * host_par))
+        # the spike must be LONG relative to an engine cold start
+        # (~8s nominal, worse when the host is starved): an autoscaler
+        # can only show it absorbs a spike that outlives its own
+        # scale-up lag — 30s leaves the converged fleet serving most
+        # of the phase
+        phases = [
+            ("light", 15.0, max(3.0, 0.12 * cap_rps)),
+            ("ramp", 10.0, 0.45 * cap_rps),
+            ("spike", 30.0, spike_factor * cap_rps),
+            ("tail", 25.0, 0.12 * cap_rps),
+        ]
+        static = _elastic_replay(srv, cache_dir, batch, phases,
+                                 "static", slo_p99_ms, max_engines)
+        elastic = _elastic_replay(srv, cache_dir, batch, phases,
+                                  "elastic", slo_p99_ms, max_engines)
+    finally:
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    cores = os.cpu_count() or 1
+    chip_ratio = elastic["chip_seconds"] / max(static["chip_seconds"],
+                                               1e-9)
+    out = {
+        "metric": "serving_elastic_replay",
+        "value": round(chip_ratio, 3),
+        "unit": "elastic/static chip-seconds (target <= 0.6)",
+        "capacity_probe_rps": round(cap_rps, 1),
+        "host_cores": cores,
+        "host_effective_parallelism": host_par,
+        "phases_rps": {n: round(r, 1) for n, _d, r in phases},
+        "spike_factor_vs_one_engine": round(spike_factor, 3),
+        "slo_p99_ms": slo_p99_ms,
+        "light_load_ab": light_ab,
+        "static": static,
+        "elastic": elastic,
+        "chip_seconds_ratio": round(chip_ratio, 3),
+        "elastic_slo_held": elastic["slo_held_steady"],
+        "zero_loss": bool(static["zero_loss"] and elastic["zero_loss"]),
+        "scale_up_cold_compiles": elastic["cold_compiled_buckets"],
+        "note": ("forced-host engines burn real cores: on this "
+                 f"{cores}-core rig (measured {host_par:g}x effective "
+                 "parallelism at bench time) the second engine only "
+                 "adds what the host grants, so spike p99 is bounded "
+                 "by the host, not the autoscaler; real engines add a "
+                 "whole chip each. Steady p99 excludes each phase's "
+                 "first half (the scale-up convergence window)."),
     }
     print(json.dumps(out))
     return 0
@@ -1387,6 +1813,18 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--pin-core", type=int, default=None,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--elastic", action="store_true",
+                    help="diurnal+spike traffic replay: static fleet vs "
+                         "autoscaled elastic fleet (adaptive batching, "
+                         "tiered admission rails; ISSUE 11)")
+    ap.add_argument("--batch-policy", default="adaptive",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--batch-timeout-ms", type=int, default=2,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--slo-latency-ms", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.fleet_child:
         if not (args.broker_url and args.engine_id):
@@ -1395,6 +1833,8 @@ def main():
         return _fleet_child(args)
     if args.engines:
         return _fleet_main(args)
+    if args.elastic:
+        return _elastic_main(args)
     if args.chaos:
         return _chaos_main(args)
     if args.devices:

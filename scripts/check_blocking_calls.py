@@ -20,6 +20,12 @@ over `analytics_zoo_tpu/serving/`:
   claim-sweep threads must never park forever on an Event or Condition
   a dead peer will never signal — pass `wait(timeout)` in a loop).
 - `socket.create_connection(...)` must pass `timeout=`.
+- control-loop modules (`serving/fleet.py`, `serving/elastic.py` —
+  the autoscaler/heartbeat/admission control paths, ISSUE 11) may not
+  call `time.sleep(...)` at all: a sleep is uninterruptible by the
+  stop event, so every pause in a control loop must be a timed
+  `Event.wait(timeout)` that a shutdown can cut short. A scale-down
+  or gateway stop must never wait out someone's nap.
 
 And over the WHOLE `analytics_zoo_tpu/` package:
 
@@ -43,6 +49,13 @@ SERVING_PKG = os.path.join("analytics_zoo_tpu", "serving")
 WHOLE_PKG = "analytics_zoo_tpu"
 
 ALLOW_RE = re.compile(r"#\s*blocking-ok:\s*\S")
+# modules whose loops steer the fleet: no time.sleep, only stop-event
+# waits (a sleep delays shutdown/retire by its full duration)
+CONTROL_LOOP_FILES = (
+    os.path.join(SERVING_PKG, "fleet.py"),
+    os.path.join(SERVING_PKG, "elastic.py"),
+)
+SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 BARE_EXCEPT_RE = re.compile(r"^\s*except\s*:", re.MULTILINE)
 GET_NOARG_RE = re.compile(r"\.get\(\s*\)")
 JOIN_NOARG_RE = re.compile(r"\.join\(\s*\)")
@@ -92,6 +105,16 @@ def check_file(path: str, serving: bool) -> List[str]:
                           "SystemExit; name the exception)")
     if not serving:
         return errors
+
+    if any(path.replace(os.sep, "/").endswith(f.replace(os.sep, "/"))
+           for f in CONTROL_LOOP_FILES):
+        for m in SLEEP_RE.finditer(src):
+            if not _allowed(src, m.start()):
+                errors.append(
+                    f"{path}:{_line_of(src, m.start())}: time.sleep() "
+                    "in a fleet control-loop module delays shutdown/"
+                    "retire by its full duration; use a timed "
+                    "stop-Event wait(timeout) instead")
 
     for m in GET_NOARG_RE.finditer(src):
         if not _allowed(src, m.start()):

@@ -95,6 +95,18 @@ REQUIRED = {
     "serving_engines_total": "counter",
     "serving_engine_heartbeats_total": "counter",
     "serving_claimed_records_total": "counter",
+    # elastic serving (ISSUE 11): the adaptive-batching cost model and
+    # controller telemetry, tiered admission outcomes, and autoscaler
+    # state — the families the elastic bench JSON, the docs tables, and
+    # any capacity dashboard read
+    "serving_bucket_ms": "histogram",
+    "serving_bucket_cost_ms": "gauge",
+    "serving_queue_age_ms": "histogram",
+    "serving_chosen_bucket_total": "counter",
+    "serving_admission_total": "counter",
+    "serving_backlog_depth": "gauge",
+    "serving_engines_target": "gauge",
+    "serving_autoscaler_decisions_total": "counter",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",

@@ -21,9 +21,15 @@ def ctx():
 
 
 def _toy_model():
+    import optax
     m = Sequential([L.Dense(8, activation="relu", input_shape=(4,)),
                     L.Dense(2, activation="softmax")])
-    m.compile("adam", "sparse_categorical_crossentropy",
+    # explicit lr 0.05: the default adam(1e-3) moves this 4-feature toy
+    # ~0.02 loss in 40 epochs from the fixed PRNGKey(0) init — the
+    # accuracy gate then measured the draw, not the estimator
+    # (deterministically 0.21 at base). At 0.05 the same fixed seed
+    # converges to accuracy 1.0 in a few epochs, every run.
+    m.compile(optax.adam(0.05), "sparse_categorical_crossentropy",
               metrics=["accuracy"])
     return m
 

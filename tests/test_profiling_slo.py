@@ -44,6 +44,7 @@ from analytics_zoo_tpu.observability import (CaptureActiveError,
                                              render_prometheus,
                                              set_session_roofline)
 from analytics_zoo_tpu.observability import roofline as roofline_mod
+from analytics_zoo_tpu.observability.registry import MetricsRegistry
 from analytics_zoo_tpu.serving import (ClusterServing, InferenceModel,
                                        InputQueue, MemoryBroker, OutputQueue)
 from analytics_zoo_tpu.serving.http_frontend import FrontEnd
@@ -112,9 +113,20 @@ class TestCostOf:
         assert cost_of(Broken()) is None
 
 
+@pytest.fixture()
+def isolated_registry():
+    """A fresh MetricsRegistry per test: the accountant math tests
+    assert EXACT counter values, and the process-global registry
+    accumulates roofline series from any training that ran earlier in
+    the same pytest process (e.g. test_fault_tolerance's auto-resume
+    fits) — cross-file contamination that made these flake depending on
+    collection order."""
+    return MetricsRegistry()
+
+
 class TestAccountant:
-    def test_account_math_and_session_roofline(self):
-        reg = get_registry()
+    def test_account_math_and_session_roofline(self, isolated_registry):
+        reg = isolated_registry
         acct = RooflineAccountant(registry=reg)
         # a deterministic denominator: achieved GB/s and TFLOP/s known
         set_session_roofline(hbm_gbps=100.0, tflops=10.0, registry=reg)
@@ -132,8 +144,9 @@ class TestAccountant:
             kind="train") == pytest.approx(0.1)
         assert reg.get("roofline_session_hbm_gbps").value() == 100.0
 
-    def test_reset_starts_gauges_clean_but_counters_accumulate(self):
-        reg = get_registry()
+    def test_reset_starts_gauges_clean_but_counters_accumulate(
+            self, isolated_registry):
+        reg = isolated_registry
         acct = RooflineAccountant(registry=reg)
         acct.account("serving", 100.0, 100.0, 1.0)
         before = reg.get("roofline_flops_total").value(kind="serving")

@@ -276,13 +276,13 @@ class TestIdempotentWriteback:
         broker = MemoryBroker()
         s = _identity_engine(broker, engine_id="e1", registry=reg)
         entry = ({"u1": "r1", "u2": "r2"}, ["1-1", "1-2"],
-                 time.perf_counter(), time.perf_counter())
+                 time.perf_counter(), time.perf_counter(), False)
         assert s._write_entry(entry)
         assert s.records_served == 2
         # the same records come back (claimed after a fake crash):
         # identical result values, but served must not double-count
         entry2 = ({"u1": "r1", "u2": "r2"}, ["1-1", "1-2"],
-                  time.perf_counter(), time.perf_counter())
+                  time.perf_counter(), time.perf_counter(), False)
         assert s._write_entry(entry2)
         assert s.records_served == 2
         fam = reg.get("serving_records_total")
@@ -301,7 +301,7 @@ class TestIdempotentWriteback:
         # simulate the partial commit: results landed, ack/reply lost
         broker.hset_many(RESULT_KEY, {"u1": "r1", "u2": "r2"})
         entry = ({"u1": "r1", "u2": "r2"}, ["1-1", "1-2"],
-                 time.perf_counter(), time.perf_counter())
+                 time.perf_counter(), time.perf_counter(), False)
         s._wb_buffer.append(entry)
         s._flush_writebacks()
         assert not s._wb_buffer
