@@ -33,7 +33,40 @@ import sys
 import time
 from typing import Dict, List, Optional, Sequence
 
-__all__ = ["launch_local_cluster", "wait_all", "ProcessMonitor"]
+__all__ = ["launch_local_cluster", "wait_all", "ProcessMonitor",
+           "force_cpu_devices"]
+
+
+def force_cpu_devices(n: int) -> None:
+    """Force the CPU backend with `n` virtual devices, across jax
+    versions: newer jax exposes a `jax_num_cpu_devices` config option;
+    older ones reject it (`Unrecognized config option`) and need the
+    `--xla_force_host_platform_device_count` XLA flag instead. Must run
+    before the CPU backend initializes (both spellings are
+    backend-construction-time knobs); the callers here sit at process
+    start, before any device use."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        import re
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={int(n)}"
+        ).strip()
+    try:
+        # cross-process collectives on the CPU backend: jax versions
+        # that gate them behind a collectives implementation raise
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend" until one is selected; gloo ships in jaxlib. A no-op
+        # for single-process runs and absent on jax trees that predate
+        # (or retired) the option.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
 
 
 def _free_port() -> int:
@@ -135,10 +168,7 @@ def _worker_main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.platform == "cpu":
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.devices_per_process)
+        force_cpu_devices(args.devices_per_process)
     else:
         import jax  # noqa: F401
 

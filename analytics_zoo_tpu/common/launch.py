@@ -175,10 +175,8 @@ def _bootstrap_devices(n: int, script: str, script_args: Sequence[str]):
     sitecustomize), then run the user script as __main__."""
     import runpy
 
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    from analytics_zoo_tpu.common.cluster import force_cpu_devices
+    force_cpu_devices(n)
     sys.argv = [script, *script_args]
     runpy.run_path(script, run_name="__main__")
 

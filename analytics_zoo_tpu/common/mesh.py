@@ -121,6 +121,35 @@ class DeviceMesh:
         return f"DeviceMesh({axes or 'single-device'})"
 
 
+def validate_axis_names(axes) -> None:
+    """THE axis-vocabulary check for config-driven mesh construction —
+    serving-config load and `mesh_from_axes` both call it, so the
+    vocabulary and its error can never drift between the two sites."""
+    unknown = set(axes) - set(AXIS_NAMES)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axis name(s) {sorted(unknown)}; valid axes: "
+            f"{list(AXIS_NAMES)}")
+
+
+def mesh_from_axes(axes: Dict[str, int],
+                   devices: Optional[Sequence[jax.Device]] = None
+                   ) -> DeviceMesh:
+    """DeviceMesh from a plain axis→size mapping (the serving-config /
+    CLI spelling, e.g. ``{"data": 1, "fsdp": 2, "tensor": 4}``) — ONE
+    validation point for config-driven mesh construction, so a typo'd
+    axis name fails with the axis vocabulary instead of a dataclass
+    TypeError. Sizes follow MeshConfig semantics (-1 infers one axis
+    from the device count; unlisted axes default per MeshConfig)."""
+    validate_axis_names(axes)
+    try:
+        sizes = {k: int(v) for k, v in axes.items()}
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"mesh axis sizes must be integers, got {axes!r}") from None
+    return DeviceMesh(MeshConfig(**sizes), devices)
+
+
 def local_mirror_mesh(n: int = 1) -> DeviceMesh:
     """Single-host mesh over the first n local devices (testing helper)."""
     return DeviceMesh(MeshConfig(data=n), jax.local_devices()[:n])

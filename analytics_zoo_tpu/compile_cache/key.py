@@ -227,10 +227,14 @@ class CacheKey:
 
 
 def make_key(kind: str, model_fp: str, signature, placement: str = "none",
-             sharding: str = "", extra: Any = None) -> CacheKey:
+             sharding: str = "", extra: Any = None,
+             dtype: str = "") -> CacheKey:
     """Build the full cache key. `kind` separates serving forwards from
     trainer steps; `signature` is `abstract_signature(...)` of the call
-    args; `sharding` describes the mesh layout for sharded placement."""
+    args; `sharding` describes the mesh layout for sharded placement;
+    `dtype` names a non-default serving precision ("int8") so a
+    quantize toggle is a guaranteed miss — empty ("", the f32 default)
+    adds NO field, keeping pre-existing keys byte-identical."""
     import jax
     try:
         backend = jax.default_backend()
@@ -252,6 +256,8 @@ def make_key(kind: str, model_fp: str, signature, placement: str = "none",
         "placement": placement,
         "sharding": sharding,
     }
+    if dtype:
+        fields["dtype"] = dtype
     if extra is not None:
         fields["extra"] = fingerprint(extra)
     return CacheKey(fields)

@@ -263,7 +263,12 @@ class CheckpointManager:
         while len(self._saved) > self.keep:
             it = self._saved.pop(0)
             for pat in (f"model.{it}", f"optimMethod-{self.optim_name}.{it}"):
-                for suffix in (".npz", ".structure.json", ".meta.json"):
+                # .int8.* is the quantization sidecar (ISSUE 12): it
+                # lives and dies with its checkpoint version, or the
+                # keep=N retention contract silently stops bounding the
+                # directory
+                for suffix in (".npz", ".structure.json", ".meta.json",
+                               ".int8.npz", ".int8.structure.json"):
                     p = os.path.join(self.run_dir, pat + suffix)
                     if os.path.exists(p):
                         os.remove(p)
@@ -358,27 +363,36 @@ def find_resume_checkpoint(root: str) -> Optional[Tuple[str, int,
     return fallback
 
 
+def resolve_checkpoint(path: str,
+                       version: Optional[int] = None) -> Tuple[str, int]:
+    """THE root-vs-run-dir resolution, shared by `load_checkpoint`,
+    `InferenceModel.load_checkpoint` and the offline quantization
+    script — one copy, so the sidecar probe and the param load can
+    never resolve different directories. `version=None` → the newest
+    INTACT checkpoint anywhere under `path`; an explicit version →
+    `path` itself when it holds `model.<version>`, else the newest run
+    dir under `path` that does. Raises FileNotFoundError."""
+    if version is None:
+        found = latest_checkpoint(path)
+        if found is None:
+            raise FileNotFoundError(f"No checkpoint under {path}")
+        return found
+    if os.path.exists(os.path.join(path, f"model.{version}.npz")):
+        return path, version
+    found = latest_checkpoint(path)
+    if found and os.path.exists(
+            os.path.join(found[0], f"model.{version}.npz")):
+        return found[0], version
+    raise FileNotFoundError(f"No model.{version} under {path}")
+
+
 def load_checkpoint(path: str, version: Optional[int] = None,
                     optim_name: str = "default", verify: bool = True):
     """Load (params, opt_tree, meta) from a checkpoint dir. `path` may be the
     ckpt root or a run dir; `version=None` → latest. `verify=False` skips
     the CRC pass — for callers (auto-resume) that ran `checkpoint_intact`
     on this exact version moments earlier."""
-    if version is None:
-        found = latest_checkpoint(path)
-        if found is None:
-            raise FileNotFoundError(f"No checkpoint under {path}")
-        run_dir, version = found
-    else:
-        run_dir = path
-        mfile = os.path.join(run_dir, f"model.{version}.npz")
-        if not os.path.exists(mfile):
-            found = latest_checkpoint(path)
-            if found and os.path.exists(
-                    os.path.join(found[0], f"model.{version}.npz")):
-                run_dir = found[0]
-            else:
-                raise FileNotFoundError(f"No model.{version} under {path}")
+    run_dir, version = resolve_checkpoint(path, version)
     params = load_pytree(os.path.join(run_dir, f"model.{version}"),
                          verify=verify)
     opt_tree = None

@@ -44,6 +44,15 @@ from analytics_zoo_tpu.learn import trainer
 log = logging.getLogger("analytics_zoo_tpu.estimator")
 
 
+class QuantizationQualityError(ValueError):
+    """The int8-quantized model's eval metrics drifted past the
+    configured tolerance from the f32 baseline — the quality gate of
+    `Estimator.evaluate(..., quantize="int8", quality_tolerance=...)`
+    refusing to bless a quantized artifact for serving (the
+    OpenVINOInt8Suite predict-equivalence contract, made a hard
+    gate)."""
+
+
 def to_dataset(data, batch_size: int = -1, batch_per_thread: int = -1,
                feature_cols: Optional[Sequence[str]] = None,
                label_cols: Optional[Sequence[str]] = None) -> TPUDataset:
@@ -303,7 +312,27 @@ class Estimator:
         return preds
 
     def evaluate(self, data, batch_per_thread: int = 32, metrics=None,
-                 feature_cols=None, label_cols=None) -> Dict[str, float]:
+                 feature_cols=None, label_cols=None,
+                 quantize: Optional[str] = None,
+                 quality_tolerance: Optional[float] = None,
+                 baseline_metrics: Optional[Dict[str, float]] = None
+                 ) -> Dict[str, float]:
+        """`quantize="int8"` evaluates the POST-TRAINING-QUANTIZED
+        model (per-output-channel int8 weights,
+        `serving/quantization.py`) instead of the f32 one, and — with
+        `quality_tolerance` — enforces the quality gate: every metric
+        must sit within `quality_tolerance` (absolute) of the f32
+        baseline or the call raises `QuantizationQualityError`, so a
+        quantized model that lost accuracy can never be blessed for
+        serving. The baseline is evaluated on the spot unless
+        `baseline_metrics` (a prior f32 `evaluate()` result) is
+        passed; the return carries the quantized metrics plus the
+        baseline as `baseline_<name>` entries."""
+        if quantize is not None:
+            return self._evaluate_quantized(
+                data, batch_per_thread, metrics, feature_cols,
+                label_cols, quantize, quality_tolerance,
+                baseline_metrics)
         ds = to_dataset(data, batch_per_thread=batch_per_thread,
                         feature_cols=feature_cols, label_cols=label_cols)
         if metrics:
@@ -342,6 +371,64 @@ class Estimator:
         return self.model.evaluate(x, y,
                                    batch_per_thread=batch_per_thread,
                                    metrics=ms)
+
+    def _evaluate_quantized(self, data, batch_per_thread, metrics,
+                            feature_cols, label_cols, quantize,
+                            quality_tolerance,
+                            baseline_metrics) -> Dict[str, float]:
+        """The quantized leg of `evaluate`: f32 baseline (given or
+        evaluated here), then the same evaluation with the model's
+        params swapped for the int8 rewrite (the layers dispatch on the
+        quantized keys; the f32 master params are restored whatever
+        happens), then the tolerance gate."""
+        if quantize != "int8":
+            raise ValueError(
+                f"Unsupported quantize={quantize!r}; only 'int8'")
+        from analytics_zoo_tpu.serving.quantization import \
+            quantize_model_params
+        base = baseline_metrics if baseline_metrics is not None else \
+            self.evaluate(data, batch_per_thread=batch_per_thread,
+                          metrics=metrics, feature_cols=feature_cols,
+                          label_cols=label_cols)
+        if self.model.params is None:
+            raise ValueError("Model has no parameters; fit or load first")
+        f32_params = self.model.params
+        q = quantize_model_params(self.model,
+                                  jax.device_get(f32_params))
+        try:
+            self.model.params = q
+            quantized = self.evaluate(
+                data, batch_per_thread=batch_per_thread,
+                metrics=metrics, feature_cols=feature_cols,
+                label_cols=label_cols)
+        finally:
+            self.model.params = f32_params
+        if quality_tolerance is not None:
+            # `not (|Δ| <= tol)`, NOT `|Δ| > tol`: a NaN metric (an
+            # int8 rewrite that overflowed) compares False either way,
+            # and the gate must REFUSE what it cannot prove within
+            # tolerance rather than bless it
+            drifted = {
+                name: (base[name], quantized[name])
+                for name in quantized
+                if name in base
+                and not (abs(quantized[name] - base[name])
+                         <= quality_tolerance)}
+            if drifted:
+                detail = ", ".join(
+                    f"{n}: f32={b:.6g} int8={q_:.6g} "
+                    f"(|Δ|={abs(q_ - b):.6g})"
+                    for n, (b, q_) in sorted(drifted.items()))
+                raise QuantizationQualityError(
+                    f"int8 quantization drifted {len(drifted)} metric(s) "
+                    f"past the quality gate (tolerance "
+                    f"{quality_tolerance:g}): {detail}. Refusing to "
+                    "bless the quantized model; raise the tolerance "
+                    "only if this accuracy loss is acceptable, or keep "
+                    "serving f32/bf16.")
+        out = dict(quantized)
+        out.update({f"baseline_{k}": v for k, v in base.items()})
+        return out
 
     # -- persistence (`orca` save/load + load_orca_checkpoint) ------------
     def get_model(self):

@@ -69,12 +69,31 @@ class _TrainingMetrics:
         self.step_retries = reg.counter(
             "training_step_retries_total",
             "failed/hung training steps retried by the step watchdog")
+        self.mesh_axis = reg.gauge(
+            "training_mesh_axis_size",
+            "device-mesh axis extents of the sharded fit, labeled by "
+            "axis (a tensor extent > 1 means column/row-parallel "
+            "placement is live)")
         self.fused_update_ms = reg.histogram(
             "training_fused_update_ms",
             "measured wall time of one fused-kernel optimizer sweep "
             "over the model's parameter tree (observed once per "
             "model/step-program build, not per fit — warm re-fits "
             "skip the probe)")
+
+    def mesh_axes(self, mesh) -> None:
+        """Publish the sharded fit's mesh factorization (one series per
+        axis) so a scrape can tell a pure-fsdp fit from a tensor-
+        parallel one without reading logs. `mesh=None` (a non-sharded
+        fit) resets every axis to 1 — a later replicated fit must not
+        leave a previous fit's factorization reading as live."""
+        if mesh is None:
+            from analytics_zoo_tpu.common.mesh import AXIS_NAMES
+            sizes = {a: 1 for a in AXIS_NAMES}
+        else:
+            sizes = mesh.axis_sizes
+        for ax, size in sizes.items():
+            self.mesh_axis.set(size, axis=ax)
 
     def epoch(self, steps: int, n_seen: int, dt: float, mean_loss: float,
               flops_per_step: Optional[float] = None):
@@ -610,13 +629,13 @@ def _shard_mapped_fused(fused_apply, shardings):
     elementwise per leaf, so any partitioning is numerically exact;
     grads arrive already reduced across the batch axes (GSPMD inserts
     the all-reduce upstream to satisfy the entry specs)."""
-    from jax.experimental.shard_map import shard_map
+    from analytics_zoo_tpu.parallel.compat import shard_map
     p_specs = jax.tree_util.tree_map(lambda s: s.spec, shardings["params"])
     o_specs = jax.tree_util.tree_map(lambda s: s.spec, shardings["opt"])
     mesh = jax.tree_util.tree_leaves(shardings["params"])[0].mesh
     return shard_map(fused_apply, mesh=mesh,
                      in_specs=(p_specs, o_specs, p_specs),
-                     out_specs=(p_specs, o_specs), check_rep=False)
+                     out_specs=(p_specs, o_specs), check=False)
 
 
 def _fused_kernel_correction(optimizer, lazy_specs, params, opt_state,
@@ -961,6 +980,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               metrics_report_s: Optional[float] = None,
               compile_cache_dir: Optional[str] = None,
               auto_resume: bool = False,
+              int8_sidecar: bool = False,
               step_retries: int = 0,
               step_timeout_s: Optional[float] = None,
               profile_steps: Optional[Tuple[int, int]] = None,
@@ -1035,6 +1055,14 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     `roofline_hbm_utilization{kind="train"}` — no flops_per_step
     needed) publish automatically each epoch; set `ZOO_ROOFLINE=0` to
     skip the one-time per-signature lowering they cost.
+    `int8_sidecar=True` runs the post-training quantization pass at
+    every checkpoint save (ISSUE 12): per-output-channel scales are
+    calibrated from the just-saved weights and persisted as an int8
+    sidecar beside `model.<iteration>`
+    (`serving/quantization.write_int8_sidecar`), so
+    `InferenceModel.load_checkpoint(..., quantize="int8")` serves the
+    pre-calibrated artifact with no quantize-at-load pass. A sidecar
+    write failure logs one warning and never fails the fit.
     `auto_resume=True` (needs `model.set_checkpoint(...)`) scans the
     checkpoint root for the newest INTACT epoch-boundary checkpoint
     before training and continues from it: params, optimizer state,
@@ -1429,6 +1457,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         writer = SummaryWriter(model._tensorboard_dir + "/train")
 
     telemetry = _TrainingMetrics()
+    telemetry.mesh_axes(mesh if shard_rules is not None else None)
     reporter = None
     if metrics_report_s:
         from analytics_zoo_tpu.observability.reporter import MetricsReporter
@@ -1566,6 +1595,28 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 "rng": np.asarray(jax.device_get(rng)).ravel().tolist(),
                 "opt_state_layout": opt_layout}
 
+    def _ckpt_save(extra: Dict[str, Any]) -> None:
+        """ONE checkpoint-commit funnel for every save site (mid-epoch
+        trigger, epoch boundary, emergency): gather the sharded state to
+        host exactly once, commit the checkpoint set, and — with
+        `int8_sidecar` — run the post-training quantization pass on the
+        SAME gathered params so the sidecar always matches the version
+        it sits beside. Sidecar failure is one warning, never a failed
+        fit (serving falls back to quantize-at-load)."""
+        host_params = gather_tree(params)
+        ckpt_mgr.save(iteration, host_params, gather_tree(opt_state),
+                      extra=extra)
+        if int8_sidecar:
+            try:
+                from analytics_zoo_tpu.serving.quantization import \
+                    write_int8_sidecar
+                write_int8_sidecar(ckpt_mgr.run_dir, iteration, model,
+                                   params=host_params)
+            except Exception as e:  # noqa: BLE001 — sidecar is optional
+                log.warning("int8 sidecar write failed at iteration %d "
+                            "(%s: %s); serving will quantize at load",
+                            iteration, type(e).__name__, e)
+
     history: Dict[str, List[float]] = {"loss": []}
     batches = None
     epoch = start_epoch
@@ -1624,15 +1675,13 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
                         tg.TriggerState(epoch=epoch, iteration=iteration,
                                         loss=last_loss)):
-                    # the sidecar records the opt-state layout (plus
-                    # the resume cursors/RNG), so a future restore
-                    # can't silently structurally mismatch a fused
-                    # fit's state against a plain one
+                    # the meta sidecar records the opt-state layout
+                    # (plus the resume cursors/RNG), so a future
+                    # restore can't silently structurally mismatch a
+                    # fused fit's state against a plain one.
                     # gather_tree, not bare device_get: correct (and
                     # actionably failing cross-host) for sharded leaves
-                    ckpt_mgr.save(iteration, gather_tree(params),
-                                  gather_tree(opt_state),
-                                  extra=_ckpt_extra(epoch, False))
+                    _ckpt_save(_ckpt_extra(epoch, False))
                 if end_trigger and end_trigger(
                         tg.TriggerState(epoch=epoch, iteration=iteration,
                                         loss=last_loss)):
@@ -1693,9 +1742,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
           if checkpoint_trigger and ckpt_mgr and checkpoint_trigger(
                   tg.TriggerState(epoch=epoch + 1, iteration=iteration,
                                   epoch_finished=True)):
-              ckpt_mgr.save(iteration, gather_tree(params),
-                            gather_tree(opt_state),
-                            extra=_ckpt_extra(epoch + 1, True))
+              _ckpt_save(_ckpt_extra(epoch + 1, True))
           if end_trigger and end_trigger(
                   tg.TriggerState(epoch=epoch + 1, iteration=iteration,
                                   epoch_finished=True)):
@@ -1714,12 +1761,12 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
             # emergency save would demote a boundary checkpoint's
             # metadata to mid-epoch for identical params)
             try:
-                from analytics_zoo_tpu.learn.checkpoint import gather_tree
-                ckpt_mgr.save(iteration,
-                              gather_tree(params),
-                              gather_tree(opt_state),
-                              extra=dict(_ckpt_extra(epoch, False),
-                                         emergency=True))
+                # through the SAME commit funnel as every other save
+                # site — the emergency checkpoint gets the int8 sidecar
+                # too, so a crash can't leave a newest version serving
+                # falls back to quantize-at-load on
+                _ckpt_save(dict(_ckpt_extra(epoch, False),
+                                emergency=True))
                 log.warning("emergency checkpoint written at iteration "
                             "%d", iteration)
             except Exception as ce:  # noqa: BLE001 — already failing

@@ -1,0 +1,58 @@
+"""jax API compatibility shims for the parallel package.
+
+`shard_map` has moved twice across jax releases — born in
+`jax.experimental.shard_map`, promoted to `jax.shard_map` (where the
+`check_rep` kwarg became `check_vma`) — and the old spelling is removed
+from versions that carry the new one, so no single import works
+everywhere. `pcast`/`pvary` (marking a value as varying over a mesh
+axis for the new shard_map's varying-axes type system) likewise exists
+only where that type system does. One resolution point here keeps
+`ring_attention.py` / `pipeline.py` / the trainer's fused-optimizer
+shard_map working on both sides of the drift; everything resolves at
+import time, so a broken jax fails loudly at import, not mid-dispatch.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax import lax
+
+
+def _resolve_shard_map():
+    impl = getattr(jax, "shard_map", None)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map as impl
+    return impl
+
+
+_SHARD_MAP = _resolve_shard_map()
+# `check_rep` (old) / `check_vma` (new) name the same knob: verify the
+# body's claimed replication/varying types. Neither existing → drop it.
+_CHECK_KW = next((k for k in ("check_vma", "check_rep")
+                  if k in inspect.signature(_SHARD_MAP).parameters), None)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check: bool = False):
+    """`shard_map` across jax spellings. `check` maps onto whichever of
+    `check_vma`/`check_rep` this jax has; default False — the callers
+    here use `ppermute` rings and masked `psum` broadcasts whose
+    replication types the older checker cannot prove."""
+    kw = {_CHECK_KW: check} if _CHECK_KW is not None else {}
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def pvary(x, axis_name: str):
+    """Mark `x` as varying over `axis_name` inside a shard_map body —
+    `lax.pvary` / `lax.pcast(..., to="varying")` where the varying-axes
+    type system exists, identity where it does not (the old shard_map
+    tracks replication, not variance, and needs no annotation)."""
+    fn = getattr(lax, "pvary", None)
+    if fn is not None:
+        return fn(x, axis_name)
+    fn = getattr(lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axis_name, to="varying")
+    return x

@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from analytics_zoo_tpu.common.mesh import BATCH_AXES, DeviceMesh
+from analytics_zoo_tpu.parallel.compat import shard_map
 
 NEG_INF = -1e30
 
@@ -101,12 +102,12 @@ def ring_attention(q, k, v, mask: Optional[jax.Array] = None, *,
 
     shard_fn = functools.partial(_ring_attention_shard, axis=axis)
     if mask is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda q, k, v: shard_fn(q, k, v, None),
             mesh=mesh.mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec)
         return fn(q, k, v)
-    fn = jax.shard_map(
+    fn = shard_map(
         shard_fn, mesh=mesh.mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec)

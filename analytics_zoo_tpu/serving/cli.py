@@ -35,7 +35,8 @@ def cmd_start(args) -> int:
     cfg = ServingConfig.load(args.config, num_replicas=replicas,
                              placement=getattr(args, "placement", None),
                              compile_cache_dir=getattr(
-                                 args, "compile_cache_dir", None))
+                                 args, "compile_cache_dir", None),
+                             mesh=getattr(args, "mesh", None))
     if getattr(args, "engine_id", None):
         # fleet override (ISSUE 10): each process in a scale-out gets
         # its own identity at launch ("auto" generates one)
@@ -70,8 +71,15 @@ def cmd_start(args) -> int:
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     model = cfg.build_model(broker=broker)
+    mesh_note = ""
+    if model.placement == "sharded" and model.mesh is not None:
+        axes = ",".join(f"{a}={s}"
+                        for a, s in model.mesh.axis_sizes.items()
+                        if s != 1)
+        mesh_note = f" mesh=[{axes or 'single-device'}]"
     print(f"placement={model.placement} replicas={model.num_replicas} "
-          f"devices={len(model.devices)}", flush=True)
+          f"devices={len(model.devices)}"
+          f"{mesh_note} dtype={model.serving_dtype}", flush=True)
     if cfg.warmup_shapes:
         # pre-compile every REACHABLE shape bucket BEFORE the stream
         # opens: no XLA compile ever lands on a request. The reader never
@@ -365,6 +373,13 @@ def main(argv=None) -> int:
     ps.add_argument("--placement", choices=["replicated", "sharded"],
                     default=None,
                     help="override params.placement")
+    ps.add_argument("--mesh", default=None,
+                    help="override params.mesh: the sharded placement's "
+                         'device-mesh factorization, e.g. '
+                         '"data=1,fsdp=2,tensor=4" (-1 infers one axis; '
+                         "a tensor extent > 1 engages column/row-"
+                         "parallel placement for bigger-than-one-chip "
+                         "models)")
     ps.add_argument("--compile-cache-dir", default=None,
                     help="override params.compile_cache_dir: persistent "
                          "AOT executable cache directory (warm restarts "

@@ -81,6 +81,14 @@ class ServingConfig:
     # every local device) or one GSPMD-sharded copy spanning all chips
     num_replicas: Any = 1                   # int, or "auto"
     placement: str = "replicated"           # replicated | sharded
+    # sharded-placement mesh factorization (ISSUE 12): params.mesh — a
+    # map {data: 1, fsdp: 2, tensor: 4} or the bare-parser string
+    # "data=1,fsdp=2,tensor=4". Axis names follow common/mesh.AXIS_NAMES
+    # (-1 infers one axis from the device count). Unset keeps the
+    # data=1 × fsdp=all default; a `tensor` extent > 1 engages the rule
+    # table's column/row-parallel specs for models whose activations
+    # must shard too (bigger than one chip).
+    mesh_axes: Optional[Dict[str, int]] = None
     # pipelined engine knobs (overlapped decode/compute/sink)
     pipelined: bool = True
     decode_workers: int = 2
@@ -179,7 +187,8 @@ class ServingConfig:
     @classmethod
     def load(cls, path: str, num_replicas=None,
              placement: Optional[str] = None,
-             compile_cache_dir: Optional[str] = None) -> "ServingConfig":
+             compile_cache_dir: Optional[str] = None,
+             mesh: Optional[str] = None) -> "ServingConfig":
         """`num_replicas`/`placement`/`compile_cache_dir` keyword
         overrides (the CLI flags) replace the file's values BEFORE
         validation, so an override can rescue a config authored for a
@@ -205,6 +214,8 @@ class ServingConfig:
             else params.get("num_replicas", 1)
         cfg.placement = placement if placement is not None \
             else str(params.get("placement", "replicated"))
+        cfg.mesh_axes = _parse_mesh_axes(
+            mesh if mesh is not None else params.get("mesh"))
         # fail HERE, not deep inside the dispatch stage: a bad placement
         # string or a replica count the host cannot satisfy is a config
         # error, and config errors belong at load time
@@ -339,6 +350,18 @@ class ServingConfig:
             raise ValueError(
                 f"params.placement={self.placement!r} is not one of "
                 f"{'/'.join(PLACEMENTS)}")
+        if self.mesh_axes is not None:
+            if self.placement != "sharded":
+                raise ValueError(
+                    "params.mesh describes the sharded placement's "
+                    f"device-mesh factorization but placement is "
+                    f"{self.placement!r}; set params.placement: sharded "
+                    "(or drop the mesh block)")
+            from analytics_zoo_tpu.common.mesh import validate_axis_names
+            try:
+                validate_axis_names(self.mesh_axes)
+            except ValueError as e:
+                raise ValueError(f"params.mesh: {e}") from None
         n = self.num_replicas
         if n is None or n == "auto":   # bare `num_replicas:` == auto,
             return                     # matching InferenceModel(None)
@@ -534,8 +557,13 @@ class ServingConfig:
             n = "auto"                   # None / "auto" (just validated)
         if n in (0, -1):
             n = "auto"
+        mesh = None
+        if self.mesh_axes is not None:
+            from analytics_zoo_tpu.common.mesh import mesh_from_axes
+            mesh = mesh_from_axes(self.mesh_axes)
         im = InferenceModel(concurrent_num=self.concurrent_num,
                             num_replicas=n, placement=self.placement,
+                            mesh=mesh,
                             compile_cache=self.build_compile_cache())
         secret = salt = None
         if self.model_encrypted:
@@ -610,6 +638,43 @@ def _parse_bytes(raw) -> Optional[int]:
             pass
     raise ValueError(f"cannot parse byte count {raw!r} "
                      '(use an int, or "512K"/"128M"/"2G")')
+
+
+def _parse_mesh_axes(raw) -> Optional[Dict[str, int]]:
+    """Mesh factorization from config: a YAML map ``{data: 1, fsdp: 2,
+    tensor: 4}`` or (bare-parser / CLI friendly) one "data=1,fsdp=2,
+    tensor=4" string. Axis-name validation happens in
+    `_validate_placement` (one vocabulary, one error site)."""
+    if raw is None:
+        return None
+    if isinstance(raw, str):
+        out: Dict[str, int] = {}
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, sep, value = part.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"params.mesh entry {part!r} must be axis=size "
+                    '(e.g. "data=1,fsdp=2,tensor=4")')
+            try:
+                out[name.strip()] = int(value)
+            except ValueError:
+                raise ValueError(
+                    f"params.mesh size {value!r} for axis "
+                    f"{name.strip()!r} must be an integer") from None
+        return out or None
+    if isinstance(raw, dict):
+        try:
+            return {str(k): int(v) for k, v in raw.items()} or None
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"params.mesh sizes must be integers, got {raw!r}"
+            ) from None
+    raise ValueError(
+        f"params.mesh={raw!r} must be a map of axis: size entries or "
+        'one "data=1,fsdp=2,tensor=4" string')
 
 
 def _parse_tiers(raw) -> Optional[list]:

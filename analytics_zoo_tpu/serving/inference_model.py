@@ -380,6 +380,9 @@ class InferenceModel:
         # empty ⇒ every predict path is byte-for-byte the legacy jit
         self._aot: Dict[tuple, Any] = {}
         self._model_fp: Optional[str] = None
+        # serving precision (ISSUE 12): set by load_fn from the weight
+        # leaves; "float32" until a model loads
+        self.serving_dtype: str = "float32"
         # roofline accounting (ISSUE 6): per-bucket XLA cost-analysis
         # FLOPs/bytes harvested at warmup, charged per materialized
         # batch against the measured predict time. Empty until warmup
@@ -432,10 +435,64 @@ class InferenceModel:
             lambda p, x: net.apply(p, x, training=False),
             load_quantized(net, path))
 
+    def load_checkpoint(self, model, path: str,
+                        version: Optional[int] = None,
+                        quantize: Optional[str] = None
+                        ) -> "InferenceModel":
+        """Serve a TRAINING checkpoint (`learn/checkpoint.py` layout)
+        on `model`'s architecture. `quantize="int8"` prefers the
+        checkpoint's pre-calibrated int8 sidecar
+        (`fit_keras(int8_sidecar=True)` /
+        `scripts/quantize_checkpoint.py`) — the shipped-artifact shape
+        of the reference's int8 OpenVINO IR — and falls back to
+        quantize-at-load when no intact sidecar exists (a torn sidecar
+        costs a calibration, never the serve)."""
+        from analytics_zoo_tpu.learn import checkpoint as ckpt_mod
+        from analytics_zoo_tpu.models.common import ZooModel
+        net = model.model if isinstance(model, ZooModel) else model
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(
+                    f"Unsupported quantize={quantize!r}; only 'int8'")
+            # ONE resolution (shared with checkpoint.load_checkpoint),
+            # reused below so the fallback never re-runs the CRC scan
+            found = ckpt_mod.resolve_checkpoint(path, version)
+            from analytics_zoo_tpu.serving.quantization import \
+                load_int8_sidecar
+            q = load_int8_sidecar(*found)
+            if q is not None:
+                remap = getattr(net, "_remap_loaded", None)
+                return self.load_fn(
+                    lambda p, x: net.apply(p, x, training=False),
+                    remap(q) if remap is not None else q)
+            path, version = found
+        params, _, _ = ckpt_mod.load_checkpoint(path, version)
+        remap = getattr(net, "_remap_loaded", None)
+        if remap is not None:
+            params = remap(params)
+        return self.load_keras(net, params=params, quantize=quantize)
+
+    @staticmethod
+    def _infer_serving_dtype(params) -> str:
+        """What precision this model SERVES in, from the weight leaves:
+        any int8 leaf means the quantized MXU path ("int8"), else bf16
+        weights mean "bfloat16", else "float32". The label every
+        `serving_*` metric/span carries when non-default, and an
+        explicit component of the compile-cache key — toggling dtype
+        can never load the other precision's executable."""
+        dtypes = {str(getattr(leaf, "dtype", ""))
+                  for leaf in jax.tree_util.tree_leaves(params)}
+        if "int8" in dtypes:
+            return "int8"
+        if "bfloat16" in dtypes:
+            return "bfloat16"
+        return "float32"
+
     def load_fn(self, fn: Callable, params) -> "InferenceModel":
         """Pure `fn(params, x)` forward."""
         self.close()               # reload: retire any old replica pool
         self._fn = fn
+        self.serving_dtype = self._infer_serving_dtype(params)
         # one jit wrapper; jax caches an executable per input shape AND
         # per committed device/sharding, so each (replica, bucket) pair
         # gets its own cached executable with no bookkeeping here
@@ -588,8 +645,16 @@ class InferenceModel:
                 sharding_descriptor
             sharding = sharding_descriptor(self.mesh,
                                            devices=self.devices)
+        # serving_dtype is an EXPLICIT key component (the params
+        # structure already differs between f32 and int8 trees, but the
+        # isolation must not hinge on a fingerprint heuristic): an int8
+        # reload can never deserialize the bf16/f32 executable, and
+        # vice versa. Default-f32 keys stay byte-identical to pre-ISSUE
+        # 12 entries (no fleet-wide cache invalidation).
         return make_key("serving", self._model_fp or "", sig,
-                        placement=self.placement, sharding=sharding)
+                        placement=self.placement, sharding=sharding,
+                        dtype=self.serving_dtype
+                        if self.serving_dtype != "float32" else "")
 
     def _aot_call(self, replica_idx: int, params, x):
         """One forward through the AOT table when it has an executable
@@ -925,11 +990,27 @@ class InferenceModel:
                      "quarantined": r.quarantined}
                     for r in self._replicas]
 
+    def weight_bytes(self) -> int:
+        """LOGICAL bytes of the loaded weight leaves (one copy's worth —
+        replication and sharding don't change the number; a sharded
+        jax.Array reports its global nbytes). 0 until a model loads.
+        The honest byte price the `serving_weight_bytes` gauge
+        publishes: int8 weights read ~4x under their f32 source."""
+        if self._replicas:
+            tree = self._replicas[0].params
+        else:
+            tree = self._params
+        if tree is None:
+            return 0
+        return sum(int(getattr(leaf, "nbytes", 0))
+                   for leaf in jax.tree_util.tree_leaves(tree))
+
     def placement_info(self) -> Dict[str, Any]:
         """Placement summary for `ClusterServing.metrics()` / the CLI."""
         info: Dict[str, Any] = {"placement": self.placement,
                                 "num_replicas": self.num_replicas,
-                                "n_devices": len(self.devices)}
+                                "n_devices": len(self.devices),
+                                "serving_dtype": self.serving_dtype}
         if self.placement == "sharded" and self.mesh is not None:
             info["mesh"] = {a: s for a, s in self.mesh.axis_sizes.items()
                             if s != 1}

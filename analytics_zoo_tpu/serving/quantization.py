@@ -16,6 +16,13 @@ Entry points:
 - `quantize_model_params(model, params)` → quantized pytree for any
   Sequential/Model/ZooModel built from the stock layer library.
 - `InferenceModel.load_keras(..., quantize="int8")` (serving façade).
+- `write_int8_sidecar(run_dir, version, model, ...)` /
+  `load_int8_sidecar(...)` — the post-training quantization pass as a
+  CHECKPOINT SIDECAR (ISSUE 12): per-output-channel scales + int8
+  weights persisted beside `model.<version>` so serving loads the
+  pre-calibrated artifact instead of re-quantizing per restart
+  (producers: `fit_keras(int8_sidecar=True)` and
+  `scripts/quantize_checkpoint.py`).
 """
 
 from __future__ import annotations
@@ -210,6 +217,72 @@ def save_quantized(model, path: str, params=None) -> Dict[str, Any]:
     q = quantize_model_params(net, jax.device_get(params))
     net.save_weights(path, params=q)
     return q
+
+
+def sidecar_path(run_dir: str, version: int) -> str:
+    """Canonical name of a checkpoint's int8 sidecar artifact (the
+    `.npz` + `.structure.json` pair `learn/checkpoint.save_pytree`
+    writes under this stem)."""
+    import os
+    return os.path.join(run_dir, f"model.{version}.int8")
+
+
+def write_int8_sidecar(run_dir: str, version: int, model,
+                       params=None) -> str:
+    """The post-training quantization pass, persisted: calibrate
+    symmetric per-output-channel scales from the checkpointed weights
+    and write the rewritten (int8 + scale) pytree as a sidecar beside
+    `model.<version>` — same atomic write-then-rename + CRC discipline
+    as the checkpoint itself, so a torn sidecar is invisible and
+    serving falls back to quantize-at-load. Returns the sidecar stem
+    path. `params` defaults to the checkpoint's own params (loaded from
+    disk), so the sidecar always describes exactly the version it sits
+    beside."""
+    from analytics_zoo_tpu.learn.checkpoint import (load_pytree,
+                                                    save_pytree)
+    from analytics_zoo_tpu.models.common import ZooModel
+    net = model.model if isinstance(model, ZooModel) else model
+    if params is None:
+        import os
+        params = load_pytree(os.path.join(run_dir, f"model.{version}"))
+        # an offline pass (scripts/quantize_checkpoint.py) runs in a
+        # fresh process whose auto-numbered layer names differ from the
+        # checkpointing process's — remap onto this instance before the
+        # layer walk (the trainer hook passes its own live params,
+        # whose names already match)
+        remap = getattr(net, "_remap_loaded", None)
+        if remap is not None:
+            params = remap(params)
+    q = quantize_model_params(net, jax.device_get(params))
+    path = sidecar_path(run_dir, version)
+    save_pytree(path, q)
+    try:
+        from analytics_zoo_tpu.observability.registry import get_registry
+        get_registry().counter(
+            "quantized_checkpoints_total",
+            "int8 checkpoint sidecars written by the post-training "
+            "quantization pass").inc()
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
+    return path
+
+
+def load_int8_sidecar(run_dir: str, version: int):
+    """The quantized pytree a `write_int8_sidecar` pass persisted, or
+    None when the sidecar is absent or fails its CRC (the caller falls
+    back to quantize-at-load — a torn sidecar costs a calibration, not
+    the serve)."""
+    import os
+
+    from analytics_zoo_tpu.learn.checkpoint import (CorruptCheckpointError,
+                                                    load_pytree)
+    path = sidecar_path(run_dir, version)
+    if not os.path.exists(path + ".npz"):
+        return None
+    try:
+        return load_pytree(path)
+    except (OSError, ValueError, KeyError, CorruptCheckpointError):
+        return None
 
 
 def load_quantized(model, path: str):

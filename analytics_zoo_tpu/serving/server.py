@@ -243,6 +243,14 @@ class ClusterServing:
         self.engine_id = engine_id
         self.consumer = engine_id or new_consumer_name()
         self._labels = {"engine": engine_id} if engine_id else {}
+        # serving precision (ISSUE 12): a NON-default dtype (int8
+        # quantized serving, bf16 weights) labels every serving_*
+        # series and span this engine publishes, same convention as the
+        # fleet `engine` label — the default-f32 schema stays
+        # byte-identical, and an int8-vs-bf16 A/B separates by label
+        self.serving_dtype = getattr(model, "serving_dtype", "float32")
+        if self.serving_dtype != "float32":
+            self._labels["serving_dtype"] = self.serving_dtype
         self.claim_min_idle_s = float(claim_min_idle_s)
         self.claim_interval_s = float(claim_interval_s)
         self.heartbeat_interval_s = float(heartbeat_interval_s)
@@ -450,6 +458,22 @@ class ClusterServing:
         wb_fn = (lambda buf=self._wb_buffer: len(buf))
         wb_gauge.set_function(wb_fn)
         self._gauge_installs.append((wb_gauge, wb_fn, {}, True))
+        # quantized serving (ISSUE 12): the honest weight-byte price
+        # per precision — an int8 model reads ~4x under its f32 source
+        # here, which is the HBM-bandwidth story behind the speedup
+        weight_fn = getattr(self.model, "weight_bytes", None)
+        if callable(weight_fn):
+            wtg = reg.gauge(
+                "serving_weight_bytes",
+                "logical bytes of the served model's weight leaves, "
+                "labeled by serving dtype (int8 quantization prices "
+                "weights at 1 byte/element)")
+            # engine label included like every other serving_* series
+            # (fleet aggregation must separate per-engine weight bytes)
+            wlabels = dict(self._labels,
+                           serving_dtype=self.serving_dtype)
+            wtg.set_function(weight_fn, **wlabels)
+            self._gauge_installs.append((wtg, weight_fn, wlabels, True))
 
     def _enqueue(self, q: "queue.Queue", batch: _Batch):
         """Stamp the enqueue time (the consumer's queue-wait span starts
@@ -1451,6 +1475,7 @@ class ClusterServing:
             "records_served": self.records_served,
             "records_read": self.records_read,
             "pipelined": self.pipelined,
+            "serving_dtype": self.serving_dtype,
             "batch": self.batch_timer.snapshot(),
             "predict": self.model.timer.snapshot(),
         }

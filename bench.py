@@ -306,6 +306,17 @@ def fit_scaling_summary(n_devices: int, counts=None, n_samples: int = 256,
         from analytics_zoo_tpu.parallel.sharding import TRANSFORMER_RULES
         state_replicated = state_footprint(full_mesh, None)
         state_sharded = state_footprint(full_mesh, TRANSFORMER_RULES)
+        # tensor-parallel leg (ISSUE 12): same model on a
+        # (data=1 × fsdp × tensor) factorization — the rule table's
+        # column/row-parallel specs live, activations sharded over
+        # tensor, state still ~1/(fsdp·tensor) per device
+        tp_tensor = 2 if n_devices % 2 == 0 else 1
+        tp_fsdp = n_devices // tp_tensor
+        tp_mesh = DeviceMesh(MeshConfig(data=1, fsdp=tp_fsdp,
+                                        tensor=tp_tensor), devs)
+        ctx.mesh = tp_mesh
+        tprate, tppeaks = timed_fit(make_model(), sharding_rules=True)
+        tp_state = state_footprint(tp_mesh, TRANSFORMER_RULES)
     finally:
         ctx.mesh = prev_mesh
 
@@ -337,6 +348,14 @@ def fit_scaling_summary(n_devices: int, counts=None, n_samples: int = 256,
             "params_opt_bytes_per_device_sharded": state_sharded,
             "params_opt_shrink": round(
                 state_replicated / max(state_sharded, 1), 2),
+        },
+        "sharded_tp": {
+            "mesh": {"data": 1, "fsdp": tp_fsdp, "tensor": tp_tensor},
+            "samples_per_sec": round(tprate, 1),
+            "per_device_peak_hbm_bytes": round(max(tppeaks.values())),
+            "params_opt_bytes_per_device": tp_state,
+            "params_opt_shrink": round(
+                state_replicated / max(tp_state, 1), 2),
         },
         "note": ("forced-host devices share the host's cores: fit "
                  f"scaling here caps near {min(n_devices, cores)}x; on "
@@ -600,6 +619,31 @@ def main():
                     ab.get("p50_improvement_pct")
             else:
                 out["serving_elastic_chip_seconds_ratio"] = None
+        # int8-vs-bf16 A/B through the full serving path (ISSUE 12):
+        # pooled p50 per precision over one bucket set + parity; the
+        # ≤0.6 acceptance ratio is an MXU property — on CPU rigs the
+        # JSON's note self-documents the missing int8 kernel
+        if os.environ.get("BENCH_INT8_AB", "1") == "1":
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            r6, _ = _run_sub([sys.executable,
+                              os.path.join(here, "bench_serving.py"),
+                              "--int8-ab"],
+                             timeout=900, env=env)
+            if r6:
+                for src, dst in (
+                        ("int8_p50_ms", "serving_int8_ab_p50_ms"),
+                        ("bf16_p50_ms", "serving_bf16_ab_p50_ms"),
+                        ("int8_vs_bf16_p50_ratio",
+                         "serving_int8_vs_bf16_p50_ratio"),
+                        ("int8_top1_agreement_vs_f32",
+                         "serving_int8_top1_agreement"),
+                        ("weight_shrink_vs_f32",
+                         "serving_int8_weight_shrink")):
+                    if r6.get(src) is not None:
+                        out[dst] = r6.get(src)
+            else:
+                out["serving_int8_vs_bf16_p50_ratio"] = None
 
     print(json.dumps(out))
 

@@ -1731,6 +1731,112 @@ def _device_forward_main():
     }))
 
 
+def _int8_ab_main(args) -> int:
+    """--int8-ab (ISSUE 12): int8 vs bf16 vs f32 through the FULL
+    serving path — InferenceModel load → per-bucket warmup (AOT/bucket
+    machinery identical across precisions) → predict — over the SAME
+    bucket set, interleaved rounds so host drift cannot bias one
+    precision's block. Reports per-bucket and pooled p50s, the
+    int8/bf16 p50 ratio (the ISSUE 12 acceptance is ≤ 0.6 on real
+    chips: 2x int8 MXU rate + 4x fewer weight bytes), top-1 parity vs
+    f32, and the per-dtype serving_weight_bytes price. On a CPU rig
+    XLA has no VNNI-style int8 kernel (the int8 dot lowers to widening
+    integer math) so the ratio documents the rig, not the design —
+    the JSON self-describes this the way the fleet/scaling benches
+    report host-core ceilings."""
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.keras import Sequential
+    from analytics_zoo_tpu.keras import layers as L
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context(cluster_mode="local")
+    width = int(os.environ.get("BENCH_INT8_WIDTH", 1024))
+    model = Sequential([
+        L.Dense(width, activation="relu", input_shape=(256,)),
+        L.Dense(width, activation="relu"),
+        L.Dense(width, activation="relu"),
+        L.Dense(10, activation="softmax")])
+    model.ensure_built(np.zeros((1, 256), np.float32))
+    params_f32 = jax.device_get(model.params)
+    params_bf16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if a.dtype == np.float32 else a, params_f32)
+
+    buckets = [1, 4, 8, 16, 32]
+
+    def load(params=None, quantize=None):
+        im = InferenceModel(max_batch=max(buckets))
+        if quantize is not None:
+            model.params = params_f32
+            im.load_keras(model, quantize=quantize)
+        elif params is not None:
+            im.load_fn(lambda p, x: model.apply(p, x, training=False),
+                       params)
+        else:
+            model.params = params_f32
+            im.load_keras(model)
+        im.warmup(np.zeros((256,), np.float32), buckets=buckets)
+        return im
+
+    variants = {"f32": load(), "bf16": load(params=params_bf16),
+                "int8": load(quantize="int8")}
+    assert variants["int8"].serving_dtype == "int8"
+    assert variants["bf16"].serving_dtype == "bfloat16"
+
+    rs = np.random.RandomState(0)
+    xs = {b: rs.rand(b, 256).astype(np.float32) for b in buckets}
+    lat = {k: {b: [] for b in buckets} for k in variants}
+    rounds, per_round = 6, 8
+    for _ in range(rounds):
+        for name, im in variants.items():        # interleaved A/B/C
+            for b in buckets:
+                for _ in range(per_round):
+                    t0 = time.perf_counter()
+                    im.predict(xs[b])
+                    lat[name][b].append(
+                        (time.perf_counter() - t0) * 1e3)
+
+    def p50(vals):
+        return float(np.percentile(np.asarray(vals), 50))
+
+    pooled = {k: p50(sum(d.values(), [])) for k, d in lat.items()}
+    per_bucket = {k: {str(b): round(p50(v), 3)
+                      for b, v in d.items()} for k, d in lat.items()}
+    # parity on the largest bucket (argmax agreement vs f32)
+    xq = rs.rand(256, 256).astype(np.float32)
+    pf = np.asarray(variants["f32"].predict(xq))
+    p8 = np.asarray(variants["int8"].predict(xq))
+    agreement = float((pf.argmax(-1) == p8.argmax(-1)).mean())
+    weight_bytes = {k: im.weight_bytes() for k, im in variants.items()}
+
+    ratio = pooled["int8"] / max(pooled["bf16"], 1e-9)
+    print(json.dumps({
+        "metric": "serving_int8_ab",
+        "buckets": buckets,
+        "int8_p50_ms": round(pooled["int8"], 3),
+        "bf16_p50_ms": round(pooled["bf16"], 3),
+        "f32_p50_ms": round(pooled["f32"], 3),
+        "int8_vs_bf16_p50_ratio": round(ratio, 3),
+        "target_ratio": 0.6,
+        "per_bucket_p50_ms": per_bucket,
+        "int8_top1_agreement_vs_f32": round(agreement, 4),
+        "weight_bytes": weight_bytes,
+        "weight_shrink_vs_f32": round(
+            weight_bytes["f32"] / max(weight_bytes["int8"], 1), 2),
+        "backend": jax.default_backend(),
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "note": ("the ≤0.6 acceptance ratio is an MXU property (2x "
+                 "int8 rate + 4x fewer weight bytes); XLA:CPU has no "
+                 "VNNI-style int8 kernel, so on a CPU rig this ratio "
+                 "documents the rig — read it on real chips, like the "
+                 "host-core ceilings of the scaling benches"),
+    }))
+    return 0
+
+
 def _registry_tail_metrics():
     """Registry-sourced tail latency + live queue depths for the JSON
     output: the process-wide `MetricsRegistry` accumulated every serving
@@ -1813,6 +1919,11 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--pin-core", type=int, default=None,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--int8-ab", action="store_true",
+                    help="int8-vs-bf16 A/B through the full serving "
+                         "path over one bucket set (ISSUE 12): pooled "
+                         "and per-bucket p50s, parity vs f32, per-dtype "
+                         "weight bytes")
     ap.add_argument("--elastic", action="store_true",
                     help="diurnal+spike traffic replay: static fleet vs "
                          "autoscaled elastic fleet (adaptive batching, "
@@ -1833,6 +1944,8 @@ def main():
         return _fleet_child(args)
     if args.engines:
         return _fleet_main(args)
+    if args.int8_ab:
+        return _int8_ab_main(args)
     if args.elastic:
         return _elastic_main(args)
     if args.chaos:

@@ -107,6 +107,15 @@ REQUIRED = {
     "serving_backlog_depth": "gauge",
     "serving_engines_target": "gauge",
     "serving_autoscaler_decisions_total": "counter",
+    # big-model frontier (ISSUE 12): quantized serving + tensor-parallel
+    # placement telemetry — the families the int8 A/B bench, the docs
+    # tables and any capacity dashboard read. serving_weight_bytes is
+    # the honest per-dtype weight price (int8 reads ~4x under f32);
+    # training_mesh_axis_size distinguishes a pure-fsdp fit from a
+    # tensor-parallel one on a scrape.
+    "serving_weight_bytes": "gauge",
+    "training_mesh_axis_size": "gauge",
+    "quantized_checkpoints_total": "counter",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Static lint for the GSPMD sharding rule table (ISSUE 12 satellite;
+tier-1 via tests/test_sharding_rules.py).
+
+The rule table (`parallel/sharding.ShardingRules`) is the ONE layout
+contract shared by the sharded fit, serving's sharded placement, the
+checkpoint gather/restore paths and the compile-cache key — a rule that
+names a nonexistent mesh axis, or carries a spec whose rank disagrees
+with the parameters it matches, fails silently at placement time
+(`_trim_spec` drops what it cannot apply) and quietly replicates state
+the operator believes is sharded. This lint makes those failures loud
+at CI time:
+
+- **axis vocabulary**: every axis a rule names must be a real mesh axis
+  (`common/mesh.AXIS_NAMES`) AND appear in at least one SUPPORTED mesh
+  factorization — the (data×fsdp) and (data×fsdp×tensor) meshes the
+  trainer and serving actually build — so a rule can never demand a
+  placement no supported mesh supplies;
+- **rank consistency**: against a canonical parameter catalog (a real
+  BERT build, unstacked and stacked, plus the task-head kernels), every
+  rule's spec must have rank <= every matched parameter's rank, and a
+  FULL-rank spec on each matched kernel (a 3-entry spec on a 2-D kernel
+  would silently truncate);
+- **liveness**: every rule must match at least one catalog parameter —
+  a dead rule is a renamed parameter waiting to replicate.
+
+Exit 0 when clean; 1 with one line per violation.
+
+    python scripts/check_sharding_rules.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The mesh factorizations the stack actually constructs: fit_keras /
+# serving default (data×fsdp) and the big-model frontier's
+# (data×fsdp×tensor). An axis outside their union has no supported mesh
+# to exist on, so a rule naming it could never engage.
+SUPPORTED_FACTORIZATIONS: Tuple[Tuple[str, ...], ...] = (
+    ("data", "fsdp"),
+    ("data", "fsdp", "tensor"),
+)
+
+
+def build_catalog() -> List[Tuple[str, Tuple[int, ...]]]:
+    """Canonical (path, shape) parameter catalog the rules are written
+    against: one real BERT build (the transformer layer library's own
+    names), its stacked-encoder form ([L, in, out] leaves), and the
+    BERT task-model head kernels quantization/serving also touch."""
+    import jax
+
+    from analytics_zoo_tpu.keras.transformer import (BERT,
+                                                     stack_block_params)
+    from analytics_zoo_tpu.parallel.sharding import _tree_paths_and_leaves
+
+    bert = BERT(vocab=32, hidden_size=16, n_block=2, n_head=2,
+                seq_len=8, intermediate_size=32, pooled_only=True,
+                name="bert")
+    params = bert.build(jax.random.PRNGKey(0), (None, 8))
+    stacked = stack_block_params(dict(params), 2, "bert")
+    cat = []
+    for prefix, tree in (("bert", params), ("bert_stacked", stacked)):
+        cat.extend((f"{prefix}/{p}", tuple(map(int, __import__(
+            "numpy").shape(l))))
+            for p, l in _tree_paths_and_leaves(tree))
+    cat.extend([("cls_kernel", (16, 2)), ("ner_kernel", (16, 4)),
+                ("qa_kernel", (16, 2))])
+    return cat
+
+
+def check_rules(rules=None, catalog=None,
+                factorizations: Sequence[Sequence[str]] = None
+                ) -> List[str]:
+    """Lint one rule table; returns a list of violation strings."""
+    from analytics_zoo_tpu.common.mesh import AXIS_NAMES
+    from analytics_zoo_tpu.parallel.sharding import TRANSFORMER_RULES
+
+    rules = rules if rules is not None else TRANSFORMER_RULES
+    catalog = catalog if catalog is not None else build_catalog()
+    factorizations = factorizations or SUPPORTED_FACTORIZATIONS
+    supported_axes = {a for f in factorizations for a in f}
+    errors: List[str] = []
+
+    for pat, spec in rules.rules:
+        where = f"rule {pat.pattern!r} -> {spec}"
+        # -- axis vocabulary ---------------------------------------------
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                if ax is None:
+                    continue
+                if ax not in AXIS_NAMES:
+                    errors.append(
+                        f"{where}: axis {ax!r} is not a mesh axis "
+                        f"(common/mesh.AXIS_NAMES = {list(AXIS_NAMES)})")
+                elif ax not in supported_axes:
+                    errors.append(
+                        f"{where}: axis {ax!r} exists on no supported "
+                        f"mesh factorization {factorizations} — the "
+                        "rule could never engage")
+        # -- rank consistency + liveness ---------------------------------
+        matched = [(p, s) for p, s in catalog if pat.search(p)]
+        if not matched:
+            errors.append(
+                f"{where}: matches no parameter in the canonical "
+                "catalog (dead rule — renamed parameter silently "
+                "falling through to the fsdp/replicate fallback?)")
+        for path, shape in matched:
+            if len(spec) > len(shape):
+                errors.append(
+                    f"{where}: spec rank {len(spec)} exceeds matched "
+                    f"parameter {path} rank {len(shape)} — the extra "
+                    "axes silently drop at placement time")
+            sharded_axes = sum(1 for e in spec if e is not None)
+            if len(shape) >= 2 and sharded_axes and len(spec) > 0 \
+                    and len(spec) < len(shape) - 1:
+                # a 2-D+ kernel matched by a 1-entry sharding spec
+                # leaves trailing dims implicitly replicated; only the
+                # FINAL dims may be elided (PartitionSpec semantics),
+                # so a spec shorter than rank-1 on a kernel is a smell
+                errors.append(
+                    f"{where}: spec rank {len(spec)} leaves "
+                    f"{len(shape) - len(spec)} trailing dim(s) of "
+                    f"{path} {shape} implicitly replicated — spell "
+                    "them (P(..., None)) so the layout is explicit")
+    return errors
+
+
+def main(argv=None) -> int:
+    errors = check_rules()
+    for e in errors:
+        print(e)
+    if errors:
+        print(f"{len(errors)} sharding-rule violation(s)")
+        return 1
+    from analytics_zoo_tpu.parallel.sharding import TRANSFORMER_RULES
+    print(f"sharding rules OK ({len(TRANSFORMER_RULES.rules)} rules "
+          f"checked against {len(build_catalog())} catalog parameters)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

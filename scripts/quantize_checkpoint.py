@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Offline post-training int8 quantization for training checkpoints
+(ISSUE 12) — the shipped-artifact shape of the reference's int8
+OpenVINO IR (`OpenVinoInferenceSupportive.scala:34-57`): calibrate
+symmetric per-output-channel scales from a checkpoint's weights and
+write them as an int8 sidecar beside `model.<version>`, so serving
+(`InferenceModel.load_checkpoint(..., quantize="int8")` or a
+ClusterServing config with `model.quantize: int8`) loads the
+pre-calibrated artifact instead of re-quantizing at every restart.
+
+    python scripts/quantize_checkpoint.py \
+        --checkpoint /ckpts/bert --model /models/bert_cls
+
+`--model` is a saved ZooModel directory (its config.json names the
+architecture class, like the serving config's model resolution);
+`--version` defaults to the newest intact checkpoint. The quality gate
+lives in `Estimator.evaluate(..., quantize="int8",
+quality_tolerance=...)` — run it on held-out data before blessing the
+sidecar for production.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def quantize_checkpoint(checkpoint: str, model_dir: str,
+                        version=None) -> dict:
+    """Run the pass; returns a summary dict (run_dir, version, sidecar
+    path, f32 vs int8 artifact bytes)."""
+    from analytics_zoo_tpu.learn.checkpoint import resolve_checkpoint
+    from analytics_zoo_tpu.serving.config import _find_model_class
+    from analytics_zoo_tpu.serving.quantization import write_int8_sidecar
+
+    run_dir, version = resolve_checkpoint(
+        checkpoint, None if version is None else int(version))
+
+    cfg_json = os.path.join(model_dir, "config.json")
+    if not os.path.exists(cfg_json):
+        raise FileNotFoundError(
+            f"{model_dir} is not a saved ZooModel directory "
+            "(no config.json); save the architecture with "
+            "save_model(...) first")
+    with open(cfg_json) as fh:
+        blob = json.load(fh)
+    cls = _find_model_class(blob["class"])
+    inst = cls(**(blob.get("config") or {}))
+
+    sidecar = write_int8_sidecar(run_dir, version, inst)
+    f32_bytes = os.path.getsize(
+        os.path.join(run_dir, f"model.{version}.npz"))
+    int8_bytes = os.path.getsize(sidecar + ".npz")
+    return {"run_dir": run_dir, "version": version,
+            "sidecar": sidecar + ".npz",
+            "f32_bytes": f32_bytes, "int8_bytes": int8_bytes,
+            "shrink": round(f32_bytes / max(int8_bytes, 1), 2)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--checkpoint", required=True,
+                    help="checkpoint root (or run dir with --version)")
+    ap.add_argument("--version", type=int, default=None,
+                    help="checkpoint version (default: newest intact)")
+    ap.add_argument("--model", required=True,
+                    help="saved ZooModel directory naming the "
+                         "architecture (config.json)")
+    args = ap.parse_args(argv)
+    try:
+        out = quantize_checkpoint(args.checkpoint, args.model,
+                                  args.version)
+    except (FileNotFoundError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,6 +150,51 @@ class TestServingConfig:
         out = im.predict(np.zeros((3, 6), np.int32))
         assert np.asarray(out).shape == (3, 2)
 
+    def test_mesh_block_parses_and_validates(self, tmp_path):
+        """params.mesh (ISSUE 12): map and string spellings parse,
+        replicated placement + mesh is a load-time error, and a typo'd
+        axis name fails with the axis vocabulary."""
+        import pytest as _pytest
+
+        def load(body):
+            f = tmp_path / "m.yaml"
+            f.write_text("model:\n  path: /m\n" + body)
+            return ServingConfig.load(str(f))
+
+        cfg = load("params:\n  placement: sharded\n  mesh:\n"
+                   "    data: 1\n    fsdp: 2\n    tensor: 4\n")
+        assert cfg.mesh_axes == {"data": 1, "fsdp": 2, "tensor": 4}
+        cfg = load("params:\n  placement: sharded\n"
+                   "  mesh: data=1,fsdp=2,tensor=-1\n")
+        assert cfg.mesh_axes == {"data": 1, "fsdp": 2, "tensor": -1}
+        with _pytest.raises(ValueError, match="placement"):
+            load("params:\n  mesh: tensor=2\n")
+        with _pytest.raises(ValueError, match="axis"):
+            load("params:\n  placement: sharded\n  mesh: tenzor=2\n")
+        with _pytest.raises(ValueError, match="integer"):
+            load("params:\n  placement: sharded\n  mesh: tensor=lots\n")
+
+    def test_build_model_sharded_on_configured_mesh(self, tmp_path):
+        """A sharded config with a params.mesh block serves on exactly
+        that factorization (tensor axis included)."""
+        from analytics_zoo_tpu.models.textclassification import \
+            TextClassifier
+        m = TextClassifier(class_num=2, vocab_size=32, embedding_dim=8,
+                           sequence_length=6)
+        m.model.ensure_built(np.zeros((1, 6), np.int32))
+        m.save_model(str(tmp_path / "tc"))
+        cfg_file = tmp_path / "c.yaml"
+        cfg_file.write_text(
+            f"model:\n  path: {tmp_path / 'tc'}\n"
+            "params:\n  placement: sharded\n"
+            "  mesh: data=1,fsdp=2,tensor=4\n")
+        im = ServingConfig.load(str(cfg_file)).build_model()
+        assert im.mesh.axis_sizes["tensor"] == 4
+        assert im.mesh.axis_sizes["fsdp"] == 2
+        out = im.predict(np.zeros((4, 6), np.int32))
+        assert np.asarray(out).shape == (4, 2)
+        im.close()
+
     def test_build_model_quantized_from_config(self, tmp_path):
         # config.yaml `model.quantize: int8` serves the int8 path
         import jax

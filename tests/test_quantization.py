@@ -159,3 +159,261 @@ class TestModelQuantization:
         m, _, _ = _trained_classifier()
         with pytest.raises(ValueError, match="int8"):
             InferenceModel().load_keras(m, quantize="int4")
+
+
+class TestCheckpointSidecar:
+    """The productionized pass (ISSUE 12): per-output-channel scales
+    calibrated once and persisted as a checkpoint sidecar, served
+    without a quantize-at-load pass."""
+
+    def _fit_with_sidecar(self, tmp_path):
+        from analytics_zoo_tpu.learn.trainer import fit_keras
+        m, x, yc = _trained_classifier()
+        m.set_checkpoint(str(tmp_path))
+        fit_keras(m, x, yc.astype(np.int32), batch_size=64, epochs=1,
+                  int8_sidecar=True, prefetch=False, device_cache=False)
+        return m, x
+
+    def test_scale_roundtrip_bitwise_through_sidecar(self, tmp_path):
+        """fit_keras(int8_sidecar=True) writes the sidecar at the
+        checkpoint save, and every int8 weight and f32 per-channel
+        scale survives the disk round trip bit for bit."""
+        from analytics_zoo_tpu.learn.checkpoint import latest_checkpoint
+        from analytics_zoo_tpu.observability.registry import get_registry
+        from analytics_zoo_tpu.serving.quantization import \
+            load_int8_sidecar
+        before = get_registry().counter(
+            "quantized_checkpoints_total", "").value()
+        m, _ = self._fit_with_sidecar(tmp_path)
+        run_dir, version = latest_checkpoint(str(tmp_path))
+        q_disk = load_int8_sidecar(run_dir, version)
+        assert q_disk is not None
+        q_mem = quantize_model_params(m, jax.device_get(m.params))
+        for a, b in zip(jax.tree_util.tree_leaves(q_disk),
+                        jax.tree_util.tree_leaves(q_mem)):
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert get_registry().counter(
+            "quantized_checkpoints_total", "").value() > before
+
+    def test_serving_prefers_sidecar_and_missing_falls_back(
+            self, tmp_path, monkeypatch):
+        """load_checkpoint(quantize="int8") serves the PRE-CALIBRATED
+        artifact (no quantize_model_params call); with the sidecar
+        deleted it falls back to quantize-at-load and still serves."""
+        import os
+
+        from analytics_zoo_tpu.keras import Sequential
+        from analytics_zoo_tpu.learn.checkpoint import latest_checkpoint
+        from analytics_zoo_tpu.serving import quantization as qmod
+        from analytics_zoo_tpu.serving.quantization import sidecar_path
+        m, x = self._fit_with_sidecar(tmp_path)
+        fresh = Sequential([L.Dense(32, activation="relu",
+                                    input_shape=(16,)),
+                            L.Dense(4, activation="softmax")])
+        fresh.ensure_built(np.zeros((1, 16), np.float32))
+
+        calls = []
+        orig = qmod.quantize_model_params
+        monkeypatch.setattr(qmod, "quantize_model_params",
+                            lambda *a, **k: calls.append(1)
+                            or orig(*a, **k))
+        im = InferenceModel().load_checkpoint(fresh, str(tmp_path),
+                                              quantize="int8")
+        assert calls == [], "sidecar load re-ran the calibration pass"
+        assert im.serving_dtype == "int8"
+        p_side = np.asarray(im.predict(x[:32]))
+
+        run_dir, version = latest_checkpoint(str(tmp_path))
+        # root + EXPLICIT version resolves the timestamped run dir too
+        # (a miss here would silently re-calibrate every restart)
+        InferenceModel().load_checkpoint(fresh, str(tmp_path),
+                                         version=version,
+                                         quantize="int8")
+        assert calls == [], "root+version call missed the sidecar"
+        for suffix in (".npz", ".structure.json"):
+            os.remove(sidecar_path(run_dir, version) + suffix)
+        im2 = InferenceModel().load_checkpoint(fresh, str(tmp_path),
+                                               quantize="int8")
+        assert calls, "fallback did not quantize at load"
+        assert im2.serving_dtype == "int8"
+        np.testing.assert_allclose(np.asarray(im2.predict(x[:32])),
+                                   p_side, rtol=1e-5, atol=1e-6)
+
+    def test_sidecars_garbage_collect_with_their_checkpoints(
+            self, tmp_path):
+        """The keep=N retention contract covers the sidecar: a pruned
+        checkpoint version takes its .int8 artifacts with it."""
+        import os
+
+        from analytics_zoo_tpu.learn.checkpoint import CheckpointManager
+        from analytics_zoo_tpu.serving.quantization import \
+            write_int8_sidecar
+        m, _, _ = _trained_classifier()
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        host = jax.device_get(m.params)
+        for it in (1, 2, 3, 4):
+            mgr.save(it, host, extra={"epoch": it})
+            write_int8_sidecar(mgr.run_dir, it, m, params=host)
+        left = sorted(os.listdir(mgr.run_dir))
+        assert not any(f.startswith(("model.1.", "model.2."))
+                       for f in left), left
+        assert "model.4.int8.npz" in left
+
+    def test_offline_script_quantizes_and_reports_shrink(self, tmp_path):
+        """scripts/quantize_checkpoint.py: a checkpoint + a saved
+        ZooModel architecture dir → sidecar beside the newest version,
+        ~4x smaller than the f32 artifact, and servable."""
+        import json
+        import os
+        import subprocess
+        import sys
+
+        from analytics_zoo_tpu.learn.trainer import fit_keras
+        from analytics_zoo_tpu.models.textclassification import \
+            TextClassifier
+        m = TextClassifier(class_num=2, vocab_size=30, embedding_dim=8,
+                           sequence_length=6)
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 30, (64, 6)).astype(np.int32)
+        y = rs.randint(0, 2, 64).astype(np.int32)
+        m.model.compile("adam", "sparse_categorical_crossentropy")
+        m.model.set_checkpoint(str(tmp_path / "ck"))
+        fit_keras(m.model, ids, y, batch_size=32, epochs=1,
+                  prefetch=False, device_cache=False)
+        m.save_model(str(tmp_path / "arch"))
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(root, "scripts", "quantize_checkpoint.py"),
+             "--checkpoint", str(tmp_path / "ck"),
+             "--model", str(tmp_path / "arch")],
+            capture_output=True, text=True, env=env, cwd=root,
+            timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout)
+        assert out["shrink"] > 2.0, out
+        fresh = TextClassifier(class_num=2, vocab_size=30,
+                               embedding_dim=8, sequence_length=6)
+        im = InferenceModel().load_checkpoint(
+            fresh, str(tmp_path / "ck"), quantize="int8")
+        assert im.serving_dtype == "int8"
+        assert np.asarray(im.predict(ids[:4])).shape == (4, 2)
+
+
+class TestQualityGate:
+    def test_within_gate_passes_and_reports_baseline(self):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        m, x, yc = _trained_classifier()
+        est = Estimator(m)
+        res = est.evaluate((x, yc.astype(np.int32)),
+                           metrics=["accuracy"], quantize="int8",
+                           quality_tolerance=0.05)
+        assert "accuracy" in res and "baseline_accuracy" in res
+        assert abs(res["accuracy"] - res["baseline_accuracy"]) <= 0.05
+        # f32 master params restored after the quantized eval
+        for leaf in jax.tree_util.tree_leaves(m.params):
+            assert np.asarray(leaf).dtype == np.float32
+
+    def test_outside_gate_refuses(self):
+        from analytics_zoo_tpu.learn.estimator import (
+            Estimator, QuantizationQualityError)
+        m, x, yc = _trained_classifier()
+        est = Estimator(m)
+        with pytest.raises(QuantizationQualityError,
+                           match="quality gate"):
+            est.evaluate((x, yc.astype(np.int32)),
+                         metrics=["accuracy"], quantize="int8",
+                         quality_tolerance=0.1,
+                         baseline_metrics={"accuracy": 1.5})
+        # a NaN metric must REFUSE, not slip through the comparison
+        # (NaN > tol and NaN <= tol are both False — the gate uses the
+        # negated form so unprovable means rejected)
+        with pytest.raises(QuantizationQualityError,
+                           match="quality gate"):
+            est.evaluate((x, yc.astype(np.int32)),
+                         metrics=["accuracy"], quantize="int8",
+                         quality_tolerance=0.1,
+                         baseline_metrics={"accuracy": float("nan")})
+
+    def test_bad_mode_rejected(self):
+        from analytics_zoo_tpu.learn.estimator import Estimator
+        m, x, yc = _trained_classifier()
+        with pytest.raises(ValueError, match="int8"):
+            Estimator(m).evaluate((x, yc.astype(np.int32)),
+                                  quantize="int4")
+
+
+class TestDtypeKeyIsolation:
+    def test_compile_cache_keys_and_entries_isolate_by_dtype(
+            self, tmp_path, monkeypatch):
+        """Toggling quantize="int8" can never load the f32 executable:
+        the serving cache key carries the dtype explicitly, an int8
+        warmup against a cache warmed by the f32 model COMPILES (no
+        false hit), and each precision's warm restart hits only its own
+        entry."""
+        import analytics_zoo_tpu.compile_cache.serialization as ccser
+        from analytics_zoo_tpu.compile_cache import CompileCache
+        if not ccser.HAVE_AOT:
+            pytest.skip("jax build lacks serialize_executable")
+        m, x, _ = _trained_classifier()
+        # host params: a retarget-loaded cached executable expects its
+        # stored single-device placement, not the fit's live mesh-
+        # replicated NamedSharding (same convention as the PR 7
+        # handoff tests)
+        m.params = jax.device_get(m.params)
+
+        calls = []
+        orig = ccser.compile_lowered
+        monkeypatch.setattr(ccser, "compile_lowered",
+                            lambda low: calls.append(1) or orig(low))
+        cache_dir = str(tmp_path / "cc")
+
+        def make(quantize):
+            return InferenceModel(
+                compile_cache=CompileCache(cache_dir)).load_keras(
+                    m, quantize=quantize)
+
+        im_f = make(None)
+        im_q = make("int8")
+        sig = im_f._exec_sig(np.zeros((8, 16), np.float32))
+        kf = im_f._cache_key(sig)
+        kq = im_q._cache_key(sig)
+        assert kf.digest != kq.digest
+        assert kq.fields.get("dtype") == "int8"
+        assert "dtype" not in kf.fields    # f32 keys stay pre-ISSUE-12
+
+        make(None).warmup(x[0], buckets=[8])
+        assert len(calls) == 1             # cold f32: one compile
+        make("int8").warmup(x[0], buckets=[8])
+        assert len(calls) == 2, \
+            "int8 warmup reused the f32 executable (dtype key leak)"
+        make(None).warmup(x[0], buckets=[8])
+        make("int8").warmup(x[0], buckets=[8])
+        assert len(calls) == 2             # warm: both hit their own
+
+    def test_engine_labels_and_weight_bytes_gauge(self):
+        """A non-default serving dtype labels the engine's serving_*
+        series (f32 schema stays label-free), and serving_weight_bytes
+        prices int8 weights ~4x under the f32 tree."""
+        from analytics_zoo_tpu.observability.registry import get_registry
+        from analytics_zoo_tpu.serving.server import ClusterServing
+        m, _, _ = _trained_classifier()
+        im_q = InferenceModel().load_keras(m, quantize="int8")
+        srv_q = ClusterServing(im_q, "memory", supervise=False)
+        assert srv_q._labels.get("serving_dtype") == "int8"
+        reg = get_registry()
+        q_bytes = reg.get("serving_weight_bytes").value(
+            serving_dtype="int8")
+        assert q_bytes > 0
+        im_f = InferenceModel().load_keras(m)
+        srv_f = ClusterServing(im_f, "memory", supervise=False)
+        assert "serving_dtype" not in srv_f._labels
+        f_bytes = reg.get("serving_weight_bytes").value(
+            serving_dtype="float32")
+        assert q_bytes < 0.5 * f_bytes
+        assert srv_q.metrics()["serving_dtype"] == "int8"
+        assert srv_f.metrics()["serving_dtype"] == "float32"

@@ -1,0 +1,61 @@
+"""Sharding-rule lint (scripts/check_sharding_rules.py) as tier-1: the
+rule table is the one layout contract shared by the sharded fit,
+serving's sharded placement and the compile-cache key — this suite
+keeps the real table clean and proves the lint actually catches each
+failure class it exists for."""
+
+import os
+import sys
+
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from analytics_zoo_tpu.parallel.sharding import ShardingRules  # noqa: E402
+from scripts.check_sharding_rules import (  # noqa: E402
+    build_catalog, check_rules)
+
+
+class TestRealTable:
+    def test_default_table_is_clean(self):
+        assert check_rules() == []
+
+    def test_catalog_covers_stacked_and_heads(self):
+        paths = [p for p, _ in build_catalog()]
+        assert any("qkv_kernel" in p for p in paths)
+        assert any(p.startswith("bert_stacked/") for p in paths)
+        assert "cls_kernel" in paths
+
+
+class TestCatches:
+    CATALOG = [("blk/qkv_kernel", (16, 48)),
+               ("blk/some_bias", (48,))]
+
+    def _errors(self, rules):
+        return check_rules(ShardingRules(rules), catalog=self.CATALOG)
+
+    def test_unknown_axis_name(self):
+        errs = self._errors([(r"qkv_kernel$", P("fsdp", "rows"))])
+        assert any("'rows'" in e and "not a mesh axis" in e
+                   for e in errs)
+
+    def test_axis_outside_supported_factorizations(self):
+        # 'expert' IS a mesh axis but no supported factorization builds
+        # it — a rule demanding it could never engage
+        errs = self._errors([(r"qkv_kernel$", P("fsdp", "expert"))])
+        assert any("expert" in e and "no supported" in e for e in errs)
+
+    def test_spec_rank_exceeds_param_rank(self):
+        errs = self._errors(
+            [(r"qkv_kernel$", P("fsdp", "tensor", None))])
+        assert any("rank 3 exceeds" in e for e in errs)
+
+    def test_dead_rule(self):
+        errs = self._errors([(r"qkv_kernel$", P("fsdp", "tensor")),
+                             (r"renamed_kernel$", P("fsdp", None))])
+        assert any("matches no parameter" in e for e in errs)
+
+    def test_clean_synthetic_table(self):
+        assert self._errors([(r"qkv_kernel$", P("fsdp", "tensor")),
+                             (r"some_bias$", P("tensor"))]) == []
