@@ -267,8 +267,12 @@ class CheckpointManager:
                 # lives and dies with its checkpoint version, or the
                 # keep=N retention contract silently stops bounding the
                 # directory
+                # .published.json is the rollout marker (ISSUE 14):
+                # retired with its version, or the watcher could keep
+                # "seeing" a version whose artifacts are gone
                 for suffix in (".npz", ".structure.json", ".meta.json",
-                               ".int8.npz", ".int8.structure.json"):
+                               ".int8.npz", ".int8.structure.json",
+                               ".published.json"):
                     p = os.path.join(self.run_dir, pat + suffix)
                     if os.path.exists(p):
                         os.remove(p)
@@ -361,6 +365,192 @@ def find_resume_checkpoint(root: str) -> Optional[Tuple[str, int,
             "mid-epoch model.%d (continuation will replay the partial "
             "epoch from its start)", root, fallback[1])
     return fallback
+
+
+# ---------------------------------------------------------------------------
+# Publish markers (ISSUE 14): the rollout contract between trainer and fleet
+# ---------------------------------------------------------------------------
+def _marker_path(run_dir: str, version: int) -> str:
+    return os.path.join(run_dir, f"model.{version}.published.json")
+
+
+def write_publish_marker(run_dir: str, version: int,
+                         extra: Optional[Dict[str, Any]] = None) -> str:
+    """Commit the PUBLISH marker for one checkpoint version — the
+    rollout watcher's admission gate. Written LAST, after every
+    artifact of the version (params, optimizer state, int8 sidecar) is
+    durable: `latest_checkpoint` only proves the model artifact is
+    intact, while a rollout must never serve a version whose sidecar
+    (or opt state, for a warm A/B restart) is still mid-write. The
+    marker records a CRC manifest of every artifact it vouches for, so
+    `verify_publish_marker` can detect a version whose bytes changed
+    (or vanished) after publication. Atomic write-then-rename like
+    every other checkpoint artifact."""
+    manifest: Dict[str, Dict[str, Any]] = {}
+    prefix = f"model.{version}."
+    optim_re = re.compile(rf"optimMethod-.+\.{version}\.")
+    for f in sorted(os.listdir(run_dir)):
+        if f.endswith(".published.json") or ".tmp-" in f:
+            continue
+        if not (f.startswith(prefix) or optim_re.match(f)):
+            continue
+        p = os.path.join(run_dir, f)
+        with open(p, "rb") as fh:
+            raw = fh.read()
+        crc = crc32c(raw)
+        if f.endswith(".npz"):
+            # publishing asserts the WHOLE set verifies — checked in
+            # THIS read pass (multi-GB checkpoints must not pay a
+            # separate checkpoint_intact sweep per publish): each npz
+            # must match the CRC its structure sidecar committed, so a
+            # writer killed mid-write (or an injected truncation) can
+            # never gain a marker
+            try:
+                with open(_struct_path(os.path.join(run_dir, f))) as sh:
+                    meta = json.load(sh)
+            except (OSError, ValueError):
+                raise CorruptCheckpointError(
+                    f"refusing to publish model.{version} in "
+                    f"{run_dir}: {f} has no readable structure "
+                    "sidecar") from None
+            if "npz_crc32c" in meta and (
+                    meta.get("npz_bytes") != len(raw)
+                    or meta["npz_crc32c"] != crc):
+                raise CorruptCheckpointError(
+                    f"refusing to publish model.{version} in "
+                    f"{run_dir}: {f} does not match its CRC sidecar")
+        manifest[f] = {"bytes": len(raw), "crc32c": crc}
+    if f"model.{version}.npz" not in manifest:
+        raise FileNotFoundError(
+            f"cannot publish model.{version} in {run_dir}: the model "
+            "artifact is not on disk")
+    marker = _marker_path(run_dir, version)
+    tmp = marker + f".tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump({"version": version, "manifest": manifest,
+                       "extra": extra or {}}, fh)
+        os.replace(tmp, marker)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return marker
+
+
+def read_publish_marker(run_dir: str,
+                        version: int) -> Optional[Dict[str, Any]]:
+    """The marker payload, or None when absent/unparseable (an
+    unparseable marker is an UNPUBLISHED version, never an error — a
+    crash mid-rename must not wedge the watcher)."""
+    try:
+        with open(_marker_path(run_dir, version)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def verify_publish_marker(run_dir: str, version: int) -> bool:
+    """True when the version carries a marker AND every artifact the
+    marker's manifest vouches for still exists with matching
+    bytes+CRC. A marked version whose artifacts were since torn (disk
+    fault, partial restore) reads as unpublished."""
+    marker = read_publish_marker(run_dir, version)
+    if marker is None:
+        return False
+    for f, meta in (marker.get("manifest") or {}).items():
+        p = os.path.join(run_dir, f)
+        try:
+            if os.path.getsize(p) != meta.get("bytes"):
+                return False
+            with open(p, "rb") as fh:
+                if crc32c(fh.read()) != meta.get("crc32c"):
+                    return False
+        except OSError:
+            return False
+    return True
+
+
+def _publish_stat_key(run_dir: str, version: int) -> Optional[tuple]:
+    """Cheap cache key for a version's publish verdict: (mtime_ns,
+    size) of the marker and of EVERY file its manifest vouches for —
+    the marker JSON is small, so reading it per poll is cheap, and
+    keying on the whole set means a verdict (True or False)
+    invalidates the moment ANY artifact changes: a sidecar repaired
+    in place re-verifies, a sidecar torn after the fact re-fails.
+    None when the marker or any manifest file is absent (definitely
+    unpublished — no verdict to cache)."""
+    marker = read_publish_marker(run_dir, version)
+    if marker is None:
+        return None
+    stats = []
+    try:
+        m = os.stat(_marker_path(run_dir, version))
+        stats.append(("", m.st_mtime_ns, m.st_size))
+        for f in sorted(marker.get("manifest") or {}):
+            s = os.stat(os.path.join(run_dir, f))
+            stats.append((f, s.st_mtime_ns, s.st_size))
+    except OSError:
+        return None
+    return (run_dir, version, tuple(stats))
+
+
+def published_intact(run_dir: str, version: int,
+                     verify_cache: Optional[Dict] = None) -> bool:
+    """The watcher's whole admission check, ONE read pass: the marker
+    proves publication, and its manifest CRCs — which cover every
+    artifact AND every structure sidecar, with npz↔sidecar consistency
+    asserted at publish time by `write_publish_marker` — prove the set
+    still holds the published bytes (a separate `checkpoint_intact`
+    sweep would re-read the same multi-GB files to learn nothing new).
+    With `verify_cache` (a caller-owned dict) the verdict is memoized
+    per stat key, so a control loop polling every second costs stats
+    plus one small JSON read per tick."""
+    if verify_cache is None:
+        return verify_publish_marker(run_dir, version)
+    key = _publish_stat_key(run_dir, version)
+    if key is None:
+        return False
+    verdict = verify_cache.get(key)
+    if verdict is None:
+        verdict = verify_publish_marker(run_dir, version)
+        verify_cache[key] = verdict
+    return verdict
+
+
+def latest_published_checkpoint(
+        root: str, skip_versions=(),
+        verify_cache: Optional[Dict] = None) -> Optional[Tuple[str, int]]:
+    """(run_dir, version) of the newest PUBLISHED checkpoint under
+    `root` — what the rollout watcher acts on. Stricter than
+    `latest_checkpoint`: a version without an intact publish marker
+    (trainer still writing, crashed mid-commit, artifacts torn after
+    the fact) is invisible, so a watcher polling a live training run
+    can only ever observe versions whose whole artifact set is
+    durable. `skip_versions` (the rollout controller's quarantine set)
+    falls back to the newest published version not in it.
+
+    `verify_cache` (a caller-owned dict) memoizes the full-CRC verdict
+    per (run_dir, version, marker/model stat): verification reads and
+    CRCs the WHOLE artifact set, which a control loop polling every
+    second must not re-pay for a multi-GB checkpoint that hasn't
+    changed — with the cache, an idle poll costs a dir listing and two
+    stats. Entries for versions no longer listed are pruned."""
+    skip = {int(v) for v in skip_versions}
+    listed = list_checkpoints(root)
+    if verify_cache is not None:
+        live = {(rd, v) for rd, v in listed}
+        for key in [k for k in verify_cache
+                    if (k[0], k[1]) not in live]:
+            verify_cache.pop(key, None)
+    for run_dir, version in listed:
+        if version in skip:
+            continue
+        if published_intact(run_dir, version, verify_cache=verify_cache):
+            return (run_dir, version)
+    return None
 
 
 def resolve_checkpoint(path: str,

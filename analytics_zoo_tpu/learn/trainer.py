@@ -1602,10 +1602,19 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
         `int8_sidecar` — run the post-training quantization pass on the
         SAME gathered params so the sidecar always matches the version
         it sits beside. Sidecar failure is one warning, never a failed
-        fit (serving falls back to quantize-at-load)."""
+        fit (serving falls back to quantize-at-load).
+
+        Publication (ISSUE 14) is the LAST act: the publish marker —
+        what the fleet's rollout watcher keys on — commits only once
+        params, opt_state AND the sidecar are all durable. A kill
+        anywhere before the marker rename leaves the version resumable
+        but UNPUBLISHED; a sidecar failure skips the marker too (the
+        version the fleet would quantize-at-load is not the version
+        the trainer meant to publish)."""
         host_params = gather_tree(params)
         ckpt_mgr.save(iteration, host_params, gather_tree(opt_state),
                       extra=extra)
+        publishable = True
         if int8_sidecar:
             try:
                 from analytics_zoo_tpu.serving.quantization import \
@@ -1613,9 +1622,22 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 write_int8_sidecar(ckpt_mgr.run_dir, iteration, model,
                                    params=host_params)
             except Exception as e:  # noqa: BLE001 — sidecar is optional
+                publishable = False
                 log.warning("int8 sidecar write failed at iteration %d "
-                            "(%s: %s); serving will quantize at load",
+                            "(%s: %s); serving will quantize at load "
+                            "and the version stays unpublished",
                             iteration, type(e).__name__, e)
+        if publishable:
+            try:
+                from analytics_zoo_tpu.learn.checkpoint import \
+                    write_publish_marker
+                write_publish_marker(ckpt_mgr.run_dir, iteration,
+                                     extra=extra)
+            except Exception as e:  # noqa: BLE001 — resume still works
+                log.warning("publish marker failed at iteration %d "
+                            "(%s: %s); the version resumes but will "
+                            "not roll out", iteration,
+                            type(e).__name__, e)
 
     history: Dict[str, List[float]] = {"loss": []}
     batches = None

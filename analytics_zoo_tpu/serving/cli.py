@@ -43,6 +43,13 @@ def cmd_start(args) -> int:
         cfg.engine_id = args.engine_id
         cfg._validate_fleet()
     engine_id = cfg.resolve_engine_id()
+    if cfg.rollout_model_dir and engine_id is None:
+        # fail BEFORE the engine joins the consumer group: dying on a
+        # config error after reading records would strand them in the
+        # PEL until a peer's claim sweep
+        raise SystemExit(
+            "params.rollout.model_dir needs a fleet identity: "
+            "pass --engine-id (or set params.engine_id)")
     if cfg.model_encrypted and cfg.http_port is None:
         raise SystemExit(
             "secure.model_encrypted needs http_port: the secret/salt "
@@ -154,8 +161,26 @@ def cmd_start(args) -> int:
     if engine_id:
         print(f"engine id {engine_id} (fleet member; claim window "
               f"{cfg.claim_min_idle_s:g}s)", flush=True)
+    rollout_agent = None
+    if cfg.rollout_model_dir:
+        # versioned rollout (ISSUE 14): this engine follows the
+        # gateway controller's directives — hot-swap on command,
+        # canary, report the new version in its heartbeat (engine_id
+        # presence was enforced before the engine joined the group)
+        from analytics_zoo_tpu.serving.rollout import EngineRolloutAgent
+        rollout_agent = EngineRolloutAgent(
+            serving, broker.clone(), stream=cfg.stream,
+            poll_interval_s=cfg.rollout_poll_interval_s,
+            drain_timeout_s=cfg.rollout_drain_timeout_s,
+            canary_timeout_s=cfg.rollout_canary_timeout_s,
+            golden_tolerance=cfg.rollout_golden_tolerance).start()
+        print(f"rollout agent watching directives for "
+              f"{cfg.rollout_model_dir} (poll "
+              f"{cfg.rollout_poll_interval_s:g}s)", flush=True)
     if frontend is not None:
         frontend._srv.serving = serving
+        if rollout_agent is not None:
+            frontend.set_rollout(rollout_agent)
     if serving.slo is not None:
         obj = serving.slo.objectives
         parts = []
@@ -169,6 +194,8 @@ def cmd_start(args) -> int:
     print("cluster serving started", flush=True)
 
     def shutdown():
+        if rollout_agent is not None:
+            rollout_agent.stop()
         if frontend:
             frontend.stop()
         serving.stop()
@@ -250,6 +277,36 @@ def cmd_gateway(args) -> int:
     print(f"fleet gateway on :{frontend.port} "
           f"(stream {args.stream}, engine ttl {args.engine_ttl:g}s)",
           flush=True)
+    rollout = None
+    # versioned rollout (ISSUE 14): the controller converges the fleet
+    # onto the newest PUBLISHED checkpoint version, one engine at a
+    # time (POST /rollout pins a version; GET /rollout/status watches).
+    # The engine config's params.rollout block seeds the knobs — ONE
+    # block drives both sides of the protocol — and explicit gateway
+    # flags override.
+    rollout_dir = args.rollout_dir or (
+        engine_cfg.rollout_model_dir if engine_cfg else None)
+    if rollout_dir:
+        rollout_interval = args.rollout_interval if args.rollout_interval \
+            is not None else (engine_cfg.rollout_poll_interval_s
+                              if engine_cfg else 1.0)
+        rollout_timeout = args.rollout_engine_timeout \
+            if args.rollout_engine_timeout is not None else (
+                engine_cfg.rollout_engine_timeout_s if engine_cfg
+                else 60.0)
+        if rollout_timeout <= 0 or rollout_interval <= 0:
+            raise SystemExit("--rollout-interval and "
+                             "--rollout-engine-timeout must be > 0")
+        from analytics_zoo_tpu.serving.rollout import RolloutController
+        rollout = RolloutController(
+            broker.clone(), args.stream, rollout_dir,
+            frontend.fleet,
+            poll_interval_s=rollout_interval,
+            engine_timeout_s=rollout_timeout).start()
+        frontend.set_rollout(rollout)
+        print(f"rollout controller watching {rollout_dir} "
+              f"(poll {rollout_interval:g}s, engine timeout "
+              f"{rollout_timeout:g}s)", flush=True)
     import threading
 
     scaler = None
@@ -313,6 +370,8 @@ def cmd_gateway(args) -> int:
 
     def shutdown():
         stopping.set()
+        if rollout is not None:
+            rollout.stop()
         if scaler is not None:
             scaler.stop()
         for p in children:
@@ -418,6 +477,21 @@ def main(argv=None) -> int:
                          "(enables tiered 429 admission on /predict)")
     pg.add_argument("--admission-max-backlog", type=int, default=512,
                     help="backlog at which even the top tier gets 429s")
+    pg.add_argument("--rollout-dir", default=None,
+                    help="run the versioned-rollout controller on this "
+                         "gateway, watching this checkpoint root for "
+                         "PUBLISHED versions (default: the engine "
+                         "config's params.rollout.model_dir — one "
+                         "block drives both sides)")
+    pg.add_argument("--rollout-interval", type=float, default=None,
+                    help="rollout controller poll cadence in seconds "
+                         "(default: engine config "
+                         "params.rollout.poll_interval_s, else 1)")
+    pg.add_argument("--rollout-engine-timeout", type=float, default=None,
+                    help="seconds an alive engine may take to convert "
+                         "before it is skipped as a straggler "
+                         "(default: engine config "
+                         "params.rollout.engine_timeout_s, else 60)")
     pg.set_defaults(fn=cmd_gateway)
     pb = sub.add_parser("broker", help="run a standalone TCP broker")
     pb.add_argument("--host", default="0.0.0.0")

@@ -124,6 +124,20 @@ class ServingConfig:
     # field that carries them, the gateway 429 threshold and the
     # engine-side shed threshold; params.autoscale bounds and tunes the
     # gateway's SLO-driven engine autoscaler
+    # versioned rollout (ISSUE 14, docs/ProgrammingGuide/
+    # cluster-serving.md "Model rollout"): params.rollout.model_dir
+    # points the engine's rollout agent (and the gateway's controller,
+    # via `gateway --rollout-dir`) at the trainer's checkpoint root;
+    # only PUBLISH-marked versions are acted on. poll/drain/canary
+    # cadences plus the golden-output delta tolerance (None =
+    # finiteness-only canary gate) and the controller's per-engine
+    # conversion timeout.
+    rollout_model_dir: Optional[str] = None
+    rollout_poll_interval_s: float = 2.0
+    rollout_drain_timeout_s: float = 10.0
+    rollout_canary_timeout_s: float = 10.0
+    rollout_golden_tolerance: Optional[float] = None
+    rollout_engine_timeout_s: float = 60.0
     batch_policy: str = "adaptive"
     deadline_ms: Optional[float] = None
     batch_margin_ms: float = 2.0
@@ -247,6 +261,25 @@ class ServingConfig:
         cfg.claim_min_idle_s = float(params.get("claim_min_idle_s", 30.0))
         cfg.claim_interval_s = float(params.get("claim_interval_s", 5.0))
         cfg._validate_fleet()
+        rollout = params.get("rollout", {}) or {}
+        if not isinstance(rollout, dict):
+            raise ValueError(
+                f"params.rollout={rollout!r} must be a map (model_dir, "
+                "poll_interval_s, drain_timeout_s, canary_timeout_s, "
+                "golden_tolerance, engine_timeout_s)")
+        cfg.rollout_model_dir = rollout.get("model_dir")
+        cfg.rollout_poll_interval_s = float(
+            rollout.get("poll_interval_s", 2.0))
+        cfg.rollout_drain_timeout_s = float(
+            rollout.get("drain_timeout_s", 10.0))
+        cfg.rollout_canary_timeout_s = float(
+            rollout.get("canary_timeout_s", 10.0))
+        if rollout.get("golden_tolerance") is not None:
+            cfg.rollout_golden_tolerance = float(
+                rollout["golden_tolerance"])
+        cfg.rollout_engine_timeout_s = float(
+            rollout.get("engine_timeout_s", 60.0))
+        cfg._validate_rollout()
         batching = params.get("batching", {}) or {}
         if not isinstance(batching, dict):
             raise ValueError(
@@ -427,6 +460,32 @@ class ServingConfig:
         if self.engine_id is not None and not str(self.engine_id).strip():
             raise ValueError("params.engine_id must be a non-empty "
                              "string, 'auto', or unset")
+
+    def _validate_rollout(self):
+        """Rollout knobs fail at config load like the rest (ISSUE 14):
+        a bad dir spelling, non-positive cadence or negative tolerance
+        is an operator error, not a control-loop surprise mid-swap."""
+        d = self.rollout_model_dir
+        if d is not None and (not isinstance(d, str) or not d.strip()):
+            raise ValueError(
+                f"params.rollout.model_dir={d!r} must be a non-empty "
+                "path string (the trainer's checkpoint root)")
+        for name, value in (
+                ("poll_interval_s", self.rollout_poll_interval_s),
+                ("drain_timeout_s", self.rollout_drain_timeout_s),
+                ("canary_timeout_s", self.rollout_canary_timeout_s),
+                ("engine_timeout_s", self.rollout_engine_timeout_s)):
+            if value <= 0:
+                raise ValueError(
+                    f"params.rollout.{name}={value:g} must be > 0")
+        tol = self.rollout_golden_tolerance
+        if tol is not None and tol < 0:
+            raise ValueError(
+                f"params.rollout.golden_tolerance={tol:g} must be "
+                ">= 0 (or unset for the finiteness-only gate)")
+        # engine_id is NOT required here: the fleet identity usually
+        # arrives as the CLI --engine-id override — cmd_start enforces
+        # the pairing after overrides land
 
     def _validate_elastic(self):
         """Elastic knobs fail at config load like the rest (ISSUE 11):

@@ -82,13 +82,22 @@ class HeartbeatPublisher:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._down = False
+        # last payload_fn fields that published cleanly: a transient
+        # telemetry error must degrade to "not ready" WITHOUT dropping
+        # slow-moving facts the gateway acts on (model_version,
+        # slo_burn) — a beat that suddenly loses its model_version
+        # would read at the rollout controller as a version regression
+        self._last_good_fields: Dict = {}
 
     def _publish_once(self) -> bool:
         payload = {"engine_id": self.engine_id, "ts": time.time(),
                    "pid": os.getpid()}
         try:
-            payload.update(self.payload_fn() or {})
+            fields = self.payload_fn() or {}
+            payload.update(fields)
+            self._last_good_fields = dict(fields)
         except Exception as e:  # noqa: BLE001 — a beat must still go out
+            payload.update(self._last_good_fields)
             payload["ready"] = False
             payload["error"] = f"{type(e).__name__}: {e}"
         try:
@@ -274,6 +283,17 @@ class FleetTracker:
         return sum(1 for row in engines.values()
                    if row.get("alive") and row.get("ready", True))
 
+    def versions(self) -> Optional[Dict[str, object]]:
+        """{engine_id: model_version} for every ALIVE engine (None per
+        engine when it predates versioned serving, e.g. mid-rollout
+        from an unversioned fleet); None when the broker is
+        unreachable. The rollout controller's convergence view."""
+        engines = self.poll()
+        if engines is None:
+            return None
+        return {eid: row.get("model_version")
+                for eid, row in engines.items() if row.get("alive")}
+
     def _alive_metric(self) -> float:
         n = self.alive_count()
         return float("nan") if n is None else float(n)
@@ -295,6 +315,12 @@ class FleetTracker:
             "ready": sum(1 for r in engines.values()
                          if r.get("alive") and r.get("ready", True)),
             "engines_seen": len(self._seen),
+            # the live version set (ISSUE 14): length 1 = converged
+            # fleet; >1 = a rollout in flight (or wedged)
+            "model_versions": sorted(
+                {r.get("model_version") for r in engines.values()
+                 if r.get("alive")
+                 and r.get("model_version") is not None}),
             "engines": engines,
         }
 

@@ -52,8 +52,8 @@ MODEL_SECURED_SALT = "salt"
 # route tables: a known route hit with the wrong method answers 405 with
 # an Allow header (silent 404s made method typos indistinguishable from
 # wrong URLs); unknown paths stay 404
-ROUTES_GET = ("/", "/metrics", "/trace", "/healthz")
-ROUTES_POST = ("/predict", "/model-secure", "/profile")
+ROUTES_GET = ("/", "/metrics", "/trace", "/healthz", "/rollout/status")
+ROUTES_POST = ("/predict", "/model-secure", "/profile", "/rollout")
 
 
 class TokenBucket:
@@ -151,10 +151,67 @@ class _Handler(BaseHTTPRequestHandler):
             self._trace()
         elif path == "/healthz":
             self._healthz()
+        elif path == "/rollout/status":
+            self._rollout_status()
         elif path in ROUTES_POST:
             self._method_not_allowed("POST")
         else:
             self._send(404, {"error": "not found"})
+
+    def _rollout_status(self):
+        """Live rollout view (ISSUE 14): the controller's state machine
+        on a gateway, the agent's last-swap record on an engine; 404
+        when no rollout is wired."""
+        rollout = self.server.rollout
+        if rollout is None:
+            self._send(404, {"error": "rollout not configured; start "
+                                      "with params.rollout.model_dir "
+                                      "(engine) or gateway "
+                                      "--rollout-dir (controller)"})
+            return
+        try:
+            self._send(200, rollout.status())
+        except Exception as e:  # noqa: BLE001 — a probe must answer
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _rollout(self):
+        """`POST /rollout` (ISSUE 14): ask the controller to converge
+        the fleet — body `{"version": N}` pins a published version
+        (manual roll-forward OR rollback); an empty body just pokes the
+        watcher. 409 on a quarantined version, 404 on an unpublished
+        one or when no controller runs here."""
+        rollout = self.server.rollout
+        if rollout is None or not hasattr(rollout, "request"):
+            self._send(404, {"error": "no rollout controller on this "
+                                      "frontend (engines follow "
+                                      "directives; POST to the "
+                                      "gateway)"})
+            return
+        version = None
+        unpin = False
+        try:
+            body = self._read_body()
+            if body.strip():
+                req = json.loads(body)
+                if isinstance(req, dict):
+                    if req.get("version") is not None:
+                        version = int(req["version"])
+                    unpin = bool(req.get("unpin"))
+        except (TypeError, ValueError) as e:
+            self._send(400, {"error": f"bad body: {e}"})
+            return
+        try:
+            status = rollout.request(version, unpin=unpin)
+        except ValueError as e:       # quarantined
+            self._send(409, {"error": str(e)})
+            return
+        except FileNotFoundError as e:
+            self._send(404, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — frontend must not die
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send(202, status)
 
     def _metrics(self):
         """Content negotiation: `Accept: text/plain` (Prometheus scrape)
@@ -298,6 +355,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/profile":
             self._profile()
+            return
+        if path == "/rollout":
+            self._rollout()
             return
         if path != "/predict":
             if path in ROUTES_GET:
@@ -502,7 +562,8 @@ class FrontEnd:
                  fleet_stream: Optional[str] = None,
                  engine_ttl_s: float = 6.0,
                  admission=None,
-                 admission_header: str = "X-Priority"):
+                 admission_header: str = "X-Priority",
+                 rollout=None):
         """`fleet_stream` (ISSUE 10) turns the frontend into a fleet
         gateway: a `FleetTracker` watches engine heartbeats on
         `engines:<fleet_stream>`, `/healthz` answers for the FLEET
@@ -563,6 +624,12 @@ class FrontEnd:
         self.admission = admission
         self._srv.admission = admission
         self._srv.admission_header = admission_header
+        # versioned rollout (ISSUE 14): a RolloutController (gateway
+        # role — POST /rollout accepted) or an EngineRolloutAgent
+        # (engine role — status only); attach later via set_rollout
+        # when the controller is built after the frontend
+        self.rollout = rollout
+        self._srv.rollout = rollout
         self._srv.timeout_s = timeout_s
         self._srv.rate_limiter = (
             TokenBucket(tokens_per_second, token_bucket_capacity)
@@ -576,6 +643,13 @@ class FrontEnd:
         self.host, self.port = self._srv.server_address[:2]
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
+
+    def set_rollout(self, rollout):
+        """Attach the rollout controller/agent after construction (the
+        gateway builds the controller with the frontend's own
+        FleetTracker, which exists only once the frontend does)."""
+        self.rollout = rollout
+        self._srv.rollout = rollout
 
     def start(self) -> "FrontEnd":
         self._thread.start()

@@ -374,6 +374,9 @@ class InferenceModel:
         self.warmup_report: Dict[str, float] = {}
         self.warmup_source: Dict[str, str] = {}
         self.warmed_buckets: set = set()
+        # the per-record sample the last warmup() ran with — what a
+        # restructured swap_params re-warms through the bucket path
+        self._warmup_sample = None
         self.compile_cache = compile_cache
         # AOT executable table, (replica index, input signature) ->
         # jax.stages.Compiled — populated only by cache-backed warmup;
@@ -549,6 +552,7 @@ class InferenceModel:
         self.warmup_report = {}
         self.warmup_source = {}
         self.warmed_buckets = set()
+        self._warmup_sample = None
         # fresh program, fresh roofline: the live serving gauges must
         # describe THIS model, not whatever was loaded before
         self._exec_cost = {}
@@ -560,6 +564,91 @@ class InferenceModel:
         except Exception:  # noqa: BLE001 — telemetry only
             self._roofline = None
         return self
+
+    # -- hot swap (ISSUE 14: zero-downtime model rollout) ------------------
+    def current_params(self) -> Any:
+        """The LIVE device-resident weight tree (replica 0's copy for a
+        replicated pool; None until a model loads). What a rollout agent
+        snapshots before `swap_params` so a failed canary restores the
+        exact serving state without a disk round trip."""
+        if self._replicas:
+            return self._replicas[0].params
+        return self._params
+
+    @staticmethod
+    def _swap_signature(tree) -> tuple:
+        """Post-transfer aval signature for the swap's structure test:
+        treedef + per-leaf (shape, CANONICAL dtype). jax canonicalizes
+        host dtypes at `device_put` (float64 → float32 with x64 off),
+        so a float64 host checkpoint swapped onto an f32 live tree
+        lands as the SAME executable structure — comparing raw host
+        dtypes would misread it as a restructure and pay a pointless
+        recompile."""
+        from jax import dtypes as jdtypes
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return (str(treedef),
+                tuple((tuple(np.shape(leaf)),
+                       str(jdtypes.canonicalize_dtype(
+                           getattr(leaf, "dtype", None)
+                           or np.asarray(leaf).dtype)))
+                      for leaf in leaves))
+
+    def swap_params(self, params) -> str:
+        """Replace the served weights WITHOUT reloading the model — the
+        engine-side primitive of a versioned rollout. Returns how the
+        executables fared:
+
+        - ``"same"`` — the new tree has the identical structure, leaf
+          shapes and dtypes as the live one. Params are swapped in
+          place (per replica device / resharded onto the mesh) and
+          every cached executable — the AOT table and jax's jit cache
+          both key on the params *structure*, never its values — keeps
+          serving: a same-shape swap costs **zero XLA compiles**.
+        - ``"restructured"`` — the tree changed shape (new layer, new
+          dtype, int8⇄f32). There is no honest way to keep the old
+          executables, so the model reloads through `load_fn` (fresh
+          jit, fresh AOT/cost tables, fresh fingerprint) and re-warms
+          the previously-warmed buckets through the existing warmup
+          path — the caller pays real compiles, visibly, instead of a
+          silent structure mismatch at dispatch time.
+
+        Swapping is reference-atomic per replica: a batch already
+        dispatched keeps the tree it captured; the next dispatch sees
+        the new one. Callers wanting a version boundary with no mixed
+        batches (the rollout agent) drain dispatch first —
+        `ClusterServing.pause_intake()` + `quiesce()`."""
+        if self._fn is None:
+            raise RuntimeError("No model loaded; load_* before swapping")
+        live = self.current_params()
+        new_sig, live_sig = self._swap_signature(params), \
+            self._swap_signature(live)
+        if new_sig != live_sig:
+            import logging
+            logging.getLogger("analytics_zoo_tpu.serving").info(
+                "swap_params: structure changed (%s -> %s); honest "
+                "reload + re-warmup", live_sig, new_sig)
+            sample, buckets = self._warmup_sample, sorted(
+                self.warmed_buckets)
+            self.load_fn(self._fn, params)
+            if sample is not None:
+                self.warmup(sample, buckets=buckets or None)
+            return "restructured"
+        if self.placement == "sharded" and self.mesh is not None:
+            from analytics_zoo_tpu.parallel.sharding import shard_params
+            self._params = shard_params(params, self.mesh)
+        elif self._replicas is not None:
+            with self._replica_cv:
+                reps = self._replicas
+                if reps is None:
+                    raise RuntimeError(
+                        "replica pool closed mid-swap; reload the model")
+                for rep in reps:
+                    rep.params = jax.device_put(params, rep.device)
+        elif self._pin_single:
+            self._params = jax.device_put(params, self.devices[0])
+        else:
+            self._params = jax.device_put(params)
+        return "same"
 
     # -- roofline accounting (observability/roofline.py) -------------------
     @staticmethod
@@ -1156,6 +1245,7 @@ class InferenceModel:
             buckets = [b for b in buckets if b % dp == 0] or \
                 [self.buckets[0]]
         sample = jax.tree_util.tree_map(np.asarray, sample)
+        self._warmup_sample = sample
         tag = "x".join(map(str, jax.tree_util.tree_leaves(sample)[0].shape)
                        ) or "scalar"
         use_cache = self._use_compile_cache()

@@ -136,7 +136,8 @@ class ClusterServing:
                  batch_margin_ms: float = 2.0,
                  admission_tiers=None,
                  admission_field: str = "tier",
-                 shed_backlog: Optional[int] = None):
+                 shed_backlog: Optional[int] = None,
+                 model_version: Optional[int] = None):
         """Fault-tolerance knobs (ISSUE 5; the rest is PR 1-4 surface):
         `supervise` starts a `ReplicaSupervisor` over a replica pool
         (quarantine after `failure_threshold` consecutive failures or
@@ -258,7 +259,18 @@ class ClusterServing:
         self.zero_copy_decode = zero_copy_decode
         self.decode_workers = max(1, decode_workers)
         self.queue_depth = max(1, queue_depth)
+        # versioned serving (ISSUE 14): which checkpoint version the
+        # model currently serves (None = unversioned weights). The
+        # rollout agent advances it AFTER a successful canary, and the
+        # heartbeat row carries it — reporting the new version IS the
+        # engine's "converted" signal to the rollout controller.
+        self.model_version = model_version
         self._stop = threading.Event()
+        # intake pause (rollout drain): while set, the reader neither
+        # reads nor claim-sweeps — in-hand work flows out, the broker
+        # queues (or peers drain) new work, and a swap sees no mixed-
+        # version batches
+        self._intake_paused = threading.Event()
         self._threads: List[threading.Thread] = []
         self._decode_q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         self._dispatch_q: "queue.Queue" = queue.Queue(
@@ -364,6 +376,11 @@ class ClusterServing:
                "healthy_replicas": h.get("healthy_replicas"),
                "records_served": self.records_served,
                "records_read": self.records_read}
+        if self.model_version is not None:
+            # the rollout controller's convergence signal (ISSUE 14):
+            # an engine reports a new version ONLY after the swap's
+            # canary passed — the beat is the commit
+            out["model_version"] = self.model_version
         slo = h.get("slo")
         if isinstance(slo, dict):
             burns = [v.get("burn_rate", 0.0) for v in slo.values()
@@ -474,6 +491,18 @@ class ClusterServing:
                            serving_dtype=self.serving_dtype)
             wtg.set_function(weight_fn, **wlabels)
             self._gauge_installs.append((wtg, weight_fn, wlabels, True))
+        # versioned serving (ISSUE 14): the live checkpoint version.
+        # Family registers unconditionally (stable schema); the series
+        # appears only once a versioned model serves, value = version
+        # number — a scrape sees the fleet converge as every engine's
+        # series reaches the same value
+        self._version_gauge = reg.gauge(
+            "serving_model_version",
+            "checkpoint version this engine currently serves (value is "
+            "the version number; absent for unversioned weights)")
+        if self.model_version is not None:
+            self._version_gauge.set(float(self.model_version),
+                                    **self._labels)
 
     def _enqueue(self, q: "queue.Queue", batch: _Batch):
         """Stamp the enqueue time (the consumer's queue-wait span starts
@@ -528,6 +557,8 @@ class ClusterServing:
             "healthy_replicas": healthy,
             "breakers": breakers,
         }
+        if self.model_version is not None:
+            out["model_version"] = self.model_version
         if not running:
             out["reason"] = "engine not running"
         elif not replicas_ok:
@@ -542,6 +573,43 @@ class ClusterServing:
             except Exception:  # noqa: BLE001 — health must always answer
                 out["slo"] = None
         return out
+
+    # -- rollout hooks (ISSUE 14; driven by serving/rollout.py) ------------
+    def set_model_version(self, version: int):
+        """Advance the served version (rollout agent, post-canary): the
+        gauge and the next heartbeat both report it — the heartbeat is
+        what tells the controller this engine converted."""
+        self.model_version = int(version)
+        self._version_gauge.set(float(version), **self._labels)
+
+    def pause_intake(self):
+        """Stop the reader pulling NEW work (reads and claim sweeps);
+        everything already in hand keeps flowing to the sink. The
+        broker buffers — or, in a fleet, live peers drain — what
+        arrives meanwhile. The rollout agent's drain barrier."""
+        self._intake_paused.set()
+
+    def resume_intake(self):
+        self._intake_paused.clear()
+
+    def quiesce(self, timeout_s: float = 10.0) -> bool:
+        """Block (bounded) until every record this engine has read is
+        committed — in-flight set empty and the stage queues drained.
+        Call after `pause_intake()`; True = the pipeline is empty and a
+        swap sees no mixed-version batch. False (timeout / engine
+        stopping) means the caller may still swap: a batch dispatched
+        pre-swap holds its own params reference, so the tail of the
+        old version simply finishes on the old weights."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                inflight = len(self._inflight_ids)
+            if inflight == 0 and self._decode_q.empty() \
+                    and self._dispatch_q.empty() and self._sink_q.empty():
+                return True
+            if self._stop.wait(0.02):
+                return False
+        return False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ClusterServing":
@@ -767,6 +835,12 @@ class ClusterServing:
         last_logged = None         # (breaker state) at last warning
         next_claim = time.monotonic() + self.claim_interval_s
         while not self._stop.is_set():
+            if self._intake_paused.is_set():
+                # rollout drain (ISSUE 14): no reads, no claim sweeps —
+                # in-hand work flows out while the swap waits on
+                # quiesce(); a timed wait so stop() still cuts through
+                self._stop.wait(0.05)
+                continue
             try:
                 records = self.reader_broker.read_group(
                     self.stream, GROUP, self.consumer, self.batch_size,
@@ -1476,6 +1550,7 @@ class ClusterServing:
             "records_read": self.records_read,
             "pipelined": self.pipelined,
             "serving_dtype": self.serving_dtype,
+            "model_version": self.model_version,
             "batch": self.batch_timer.snapshot(),
             "predict": self.model.timer.snapshot(),
         }

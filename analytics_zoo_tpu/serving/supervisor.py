@@ -59,6 +59,7 @@ class ReplicaSupervisor:
         self.probe_timeout_s = probe_timeout_s
         self._consec: Dict[int, int] = collections.defaultdict(int)
         self._slow: Dict[int, int] = collections.defaultdict(int)
+        self._suspended = False
         # rolling pool-wide latency window: the outlier baseline. One
         # shared deque (not per-replica): a sick replica must stand out
         # against the POOL, not against its own degraded history.
@@ -104,10 +105,34 @@ class ReplicaSupervisor:
         # a stopped supervisor must not pin the model in the registry
         self._healthy_gauge.release_function(self._healthy_fn, freeze=True)
 
+    # -- rollout hand-off (ISSUE 14) ---------------------------------------
+    def suspend(self):
+        """Stop judging outcomes while a model swap is in flight: the
+        first post-swap batches of a restructured version pay honest
+        re-warmup latency, and counting those as outliers (or a torn
+        mid-swap dispatch as a failure streak) would quarantine healthy
+        replicas exactly when the rollout needs them. The canary probe
+        loop keeps running — revival is still wanted mid-swap."""
+        with self._lock:
+            self._suspended = True
+
+    def resume(self):
+        """Re-arm supervision after a swap, with a CLEAN slate: strikes
+        reset and the latency window drops — the new version's latency
+        family must build its own baseline, not be judged against the
+        old model's."""
+        with self._lock:
+            self._suspended = False
+            self._consec.clear()
+            self._slow.clear()
+            self._lat_window.clear()
+
     # -- outcome stream (called from replica worker threads) ---------------
     def _record(self, replica: int, ok: bool, latency_s: float):
         quarantine_as = None
         with self._lock:
+            if self._suspended:
+                return
             if not ok:
                 self._consec[replica] += 1
                 if self._consec[replica] >= self.failure_threshold:
@@ -208,4 +233,5 @@ class ReplicaSupervisor:
                 "quarantined": self.model.quarantined_replicas(),
                 "consecutive_failures": dict(self._consec),
                 "latency_strikes": dict(self._slow),
+                "suspended": self._suspended,
             }

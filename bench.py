@@ -595,6 +595,34 @@ def main():
                         out[dst] = r4.get(src)
             else:
                 out["serving_fleet_drain_rps"] = None
+        # chaos-rollout (ISSUE 14): publish a new checkpoint version to
+        # a live 3-engine fleet, kill the gateway + one engine
+        # mid-rollout, restart — convergence time to exactly one
+        # version, zero accepted-record loss, and 0 XLA compiles from
+        # the same-structure swaps
+        if os.environ.get("BENCH_ROLLOUT", "1") == "1":
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            r7, _ = _run_sub([sys.executable,
+                              os.path.join(here, "bench_serving.py"),
+                              "--chaos-rollout"],
+                             timeout=900, env=env)
+            if r7:
+                for src, dst in (
+                        ("convergence_s", "serving_rollout_convergence_s"),
+                        ("post_kill_convergence_s",
+                         "serving_rollout_post_kill_convergence_s"),
+                        ("records_lost", "serving_rollout_records_lost"),
+                        ("zero_loss", "serving_rollout_zero_loss"),
+                        ("final_versions",
+                         "serving_rollout_final_versions"),
+                        ("swap_compiles", "serving_rollout_swap_compiles"),
+                        ("total_accepted",
+                         "serving_rollout_total_accepted")):
+                    if r7.get(src) is not None:
+                        out[dst] = r7.get(src)
+            else:
+                out["serving_rollout_zero_loss"] = None
         # elastic replay (ISSUE 11): diurnal + spike trace against a
         # static fleet vs the autoscaled one — chip-seconds ratio,
         # per-phase p99 vs the declared SLO, light-load p50 A/B against

@@ -695,6 +695,20 @@ def _fleet_child(args) -> int:
     # stays bytes-cheap too — drain scaling should measure compute,
     # not RESP serialization of 512-float rows.
     base_fn, W, sample = _md_model(width=256, iters=1024)
+    rollout_version = None
+    if args.rollout_dir:
+        # chaos-rollout leg (ISSUE 14): the versioned weights come
+        # from the published checkpoint dir, not the generator — every
+        # engine starts on the newest PUBLISHED version and then
+        # follows the controller's directives
+        from analytics_zoo_tpu.learn.checkpoint import (
+            latest_published_checkpoint, load_checkpoint)
+        found = latest_published_checkpoint(args.rollout_dir)
+        if found is None:
+            raise SystemExit(
+                f"no published checkpoint under {args.rollout_dir}")
+        run_dir, rollout_version = found
+        W, _, _ = load_checkpoint(run_dir, rollout_version)
 
     def fn(p, x):
         return base_fn(p, x).mean(axis=-1)
@@ -720,7 +734,7 @@ def _fleet_child(args) -> int:
         # deadline-aware engines against "static" pad-to-largest ones
         batch_policy=args.batch_policy,
         deadline_ms=args.deadline_ms or None,
-        slo=slo)
+        slo=slo, model_version=rollout_version)
     broker.hset(f"fleet:ready:{args.stream}", args.engine_id, "1")
     gate_deadline = time.time() + 600
     while not broker.hget(f"fleet:gate:{args.stream}", "go"):
@@ -728,10 +742,20 @@ def _fleet_child(args) -> int:
             raise SystemExit("fleet start gate never opened")
         time.sleep(0.02)
     serving.start()
+    agent = None
+    exec_before = im.compile_cache_size()
+    if args.rollout_dir:
+        from analytics_zoo_tpu.serving.rollout import EngineRolloutAgent
+        agent = EngineRolloutAgent(
+            serving, broker.clone(), stream=args.stream,
+            poll_interval_s=0.1, drain_timeout_s=5.0,
+            canary_timeout_s=10.0).start()
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     while not stop:
         time.sleep(0.05)
+    if agent is not None:
+        agent.stop()
     serving.stop()
     sources = {}
     for v in im.warmup_source.values():
@@ -741,14 +765,22 @@ def _fleet_child(args) -> int:
               for k, v in m.get("stages", {}).items()}
     stages["predict"] = round(m["predict"].get("p50_ms", 0.0), 2)
     n_batches = m.get("stages", {}).get("dispatch", {}).get("count", 0)
-    print(json.dumps({"engine_id": args.engine_id,
-                      "sources": sources,
-                      "records_served": serving.records_served,
-                      "stage_p50_ms": stages,
-                      "avg_read_batch": round(
-                          serving.records_read / n_batches, 2)
-                      if n_batches else None,
-                      "claimed_records": m.get("claimed_records", 0)}))
+    report = {"engine_id": args.engine_id,
+              "sources": sources,
+              "records_served": serving.records_served,
+              "stage_p50_ms": stages,
+              "avg_read_batch": round(
+                  serving.records_read / n_batches, 2)
+              if n_batches else None,
+              "claimed_records": m.get("claimed_records", 0)}
+    if args.rollout_dir:
+        # the 0-compiles-on-swap evidence: executable count after the
+        # rollout minus before — a same-structure swap adds nothing
+        report["model_version"] = serving.model_version
+        report["swap"] = agent.last_swap if agent is not None else None
+        report["executables_delta"] = \
+            im.compile_cache_size() - exec_before
+    print(json.dumps(report))
     return 0
 
 
@@ -1030,6 +1062,186 @@ def _fleet_main(args) -> int:
         "survivor_claimed_records": survivors_claimed,
         "engine_reports": reports,
     }
+    print(json.dumps(out))
+    return 0
+
+
+# -- chaos-rollout: kill the gateway + one engine mid-rollout (ISSUE 14) ---
+
+def _chaos_rollout_main(args) -> int:
+    """`--chaos-rollout`: the zero-downtime lifecycle under fire.
+
+    A 3-engine fleet serves published checkpoint version 1 while an
+    open-loop feeder keeps records flowing. The trainer-side publishes
+    version 2; the rollout controller starts converging the fleet
+    engine-by-engine. Mid-rollout — at least one engine converted,
+    at least one not — BOTH the gateway (controller killed without
+    cleanup: its directive row stays behind, mid-campaign) and one
+    unconverted engine (SIGKILL: no drain, unacked records strand in
+    its PEL) die. A fresh controller then restarts, digests the mixed
+    fleet from heartbeat rows alone, and must converge the survivors
+    to EXACTLY version 2 with zero accepted-record loss (strict
+    per-record accounting: every uri the feeder successfully XADDed
+    has a result) and zero XLA compiles from the same-structure swaps
+    (per-engine executable-count deltas)."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+    import uuid
+
+    from analytics_zoo_tpu.learn.checkpoint import (CheckpointManager,
+                                                    write_publish_marker)
+    from analytics_zoo_tpu.serving.broker import (RedisBroker,
+                                                  encode_ndarray)
+    from analytics_zoo_tpu.serving.fleet import FleetTracker
+    from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+    from analytics_zoo_tpu.serving.rollout import RolloutController
+
+    n = 3
+    batch = 8
+    stream = "serving_stream_rollout"
+    _fn, W, sample = _md_model(width=256, iters=1024)
+    encoded = encode_ndarray(np.asarray(sample))
+    model_dir = tempfile.mkdtemp(prefix="zoo-rollout-ckpt-")
+    cache_dir = tempfile.mkdtemp(prefix="zoo-rollout-cc-")
+    mgr = CheckpointManager(model_dir, keep=10)
+    # publish in the dtype the model SERVES (numpy>=2 promotes the
+    # generator's /sqrt(width) to f64; jax would canonicalize at load,
+    # but the artifact should say what it means)
+    W = np.asarray(W, np.float32)
+    mgr.save(1, W)
+    write_publish_marker(mgr.run_dir, 1)
+    srv = MiniRedisServer().start()
+    broker = RedisBroker(srv.host, srv.port)
+    accepted = []
+    feeding = threading.Event()
+    feeding.set()
+
+    def feeder():
+        # open-loop, modest rate: the point is continuous traffic
+        # THROUGH the rollout, not saturation — every uri appended to
+        # `accepted` was acknowledged by the broker and must come back
+        while feeding.is_set():
+            uri = uuid.uuid4().hex
+            try:
+                broker.xadd(stream, {"uri": uri, "data": {"t": encoded}})
+            except Exception:  # noqa: BLE001 — not accepted, not owed
+                time.sleep(0.05)
+                continue
+            accepted.append(uri)
+            time.sleep(0.01)
+
+    procs = []
+    out = {"metric": "serving_rollout_chaos", "engines": n}
+    reports = []
+    try:
+        procs = _fleet_spawn(1, stream, srv.port, cache_dir, 1.0, batch,
+                             extra_args=("--rollout-dir", model_dir))
+        _fleet_wait_ready(broker, stream, procs, 1)
+        procs += _fleet_spawn(n - 1, stream, srv.port, cache_dir, 1.0,
+                              batch, start_idx=1,
+                              extra_args=("--rollout-dir", model_dir))
+        _fleet_wait_ready(broker, stream, procs, n)
+        broker.hset(f"fleet:gate:{stream}", "go", "1")
+        feed_thread = threading.Thread(target=feeder, daemon=True)
+        feed_thread.start()
+        tracker = FleetTracker(broker.clone(), stream, ttl_s=2.0,
+                               poll_min_interval_s=0.05)
+        controller = RolloutController(
+            broker.clone(), stream, model_dir, tracker,
+            poll_interval_s=0.2, engine_timeout_s=120.0).start()
+        # trainer publishes version 2 (same structure: 1.01x weights)
+        mgr.save(2, W * 1.01)
+        write_publish_marker(mgr.run_dir, 2)
+        t_publish = time.perf_counter()
+        # mid-rollout point: >=1 engine on v2, >=1 still on v1
+        deadline = time.time() + 300
+        victim = None
+        while time.time() < deadline:
+            versions = tracker.versions() or {}
+            on_new = [e for e, v in versions.items() if v == 2]
+            on_old = [e for e, v in versions.items() if v != 2]
+            if on_new and on_old:
+                victim = sorted(on_old)[0]
+                break
+            time.sleep(0.02)
+        if victim is None:
+            raise SystemExit("rollout never reached a mid-point "
+                             "(no mixed-version window observed)")
+        # kill the GATEWAY (no clean stop: the thread is cut loose and
+        # its directive row stays behind) and one UNCONVERTED engine
+        controller._stop.set()
+        idx = int(victim.split("-")[-1])
+        procs[idx].send_signal(_signal.SIGKILL)
+        t_kill = time.perf_counter()
+        # gateway restarts: a FRESH controller must digest the mess
+        tracker2 = FleetTracker(broker.clone(), stream, ttl_s=2.0,
+                                poll_min_interval_s=0.05)
+        controller2 = RolloutController(
+            broker.clone(), stream, model_dir, tracker2,
+            poll_interval_s=0.2, engine_timeout_s=120.0).start()
+        # traffic keeps flowing a while longer, then stops
+        time.sleep(2.0)
+        feeding.clear()
+        feed_thread.join(timeout=10)
+        total = len(accepted)
+        # convergence: every ALIVE engine on version 2, exactly
+        deadline = time.time() + 300
+        converged_at = None
+        final_versions = {}
+        while time.time() < deadline:
+            versions = tracker2.versions() or {}
+            vals = set(versions.values())
+            if len(versions) == n - 1 and vals == {2}:
+                converged_at = time.perf_counter()
+                final_versions = dict(versions)
+                break
+            time.sleep(0.05)
+        # drain: every accepted record answered (claim sweep owns the
+        # dead engine's strays)
+        result_key = f"result:{stream}"
+        deadline = time.time() + 300
+        while broker.hlen(result_key) < total \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        got = broker.hlen(result_key)
+        res = broker.hgetall(result_key)
+        missing = [u for u in accepted if u not in res]
+        controller2.stop()
+        status = controller2.status()
+        reports = _fleet_reports([p for p in procs
+                                  if p.poll() is None])
+        # compiles attributable to the SWAPS themselves (the agent
+        # measures across its own swap+canary window; the whole-run
+        # executables_delta additionally catches unrelated bucket
+        # traffic, e.g. a claim sweep forming an unwarmed batch size)
+        swap_compiles = sum(
+            (r.get("swap") or {}).get("swap_executables_delta") or 0
+            for r in reports)
+        out.update({
+            "total_accepted": total,
+            "records_lost": len(missing),
+            "zero_loss": not missing,
+            "results_written": got,
+            "killed_engine": victim,
+            "converged": converged_at is not None,
+            "convergence_s": round(converged_at - t_publish, 2)
+            if converged_at else None,
+            "post_kill_convergence_s": round(converged_at - t_kill, 2)
+            if converged_at else None,
+            "final_versions": sorted(set(final_versions.values())),
+            "swap_compiles": swap_compiles,
+            "controller_state": status.get("state"),
+            "engine_reports": reports,
+        })
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(model_dir, ignore_errors=True)
     print(json.dumps(out))
     return 0
 
@@ -1915,6 +2127,13 @@ def main():
     ap.add_argument("--engine-id", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--claim-min-idle", type=float, default=30.0,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--chaos-rollout", action="store_true",
+                    help="zero-downtime rollout under fire: publish "
+                         "v2 to a 3-engine fleet, kill the gateway + "
+                         "one engine mid-rollout, restart, assert "
+                         "convergence to exactly one version with "
+                         "zero accepted-record loss (ISSUE 14)")
+    ap.add_argument("--rollout-dir", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--fleet-batch", type=int, default=8,
                     help=argparse.SUPPRESS)
     ap.add_argument("--pin-core", type=int, default=None,
@@ -1944,6 +2163,8 @@ def main():
         return _fleet_child(args)
     if args.engines:
         return _fleet_main(args)
+    if args.chaos_rollout:
+        return _chaos_rollout_main(args)
     if args.int8_ab:
         return _int8_ab_main(args)
     if args.elastic:

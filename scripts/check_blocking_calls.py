@@ -54,6 +54,10 @@ ALLOW_RE = re.compile(r"#\s*blocking-ok:\s*\S")
 CONTROL_LOOP_FILES = (
     os.path.join(SERVING_PKG, "fleet.py"),
     os.path.join(SERVING_PKG, "elastic.py"),
+    # the rollout control plane (ISSUE 14): agent + controller loops
+    # pace on stop-event waits only — a sleep would hold a paused
+    # engine's intake (or a gateway shutdown) hostage for its duration
+    os.path.join(SERVING_PKG, "rollout.py"),
 )
 SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 BARE_EXCEPT_RE = re.compile(r"^\s*except\s*:", re.MULTILINE)

@@ -116,6 +116,15 @@ REQUIRED = {
     "serving_weight_bytes": "gauge",
     "training_mesh_axis_size": "gauge",
     "quantized_checkpoints_total": "counter",
+    # zero-downtime rollout (ISSUE 14): the version lifecycle families
+    # the /rollout endpoints, the chaos-rollout bench JSON, and the
+    # fleet-convergence dashboard read — serving_model_version is how
+    # a scrape watches a rollout sweep the fleet, and renaming any of
+    # these silently blinds the rollback/quarantine audit trail
+    "serving_model_version": "gauge",
+    "serving_rollout_state": "gauge",
+    "serving_rollout_transitions_total": "counter",
+    "serving_rollout_rollbacks_total": "counter",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",
