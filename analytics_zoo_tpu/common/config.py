@@ -114,6 +114,19 @@ class ZooConfig:
     # Off-path optimizers and non-lowering backends degrade to plain
     # optax with one WARNING, so this is safe to set fleet-wide.
     fused_optimizer: bool = False
+    # Parallel streaming input pipeline (ISSUE 15): worker threads for
+    # file-backed dataset read+decode (`data/pipeline.py` — TFRecord /
+    # parquet / csv shards decode concurrently behind a deterministic
+    # reorder buffer, so any value yields the SAME batch stream). 0
+    # keeps datasets single-threaded unless they pass their own
+    # workers knob. Env spelling ZOO_PIPELINE_WORKERS.
+    pipeline_workers: int = 0
+    # Depth of the trainer's host→device prefetch queue (batches held
+    # ready while the device runs the current step). Bounds host
+    # memory: the input side never materializes more than
+    # prefetch_depth batches + one decoded shard per pipeline worker.
+    # Env spelling ZOO_PREFETCH_DEPTH.
+    prefetch_depth: int = 2
     default_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     # pandas_read_backend flag of the reference (`nncontext.py:269`)

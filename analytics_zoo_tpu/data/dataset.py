@@ -100,7 +100,9 @@ class TPUDataset:
                       batch_size: int = -1, batch_per_thread: int = -1,
                       shuffle: bool = True, shuffle_buffer: int = 8192,
                       verify_payload: bool = False,
-                      num_workers: int = 1) -> "TPUDataset":
+                      num_workers: Optional[int] = None,
+                      pipeline_workers: Optional[int] = None
+                      ) -> "TPUDataset":
         """Stream a TFRecord corpus into training (the reference's
         `TFDataset.from_tf_data_dataset`/`TFBytesDataset` role,
         `tf_dataset.py:593,911`, minus the tf.data graph shuttling).
@@ -112,15 +114,25 @@ class TPUDataset:
         reshuffled per epoch); batches are stacked to static shapes and the
         tail remainder is dropped, per the training batch contract.
 
-        `num_workers` > 1 runs decode+parse through the threaded
-        order-preserving map (`image.parallel_map_ordered`) — JPEG decode
-        and cv2 augmentation release the GIL, so an ImageNet-style
-        pipeline keeps the chip fed."""
+        `pipeline_workers` (default: `ZooConfig.pipeline_workers` /
+        env ZOO_PIPELINE_WORKERS, else `num_workers`) runs read+decode
+        through the parallel shard pipeline (`data/pipeline.py`): each
+        FILE is decoded on a worker thread — frame batches through the
+        vectorized `decode_example_batch`, then `parse_fn` per sample —
+        and a bounded reorder buffer re-serializes shard order, so the
+        batch stream is bitwise-identical at any worker count (a pure
+        function of `(seed, epoch)`). Multi-host fits automatically
+        read DISJOINT files per host (`pipeline.host_shard` over the
+        mesh's data axis). `num_workers` is the legacy spelling of the
+        same knob: when passed (any value, including an explicit 1 to
+        opt out of decode threads) it wins over ambient config, and
+        `pipeline_workers` wins over both."""
         from analytics_zoo_tpu.data import tfrecord as tfr
         files = tfr.expand_files(paths)
         return _TFRecordDataset(files, parse_fn, batch_size,
                                 batch_per_thread, shuffle, shuffle_buffer,
-                                verify_payload, num_workers)
+                                verify_payload, num_workers,
+                                pipeline_workers)
 
     # -- consumption -------------------------------------------------------
     def n_samples(self) -> int:
@@ -187,11 +199,25 @@ class _FeatureSetDataset(TPUDataset):
 class _TFRecordDataset(TPUDataset):
     """Streaming TFRecord corpus → static-shape batches, via a bounded
     shuffle buffer (no full materialization; a corpus larger than host RAM
-    trains fine)."""
+    trains fine). Read+decode runs through the parallel shard pipeline
+    (`data/pipeline.py`): files decode concurrently, the reorder buffer
+    keeps the sample stream a pure function of `(seed, epoch)`."""
+
+    # multi-host fits read disjoint files per host (iter_train), so the
+    # trainer's streaming-duplication guard does not apply
+    shards_per_host = True
+
+    # frame batch per vectorized decode_example_batch call
+    _DECODE_CHUNK = 256
+    # records per pipeline shard: big files split into bounded record
+    # ranges, so a worker's residency is ≤ this many parsed samples no
+    # matter the file size (a one-file 100 GB corpus still streams)
+    _SHARD_RECORDS = 1024
 
     def __init__(self, files: List[str], parse_fn, batch_size: int,
                  batch_per_thread: int, shuffle: bool, shuffle_buffer: int,
-                 verify_payload: bool, num_workers: int = 1):
+                 verify_payload: bool, num_workers: Optional[int] = None,
+                 pipeline_workers: Optional[int] = None):
         super().__init__(x=None, y=None, batch_size=batch_size,
                          batch_per_thread=batch_per_thread, shuffle=shuffle)
         if parse_fn is None:
@@ -202,13 +228,63 @@ class _TFRecordDataset(TPUDataset):
         self._parse_fn = parse_fn
         self._shuffle_buffer = max(1, shuffle_buffer)
         self._verify_payload = verify_payload
-        self._num_workers = max(1, num_workers)
+        self._num_workers = num_workers
+        self._pipeline_workers = pipeline_workers
         self._n: Optional[int] = None
+        self._index_cache: Dict[str, Tuple] = {}
+        self._count_cache: Dict[str, int] = {}
+
+    def _workers(self) -> int:
+        from analytics_zoo_tpu.data.pipeline import resolve_workers
+        if self._pipeline_workers is None and self._num_workers is not None:
+            # an explicitly-passed legacy num_workers is a call-site
+            # decision — INCLUDING num_workers=1 (opting out of decode
+            # threads on a co-tenant host): ambient config must not
+            # silently override it
+            return max(1, self._num_workers)
+        return resolve_workers(self._pipeline_workers)
+
+    def _file_index(self, path: str):
+        """(payload_offsets, payload_lengths) for one file, memoized —
+        the file set is immutable, so the header walk is paid once per
+        file per dataset, not per epoch (a fuse-mounted corpus must not
+        re-scan every shard at every epoch start)."""
+        idx = self._index_cache.get(path)
+        if idx is None:
+            from analytics_zoo_tpu.data import tfrecord as tfr
+            idx = self._index_cache[path] = tfr.scan_index(
+                path, verify_payload=self._verify_payload)
+        return idx
+
+    def _file_indexes(self, files: List[str]):
+        """Memoized indexes for `files`, the uncached ones scanned on
+        the worker pool."""
+        from analytics_zoo_tpu.data.pipeline import parallel_read
+        missing = [f for f in files if f not in self._index_cache]
+        if missing:
+            parallel_read(missing, self._file_index,
+                          workers=self._workers())
+        return {f: self._file_index(f) for f in files}
+
+    def _file_count(self, path: str) -> int:
+        """Record count for one file, memoized. Reads the index cache
+        when the parallel path already built it, else the O(1)-memory
+        native/header count — counting must NOT grow a per-record
+        index the single-threaded path never needs."""
+        idx = self._index_cache.get(path)
+        if idx is not None:
+            return len(idx[0])
+        n = self._count_cache.get(path)
+        if n is None:
+            from analytics_zoo_tpu.data import tfrecord as tfr
+            n = self._count_cache[path] = tfr.count_records(path)
+        return n
 
     def n_samples(self) -> int:
         if self._n is None:
-            from analytics_zoo_tpu.data import tfrecord as tfr
-            self._n = sum(tfr.count_records(f) for f in self._files)
+            from analytics_zoo_tpu.data.pipeline import parallel_read
+            self._n = sum(parallel_read(self._files, self._file_count,
+                                        workers=self._workers()))
         return self._n
 
     def first_sample(self):
@@ -236,26 +312,104 @@ class _TFRecordDataset(TPUDataset):
             else jax.tree_util.tree_map(lambda *a: np.stack(a), *ys)
         return x, y
 
-    def _iter_samples(self, rng: np.random.RandomState,
-                      ordered: bool = False):
+    def _shard_chunks(self, path: str):
+        """ONE file's samples, a decode-chunk at a time: frames batch
+        through the vectorized Example codec, `parse_fn` runs per
+        sample. Yields lists of up to `_DECODE_CHUNK` samples."""
         from analytics_zoo_tpu.data import tfrecord as tfr
-        from analytics_zoo_tpu.data.image import parallel_map_ordered
-        files = list(self._files)
-        if self.shuffle and not ordered:
-            rng.shuffle(files)
+        chunk: List[bytes] = []
+        for payload in tfr.read_records(
+                path, verify_payload=self._verify_payload):
+            chunk.append(payload)
+            if len(chunk) >= self._DECODE_CHUNK:
+                yield [self._parse_fn(ex)
+                       for ex in tfr.decode_example_batch(chunk)]
+                chunk = []
+        if chunk:
+            yield [self._parse_fn(ex)
+                   for ex in tfr.decode_example_batch(chunk)]
 
-        def payloads():
+    def _read_shard(self, shard: Tuple[str, int]) -> List[Tuple]:
+        """Worker unit for the PARALLEL path: ONE bounded record range
+        of one file — seek-read via the memoized index, chunked
+        vectorized decode, `parse_fn` per sample. Residency per
+        in-flight shard is ≤ `_SHARD_RECORDS` parsed samples no matter
+        how big the file is."""
+        from analytics_zoo_tpu.data import tfrecord as tfr
+        path, start = shard
+        offs, lens = self._file_index(path)
+        sl = slice(start, start + self._SHARD_RECORDS)
+        out: List[Tuple] = []
+        chunk: List[bytes] = []
+        for payload in tfr.read_payloads_at(path, offs[sl], lens[sl]):
+            chunk.append(payload)
+            if len(chunk) >= self._DECODE_CHUNK:
+                out.extend(self._parse_fn(ex)
+                           for ex in tfr.decode_example_batch(chunk))
+                chunk = []
+        if chunk:
+            out.extend(self._parse_fn(ex)
+                       for ex in tfr.decode_example_batch(chunk))
+        return out
+
+    def _iter_samples(self, rng: np.random.RandomState,
+                      ordered: bool = False,
+                      files: Optional[List[str]] = None):
+        """Sample stream in deterministic shard order: `files` (or the
+        per-epoch shuffled file list) read+decoded by the worker pool,
+        re-serialized by the reorder buffer — bitwise-identical at any
+        worker count. workers<=1 streams chunk-by-chunk (one decode
+        chunk resident — a corpus stored as one giant file still
+        trains in bounded memory, the class's original contract);
+        workers>1 splits every file into `_SHARD_RECORDS`-record
+        ranges via the memoized header index, so residency is
+        (workers+1) × bounded ranges, never whole files."""
+        from analytics_zoo_tpu.data.pipeline import ShardPipeline
+        if files is None:
+            files = list(self._files)
+            if self.shuffle and not ordered:
+                rng.shuffle(files)
+        workers = self._workers()
+        if workers <= 1:
             for path in files:
-                yield from tfr.read_records(
-                    path, verify_payload=self._verify_payload)
+                for chunk in self._shard_chunks(path):
+                    yield from chunk
+            return
+        indexes = self._file_indexes(files)
+        shards = [(path, start)
+                  for path in files
+                  for start in range(0, len(indexes[path][0]),
+                                     self._SHARD_RECORDS)]
+        pipe = ShardPipeline(shards, self._read_shard, workers=workers,
+                             label_fn=lambda s: s[0])
+        try:
+            yield from pipe.samples()
+        finally:
+            pipe.close()
 
-        yield from parallel_map_ordered(
-            lambda p: self._parse_fn(tfr.decode_example(p)),
-            payloads(), self._num_workers)
+    def _host_files(self, files: List[str]) -> List[str]:
+        """Disjoint per-host file assignment for multi-process fits —
+        each host streams only its stride of the (seed, epoch)-shuffled
+        list, over the mesh's data axis."""
+        import jax
+        if jax.process_count() <= 1:
+            return files
+        from analytics_zoo_tpu.data.pipeline import host_shard
+        return host_shard(files)
 
     def iter_train(self, data_parallel: int, seed: int = 0):
         import jax
         batch = self.global_batch(data_parallel)
+        n_proc = jax.process_count()
+        if n_proc > 1:
+            # the GLOBAL batch splits across hosts; each host stacks its
+            # LOCAL share from its own disjoint file stride
+            if batch % n_proc:
+                raise ValueError(
+                    f"global batch_size ({batch}) must divide by the "
+                    f"process count ({n_proc}) to stream per-host "
+                    "TFRecord shards")
+            batch //= n_proc
         rng = np.random.RandomState(seed)
 
         def stack(samples):
@@ -266,25 +420,66 @@ class _TFRecordDataset(TPUDataset):
                 else jax.tree_util.tree_map(lambda *a: np.stack(a), *ys)
             return xb, yb, batch
 
-        buf: List[Tuple] = []
-        pending: List[Tuple] = []
-        for sample in self._iter_samples(rng):
-            if self.shuffle:
-                buf.append(sample)
-                if len(buf) < self._shuffle_buffer:
-                    continue
-                i = rng.randint(len(buf))
-                buf[i], sample = buf[-1], buf[i]
-                buf.pop()
-            pending.append(sample)
-            if len(pending) == batch:
-                yield stack(pending)
-                pending = []
-        # drain the shuffle window; drop the tail remainder (static shapes)
-        if self.shuffle and buf:
-            rng.shuffle(buf)
-            for sample in buf:
+        files = list(self._files)
+        if self.shuffle:
+            rng.shuffle(files)
+        files = self._host_files(files)
+        max_batches = None
+        if n_proc > 1:
+            # equalize STEPS across hosts: per-host file strides rarely
+            # hold identical record counts, and an uneven epoch would
+            # desync the per-step collectives and deadlock mid-epoch —
+            # the exact failure the in-memory path guards with its own
+            # allgather (trainer.fit_keras). Counts come from the
+            # memoized header index, so only the FIRST epoch pays the
+            # scan (a fuse-mounted corpus must not re-walk every shard
+            # per epoch).
+            from jax.experimental import multihost_utils
+            from analytics_zoo_tpu.data.pipeline import parallel_read
+            local_n = sum(parallel_read(files, self._file_count,
+                                        workers=self._workers()))
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.asarray(local_n, np.int64)))
+            max_batches = int(counts.min()) // batch
+            if max_batches == 0:
+                raise ValueError(
+                    "Multi-host TFRecord fit: the smallest host shard "
+                    f"holds {int(counts.min())} records, fewer than "
+                    f"the per-host batch ({batch}); add shard files "
+                    "or lower batch_size")
+
+        def batches():
+            buf: List[Tuple] = []
+            pending: List[Tuple] = []
+            for sample in self._iter_samples(rng, files=files):
+                if self.shuffle:
+                    buf.append(sample)
+                    if len(buf) < self._shuffle_buffer:
+                        continue
+                    i = rng.randint(len(buf))
+                    buf[i], sample = buf[-1], buf[i]
+                    buf.pop()
                 pending.append(sample)
                 if len(pending) == batch:
                     yield stack(pending)
                     pending = []
+            # drain the shuffle window; drop the tail remainder (static
+            # shapes)
+            if self.shuffle and buf:
+                rng.shuffle(buf)
+                for sample in buf:
+                    pending.append(sample)
+                    if len(pending) == batch:
+                        yield stack(pending)
+                        pending = []
+
+        if max_batches is None:
+            yield from batches()
+            return
+        import itertools
+        it = batches()
+        try:
+            # every host emits EXACTLY min-host batches per epoch
+            yield from itertools.islice(it, max_batches)
+        finally:
+            it.close()       # unwinds the shard pipeline's pool

@@ -151,11 +151,19 @@ class FeatureSet:
 
     def iter_batches(self, batch_size: int, shuffle: bool = True,
                      seed: int = 0, drop_remainder: bool = True,
-                     native: Optional[bool] = None):
+                     native: Optional[bool] = None,
+                     pipeline_workers: Optional[int] = None):
         """`native=None` auto-selects: spilled tiers go through the C++
         threaded loader (batch assembly off the GIL, overlapping the TPU
         step); DRAM stays on the numpy fast path. shuffle=False keeps the
-        sequential-order contract (single-worker native delivery)."""
+        sequential-order contract (single-worker native delivery).
+        `pipeline_workers` (default `ZooConfig.pipeline_workers` / env
+        ZOO_PIPELINE_WORKERS) assembles the python-path batches on the
+        shared input-pipeline worker pool instead: the per-epoch index
+        permutation is fixed up front by `seed`, each index-batch
+        gathers on a worker, and the reorder buffer emits batches in
+        permutation order — identical batches at any worker count,
+        bounded to `workers + 1` resident gathers."""
         import jax
         if native is None:
             native = self._split < self._n
@@ -171,10 +179,22 @@ class FeatureSet:
             np.random.RandomState(seed).shuffle(idx)
         nb = self._n // batch_size if drop_remainder \
             else -(-self._n // batch_size)
-        for b in range(nb):
-            sel = idx[b * batch_size:(b + 1) * batch_size]
-            if len(sel) < batch_size and drop_remainder:
-                break
+        sels = [idx[b * batch_size:(b + 1) * batch_size] for b in range(nb)]
+        sels = [s for s in sels
+                if len(s) == batch_size or not drop_remainder]
+        from analytics_zoo_tpu.data.pipeline import (ShardPipeline,
+                                                     resolve_workers)
+        workers = resolve_workers(pipeline_workers)
+        if workers > 1 and len(sels) > 1:
+            pipe = ShardPipeline(sels, lambda sel: [self.take(sel)],
+                                 workers=workers,
+                                 label_fn=lambda s: "featureset batch")
+            try:
+                yield from pipe.samples()
+            finally:
+                pipe.close()
+            return
+        for sel in sels:
             yield self.take(sel)
 
     def to_dataset(self, batch_size: int = -1, batch_per_thread: int = -1):

@@ -104,14 +104,20 @@ class ParquetDataset:
         return out
 
     @staticmethod
-    def read_as_xshards(path: str) -> XShards:
-        """One shard per parquet block (`_read_as_xshards`)."""
+    def read_as_xshards(path: str,
+                        pipeline_workers: Optional[int] = None) -> XShards:
+        """One shard per parquet block (`_read_as_xshards`). Blocks
+        read+decode concurrently on the input-pipeline worker pool
+        (shard order preserved; a bad part file raises one error
+        naming it)."""
         import pyarrow.parquet as pq
+        from analytics_zoo_tpu.data.pipeline import parallel_read
         parts = sorted(
             os.path.join(path, f) for f in os.listdir(path)
             if f.endswith(".parquet"))
-        shards = [ParquetDataset._decode_table(pq.read_table(p))
-                  for p in parts]
+        shards = parallel_read(
+            parts, lambda p: ParquetDataset._decode_table(pq.read_table(p)),
+            workers=pipeline_workers)
         return XShards(shards)
 
     @staticmethod

@@ -5,7 +5,12 @@
 `OrcaContext.pandas_read_backend` flag); `read_parquet` covers the parquet
 image-dataset reader (`orca/data/image/parquet_dataset.py`). Each file (or
 row-group) becomes one shard so preprocessing parallelizes like the
-reference's per-partition reads.
+reference's per-partition reads — and the reads themselves run on the
+shared input-pipeline worker pool (`data/pipeline.py`, ISSUE 15): a
+64-file directory is 64 concurrent `pd.read_csv` calls instead of 64
+sequential ones, results in deterministic file order, and a per-file
+failure surfaces as ONE error naming the file. `pipeline_workers`
+defaults to `ZooConfig.pipeline_workers` (env ZOO_PIPELINE_WORKERS).
 """
 
 from __future__ import annotations
@@ -31,13 +36,22 @@ def _expand(file_path: str, extensions: Sequence[str]) -> List[str]:
     return files
 
 
+def _read_shards(files: List[str], read_one: Callable[[str], Any],
+                 pipeline_workers: Optional[int],
+                 label_fn: Callable[[Any], str] = str) -> List[Any]:
+    from analytics_zoo_tpu.data.pipeline import parallel_read
+    return parallel_read(files, read_one, workers=pipeline_workers,
+                         label_fn=label_fn)
+
+
 def read_csv(file_path: str, num_shards: Optional[int] = None,
-             **kwargs) -> XShards:
+             pipeline_workers: Optional[int] = None, **kwargs) -> XShards:
     """Read csv file/dir/glob into XShards of pandas DataFrames
-    (`zoo.orca.data.pandas.read_csv`)."""
+    (`zoo.orca.data.pandas.read_csv`), one concurrent read per file."""
     import pandas as pd
     files = _expand(file_path, ("csv",))
-    shards = [pd.read_csv(f, **kwargs) for f in files]
+    shards = _read_shards(files, lambda f: pd.read_csv(f, **kwargs),
+                          pipeline_workers)
     out = XShards(shards)
     if num_shards and num_shards != out.num_partitions():
         out = out.repartition(num_shards)
@@ -45,10 +59,11 @@ def read_csv(file_path: str, num_shards: Optional[int] = None,
 
 
 def read_json(file_path: str, num_shards: Optional[int] = None,
-              **kwargs) -> XShards:
+              pipeline_workers: Optional[int] = None, **kwargs) -> XShards:
     import pandas as pd
     files = _expand(file_path, ("json", "jsonl"))
-    shards = [pd.read_json(f, **kwargs) for f in files]
+    shards = _read_shards(files, lambda f: pd.read_json(f, **kwargs),
+                          pipeline_workers)
     out = XShards(shards)
     if num_shards and num_shards != out.num_partitions():
         out = out.repartition(num_shards)
@@ -56,17 +71,39 @@ def read_json(file_path: str, num_shards: Optional[int] = None,
 
 
 def read_parquet(file_path: str, columns: Optional[Sequence[str]] = None,
-                 num_shards: Optional[int] = None) -> XShards:
+                 num_shards: Optional[int] = None,
+                 pipeline_workers: Optional[int] = None) -> XShards:
     """Parquet → XShards, one shard per row-group/file
-    (`orca/data/image/parquet_dataset.py` read side)."""
-    import pandas as pd
+    (`orca/data/image/parquet_dataset.py` read side). Row-group
+    metadata is listed sequentially (cheap footer reads), then the
+    row-group DECODE — the expensive part — fans out over the worker
+    pool with the (file, row-group) order preserved."""
+    import threading
+
     import pyarrow.parquet as pq
     files = _expand(file_path, ("parquet", "pq"))
-    shards = []
+    units: List[tuple] = []
     for f in files:
         pf = pq.ParquetFile(f)
-        for rg in range(pf.num_row_groups):
-            shards.append(pf.read_row_group(rg, columns=columns).to_pandas())
+        units.extend((f, rg) for rg in range(pf.num_row_groups))
+
+    # one footer parse per (file, thread), not per row-group: a
+    # 1000-row-group file must not pay 1000 redundant metadata reads
+    # (ParquetFile handles are not thread-safe, hence per-thread)
+    tls = threading.local()
+
+    def read_unit(unit):
+        f, rg = unit
+        cache = getattr(tls, "files", None)
+        if cache is None:
+            cache = tls.files = {}
+        pf = cache.get(f)
+        if pf is None:
+            pf = cache[f] = pq.ParquetFile(f)
+        return pf.read_row_group(rg, columns=columns).to_pandas()
+
+    shards = _read_shards(units, read_unit, pipeline_workers,
+                          label_fn=lambda u: f"{u[0]} row-group {u[1]}")
     out = XShards(shards)
     if num_shards and num_shards != out.num_partitions():
         out = out.repartition(num_shards)

@@ -218,6 +218,12 @@ class Estimator:
             batch_iter_factory = (
                 (lambda epoch: ds.iter_train(dp, seed=seed + epoch))
                 if lazy else None)
+            if batch_iter_factory is not None:
+                # datasets that read DISJOINT files per host (TFRecord
+                # via pipeline.host_shard) declare it so fit_keras's
+                # multi-process streaming-duplication guard admits them
+                batch_iter_factory.shards_per_host = getattr(
+                    ds, "shards_per_host", False)
         if lazy and self.model.params is None \
                 and hasattr(ds, "first_sample"):
             # cheap shape probe: one record, not a shuffle-buffer fill

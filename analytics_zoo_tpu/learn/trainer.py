@@ -74,6 +74,17 @@ class _TrainingMetrics:
             "device-mesh axis extents of the sharded fit, labeled by "
             "axis (a tensor extent > 1 means column/row-parallel "
             "placement is live)")
+        self.input_wait_ms = reg.histogram(
+            "training_input_wait_ms",
+            "per-step wall time the training loop sat blocked on the "
+            "input-pipeline prefetch queue before dispatching (device "
+            "idle, host decoding — the input-stall histogram)")
+        self.input_bound = reg.gauge(
+            "training_input_bound",
+            "fraction of the last epoch's wall time the step loop "
+            "spent blocked on the prefetch queue (0 = device-bound, "
+            "1 = fully input-bound; the measured verdict on whether "
+            "a fit needs more pipeline_workers)")
         self.fused_update_ms = reg.histogram(
             "training_fused_update_ms",
             "measured wall time of one fused-kernel optimizer sweep "
@@ -460,17 +471,27 @@ class _Prefetcher:
     """Background-thread batch prefetch: prepares + device_puts the next
     item while the device runs the current one. Depth-bounded so host
     memory stays flat. The TPU analogue of the reference FeatureSet's
-    prefetching cached tier."""
+    prefetching cached tier.
+
+    Stall accounting (ISSUE 15): every consumer `__next__` times how
+    long it sat blocked on the queue — that wait IS the device's input
+    stall (the step can't dispatch until the batch exists). `wait_s`
+    accumulates the epoch total; `on_wait(seconds)` fires per get for
+    the per-step histogram. An always-full queue reads ~0: the host
+    pipeline is keeping up."""
 
     _END = object()
 
-    def __init__(self, source_iter, transfer, depth: int = 2):
+    def __init__(self, source_iter, transfer, depth: int = 2,
+                 on_wait=None):
         import queue
         import threading
-        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._err = None
         self._stop = False
         self._queue_mod = queue
+        self._on_wait = on_wait
+        self.wait_s = 0.0
 
         def worker():
             try:
@@ -503,7 +524,15 @@ class _Prefetcher:
         return self
 
     def __next__(self):
+        t0 = time.perf_counter()
         item = self._q.get()
+        waited = time.perf_counter() - t0
+        self.wait_s += waited
+        if self._on_wait is not None:
+            try:
+                self._on_wait(waited)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         if item is self._END:
             if self._err is not None:
                 raise self._err
@@ -971,6 +1000,7 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
               batch_iter_factory: Optional[Callable] = None,
               steps_per_run: int = 1, mixed_precision: bool = False,
               prefetch: bool = True,
+              prefetch_depth: Optional[int] = None,
               lazy_embeddings: bool = False,
               device_cache: Optional[bool] = None,
               flat_optimizer: bool = False,
@@ -993,7 +1023,15 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
     The loop is fully asynchronous: batches are device_put by a prefetch
     thread while the device computes, the per-step loss stays on device,
     and the ONLY host sync is one `_materialize` per epoch (plus any
-    loss-reading trigger the caller installs). `steps_per_run=k` fuses k
+    loss-reading trigger the caller installs). `prefetch_depth` (config
+    `ZooConfig.prefetch_depth` / env ZOO_PREFETCH_DEPTH, default 2)
+    bounds the transferred-batch backlog; the time the step loop spends
+    BLOCKED on that queue is measured per step into
+    `training_input_wait_ms` and per epoch into the
+    `training_input_bound` gauge (+ the roofline snapshot's input-stall
+    column) — the device-wait vs host-wait accounting that says whether
+    a file-backed fit needs more `pipeline_workers`
+    (docs/ProgrammingGuide/distributed-training.md "Input pipeline"). `steps_per_run=k` fuses k
     steps into one `lax.scan` program — one dispatch per k steps —
     trading trigger granularity (checked every k iterations) for dispatch
     overhead. `mixed_precision` runs fwd/bwd in bf16 with f32 masters.
@@ -1119,6 +1157,13 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                        fsdp=mesh.size("fsdp") if mesh else 1)
     if steps_per_run < 1:
         raise ValueError(f"steps_per_run must be >=1, got {steps_per_run}")
+    # prefetch-queue depth: explicit kwarg > config (ZOO_PREFETCH_DEPTH)
+    # > 2. Bounds the host batch backlog — the input side never holds
+    # more than `depth` transferred batches + one decoded shard per
+    # pipeline worker.
+    depth = int(prefetch_depth) if prefetch_depth else \
+        int(getattr(getattr(ctx, "config", None), "prefetch_depth", 0)
+            or 2)
 
     # Multi-process: `batch_size` stays GLOBAL (the reference's total-core
     # contract); each process feeds its LOCAL data shard, sliced at
@@ -1148,15 +1193,19 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                 "params span non-addressable devices under "
                 "multi-process, which the checkpoint gather/restore "
                 "paths do not handle yet")
-        if batch_iter_factory is not None:
-            # lazy/streaming datasets batch at the GLOBAL size per process
-            # and (worse) every process would stream the same records —
-            # silent sample duplication; shard files per host instead
+        if batch_iter_factory is not None and not getattr(
+                batch_iter_factory, "shards_per_host", False):
+            # a streaming factory that does NOT declare per-host shard
+            # assignment would feed every process the same records —
+            # silent sample duplication. TFRecord datasets declare it
+            # (`_TFRecordDataset.shards_per_host`: disjoint files per
+            # host over the mesh's data axis, `pipeline.host_shard`).
             raise NotImplementedError(
-                "Multi-process fit over streaming datasets "
-                "(TFRecord/FeatureSet) is not supported yet: every "
-                "process would feed the same records. Materialize a "
-                "per-host shard and pass arrays instead")
+                "Multi-process fit over streaming datasets needs "
+                "per-host shard assignment: every process would feed "
+                "the same records. Use TPUDataset.from_tfrecord (which "
+                "shards files per host) or materialize a per-host "
+                "shard and pass arrays instead")
         local_batch = batch_size // n_proc
 
     if batch_iter_factory is None:
@@ -1675,8 +1724,10 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
                             else None,
                             real, 1)
                 source = batch_iter_factory(epoch)
-            batches = _Prefetcher(source, transfer) if prefetch \
-                else map(transfer, source)
+            batches = _Prefetcher(
+                source, transfer, depth=depth,
+                on_wait=lambda w: telemetry.input_wait_ms.observe(
+                    w * 1e3)) if prefetch else map(transfer, source)
 
             for xb, yb, real, k in batches:
                 if multi:
@@ -1727,6 +1778,22 @@ def fit_keras(model, x, y=None, batch_size: int = 32, epochs: int = 1,
           throughput = n_seen / max(dt, 1e-9)
           step_ms = telemetry.epoch(iteration - it0, n_seen, dt, mean_loss,
                                     flops_per_step=flops_per_step)
+          # device-wait vs host-wait verdict (ISSUE 15): the prefetch
+          # queue's measured blocked time over the epoch wall time is
+          # the fraction of the fit that was input-bound — a measured
+          # answer, not a guess. Also lands in the roofline snapshot's
+          # input-stall column.
+          input_wait_s = batches.wait_s \
+              if isinstance(batches, _Prefetcher) else 0.0
+          telemetry.input_bound.set(
+              min(1.0, input_wait_s / max(dt, 1e-9)))
+          if input_wait_s > 0:
+              try:
+                  from analytics_zoo_tpu.observability.roofline import \
+                      get_accountant
+                  get_accountant().account_stall("train", input_wait_s)
+              except Exception as ie:  # noqa: BLE001 — telemetry only
+                  log.debug("input-stall accounting failed: %s", ie)
           if cost_tracker is not None and cost_tracker.calls:
               # dt is device wall time (the _materialize above synced),
               # so achieved = XLA-counted work / measured epoch seconds.

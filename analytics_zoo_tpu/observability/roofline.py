@@ -248,7 +248,8 @@ class RooflineAccountant:
             if seconds <= 0.0 or (flops <= 0.0 and bytes_ <= 0.0):
                 return
             with self._lock:
-                acc = self._acc.setdefault(kind, [0.0, 0.0, 0.0, 1])
+                acc = self._acc.setdefault(kind,
+                                           [0.0, 0.0, 0.0, 1, 0.0])
                 acc[0] += flops
                 acc[1] += bytes_
                 acc[2] += seconds
@@ -271,6 +272,26 @@ class RooflineAccountant:
             log.debug("roofline accounting failed: %s: %s",
                       type(e).__name__, e)
 
+    def account_stall(self, kind: str, stall_seconds: float) -> None:
+        """Input-stall accumulation (ISSUE 15): wall seconds the kind's
+        hot loop spent BLOCKED on its input pipeline (the trainer's
+        prefetch-queue wait) inside the busy window `account` measures.
+        Surfaces in `snapshot(kind)` as `input_stall_seconds` and
+        `input_stall_fraction` — the roofline's answer to "is this fit
+        compute-bound or input-bound": an epoch at 40% MFU with a 0.5
+        stall fraction is a HOST problem, not a kernel problem. Never
+        raises."""
+        try:
+            if stall_seconds <= 0.0:
+                return
+            with self._lock:
+                acc = self._acc.setdefault(kind,
+                                           [0.0, 0.0, 0.0, 1, 0.0])
+                acc[4] += stall_seconds
+        except Exception as e:  # noqa: BLE001 — telemetry must not raise
+            log.debug("roofline stall accounting failed: %s: %s",
+                      type(e).__name__, e)
+
     def reset(self, kind: Optional[str] = None) -> None:
         """Zero the rate accumulators (counters keep accumulating): a
         reloaded serving model / a fresh fit starts its gauges clean."""
@@ -286,12 +307,17 @@ class RooflineAccountant:
         mfu/hbm_utilization divide by that many chips' roofline, like
         the live gauges."""
         with self._lock:
-            f, b, s, n = self._acc.get(kind, (0.0, 0.0, 0.0, 1))
+            f, b, s, n, stall = self._acc.get(
+                kind, (0.0, 0.0, 0.0, 1, 0.0))
         out: Dict[str, Any] = {"flops": f, "bytes": b, "seconds": s,
-                               "devices": n}
+                               "devices": n,
+                               "input_stall_seconds": stall}
         if s > 0:
             out["achieved_tflops"] = f / s / 1e12
             out["achieved_hbm_gbps"] = b / s / 1e9
+            # the input-stall column (ISSUE 15): what share of the busy
+            # window the loop sat blocked on host input
+            out["input_stall_fraction"] = min(1.0, stall / s)
             try:
                 hbm_roof, flops_roof = session_roofline()
                 out["mfu"] = f / s / (flops_roof * n)

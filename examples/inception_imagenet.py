@@ -6,10 +6,12 @@ from TFRecord shards — the reference's headline training workload
 
 Composes the full input path at real-image scale: JPEG-encoded TFRecord
 shards → streaming reader (C++ scanner) → THREADED decode + augmentation
-(`parallel_map_ordered` through `from_tfrecord(num_workers=...)`; JPEG
-decode and cv2 ops release the GIL) → shuffle window → static-shape
-batches → `Estimator.fit` with the prefetch pipeline overlapping
-host→device transfer.
+(the parallel shard pipeline, `data/pipeline.py`, through
+`from_tfrecord(num_workers=...)`: bounded record-range shards decode on
+the pool behind a deterministic reorder buffer; JPEG decode and cv2 ops
+release the GIL) → shuffle window → static-shape batches →
+`Estimator.fit` with the prefetch pipeline overlapping host→device
+transfer.
 
 Logs the pipeline-vs-chip budget: mean producer time per batch (measured
 inside the iterator the prefetch thread drains) against the mean

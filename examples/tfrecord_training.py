@@ -7,10 +7,16 @@ Generates an ImageNet-style synthetic corpus ("image/encoded" raw bytes +
 "image/class/label") across shard files, then streams it through
 `TPUDataset.from_tfrecord` into `Estimator.fit` — no materialization of
 the whole corpus, shuffle-buffer streaming, static batch shapes.
+`--pipeline-workers N` decodes shard files on N threads (the parallel
+input pipeline, `data/pipeline.py` — same batches at any N, just
+faster); `--prefetch-depth` sizes the trainer's batch prefetch queue.
+After the fit it prints the measured input-bound fraction
+(`training_input_bound`).
 
-    python examples/tfrecord_training.py
+    python examples/tfrecord_training.py --pipeline-workers 4
 """
 
+import argparse
 import os
 import tempfile
 
@@ -51,12 +57,22 @@ def parse_fn(ex):
 
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pipeline-workers", type=int, default=None,
+                    help="threads decoding shard files concurrently "
+                         "(default: ZOO_PIPELINE_WORKERS / config, "
+                         "else 1; any value yields the same batches)")
+    ap.add_argument("--prefetch-depth", type=int, default=None,
+                    help="trainer prefetch-queue depth (default: "
+                         "ZOO_PREFETCH_DEPTH / config, else 2)")
+    args = ap.parse_args()
     init_orca_context(cluster_mode="local")
     with tempfile.TemporaryDirectory() as d:
         write_corpus(d)
         ds = TPUDataset.from_tfrecord(
             os.path.join(d, "train-*"), parse_fn,
-            batch_size=32, shuffle_buffer=128)
+            batch_size=32, shuffle_buffer=128,
+            pipeline_workers=args.pipeline_workers)
         print(f"corpus: {ds.n_samples()} records in 4 shards")
 
         model = Sequential([
@@ -69,9 +85,16 @@ def main():
         ])
         est = Estimator.from_keras(
             model, optimizer="adam", loss="sparse_categorical_crossentropy")
-        hist = est.fit(ds, epochs=6)
+        fit_kw = {}
+        if args.prefetch_depth is not None:
+            fit_kw["prefetch_depth"] = args.prefetch_depth
+        hist = est.fit(ds, epochs=6, **fit_kw)
         print("loss:", [round(v, 3) for v in hist["loss"]])
         assert hist["loss"][-1] < hist["loss"][0]
+        from analytics_zoo_tpu.observability import get_registry
+        print("input_bound: %.3f  input_wait p50: %.2f ms" % (
+            get_registry().get("training_input_bound").value(),
+            get_registry().get("training_input_wait_ms").percentile(0.5)))
         print("TFRecord streaming training OK")
 
 

@@ -5,7 +5,10 @@ via tests/test_fault_tolerance.py).
 A fault-tolerant serving engine must never block forever: a wedged
 queue peer or a dead socket has to surface as a timeout some layer can
 act on (backoff, quarantine, drain). This lint enforces that statically
-over `analytics_zoo_tpu/serving/`:
+over `analytics_zoo_tpu/serving/` (and the training input pipeline,
+`analytics_zoo_tpu/data/pipeline.py` — its worker pool and reorder
+buffer pace training the way the serving stages pace inference, ISSUE
+15: an untimed queue/condition wait there is a hung fit):
 
 - `Queue.get()` with no arguments (an indefinite block) is banned —
   use `get(timeout=...)` in a loop, or `get_nowait()`. A no-argument
@@ -47,6 +50,11 @@ from typing import List, Tuple
 
 SERVING_PKG = os.path.join("analytics_zoo_tpu", "serving")
 WHOLE_PKG = "analytics_zoo_tpu"
+# modules OUTSIDE serving/ that get the full blocking-call rule set:
+# the parallel input pipeline's pool/reorder machinery (ISSUE 15)
+EXTRA_STRICT_FILES = (
+    os.path.join("analytics_zoo_tpu", "data", "pipeline.py"),
+)
 
 ALLOW_RE = re.compile(r"#\s*blocking-ok:\s*\S")
 # modules whose loops steer the fleet: no time.sleep, only stop-event
@@ -170,7 +178,10 @@ def check(repo_root: str = ".") -> Tuple[List[str], int]:
     for path in iter_py(pkg_root):
         in_serving = os.path.abspath(path).startswith(
             os.path.abspath(serving_root) + os.sep)
-        errors.extend(check_file(path, serving=in_serving))
+        strict = in_serving or any(
+            path.replace(os.sep, "/").endswith(f.replace(os.sep, "/"))
+            for f in EXTRA_STRICT_FILES)
+        errors.extend(check_file(path, serving=strict))
         n += 1
     return errors, n
 

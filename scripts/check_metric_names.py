@@ -125,6 +125,12 @@ REQUIRED = {
     "serving_rollout_state": "gauge",
     "serving_rollout_transitions_total": "counter",
     "serving_rollout_rollbacks_total": "counter",
+    # parallel input pipeline (ISSUE 15): the device-wait vs host-wait
+    # accounting the input-pipeline bench A/B and the distributed-
+    # training guide's "am I input-bound" runbook read — renaming
+    # either silently blinds the input-stall verdict
+    "training_input_wait_ms": "histogram",
+    "training_input_bound": "gauge",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",
