@@ -58,6 +58,18 @@ class Broker:
     def xadd(self, stream: str, record: Dict) -> str:
         raise NotImplementedError
 
+    def xadd_many(self, entries: List[Tuple[str, Dict]]) -> List[str]:
+        """Batched enqueue — the ingest analogue of the sink's fused
+        `writeback`: append a whole burst of (stream, record) pairs in
+        ONE broker interaction (a pipelined multi-XADD on Redis, one
+        lock acquisition on MemoryBroker, one RPC on TCPBroker) and
+        return the record ids in order. Entries may target DIFFERENT
+        streams — a hash-partitioned burst fans out across partition
+        streams inside the same round trip, so the frontend→broker hop
+        costs one RTT per coalesced flush instead of one per record.
+        Default loops `xadd` for brokers without a cheaper path."""
+        return [self.xadd(stream, record) for stream, record in entries]
+
     def read_group(self, stream: str, group: str, consumer: str,
                    count: int, block_ms: int = 100
                    ) -> List[Tuple[str, Dict]]:
@@ -128,6 +140,15 @@ class Broker:
     def hget(self, key: str, field: str) -> Optional[str]:
         raise NotImplementedError
 
+    def hmget(self, key: str, fields: List[str]) -> List[Optional[str]]:
+        """Batched field read (HMGET): one round trip answers a whole
+        poll's worth of result lookups — the client's fused
+        enqueue+poll path reads every outstanding uri per sweep with
+        one command instead of one HGET each. Missing fields come back
+        as None, position-matched to `fields`. Default loops `hget`
+        for brokers without a cheaper path."""
+        return [self.hget(key, field) for field in fields]
+
     def hgetall(self, key: str) -> Dict[str, str]:
         raise NotImplementedError
 
@@ -168,6 +189,19 @@ class MemoryBroker(Broker):
             self._streams.setdefault(stream, OrderedDict())[rid] = record
             self._lock.notify_all()
             return rid
+
+    def xadd_many(self, entries):
+        with self._lock:  # one lock acquisition for the whole burst
+            rids = []
+            for stream, record in entries:
+                self._seq += 1
+                rid = f"{int(time.time() * 1000)}-{self._seq}"
+                self._streams.setdefault(stream, OrderedDict())[rid] = \
+                    record
+                rids.append(rid)
+            if rids:
+                self._lock.notify_all()
+            return rids
 
     def read_group(self, stream, group, consumer, count, block_ms=100):
         deadline = time.time() + block_ms / 1000.0
@@ -260,6 +294,11 @@ class MemoryBroker(Broker):
         with self._lock:
             return self._hashes.get(key, {}).get(field)
 
+    def hmget(self, key, fields):
+        with self._lock:
+            h = self._hashes.get(key, {})
+            return [h.get(field) for field in fields]
+
     def hgetall(self, key):
         with self._lock:
             return dict(self._hashes.get(key, {}))
@@ -283,6 +322,10 @@ class MemoryBroker(Broker):
 # TCP transport: newline-delimited JSON RPC onto a shared MemoryBroker
 # ---------------------------------------------------------------------------
 class _Handler(socketserver.StreamRequestHandler):
+    # see _RESPHandler in redis_server.py: Nagle + delayed ACK stalls
+    # small back-to-back reply writes ~40 ms each on pipelined batches
+    disable_nagle_algorithm = True
+
     def handle(self):
         while True:
             line = self.rfile.readline()
@@ -332,6 +375,8 @@ class TCPBroker(Broker):
     def _conn(self):
         if getattr(self._local, "sock", None) is None:
             sock = socket.create_connection((self.host, self.port), timeout=30)
+            # the client half of the Nagle/delayed-ACK fix (see _Handler)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._local.sock = sock
             self._local.rfile = sock.makefile("rb")
         return self._local.sock, self._local.rfile
@@ -361,6 +406,11 @@ class TCPBroker(Broker):
 
     def xadd(self, stream, record):
         return self._call("xadd", stream, record)
+
+    def xadd_many(self, entries):
+        # one RPC round trip for the whole burst
+        return self._call("xadd_many",
+                          [[stream, record] for stream, record in entries])
 
     def read_group(self, stream, group, consumer, count, block_ms=100):
         return self._call("read_group", stream, group, consumer, count,
@@ -392,6 +442,9 @@ class TCPBroker(Broker):
 
     def hget(self, key, field):
         return self._call("hget", key, field)
+
+    def hmget(self, key, fields):
+        return self._call("hmget", key, list(fields))
 
     def hgetall(self, key):
         return self._call("hgetall", key)
@@ -428,6 +481,10 @@ class _RESPClient:
     def _connect(self):
         self._sock = socket.create_connection(
             (self._host, self._port), timeout=self._timeout_s)
+        # a pipelined request body can span segments; Nagle would hold
+        # the tail waiting on the server's delayed ACK (~40 ms) — the
+        # server side sets disable_nagle_algorithm for its replies
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = self._sock.makefile("rb")
 
     def _close_locked(self):
@@ -574,6 +631,19 @@ class RedisBroker(Broker):
         return self._r.command("XADD", stream, "*", "json",
                                json.dumps(record))
 
+    def xadd_many(self, entries):
+        # ONE pipelined round trip appends the whole burst — the ingest
+        # analogue of the sink's fused writeback. Entries may span
+        # partition streams; Redis executes the XADDs in order, so the
+        # returned ids are position-matched to the input
+        entries = list(entries)
+        if not entries:
+            return []
+        replies = self._r.pipeline(
+            *(("XADD", stream, "*", "json", json.dumps(record))
+              for stream, record in entries))
+        return list(replies)
+
     def _ensure_group(self, stream, group):
         if (stream, group) in self._groups_made:
             return
@@ -673,6 +743,13 @@ class RedisBroker(Broker):
 
     def hget(self, key, field):
         return self._r.command("HGET", key, field)
+
+    def hmget(self, key, fields):
+        fields = list(fields)
+        if not fields:
+            return []
+        return list(self._r.command("HMGET", key, *fields) or
+                    [None] * len(fields))
 
     def hgetall(self, key):
         flat = self._r.command("HGETALL", key) or []

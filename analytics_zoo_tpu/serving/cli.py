@@ -42,7 +42,20 @@ def cmd_start(args) -> int:
         # its own identity at launch ("auto" generates one)
         cfg.engine_id = args.engine_id
         cfg._validate_fleet()
+    if getattr(args, "partitions", None) is not None:
+        cfg.partitions = args.partitions
+    if getattr(args, "reshard", False):
+        cfg.reshard = True
+    cfg._validate_partitions()
     engine_id = cfg.resolve_engine_id()
+    if cfg.partitions > 1 and engine_id is None:
+        # same discipline as rollout below: a partitioned engine with
+        # no fleet identity cannot lease partitions — fail before the
+        # consumer group sees this process
+        raise SystemExit(
+            "params.partitions > 1 needs a fleet identity: pass "
+            "--engine-id (or set params.engine_id) — the partition "
+            "lease table keys ownership on it")
     if cfg.rollout_model_dir and engine_id is None:
         # fail BEFORE the engine joins the consumer group: dying on a
         # config error after reading records would strand them in the
@@ -74,7 +87,11 @@ def cmd_start(args) -> int:
             engine_ttl_s=cfg.engine_ttl_s,
             # tiered admission (ISSUE 11): cheap early 429s per tier
             admission=cfg.build_admission(broker),
-            admission_header=cfg.admission_header).start()
+            admission_header=cfg.admission_header,
+            # partitioned request plane (ISSUE 16): /predict enqueues
+            # hash-route across the same partition streams the engines
+            # lease
+            partitions=cfg.partitions).start()
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     model = cfg.build_model(broker=broker)
@@ -145,7 +162,16 @@ def cmd_start(args) -> int:
                              batch_margin_ms=cfg.batch_margin_ms,
                              admission_tiers=cfg.admission_tiers,
                              admission_field=cfg.admission_field,
-                             shed_backlog=cfg.shed_backlog).start()
+                             shed_backlog=cfg.shed_backlog,
+                             partitions=cfg.partitions,
+                             reshard=cfg.reshard,
+                             partition_lease_ttl_s=cfg
+                             .partition_lease_ttl_s).start()
+    if cfg.partitions > 1:
+        print(f"partitioned request plane: {cfg.partitions} partition "
+              f"streams, lease ttl {cfg.partition_lease_ttl_s:g}s "
+              f"(owned set rebalances as engines join/leave)",
+              flush=True)
     if cfg.batch_policy != "fixed":
         print(f"batching: policy={cfg.batch_policy}"
               + (f" deadline={cfg.deadline_ms:g}ms"
@@ -242,6 +268,16 @@ def cmd_gateway(args) -> int:
         # TTL flaps every beating engine dead — fail at launch
         raise SystemExit(
             f"--engine-ttl {args.engine_ttl:g} must be > 0")
+    if args.leader_ttl <= 0:
+        raise SystemExit(
+            f"--leader-ttl {args.leader_ttl:g} must be > 0")
+    if args.partitions is not None:
+        from analytics_zoo_tpu.serving.partitions import \
+            validate_partitions
+        try:
+            validate_partitions(args.partitions)
+        except ValueError as e:
+            raise SystemExit(f"--partitions: {e}")
     engine_cfg = ServingConfig.load(args.engine_config) \
         if args.engine_config else None
     admission = None
@@ -267,16 +303,30 @@ def cmd_gateway(args) -> int:
             max_backlog=engine_cfg.admission_max_backlog)
     if engine_cfg is not None:
         admission_header = engine_cfg.admission_header
+    partitions = args.partitions if args.partitions is not None else (
+        engine_cfg.partitions if engine_cfg else 1)
+    gateway_id = args.gateway_id
+    if gateway_id and gateway_id.lower() == "auto":
+        import os as _os
+        import uuid as _uuid
+        gateway_id = f"gateway-{_os.getpid()}-{_uuid.uuid4().hex[:6]}"
     frontend = FrontEnd(
         broker, None, host=args.host,
         port=args.port, fleet_stream=args.stream,
         engine_ttl_s=args.engine_ttl,
         tokens_per_second=args.tokens_per_second,
         admission=admission,
-        admission_header=admission_header).start()
+        admission_header=admission_header,
+        partitions=partitions,
+        gateway_id=gateway_id,
+        leader_ttl_s=args.leader_ttl).start()
     print(f"fleet gateway on :{frontend.port} "
           f"(stream {args.stream}, engine ttl {args.engine_ttl:g}s)",
           flush=True)
+    if gateway_id:
+        print(f"gateway replica {gateway_id} (leader lease ttl "
+              f"{args.leader_ttl:g}s; control loops act only while "
+              "this replica leads)", flush=True)
     rollout = None
     # versioned rollout (ISSUE 14): the controller converges the fleet
     # onto the newest PUBLISHED checkpoint version, one engine at a
@@ -302,7 +352,11 @@ def cmd_gateway(args) -> int:
             broker.clone(), args.stream, rollout_dir,
             frontend.fleet,
             poll_interval_s=rollout_interval,
-            engine_timeout_s=rollout_timeout).start()
+            engine_timeout_s=rollout_timeout,
+            # replicated gateway (ISSUE 16): every replica accepts
+            # POST /rollout (the pin persists in the control hash) but
+            # only the leader's loop directs engines
+            leader_fn=frontend.is_leader).start()
         frontend.set_rollout(rollout)
         print(f"rollout controller watching {rollout_dir} "
               f"(poll {rollout_interval:g}s, engine timeout "
@@ -362,6 +416,9 @@ def cmd_gateway(args) -> int:
             # flapping the shared serving_backlog_depth gauge)
             backlog_fn=admission.backlog if admission is not None
             else None,
+            # follower replicas observe but never spawn/retire — two
+            # autoscalers holding min_engines would double-provision
+            leader_fn=frontend.is_leader,
             **knobs).start()
         print(f"autoscaler: engines [{scaler.min_engines}, "
               f"{scaler.max_engines}], backlog "
@@ -447,6 +504,16 @@ def main(argv=None) -> int:
                     help="fleet mode: this engine's identity as one of "
                          "N co-consumers ('auto' generates a unique id; "
                          "enables heartbeats + the claim sweep)")
+    ps.add_argument("--partitions", type=int, default=None,
+                    help="override params.partitions: split the request "
+                         "stream into N hash-keyed partition streams "
+                         "leased across the fleet (needs --engine-id; "
+                         "1 = the legacy single stream)")
+    ps.add_argument("--reshard", action="store_true",
+                    help="acknowledge a partition-count change against "
+                         "a live fleet's broker meta (in-flight records "
+                         "on the old layout may strand until every "
+                         "engine restarts on the new count)")
     ps.set_defaults(fn=cmd_start)
     pg = sub.add_parser("gateway", help="run an engine-less fleet "
                                         "gateway frontend")
@@ -492,6 +559,20 @@ def main(argv=None) -> int:
                          "before it is skipped as a straggler "
                          "(default: engine config "
                          "params.rollout.engine_timeout_s, else 60)")
+    pg.add_argument("--partitions", type=int, default=None,
+                    help="hash-route /predict enqueues across N "
+                         "partition streams — must match the engines' "
+                         "params.partitions (default: engine config, "
+                         "else 1)")
+    pg.add_argument("--gateway-id", default=None,
+                    help="run as one REPLICA of a replicated gateway "
+                         "('auto' generates an id): a leader lease on "
+                         "the broker elects which replica's control "
+                         "loops act; every replica serves reads and "
+                         "accepts POST /rollout")
+    pg.add_argument("--leader-ttl", type=float, default=3.0,
+                    help="seconds without a renewal before the gateway "
+                         "leader lease is up for takeover")
     pg.set_defaults(fn=cmd_gateway)
     pb = sub.add_parser("broker", help="run a standalone TCP broker")
     pb.add_argument("--host", default="0.0.0.0")

@@ -5,38 +5,98 @@ Protocol preserved from the reference: `enqueue` XADDs a b64-encoded ndarray
 sync round-trip (`client.py:199` via the HTTP frontend there; here it polls
 the result hash), `OutputQueue.query/dequeue` read results back
 (`client.py:203`). Results arrive as b64 ndarrays or the literal "NaN" for
-per-record failures (`ClusterServingInference.scala:71-79` degradation)."""
+per-record failures (`ClusterServingInference.scala:71-79` degradation).
+
+Wire-speed ingest (ISSUE 16): with `partitions > 1` every record routes to
+the partition stream its uri hashes to (serving/partitions.py — the same
+map every gateway and engine computes); results still land in the ONE
+``result:<stream>`` hash, so polling is unchanged. The sync paths fuse
+their RESP round trips the way PR 10 fused the sink commit: a
+`predict_batch` burst is ONE pipelined multi-XADD in, ONE `HMGET` per poll
+sweep out (`pipelined=False` keeps the per-record wire pattern as the
+bench A/B baseline). `StreamingSession` holds the pattern open across
+bursts on one persistent connection. Every broker op retries through a
+jittered exponential backoff when the connection drops (a restarted
+broker costs the in-flight request a reconnect, not a failure)."""
 
 from __future__ import annotations
 
 import json
+import logging
 import time
 import uuid
-from typing import Dict, Optional, Union
+from typing import Dict, List, Optional, Union
 
 import numpy as np
 
+from analytics_zoo_tpu.serving.breaker import BackoffPolicy
 from analytics_zoo_tpu.serving.broker import (Broker, connect_broker,
                                               decode_ndarray, encode_ndarray)
+from analytics_zoo_tpu.serving.partitions import (stream_for,
+                                                  validate_partitions)
+
+log = logging.getLogger("analytics_zoo_tpu.serving.client")
 
 STREAM = "serving_stream"          # reference stream name
 RESULT_KEY = "result:serving_stream"
 
 
-class InputQueue:
+class _Reconnecting:
+    """Shared retry harness: run a broker op, and on a dropped
+    connection (broker restart, network blip) back off with jitter and
+    try again instead of failing the caller's in-flight request. The
+    transports reconnect lazily — their next command redials — so the
+    retry IS the reconnect. Jitter matters: a fleet of clients hitting
+    a restarting broker in lockstep is its own outage."""
+
+    def __init__(self, reconnect_attempts: int = 8,
+                 backoff: Optional[BackoffPolicy] = None):
+        self.reconnect_attempts = max(1, int(reconnect_attempts))
+        self.backoff = backoff or BackoffPolicy(initial_s=0.02, max_s=1.0)
+
+    def _call(self, fn, *args, deadline: Optional[float] = None):
+        attempt = 0
+        while True:
+            try:
+                return fn(*args)
+            except (ConnectionError, OSError) as e:
+                attempt += 1
+                if attempt >= self.reconnect_attempts:
+                    raise
+                delay = self.backoff.delay(attempt)
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise
+                    delay = min(delay, remaining)
+                if attempt == 1:
+                    log.warning(
+                        "broker call failed (%s: %s); reconnecting with "
+                        "backoff", type(e).__name__, e)
+                time.sleep(delay)
+
+
+class InputQueue(_Reconnecting):
     def __init__(self, broker: Union[Broker, str, None] = None,
-                 stream: str = STREAM):
+                 stream: str = STREAM, partitions: int = 1,
+                 pipelined: bool = True,
+                 reconnect_attempts: int = 8):
+        """`partitions` must match the serving fleet's count — both
+        sides compute the same uri hash, so a mismatch strands records
+        on streams nobody reads (the engine's lease-table meta guard
+        exists to catch exactly that drift at engine startup).
+        `pipelined=False` restores the per-record XADD + per-uri HGET
+        wire pattern — kept ONLY as the bench_serving ingest A/B
+        baseline."""
+        super().__init__(reconnect_attempts=reconnect_attempts)
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self.stream = stream
+        self.partitions = validate_partitions(partitions)
+        self.pipelined = pipelined
 
-    def enqueue(self, uri: Optional[str] = None, tier: Optional[str] = None,
-                **data) -> str:
-        """`enqueue("uuid", t=ndarray)` or path/bytes via `image=`.
-        `tier` (ISSUE 11) names the record's priority class — the
-        engine's tiered scheduler dispatches higher tiers first and
-        sheds the lowest tier first under overload; records without one
-        rank lowest."""
+    def _record(self, uri: Optional[str], tier: Optional[str],
+                data: Dict) -> tuple:
         uri = uri or uuid.uuid4().hex
         payload: Dict = {}
         for name, value in data.items():
@@ -49,8 +109,38 @@ class InputQueue:
         record = {"uri": uri, "data": payload}
         if tier is not None:
             record["tier"] = str(tier)
-        self.broker.xadd(self.stream, record)
+        return uri, stream_for(self.stream, uri, self.partitions), record
+
+    def enqueue(self, uri: Optional[str] = None, tier: Optional[str] = None,
+                **data) -> str:
+        """`enqueue("uuid", t=ndarray)` or path/bytes via `image=`.
+        `tier` (ISSUE 11) names the record's priority class — the
+        engine's tiered scheduler dispatches higher tiers first and
+        sheds the lowest tier first under overload; records without one
+        rank lowest."""
+        uri, stream, record = self._record(uri, tier, data)
+        self._call(self.broker.xadd, stream, record)
         return uri
+
+    def enqueue_batch(self, samples, tier: Optional[str] = None,
+                      uris: Optional[List[str]] = None) -> List[str]:
+        """Batched ingest: the whole burst goes out as ONE pipelined
+        multi-XADD (entries spanning partition streams), so N records
+        cost one round trip instead of N — the wire-floor win the
+        BENCH r09 A/B measures. Falls back to per-record XADDs when
+        the queue was built `pipelined=False`."""
+        entries, out = [], []
+        for i, s in enumerate(samples):
+            uri, stream, record = self._record(
+                uris[i] if uris else None, tier, {"t": np.asarray(s)})
+            entries.append((stream, record))
+            out.append(uri)
+        if self.pipelined:
+            self._call(self.broker.xadd_many, entries)
+        else:
+            for stream, record in entries:
+                self._call(self.broker.xadd, stream, record)
+        return out
 
     @staticmethod
     def _encode_image(value) -> Dict:
@@ -78,12 +168,22 @@ class InputQueue:
         suspend/resume — must not shrink or blow the budget), and idle
         polls back off exponentially from 1 ms to a 50 ms cap instead of
         hammering the broker at a fixed tight interval; any progress
-        resets the backoff so a streaming burst is drained promptly."""
-        uris = [self.enqueue(None, tier=tier, t=np.asarray(s))
-                for s in samples]
-        out = OutputQueue(self.broker, self.stream)
-        results: dict = {}
+        resets the backoff so a streaming burst is drained promptly.
+
+        Pipelined (default), the burst enqueues as one multi-XADD and
+        each poll sweep reads EVERY outstanding uri in one HMGET — the
+        round-trip count per poll is 1, not len(missing). The legacy
+        per-record pattern survives under `pipelined=False` for the
+        bench A/B."""
         deadline = time.monotonic() + timeout_s
+        out = OutputQueue(self.broker, self.stream,
+                          reconnect_attempts=self.reconnect_attempts)
+        if self.pipelined:
+            uris = self.enqueue_batch(samples, tier=tier)
+        else:
+            uris = [self.enqueue(None, tier=tier, t=np.asarray(s))
+                    for s in samples]
+        results: dict = {}
         backoff = 0.001
         while len(results) < len(uris):
             # deadline checked every pass, progress or not: trickling
@@ -92,13 +192,19 @@ class InputQueue:
             if remaining <= 0:
                 break
             progress = False
-            for uri in uris:
-                if uri in results:
-                    continue
-                res = out.query(uri, delete=True)
-                if res is not None:
-                    results[uri] = res
+            missing = [u for u in uris if u not in results]
+            if self.pipelined:
+                found = out.query_many(missing, delete=True,
+                                       deadline=deadline)
+                if found:
+                    results.update(found)
                     progress = True
+            else:
+                for uri in missing:
+                    res = out.query(uri, delete=True)
+                    if res is not None:
+                        results[uri] = res
+                        progress = True
             if progress:
                 backoff = 0.001
                 continue
@@ -111,31 +217,139 @@ class InputQueue:
                 f"within {timeout_s}s")
         return [results[u] for u in uris]
 
+    def stream_session(self, max_inflight: int = 256) -> "StreamingSession":
+        """A persistent-connection streaming mode over this queue."""
+        return StreamingSession(self, max_inflight=max_inflight)
 
-class OutputQueue:
+
+class StreamingSession:
+    """Persistent-connection streaming client (ISSUE 16): many requests
+    in flight over ONE broker connection, with the fused wire pattern
+    held open across bursts — `submit()` buffers locally, `flush()`
+    ships everything buffered as one multi-XADD, `drain()` collects
+    outstanding results with one HMGET per poll sweep. Usable as a
+    context manager; exiting drains what was submitted.
+
+        with inq.stream_session() as s:
+            for x in arrays:
+                s.submit(x)
+            results = s.drain()          # {uri: ndarray}
+
+    `max_inflight` bounds the unflushed + unanswered window: submit
+    past it triggers an implicit flush (backpressure lives at the
+    broker, not in this buffer)."""
+
+    def __init__(self, inq: InputQueue, max_inflight: int = 256):
+        self.inq = inq
+        self.out = OutputQueue(inq.broker, inq.stream,
+                               reconnect_attempts=inq.reconnect_attempts)
+        self.max_inflight = max(1, int(max_inflight))
+        self._buffered: List[tuple] = []     # (stream, record)
+        self._outstanding: List[str] = []    # uris awaiting results
+        self._order: List[str] = []          # submission order (stable)
+
+    def __enter__(self) -> "StreamingSession":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.drain()
+        return False
+
+    def submit(self, data, uri: Optional[str] = None,
+               tier: Optional[str] = None) -> str:
+        uri, stream, record = self.inq._record(
+            uri, tier, {"t": np.asarray(data)})
+        self._buffered.append((stream, record))
+        self._outstanding.append(uri)
+        self._order.append(uri)
+        if len(self._buffered) >= self.max_inflight:
+            self.flush()
+        return uri
+
+    def flush(self):
+        """Ship the buffered records: one pipelined multi-XADD no
+        matter how many partitions the burst fans out across."""
+        if not self._buffered:
+            return
+        entries, self._buffered = self._buffered, []
+        self.inq._call(self.inq.broker.xadd_many, entries)
+
+    def drain(self, timeout_s: float = 30.0) -> Dict[str, object]:
+        """Flush, then collect every outstanding result (submission
+        order). One HMGET round trip per poll sweep regardless of how
+        many records are outstanding."""
+        self.flush()
+        deadline = time.monotonic() + timeout_s
+        results: dict = {}
+        backoff = 0.001
+        while len(results) < len(self._outstanding):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            missing = [u for u in self._outstanding if u not in results]
+            found = self.out.query_many(missing, delete=True,
+                                        deadline=deadline)
+            if found:
+                results.update(found)
+                backoff = 0.001
+                continue
+            time.sleep(min(backoff, max(0.0, remaining)))
+            backoff = min(backoff * 2, 0.05)
+        missing = [u for u in self._outstanding if u not in results]
+        if missing:
+            raise TimeoutError(
+                f"No prediction for {len(missing)}/"
+                f"{len(self._outstanding)} streamed records within "
+                f"{timeout_s}s")
+        ordered = {u: results[u] for u in self._order if u in results}
+        self._outstanding = []
+        self._order = []
+        return ordered
+
+
+class OutputQueue(_Reconnecting):
     def __init__(self, broker: Union[Broker, str, None] = None,
-                 stream: str = STREAM):
+                 stream: str = STREAM, reconnect_attempts: int = 8):
+        super().__init__(reconnect_attempts=reconnect_attempts)
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self.result_key = f"result:{stream}"
 
     def query(self, uri: str, delete: bool = False):
-        raw = self.broker.hget(self.result_key, uri)
+        raw = self._call(self.broker.hget, self.result_key, uri)
         if raw is None:
             return None
         if delete:
-            self.broker.hdel(self.result_key, uri)
+            self._call(self.broker.hdel, self.result_key, uri)
         return self._decode(raw)
+
+    def query_many(self, uris, delete: bool = False,
+                   deadline: Optional[float] = None) -> Dict[str, object]:
+        """Fused poll: ONE HMGET answers every uri in the sweep (the
+        read analogue of the batched multi-XADD), plus one batched
+        delete for whatever landed. Missing fields simply aren't in
+        the returned dict."""
+        uris = list(uris)
+        if not uris:
+            return {}
+        raws = self._call(self.broker.hmget, self.result_key, uris,
+                          deadline=deadline)
+        found = {u: raw for u, raw in zip(uris, raws) if raw is not None}
+        if delete and found:
+            self._call(self.broker.hdel_many, self.result_key,
+                       list(found), deadline=deadline)
+        return {u: self._decode(raw) for u, raw in found.items()}
 
     def dequeue(self) -> Dict[str, np.ndarray]:
         """Drain all results (`client.py:203` semantics): one read plus
         one batched delete, not one round trip per field."""
-        allr = self.broker.hgetall(self.result_key)
+        allr = self._call(self.broker.hgetall, self.result_key)
         out = {}
         for uri, raw in allr.items():
             out[uri] = self._decode(raw)
         if allr:
-            self.broker.hdel_many(self.result_key, list(allr))
+            self._call(self.broker.hdel_many, self.result_key, list(allr))
         return out
 
     @staticmethod

@@ -116,6 +116,18 @@ class ServingConfig:
     engine_ttl_s: float = 6.0
     claim_min_idle_s: float = 30.0
     claim_interval_s: float = 5.0
+    # partitioned request plane (ISSUE 16, docs/ProgrammingGuide/
+    # request-plane.md): params.partitions splits the stream into N
+    # broker streams keyed by consistent hash of the record id; engines
+    # lease partition SETS from a broker table and take over an expired
+    # peer's partitions. The count is a FLEET-WIDE agreement persisted
+    # in the broker meta row — changing it under a live fleet is
+    # rejected unless params.reshard (or --reshard) explicitly
+    # acknowledges that in-flight records on the old layout may land on
+    # engines not reading their stream until the fleet restarts.
+    partitions: int = 1
+    reshard: bool = False
+    partition_lease_ttl_s: float = 5.0
     # elastic serving (ISSUE 11, docs/ProgrammingGuide/cluster-serving.md
     # "Elastic serving"): params.batching selects the reader's
     # micro-batching policy (adaptive | fixed | static) and its deadline
@@ -261,6 +273,11 @@ class ServingConfig:
         cfg.claim_min_idle_s = float(params.get("claim_min_idle_s", 30.0))
         cfg.claim_interval_s = float(params.get("claim_interval_s", 5.0))
         cfg._validate_fleet()
+        cfg.partitions = int(params.get("partitions", 1))
+        cfg.reshard = bool(params.get("reshard", False))
+        cfg.partition_lease_ttl_s = float(
+            params.get("partition_lease_ttl_s", 5.0))
+        cfg._validate_partitions()
         rollout = params.get("rollout", {}) or {}
         if not isinstance(rollout, dict):
             raise ValueError(
@@ -460,6 +477,32 @@ class ServingConfig:
         if self.engine_id is not None and not str(self.engine_id).strip():
             raise ValueError("params.engine_id must be a non-empty "
                              "string, 'auto', or unset")
+
+    def _validate_partitions(self):
+        """Partition knobs fail at config load like the rest (ISSUE
+        16): a bad count, a partitioned engine without the pipelined
+        path or a fleet identity, or a non-positive lease TTL are
+        operator errors, not reader-loop surprises. (The count-change-
+        under-a-live-fleet check is runtime state, not config: the
+        broker's meta row enforces it when the engine starts —
+        `partitions.PartitionLeaseTable.ensure_meta`.)"""
+        from analytics_zoo_tpu.serving.partitions import \
+            validate_partitions
+        try:
+            validate_partitions(self.partitions)
+        except ValueError as e:
+            raise ValueError(f"params.partitions: {e}") from None
+        if self.partition_lease_ttl_s <= 0:
+            raise ValueError(
+                f"params.partition_lease_ttl_s="
+                f"{self.partition_lease_ttl_s:g} must be > 0")
+        if self.partitions > 1 and not self.pipelined:
+            raise ValueError(
+                "params.partitions > 1 needs params.pipelined: true — "
+                "the legacy single-threaded loop reads one stream")
+        # engine_id is NOT required here: the fleet identity usually
+        # arrives as the CLI --engine-id override — cmd_start enforces
+        # the pairing after overrides land
 
     def _validate_rollout(self):
         """Rollout knobs fail at config load like the rest (ISSUE 14):

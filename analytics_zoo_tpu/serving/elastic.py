@@ -344,16 +344,28 @@ class AdmissionController:
     Backlog reads are rate-limited and cached, one poll per
     ``poll_min_interval_s`` shared by every concurrent request; an
     unreachable broker admits (the downstream enqueue will surface the
-    real error — admission must not add a failure mode)."""
+    real error — admission must not add a failure mode).
+
+    Partitioned plane (ISSUE 16): `partitions > 1` makes the backlog
+    the SUM across the partition streams (total queued work is what
+    admission gates on) and exports each stream's depth as a
+    ``serving_partition_depth{partition=}`` series — the per-shard view
+    that shows a hot partition or an orphaned one (depth climbing with
+    no engine holding its lease) before clients feel it."""
 
     def __init__(self, broker, stream: str, tiers: Sequence[str],
                  max_backlog: int = 512, registry=None,
                  poll_min_interval_s: float = 0.2,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0,
+                 partitions: int = 1):
         if max_backlog <= 0:
             raise ValueError(f"max_backlog={max_backlog} must be > 0")
+        from analytics_zoo_tpu.serving.partitions import (
+            partition_streams, validate_partitions)
         self.broker = broker
         self.stream = stream
+        self.partitions = validate_partitions(partitions)
+        self._streams = partition_streams(stream, self.partitions)
         self.tiers = tiers if isinstance(tiers, TierTable) \
             else TierTable(tiers)
         self.max_backlog = int(max_backlog)
@@ -373,6 +385,10 @@ class AdmissionController:
             "serving_backlog_depth",
             "broker stream depth (enqueued records not yet committed) "
             "as last sampled by the elastic layer")
+        self._partition_gauge = registry.gauge(
+            "serving_partition_depth",
+            "per-partition broker stream depth as last sampled by the "
+            "elastic layer (series appear only when partitions > 1)")
 
     def threshold(self, level: int) -> int:
         n = len(self.tiers)
@@ -386,13 +402,19 @@ class AdmissionController:
                 return self._backlog
             self._last_poll = now
         try:
-            depth = int(self.broker.stream_depth(self.stream))
+            depths = [int(self.broker.stream_depth(s))
+                      for s in self._streams]
+            depth = sum(depths)
         except Exception:  # noqa: BLE001 — admission must not add faults
-            depth = None
+            depth, depths = None, None
         with self._lock:
             self._backlog = depth
         if depth is not None:
             self._backlog_gauge.set(float(depth))
+            if self.partitions > 1 and depths is not None:
+                for i, d in enumerate(depths):
+                    self._partition_gauge.set(float(d),
+                                              partition=str(i))
         return depth
 
     def admit(self, tier_name) -> Tuple[bool, float]:

@@ -408,7 +408,8 @@ class FleetAutoscaler:
                  cooldown_s: float = 5.0, interval_s: float = 1.0,
                  spawn_grace_s: float = 30.0, registry=None,
                  backlog_fn: Optional[Callable[[], Optional[int]]]
-                 = None):
+                 = None,
+                 leader_fn: Optional[Callable[[], bool]] = None):
         validate_autoscale({
             "min_engines": min_engines, "max_engines": max_engines,
             "backlog_high": backlog_high, "backlog_low": backlog_low,
@@ -424,6 +425,11 @@ class FleetAutoscaler:
         # controller) shares its rate-limited probe via backlog_fn
         # instead of this loop running a second poller on the same key
         self.backlog_fn = backlog_fn
+        # replicated gateway (ISSUE 16): only the leader replica's
+        # autoscaler acts — two replicas both holding min_engines would
+        # double-provision every scale-up. Followers tick as no-ops and
+        # pick up instantly when the lease moves here.
+        self.leader_fn = leader_fn
         self.min_engines = int(min_engines)
         self.max_engines = int(max_engines)
         self.backlog_high = float(backlog_high)
@@ -485,6 +491,8 @@ class FleetAutoscaler:
     def tick(self, now: Optional[float] = None) -> Optional[str]:
         """One control-loop pass; returns "up"/"down" when an action
         fired, else None."""
+        if self.leader_fn is not None and not self.leader_fn():
+            return None          # follower replica: observe, never act
         now = time.monotonic() if now is None else now
         alive, burn = self._fleet_view()
         backlog = self._backlog()

@@ -265,15 +265,21 @@ class _Handler(BaseHTTPRequestHandler):
         claim to make."""
         serving = self.server.serving
         fleet = self.server.fleet
+        gateway = self._gateway_block()
         health_fn = getattr(serving, "health", None) if serving else None
         if not callable(health_fn):
             if fleet is None:
-                self._send(200, {"ready": True, "engine": None})
+                payload = {"ready": True, "engine": None}
+                if gateway is not None:
+                    payload["gateway"] = gateway
+                self._send(200, payload)
                 return
             summary = fleet.summary()
             ready = summary.get("ready")
             payload = {"ready": bool(ready), "engine": None,
                        "fleet": summary}
+            if gateway is not None:
+                payload["gateway"] = gateway
             if ready:
                 self._send(200, payload)
                 return
@@ -291,12 +297,25 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if fleet is not None:
             h["fleet"] = fleet.summary()
+        if gateway is not None:
+            h["gateway"] = gateway
         if h.get("ready"):
             self._send(200, h)
         else:
             retry_s = getattr(serving, "retry_after_s", 1)
             self._send(503, h,
                        extra_headers={"Retry-After": str(retry_s)})
+
+    def _gateway_block(self) -> Optional[dict]:
+        """Replicated-gateway identity for /healthz (ISSUE 16): which
+        replica answered, its current role, and who it believes leads.
+        None on a frontend running without a gateway_id."""
+        lease = getattr(self.server, "leader_lease", None)
+        if lease is None:
+            return None
+        return {"id": lease.gateway_id,
+                "role": "leader" if lease.is_leader() else "follower",
+                "leader": lease.leader()}
 
     def _profile(self):
         """`POST /profile?seconds=N` (ISSUE 6): one bounded jax.profiler
@@ -563,7 +582,10 @@ class FrontEnd:
                  engine_ttl_s: float = 6.0,
                  admission=None,
                  admission_header: str = "X-Priority",
-                 rollout=None):
+                 rollout=None,
+                 partitions: int = 1,
+                 gateway_id: Optional[str] = None,
+                 leader_ttl_s: float = 3.0):
         """`fleet_stream` (ISSUE 10) turns the frontend into a fleet
         gateway: a `FleetTracker` watches engine heartbeats on
         `engines:<fleet_stream>`, `/healthz` answers for the FLEET
@@ -577,12 +599,28 @@ class FrontEnd:
         tiered early 429s on `/predict` — the requester's priority
         class arrives in the `admission_header` header (or a "tier"
         body field) and is forwarded on the enqueued record for the
-        engine's tiered scheduler."""
+        engine's tiered scheduler.
+
+        `partitions` (ISSUE 16) routes enqueued records across the
+        partitioned request plane — it must match the engines'
+        partition count (the broker-persisted meta row is the
+        authority; engines validate it on startup).
+
+        `gateway_id` (ISSUE 16) makes this frontend one REPLICA of a
+        replicated gateway: a `GatewayLeaderLease` on
+        `gateway:<fleet_stream>` elects one leader among the replicas.
+        Every replica serves `/predict`, `/healthz`, `/metrics`,
+        `/rollout` and `/rollout/status` from broker-derived state;
+        only the leader's control loops (rollout convergence,
+        autoscaling) act — wire `leader_fn=frontend.is_leader` into
+        `RolloutController`/`FleetAutoscaler`. Kill the leader and a
+        surviving replica takes the lease within ~`leader_ttl_s`."""
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self._srv = _FrontEndServer((host, port), _Handler)
         self._srv.daemon_threads = True
-        self._srv.input_queue = InputQueue(self.broker)
+        self._srv.input_queue = InputQueue(self.broker,
+                                           partitions=partitions)
         self._srv.broker = self.broker
         self._srv.serving = serving
         self._srv.request_timer = Timer("http_predict")
@@ -621,6 +659,22 @@ class FrontEnd:
                                       ttl_s=engine_ttl_s,
                                       registry=self.registry)
         self._srv.fleet = self.fleet
+        # replicated gateway (ISSUE 16): leader election over the same
+        # broker as everything else. The lease thread gets its own
+        # connection (clone) so a long /predict poll on the shared
+        # socket can never delay a renewal past the ttl
+        self.leader_lease = None
+        self.gateway_id = gateway_id
+        if gateway_id is not None:
+            from analytics_zoo_tpu.serving.client import STREAM
+            from analytics_zoo_tpu.serving.partitions import \
+                GatewayLeaderLease
+            clone = getattr(self.broker, "clone", None)
+            lease_broker = clone() if callable(clone) else self.broker
+            self.leader_lease = GatewayLeaderLease(
+                lease_broker, fleet_stream or STREAM, gateway_id,
+                ttl_s=leader_ttl_s, registry=self.registry)
+        self._srv.leader_lease = self.leader_lease
         self.admission = admission
         self._srv.admission = admission
         self._srv.admission_header = admission_header
@@ -651,12 +705,27 @@ class FrontEnd:
         self.rollout = rollout
         self._srv.rollout = rollout
 
+    def is_leader(self) -> bool:
+        """True when this replica's control loops should act. A
+        frontend started WITHOUT a gateway_id is the only gateway
+        there is — trivially the leader — so `leader_fn=...is_leader`
+        is always safe to wire."""
+        return self.leader_lease is None or self.leader_lease.is_leader()
+
     def start(self) -> "FrontEnd":
+        if self.leader_lease is not None:
+            self.leader_lease.start()
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, release_lease: bool = True):
+        """`release_lease=False` is the kill-the-leader chaos analogue:
+        the HTTP listener dies but the lease row stays unreleased in
+        the broker, exactly as a SIGKILLed gateway would leave it — a
+        surviving replica must win it only by expiry."""
         self._srv.shutdown()
         self._srv.server_close()
+        if self.leader_lease is not None:
+            self.leader_lease.stop(release=release_lease)
         if self.fleet is not None:
             self.fleet.close()

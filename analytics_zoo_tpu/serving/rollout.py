@@ -446,11 +446,19 @@ class RolloutController:
 
     def __init__(self, broker, stream: str, model_dir: str,
                  tracker, poll_interval_s: float = 1.0,
-                 engine_timeout_s: float = 60.0, registry=None):
+                 engine_timeout_s: float = 60.0,
+                 leader_fn: Optional[Callable[[], bool]] = None,
+                 registry=None):
         if poll_interval_s <= 0 or engine_timeout_s <= 0:
             raise ValueError("poll_interval_s and engine_timeout_s "
                              "must be > 0")
         self.broker = broker
+        # replicated-gateway gate (ISSUE 16): when set, only the
+        # replica whose leader lease holds runs the convergence core —
+        # followers' ticks are no-ops, but request()/status() stay
+        # live everywhere because the pin and quarantine both persist
+        # in the control hash and the goal state derives from it
+        self.leader_fn = leader_fn
         self.stream = stream
         self.key = rollout_key(stream)
         self.model_dir = model_dir
@@ -571,11 +579,32 @@ class RolloutController:
     def tick(self, now: Optional[float] = None) -> Optional[str]:
         """One control pass; returns "direct"/"advance"/"converged"/
         "rollback" when something happened, else None."""
+        if self.leader_fn is not None and not self.leader_fn():
+            return None          # follower: reads only, never directs
         with self._lock:
             return self._tick_locked(
                 time.monotonic() if now is None else now)
 
+    def _sync_pin_locked(self):
+        """Adopt the broker-persisted operator pin. Any gateway replica
+        accepts POST /rollout by writing the `pin` field; the leader's
+        tick reads it here, so a kill-the-leader handover converges the
+        in-flight request without the operator re-issuing it. A broker
+        blip keeps the last-synced local value (never silently unpins)."""
+        try:
+            raw = self.broker.hget(self.key, "pin")
+        except Exception:  # noqa: BLE001 — broker blip: local pin rules
+            return
+        if raw:
+            try:
+                self.force_version = int(json.loads(raw))
+            except (TypeError, ValueError):
+                pass
+        else:
+            self.force_version = None
+
     def _tick_locked(self, now: float) -> Optional[str]:
+        self._sync_pin_locked()
         # vetoes first: a failed canary anywhere quarantines the
         # version before any further engine is directed at it; an
         # ENGINE-scope refusal (load failure — a fact about that
@@ -633,6 +662,12 @@ class RolloutController:
                 "the pin", self.force_version,
                 self.quarantined[str(self.force_version)])
             self.force_version = None
+            try:
+                # clear the persisted pin too, or the next sync would
+                # re-adopt the poisoned version forever
+                self.broker.hdel(self.key, "pin")
+            except Exception:  # noqa: BLE001 — quarantine outranks the
+                pass           # pin on every future sync anyway
         if self.force_version is not None:
             run_dir, v = resolve_checkpoint(self.model_dir,
                                             self.force_version)
@@ -812,6 +847,7 @@ class RolloutController:
         if unpin:
             with self._lock:
                 self.force_version = None
+            self.broker.hdel(self.key, "pin")
         if version is not None:
             from analytics_zoo_tpu.learn.checkpoint import (
                 published_intact, resolve_checkpoint)
@@ -829,11 +865,19 @@ class RolloutController:
                     f"version {v} exists but is not published")
             with self._lock:
                 self.force_version = v
+            # the pin lives in the control hash, not in this replica:
+            # ANY gateway accepts the request, and whichever replica
+            # holds (or inherits) the leader lease converges it
+            self.broker.hset(self.key, "pin", json.dumps(v))
         self.tick()
         return self.status()
 
     def status(self) -> Dict[str, Any]:
         with self._lock:
+            # followers never tick, so surface the broker-persisted pin
+            # here — GET /rollout/status answers the same on every
+            # gateway replica
+            self._sync_pin_locked()
             out = {
                 "state": self.state,
                 "active_version": self.active_version,

@@ -52,7 +52,7 @@ import logging
 import queue
 import threading
 import time
-from typing import List, Optional, Union
+from typing import Dict, List, Optional, Union
 
 import numpy as np
 
@@ -90,10 +90,11 @@ class _Batch:
     """One shape-homogeneous unit of pipeline work."""
 
     __slots__ = ("ids", "uris", "arrays", "t0", "pending", "nan", "t_enq",
-                 "stacked", "valid_n", "shed", "bucket", "t_dispatch")
+                 "stacked", "valid_n", "shed", "bucket", "t_dispatch",
+                 "stream")
 
     def __init__(self, ids, uris, arrays, t0, nan=False, stacked=None,
-                 valid_n=None, shed=False):
+                 valid_n=None, shed=False, stream=None):
         self.ids = ids            # broker record ids (for the batched ack)
         self.uris = uris          # result-hash fields
         self.arrays = arrays      # decoded host arrays (None once stacked)
@@ -106,6 +107,7 @@ class _Batch:
         self.shed = shed          # admission-shed batch: sink writes "SHED"
         self.bucket = None        # dispatched bucket (cost-model key)
         self.t_dispatch = None    # dispatch timestamp (cost-model base)
+        self.stream = stream      # source partition stream (None = base)
 
 
 class ClusterServing:
@@ -137,7 +139,10 @@ class ClusterServing:
                  admission_tiers=None,
                  admission_field: str = "tier",
                  shed_backlog: Optional[int] = None,
-                 model_version: Optional[int] = None):
+                 model_version: Optional[int] = None,
+                 partitions: int = 1,
+                 reshard: bool = False,
+                 partition_lease_ttl_s: float = 5.0):
         """Fault-tolerance knobs (ISSUE 5; the rest is PR 1-4 surface):
         `supervise` starts a `ReplicaSupervisor` over a replica pool
         (quarantine after `failure_threshold` consecutive failures or
@@ -193,7 +198,22 @@ class ClusterServing:
         `InputQueue`) always write the native "tier" record key;
         `admission_field` points the reader at a FOREIGN producer's
         spelling, with "tier" kept as the fallback so mixed traffic
-        never inverts priorities."""
+        never inverts priorities.
+
+        Partitioned request plane (ISSUE 16): `partitions` shards the
+        stream N ways (`<stream>.p<i>`, records routed by uri hash —
+        see serving/partitions.py). The engine owns a partition SET via
+        a lease table in the broker; the reader renews/acquires/sheds
+        leases inline (paced like the claim sweep) and round-robins
+        reads across the streams it owns. Lease expiry generalizes the
+        PR 10 claim sweep from records to whole partitions: a dead
+        peer's partitions move here after `partition_lease_ttl_s` of
+        silence, then its unacked records redeliver through the
+        ordinary per-stream sweep. `partitions=1` (default) keeps the
+        legacy single-stream behavior byte-identical. Changing the
+        count against a live lease table is refused unless `reshard`
+        is set (records already routed under the old count would
+        strand)."""
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
@@ -255,6 +275,34 @@ class ClusterServing:
         self.claim_min_idle_s = float(claim_min_idle_s)
         self.claim_interval_s = float(claim_interval_s)
         self.heartbeat_interval_s = float(heartbeat_interval_s)
+        # partitioned request plane (ISSUE 16)
+        from analytics_zoo_tpu.serving.partitions import (
+            PartitionLeaseTable, validate_partitions)
+        self.partitions = validate_partitions(partitions)
+        self.lease_table = None
+        if self.partitions > 1:
+            if not pipelined:
+                raise ValueError(
+                    "partitions > 1 needs the pipelined engine (the "
+                    "legacy serve_once loop reads one stream)")
+            if engine_id is None:
+                raise ValueError(
+                    "partitions > 1 needs an engine_id: partition "
+                    "leases are owned by a nameable engine")
+            # lease I/O rides the reader's broker connection: polls run
+            # in the reader thread (paced like the claim sweep) and the
+            # final release runs after the reader joins — never two
+            # threads on one socket
+            self.lease_table = PartitionLeaseTable(
+                self.reader_broker, stream, self.partitions,
+                owner=engine_id, ttl_s=partition_lease_ttl_s,
+                registry=self.registry)
+            # the resharding gate: refuse a partition count that
+            # disagrees with the live lease table unless the operator
+            # explicitly asked to reshard
+            self.lease_table.ensure_meta(reshard=reshard)
+        self._lease_poll_s = max(0.05, float(partition_lease_ttl_s) / 3.0)
+        self._killed = False
         self.pipelined = pipelined
         self.zero_copy_decode = zero_copy_decode
         self.decode_workers = max(1, decode_workers)
@@ -381,6 +429,11 @@ class ClusterServing:
             # an engine reports a new version ONLY after the swap's
             # canary passed — the beat is the commit
             out["model_version"] = self.model_version
+        if self.lease_table is not None:
+            # the gateway's partition-coverage view (ISSUE 16): which
+            # partitions this engine reads right now — summed across
+            # beats, an operator sees holes before clients do
+            out["partitions_owned"] = self.lease_table.owned()
         slo = h.get("slo")
         if isinstance(slo, dict):
             burns = [v.get("burn_rate", 0.0) for v in slo.values()
@@ -672,6 +725,14 @@ class ClusterServing:
         sinks = [t for t in self._threads if "sink" in t.name]
         for t in readers:
             t.join(timeout=10)
+        if self.lease_table is not None:
+            # after the reader joins (its thread owns the lease broker
+            # connection): give the partitions back so peers rebalance
+            # now instead of waiting out the ttl
+            try:
+                self.lease_table.release()
+            except Exception:  # noqa: BLE001 — peers expire the leases
+                pass
         self._poison(self._decode_q, len(decoders))
         for t in decoders:
             t.join(timeout=10)
@@ -691,6 +752,33 @@ class ClusterServing:
                     br.close()
                 except Exception:  # noqa: BLE001 — shutdown best effort
                     pass
+
+    def kill(self):
+        """Crash analogue for chaos tests (ISSUE 16): stop every stage
+        WITHOUT the drain, the heartbeat deregistration, or the lease
+        release a clean `stop()` performs. Work in hand is abandoned
+        uncommitted — its records stay in the broker PEL and this
+        engine's partition leases sit in the table until they age out,
+        exactly the state a SIGKILLed engine leaves behind for peer
+        takeover (lease expiry + claim sweep) to recover."""
+        self._killed = True
+        self._stop.set()
+        if self.heartbeat is not None:
+            self.heartbeat.stop(deregister=False)
+        if self.slo is not None:
+            self.slo.stop_auto()
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        for q in (self._decode_q, self._dispatch_q, self._sink_q):
+            self._poison(q, self.decode_workers + 2)
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads = []
+        if self.lease_table is not None:
+            # unhook the local gauge only; the broker rows are the
+            # corpse the takeover path must find
+            self.lease_table.abandon()
+        self._unwire_gauges()
 
     def _unwire_gauges(self):
         """Post-drain registry cleanup (runs AFTER the stage joins, so
@@ -737,26 +825,40 @@ class ClusterServing:
                         if abandon is not None:
                             abandon()
 
-    def _filter_inflight(self, records):
+    def _filter_inflight(self, records, stream=None):
         """Drop records this engine already holds un-acked (its own
         slow in-flight work coming back through the claim sweep or a
         redelivery window) and register the rest. The sink releases ids
         on ack — and on shed, where redelivering (ideally to a peer)
-        is exactly the contract."""
+        is exactly the contract. Ids key by (stream, rid): partition
+        streams assign record ids independently, so a bare rid is not
+        unique across the partition set."""
         if not records:
             return []
+        stream = stream or self.stream
         out = []
         with self._inflight_lock:
             for rid, rec in records:
-                if rid in self._inflight_ids:
+                if (stream, rid) in self._inflight_ids:
                     continue
-                self._inflight_ids.add(rid)
+                self._inflight_ids.add((stream, rid))
                 out.append((rid, rec))
         return out
 
-    def _release_inflight(self, ids):
+    def _release_inflight(self, ids, stream=None):
+        stream = stream or self.stream
         with self._inflight_lock:
-            self._inflight_ids.difference_update(ids)
+            self._inflight_ids.difference_update(
+                (stream, rid) for rid in ids)
+
+    def _read_streams(self) -> List[str]:
+        """The streams this engine reads right now: the single base
+        stream, or (partitioned) the set it currently holds leases on
+        — possibly empty while a newcomer waits for incumbents to shed
+        its fair share."""
+        if self.lease_table is None:
+            return [self.stream]
+        return self.lease_table.owned_streams()
 
     def _stream_backlog(self) -> Optional[int]:
         """Rate-limited broker stream depth MINUS this engine's own
@@ -764,14 +866,16 @@ class ClusterServing:
         so raw depth would read our own pipeline back as other
         people's load and misclassify a light trickle as heavy — the
         adaptive batcher would then re-add the padding wait it exists
-        to remove). Reader-thread only. None = unknown (transport
-        without XLEN, or a mid-outage read) — the controller then
-        plans conservatively."""
+        to remove). Partitioned engines sum across the streams they
+        own — the load THIS engine must plan for. Reader-thread only.
+        None = unknown (transport without XLEN, or a mid-outage read)
+        — the controller then plans conservatively."""
         now = time.monotonic()
         if now - self._backlog_t >= 0.2:
             self._backlog_t = now
             try:
-                depth = int(self.reader_broker.stream_depth(self.stream))
+                depth = sum(int(self.reader_broker.stream_depth(s))
+                            for s in self._read_streams())
             except Exception:  # noqa: BLE001 — load signal, not a fault
                 depth = None
             self._backlog_cache = depth
@@ -781,7 +885,7 @@ class ClusterServing:
             own = len(self._inflight_ids)
         return max(0, self._backlog_cache - own)
 
-    def _tier_order_and_shed(self, records, t0):
+    def _tier_order_and_shed(self, records, t0, src=None):
         """Tiered scheduling in the reader (ISSUE 11): higher-tier
         records decode and dispatch first (a stable sort — FIFO within
         a tier), and under overload (stream depth past `shed_backlog`)
@@ -821,7 +925,7 @@ class ClusterServing:
                 [rid for rid, _ in shed],
                 [rec.get("uri", rid) if isinstance(rec, dict)
                  else str(rid) for rid, rec in shed],
-                None, t0, shed=True))
+                None, t0, shed=True, stream=src))
         return keep
 
     # -- stage: reader -----------------------------------------------------
@@ -833,18 +937,53 @@ class ClusterServing:
         idle_block = max(self.batch_timeout_ms, 50)
         failures = 0
         last_logged = None         # (breaker state) at last warning
-        next_claim = time.monotonic() + self.claim_interval_s
+        # claim pacing is PER STREAM: one global clock aliases against
+        # the rotation when the rotation period divides the claim
+        # interval (2 owned streams x half the idle block == exactly
+        # claim_interval_s), and every sweep then lands on the SAME
+        # partition — a dead peer's other partitions never drain
+        next_claim: Dict[str, float] = {}
+        first_claim = time.monotonic() + self.claim_interval_s
+        next_lease = 0.0           # first pass acquires immediately
+        rr = 0                     # round-robin cursor over owned streams
         while not self._stop.is_set():
+            # partition lease upkeep (ISSUE 16), BEFORE the pause gate:
+            # a rollout drain must keep renewing or the pause itself
+            # would forfeit this engine's partitions to its peers
+            if self.lease_table is not None \
+                    and time.monotonic() >= next_lease:
+                next_lease = time.monotonic() + self._lease_poll_s
+                try:
+                    self.lease_table.poll()
+                except Exception as e:  # noqa: BLE001 — ttl absorbs it
+                    log.warning(
+                        "partition lease poll failed (%s: %s); "
+                        "retrying next interval", type(e).__name__, e)
             if self._intake_paused.is_set():
                 # rollout drain (ISSUE 14): no reads, no claim sweeps —
                 # in-hand work flows out while the swap waits on
                 # quiesce(); a timed wait so stop() still cuts through
                 self._stop.wait(0.05)
                 continue
+            streams = self._read_streams()
+            if not streams:
+                # newcomer awaiting its fair share: the next lease poll
+                # acquires what incumbents shed
+                self._stop.wait(0.05)
+                continue
+            # one source stream per cycle (rotating): a read batch —
+            # and every _Batch cut from it — belongs to exactly one
+            # partition, so the sink acks against the right PEL. The
+            # idle block splits across owned streams to keep worst-case
+            # first-byte latency at one full block window.
+            src = streams[rr % len(streams)]
+            rr += 1
+            block = idle_block if len(streams) == 1 \
+                else max(5, idle_block // len(streams))
             try:
                 records = self.reader_broker.read_group(
-                    self.stream, GROUP, self.consumer, self.batch_size,
-                    block_ms=idle_block)
+                    src, GROUP, self.consumer, self.batch_size,
+                    block_ms=block)
                 if failures:
                     # back from an outage: ONE info line + the counter,
                     # mirroring the one-warning-per-transition cap below
@@ -853,7 +992,7 @@ class ClusterServing:
                              "attempt(s)", failures)
                     failures = 0
                     last_logged = None
-                if time.monotonic() >= next_claim:
+                if time.monotonic() >= next_claim.get(src, first_claim):
                     # stale-pending claim sweep (ISSUE 10): a killed
                     # peer's delivered-but-unacked entries become this
                     # engine's work once idle past the claim window.
@@ -862,10 +1001,15 @@ class ClusterServing:
                     # sweep: brokers without the claim op, or a claim
                     # that dies mid-outage, must not cost the records
                     # already in hand.
-                    next_claim = time.monotonic() + self.claim_interval_s
+                    # partitioned engines sweep the cycle's source
+                    # stream (per-stream pacing covers the set; takeover
+                    # of a dead peer's WHOLE partition is the lease
+                    # table's job, after which this sweep drains its PEL)
+                    next_claim[src] = time.monotonic() \
+                        + self.claim_interval_s
                     try:
                         claimed = self.reader_broker.claim_stale(
-                            self.stream, GROUP, self.consumer,
+                            src, GROUP, self.consumer,
                             int(self.claim_min_idle_s * 1000),
                             self.batch_size)
                     except NotImplementedError:
@@ -876,7 +1020,7 @@ class ClusterServing:
                             "claim sweep failed (%s: %s); retrying next "
                             "interval", type(e).__name__, e)
                     if claimed:
-                        claimed = self._filter_inflight(claimed)
+                        claimed = self._filter_inflight(claimed, src)
                     if claimed:
                         self._claimed_records.inc(len(claimed),
                                                   **self._labels)
@@ -885,7 +1029,7 @@ class ClusterServing:
                                  len(claimed))
                 else:
                     claimed = []
-                records = claimed + self._filter_inflight(records)
+                records = claimed + self._filter_inflight(records, src)
                 if not records:
                     continue
                 # adaptive accumulation (ISSUE 11; the straggler sweep,
@@ -909,10 +1053,10 @@ class ClusterServing:
                     try:
                         more = self._filter_inflight(
                             self.reader_broker.read_group(
-                                self.stream, GROUP, self.consumer,
+                                src, GROUP, self.consumer,
                                 plan.target - len(records),
                                 block_ms=max(1, int(min(remaining_ms,
-                                                        50)))))
+                                                        50)))), src)
                     except Exception as e:  # noqa: BLE001 — keep batch
                         log.warning(
                             "batch-collection read failed (%s: %s); "
@@ -934,10 +1078,11 @@ class ClusterServing:
                 self._records_total.inc(len(records), outcome="read",
                                         **self._labels)
                 if self.tier_table is not None:
-                    records = self._tier_order_and_shed(records, t_first)
+                    records = self._tier_order_and_shed(records, t_first,
+                                                        src)
                     if not records:
                         continue
-                item = (t_first, records)
+                item = (t_first, records, src)
                 while not self._stop.is_set():
                     try:
                         self._decode_q.put(item, timeout=0.25)
@@ -1074,7 +1219,7 @@ class ClusterServing:
                 continue               # exit is by pill, not timeout
             if item is _STOP:
                 return
-            t0, records = item
+            t0, records, src = item
             tr = self.tracer
             uris = _record_uris(records) if tr is not None else None
             if tr is not None:
@@ -1091,17 +1236,19 @@ class ClusterServing:
                 if failed:
                     self._enqueue(self._sink_q, _Batch(
                         [rid for rid, _ in failed],
-                        [uri for _, uri in failed], None, t0, nan=True))
+                        [uri for _, uri in failed], None, t0, nan=True,
+                        stream=src))
                 if batches is not None:
                     for ids, uris, buf, n in batches:
                         self._enqueue(self._dispatch_q, _Batch(
-                            ids, uris, None, t0, stacked=buf, valid_n=n))
+                            ids, uris, None, t0, stacked=buf, valid_n=n,
+                            stream=src))
                 else:
                     for items in by_shape.values():
                         self._enqueue(self._dispatch_q, _Batch(
                             [rid for rid, _, _ in items],
                             [uri for _, uri, _ in items],
-                            [a for _, _, a in items], t0))
+                            [a for _, _, a in items], t0, stream=src))
                 t_end = time.perf_counter()
                 self.decode_timer.record(t_end - t_work)
                 if tr is not None:
@@ -1111,7 +1258,7 @@ class ClusterServing:
                 # the dropped batch stays unacked, so the broker WILL
                 # redeliver it — release its ids or _filter_inflight
                 # would suppress that redelivery forever
-                self._release_inflight([rid for rid, _ in records])
+                self._release_inflight([rid for rid, _ in records], src)
                 log.error("decode stage failed for a read batch: %s", e)
 
     # -- stage: dispatch ---------------------------------------------------
@@ -1281,6 +1428,15 @@ class ClusterServing:
         writeback when the broker is down. Materialization errors
         degrade to "NaN" inside `_materialize`; from here on the only
         failure mode is the broker, and the buffer owns that."""
+        if self._killed:
+            # kill() (crash analogue): a dead process commits nothing —
+            # the batch's records stay unacked for peer takeover. A
+            # routed pending still holds a replica permit that only
+            # consumption releases; abandon it like _poison does.
+            abandon = getattr(batch.pending, "abandon", None)
+            if abandon is not None:
+                abandon()
+            return
         t_work = batch.t_enq
         values = self._materialize(batch)
         if batch.bucket is not None and batch.t_dispatch is not None \
@@ -1291,7 +1447,8 @@ class ClusterServing:
                 batch.bucket,
                 (time.perf_counter() - batch.t_dispatch) * 1e3)
         entry = (dict(zip(batch.uris, values)), list(batch.ids),
-                 batch.t0, t_work, batch.shed)
+                 batch.t0, t_work, batch.shed,
+                 batch.stream or self.stream)
         if self._wb_buffer:
             # keep writeback order: flush the backlog first, and if any
             # of it still can't go out, queue behind it
@@ -1309,15 +1466,18 @@ class ClusterServing:
         retry's new-field count reads 0 — but the records were served
         exactly once by this engine's compute and must count as
         served, not duplicate."""
-        mapping, ids, t0, t_work, shed = entry
+        mapping, ids, t0, t_work, shed = entry[:5]
+        # pre-partition entries (tests, a buffer that survived an
+        # upgrade) carry no stream element: they mean the base stream
+        stream = entry[5] if len(entry) > 5 else self.stream
         try:
             # the whole batch commits as ONE broker interaction —
             # results + ack in a single (pipelined) round trip, not
             # N+1, not even 3: round-trip latency is what caps sink
             # throughput when the broker host is loaded
             added = self.sink_broker.writeback(
-                self.result_key, mapping, self.stream, GROUP, ids)
-            self._release_inflight(ids)
+                self.result_key, mapping, stream, GROUP, ids)
+            self._release_inflight(ids, stream)
         except Exception as e:  # noqa: BLE001 — the buffer owns retries
             if not self._sink_down:
                 # one warning per outage, not per batch (the breaker
@@ -1399,7 +1559,8 @@ class ClusterServing:
             # shed records must be re-readable: release their ids so a
             # redelivery (this engine or a claiming peer) isn't filtered
             # out as already-in-flight
-            self._release_inflight(shed[1])
+            self._release_inflight(
+                shed[1], shed[5] if len(shed) > 5 else None)
             log.warning(
                 "sink buffer overflow: shed a writeback of %d records "
                 "(unacked; the broker will redeliver)", len(shed[0]))
@@ -1558,6 +1719,11 @@ class ClusterServing:
             m["engine_id"] = self.engine_id
             m["claimed_records"] = int(
                 self._claimed_records.value(**self._labels))
+        if self.lease_table is not None:
+            m["partitions"] = {
+                "total": self.partitions,
+                "owned": self.lease_table.owned(),
+            }
         if self.pipelined:
             m["stages"] = {
                 "decode": self.decode_timer.snapshot(),

@@ -734,7 +734,12 @@ def _fleet_child(args) -> int:
         # deadline-aware engines against "static" pad-to-largest ones
         batch_policy=args.batch_policy,
         deadline_ms=args.deadline_ms or None,
-        slo=slo, model_version=rollout_version)
+        slo=slo, model_version=rollout_version,
+        # request-plane knobs (ISSUE 16): the --request-plane scaling
+        # leg runs p engines over p partition streams; default 1 keeps
+        # every other leg on the legacy unsuffixed stream
+        partitions=args.partitions,
+        partition_lease_ttl_s=args.partition_lease_ttl)
     broker.hset(f"fleet:ready:{args.stream}", args.engine_id, "1")
     gate_deadline = time.time() + 600
     while not broker.hget(f"fleet:gate:{args.stream}", "go"):
@@ -756,6 +761,10 @@ def _fleet_child(args) -> int:
         time.sleep(0.05)
     if agent is not None:
         agent.stop()
+    # owned set BEFORE stop(): a clean stop releases every lease, so
+    # reading after would always report []
+    owned_at_stop = serving.lease_table.owned() \
+        if args.partitions > 1 else None
     serving.stop()
     sources = {}
     for v in im.warmup_source.values():
@@ -773,6 +782,8 @@ def _fleet_child(args) -> int:
                   serving.records_read / n_batches, 2)
               if n_batches else None,
               "claimed_records": m.get("claimed_records", 0)}
+    if owned_at_stop is not None:
+        report["partitions_owned"] = owned_at_stop
     if args.rollout_dir:
         # the 0-compiles-on-swap evidence: executable count after the
         # rollout minus before — a same-structure swap adds nothing
@@ -1062,6 +1073,268 @@ def _fleet_main(args) -> int:
         "survivor_claimed_records": survivors_claimed,
         "engine_reports": reports,
     }
+    print(json.dumps(out))
+    return 0
+
+
+# -- request plane: ingest A/B + partition scaling (ISSUE 16) --------------
+
+def _request_plane_main(args) -> int:
+    """`--request-plane`: the million-user request-plane benches.
+
+    Leg 1 — wire-speed ingest A/B against one MiniRedis. The wire
+    floor is the measured RESP round trip (minimal HGET: request +
+    nil reply). Ingest-only: the same burst enqueued per-record (one
+    XADD round trip each — the PR 3 frontend pattern) vs
+    `enqueue_batch` (ONE pipelined multi-XADD spanning partition
+    streams). End-to-end: the burst through `predict_batch` on a
+    `pipelined=False` queue (per-record XADD + per-uri HGET polls) vs
+    the batched queue (multi-XADD + HMGET sweeps) vs a
+    `StreamingSession`, all against the same in-process
+    identity-model engine so the A/B isolates the client wire
+    pattern, not model compute. The acceptance figure is frontend
+    overhead per record OVER the wire floor, which the batched modes
+    must cut >= 2x — the batched overhead deliberately does NOT
+    subtract its own (amortized, ~rtt/n) wire share, so the ratio is
+    conservative.
+
+    Leg 2 — partition scaling: p in (1, 2, 4) partition streams with
+    p engine processes each (fleet children under `--partitions p`),
+    the same prefilled backlog per leg routed by the SAME crc32 hash
+    the engines' lease tables partition by, drain rps per leg.
+    Engine compute is single-threaded by construction (the _md_model
+    contract), so the curve caps at min(p, host cores, measured host
+    parallelism) — reported per the PR 3/10 honest-ceiling
+    convention. A short lease ttl (1 s) keeps the fair-share
+    rebalance (engines start owning nothing; the first poll grabs up
+    to ceil(p/members)) well inside the first drain; best-of-2 then
+    measures the balanced steady state."""
+    import shutil
+    import tempfile
+    import uuid
+
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.serving.broker import (RedisBroker,
+                                                  encode_ndarray)
+    from analytics_zoo_tpu.serving.client import InputQueue
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving.partitions import stream_for
+    from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+    from analytics_zoo_tpu.serving.server import ClusterServing
+
+    init_orca_context(cluster_mode="local")
+    srv = MiniRedisServer().start()
+    cache_dir = tempfile.mkdtemp(prefix="zoo-rp-cc-")
+    out = {"metric": "serving_request_plane"}
+    try:
+        broker = RedisBroker(srv.host, srv.port)
+
+        # wire floor: p50 of the smallest useful RESP round trip
+        rtts = []
+        for _ in range(300):
+            t0 = time.perf_counter()
+            broker.hget("wire:floor", "f")
+            rtts.append((time.perf_counter() - t0) * 1e3)
+        wire_rtt = _percentile(rtts, 0.5)
+
+        # -- ingest-only A/B: per-record XADD vs one multi-XADD ----------
+        n_ingest = 400
+        burst = [np.full((4,), float(i), np.float32)
+                 for i in range(n_ingest)]
+        q_sync = InputQueue(RedisBroker(srv.host, srv.port),
+                            stream="rp_ingest_sync", pipelined=False)
+        t0 = time.perf_counter()
+        for s in burst:
+            q_sync.enqueue(t=s)
+        sync_ms = (time.perf_counter() - t0) * 1e3 / n_ingest
+        # partitions=4 on purpose: the fused path must hold its win
+        # while fanning one burst across 4 partition streams
+        q_pipe = InputQueue(RedisBroker(srv.host, srv.port),
+                            stream="rp_ingest_pipe", partitions=4)
+        t0 = time.perf_counter()
+        q_pipe.enqueue_batch(burst)
+        pipe_ms = (time.perf_counter() - t0) * 1e3 / n_ingest
+        # wire-only sub-leg: the SAME prebuilt records straight at the
+        # broker (no client encode), per-record XADD vs chunked
+        # multi-XADD — isolates the wire pattern itself. The full
+        # client legs above still pay numpy encode per record in BOTH
+        # modes, so on a loopback rtt their ratio is encode-bound.
+        prebuilt = [("rp_ingest_wire",
+                     {"uri": f"w{i}", "data": {"t": "x" * 64}})
+                    for i in range(n_ingest)]
+        t0 = time.perf_counter()
+        for st, rec in prebuilt:
+            broker.xadd(st, rec)
+        wire_sync_ms = (time.perf_counter() - t0) * 1e3 / n_ingest
+        t0 = time.perf_counter()
+        for i in range(0, n_ingest, 64):
+            broker.xadd_many(prebuilt[i:i + 64])
+        wire_pipe_ms = (time.perf_counter() - t0) * 1e3 / n_ingest
+        # per-record mode pays >= 1 round trip per record BY
+        # CONSTRUCTION — overhead is what it spends beyond that floor;
+        # the batched mode's amortized wire share is NOT subtracted
+        # (conservative against the claim)
+        ingest_over_sync = max(sync_ms - wire_rtt, 0.0)
+        ingest_over_pipe = max(pipe_ms, 1e-6)
+        wire_over_sync = max(wire_sync_ms - wire_rtt, 0.0)
+        wire_over_pipe = max(wire_pipe_ms, 1e-6)
+        out["ingest"] = {
+            "n": n_ingest,
+            "per_record_xadd_ms": round(sync_ms, 3),
+            "batched_xadd_many_ms": round(pipe_ms, 3),
+            "overhead_over_wire_ms": {
+                "per_record": round(ingest_over_sync, 3),
+                "batched": round(ingest_over_pipe, 3)},
+            "overhead_reduction": round(
+                ingest_over_sync / ingest_over_pipe, 2),
+            "wire_only": {
+                "per_record_xadd_ms": round(wire_sync_ms, 3),
+                "batched_xadd_many_ms": round(wire_pipe_ms, 3),
+                "overhead_reduction": round(
+                    wire_over_sync / wire_over_pipe, 2)},
+        }
+
+        # -- end-to-end A/B through an identity engine -------------------
+        e2e_stream = "rp_e2e"
+        ident = InferenceModel().load_fn(lambda p, x: x, params=())
+        ident.warmup(np.zeros((4,), np.float32),
+                     buckets=[1, 2, 4, 8, 16, 32, 64])
+        serving = ClusterServing(
+            ident, broker=RedisBroker(srv.host, srv.port),
+            stream=e2e_stream, batch_size=64, batch_timeout_ms=2).start()
+        n_e2e = 240
+        e2e = {}
+        for mode in ("per_record", "batched", "streaming"):
+            q = InputQueue(RedisBroker(srv.host, srv.port),
+                           stream=e2e_stream,
+                           pipelined=(mode != "per_record"))
+            t0 = time.perf_counter()
+            if mode == "streaming":
+                with q.stream_session(max_inflight=64) as sess:
+                    for i, x in enumerate(burst[:n_e2e]):
+                        sess.submit(x, uri=f"rp-stream-{i}")
+                    got = sess.drain(timeout_s=300)
+                assert len(got) == n_e2e
+            else:
+                res = q.predict_batch(burst[:n_e2e], timeout_s=600)
+                assert len(res) == n_e2e
+            dt = time.perf_counter() - t0
+            e2e[mode] = {
+                "per_record_ms": round(dt * 1e3 / n_e2e, 3),
+                "rps": round(n_e2e / dt, 1)}
+            q.broker.close()
+        serving.stop()
+        # the per-record e2e floor is TWO round trips (XADD + >= 1
+        # HGET); again the batched modes' amortized wire share is not
+        # subtracted, keeping the reduction ratios conservative
+        e2e_over_sync = max(
+            e2e["per_record"]["per_record_ms"] - 2 * wire_rtt, 0.0)
+        out["e2e"] = {
+            "n": n_e2e, "modes": e2e,
+            "overhead_over_wire_ms": round(e2e_over_sync, 3),
+            "overhead_reduction_batched": round(
+                e2e_over_sync / max(e2e["batched"]["per_record_ms"],
+                                    1e-6), 2),
+            "overhead_reduction_streaming": round(
+                e2e_over_sync / max(e2e["streaming"]["per_record_ms"],
+                                    1e-6), 2),
+        }
+
+        # -- partition scaling: p engines over p partition streams -------
+        total = args.total
+        batch = 8
+        _fn, _W, sample = _md_model(width=256, iters=1024)
+        encoded = encode_ndarray(np.asarray(sample))
+        curve, host_par, reports = {}, {}, []
+        for p in (1, 2, 4):
+            stream = f"serving_stream_rp{p}"
+            pb = RedisBroker(srv.host, srv.port)
+            extra = ("--partitions", str(p),
+                     "--partition-lease-ttl", "1.0")
+            # staggered start: engine 0 warms the shared cache alone
+            procs = _fleet_spawn(1, stream, srv.port, cache_dir, 30.0,
+                                 batch, extra_args=extra)
+            _fleet_wait_ready(pb, stream, procs, 1)
+            if p > 1:
+                procs += _fleet_spawn(p - 1, stream, srv.port,
+                                      cache_dir, 30.0, batch,
+                                      start_idx=1, extra_args=extra)
+                _fleet_wait_ready(pb, stream, procs, p)
+            # this leg's ACTUAL ceiling, probed while engines idle at
+            # the gate (shared hosts swing minute to minute)
+            host_par[str(p)] = _measure_host_parallelism()
+
+            def prefill(count):
+                # routed by the same crc32 the engines partition by,
+                # shipped as chunked multi-XADDs (the leg's producers
+                # run at wire speed too)
+                entries = []
+                for _ in range(count):
+                    uri = uuid.uuid4().hex
+                    entries.append((stream_for(stream, uri, p),
+                                    {"uri": uri,
+                                     "data": {"t": encoded}}))
+                for i in range(0, len(entries), 64):
+                    pb.xadd_many(entries[i:i + 64])
+
+            def drained(count, deadline_s=600.0):
+                key = f"result:{stream}"
+                deadline = time.time() + deadline_s
+                while time.time() < deadline:
+                    if pb.hlen(key) >= count:
+                        break
+                    time.sleep(0.05)
+                return pb.hlen(key)
+
+            # whole backlog lands before the gate opens (the _fleet_main
+            # discipline: measure drain capacity, not the prefill)
+            prefill(total)
+            pb.hset(f"fleet:gate:{stream}", "go", "1")
+            t0 = time.perf_counter()
+            got = drained(total)
+            rate = got / (time.perf_counter() - t0)
+            # best-of-2: round two runs on the rebalanced, warm fleet
+            t0 = time.perf_counter()
+            prefill(total)
+            got2 = drained(2 * total) - total
+            rate = max(rate, got2 / (time.perf_counter() - t0))
+            curve[str(p)] = round(rate, 1)
+            reports += _fleet_reports(procs)
+            pb.close()
+
+        cores = os.cpu_count() or 1
+        hp = max(host_par.values())
+        speedup = curve["4"] / max(curve["1"], 1e-9)
+        ceiling = min(4.0, float(cores), hp)
+        owned = {r.get("engine_id"): r.get("partitions_owned")
+                 for r in reports if "partitions_owned" in r}
+        out.update({
+            "wire_rtt_ms": round(wire_rtt, 3),
+            "partitions_drain_rps": curve,
+            "partition_speedup_1_to_4": round(speedup, 2),
+            "host_cores": cores,
+            "host_effective_parallelism": hp,
+            "host_effective_parallelism_per_leg": host_par,
+            "efficiency_vs_host_ceiling": round(
+                speedup / max(ceiling, 1e-9), 3),
+            "note": ("engine compute is single-threaded by "
+                     "construction, so COMPUTE caps the curve near "
+                     f"{ceiling:g}x here: min(4 partitions, {cores} "
+                     f"host cores, measured {hp:g}x effective host "
+                     "parallelism at bench time). A speedup ABOVE "
+                     "that ceiling means the 1-partition baseline was "
+                     "stream-serialization-bound, not compute-bound: "
+                     "one engine on one stream idles in its own "
+                     "read/writeback round trips, and partitioning "
+                     "recovers that idle time by overlapping "
+                     "independent streams. Real engines on separate "
+                     "hosts scale with the partition count."),
+            "partitions_owned_final": owned or None,
+            "engine_reports": reports,
+        })
+    finally:
+        srv.stop()
+        shutil.rmtree(cache_dir, ignore_errors=True)
     print(json.dumps(out))
     return 0
 
@@ -2155,6 +2428,17 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--slo-latency-ms", type=float, default=0.0,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--request-plane", action="store_true",
+                    help="request-plane mode (ISSUE 16): wire-speed "
+                         "ingest A/B (per-record XADD vs batched "
+                         "multi-XADD vs streaming session, against the "
+                         "measured RESP wire floor) plus the "
+                         "partition-scaling drain curve at 1/2/4 "
+                         "partition streams")
+    ap.add_argument("--partitions", type=int, default=1,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--partition-lease-ttl", type=float, default=5.0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.fleet_child:
         if not (args.broker_url and args.engine_id):
@@ -2163,6 +2447,8 @@ def main():
         return _fleet_child(args)
     if args.engines:
         return _fleet_main(args)
+    if args.request_plane:
+        return _request_plane_main(args)
     if args.chaos_rollout:
         return _chaos_rollout_main(args)
     if args.int8_ab:

@@ -66,6 +66,11 @@ CONTROL_LOOP_FILES = (
     # pace on stop-event waits only — a sleep would hold a paused
     # engine's intake (or a gateway shutdown) hostage for its duration
     os.path.join(SERVING_PKG, "rollout.py"),
+    # the partitioned request plane (ISSUE 16): lease-table polling and
+    # gateway leader election pace on stop-event waits only — a sleep
+    # here delays a lease renewal past its TTL and hands the partition
+    # (or the gateway leadership) to a peer mid-drain
+    os.path.join(SERVING_PKG, "partitions.py"),
 )
 SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 BARE_EXCEPT_RE = re.compile(r"^\s*except\s*:", re.MULTILINE)

@@ -131,6 +131,16 @@ REQUIRED = {
     # either silently blinds the input-stall verdict
     "training_input_wait_ms": "histogram",
     "training_input_bound": "gauge",
+    # partitioned request plane + replicated gateway (ISSUE 16): the
+    # per-partition ownership/churn families the request-plane guide's
+    # runbook and the partition-scaling bench JSON read, plus the
+    # gateway leader-election telemetry — renaming any of these blinds
+    # the takeover audit trail a kill-the-leader drill depends on
+    "serving_partitions_owned": "gauge",
+    "serving_partition_lease_changes_total": "counter",
+    "serving_partition_depth": "gauge",
+    "gateway_role": "gauge",
+    "gateway_leader_changes_total": "counter",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",

@@ -17,9 +17,26 @@
   200 only for a truly standalone frontend) and the
   serving_engines_alive / serving_engines_total families.
 - Fleet config/CLI knob validation.
+
+Request-plane scale-out (ISSUE 16), layered on the above:
+
+- Partition lease table: fair-share acquisition, rebalance on member
+  join, expiry takeover, the resharding meta gate — driven through
+  `poll(now)` with explicit clocks, no sleeps.
+- Gateway leader lease: single election among replicas, expiry
+  takeover, demotion on an overwritten nonce.
+- Chaos legs: a killed engine's partitions AND in-flight records move
+  to a live peer with zero loss and exactly-once commit; a killed
+  leader gateway hands the control plane to the survivor mid-traffic
+  with zero 503s, and a rollout pin POSTed to a FOLLOWER survives the
+  leader's death.
+- Client reconnect: the jittered-backoff retry in InputQueue rides out
+  a MiniRedis stop/restart on the same port (live connections are
+  severed on stop, so the old socket cannot fake liveness).
 """
 
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -36,6 +53,9 @@ from analytics_zoo_tpu.serving.fleet import (FleetTracker,
                                              engines_key)
 from analytics_zoo_tpu.serving.http_frontend import FrontEnd
 from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.partitions import (GatewayLeaderLease,
+                                                  PartitionLeaseTable,
+                                                  partitions_key)
 from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
 from analytics_zoo_tpu.serving.server import GROUP, ClusterServing
 
@@ -163,6 +183,38 @@ class TestRedeliveryConformance:
         a.hset_many("h", {"u1": "r1", "u2": "r2"})
         a.hset("h", "u1", "r1b")                    # overwrite
         assert b.hlen("h") == 2 == len(b.hgetall("h"))
+
+    def test_xadd_many_one_call_spans_partition_streams(self,
+                                                        broker_pair):
+        """The wire-speed ingest op (ISSUE 16): one xadd_many call
+        appends a batch spanning several partition streams, in order,
+        on every transport."""
+        a, b, _ = broker_pair
+        entries = [(f"{STREAM}.p{i % 2}", {"uri": f"u{i}",
+                                           "data": {"v": i}})
+                   for i in range(6)]
+        ids = a.xadd_many(entries)
+        assert len(ids) == 6 and all(ids)
+        assert b.stream_depth(f"{STREAM}.p0") == 3
+        assert b.stream_depth(f"{STREAM}.p1") == 3
+        got = b.read_group(f"{STREAM}.p0", "g", "c", 10, block_ms=50)
+        assert [rec["uri"] for _, rec in got] == ["u0", "u2", "u4"]
+        got = b.read_group(f"{STREAM}.p1", "g", "c", 10, block_ms=50)
+        assert [rec["uri"] for _, rec in got] == ["u1", "u3", "u5"]
+
+    def test_hmget_matches_hget_and_hdel_many_deletes(self,
+                                                      broker_pair):
+        """The fused result-poll pair (ISSUE 16): hmget answers every
+        outstanding field in one round trip (None for missing, like
+        HMGET's nil), hdel_many acknowledges a batch in one more."""
+        a, b, _ = broker_pair
+        a.hset_many("h", {"u1": "r1", "u2": "r2"})
+        assert b.hmget("h", ["u1", "missing", "u2"]) == \
+            ["r1", None, "r2"]
+        assert b.hmget("h", []) == []
+        a.hdel_many("h", ["u1", "u2", "missing"])
+        assert b.hmget("h", ["u1", "u2"]) == [None, None]
+        assert b.hlen("h") == 0
 
 
 def _identity_engine(broker, engine_id=None, registry=None, **kw):
@@ -558,3 +610,420 @@ class TestFleetConfig:
         from analytics_zoo_tpu.serving.cli import main
         with pytest.raises(SystemExit, match="engine-ttl"):
             main(["gateway", "--engine-ttl", "0"])
+
+    def test_partition_params_parse_and_validate(self, tmp_path):
+        cfg = self._load(tmp_path, {"pipelined": "true", "partitions": 4,
+                                    "partition_lease_ttl_s": 2})
+        assert cfg.partitions == 4 and not cfg.reshard
+        assert cfg.partition_lease_ttl_s == 2.0
+        with pytest.raises(ValueError, match="params.partitions"):
+            self._load(tmp_path, {"pipelined": "true", "partitions": 0})
+        # the legacy single-threaded loop reads ONE stream: partitions
+        # need the pipelined engine
+        with pytest.raises(ValueError, match="pipelined"):
+            self._load(tmp_path, {"pipelined": "false", "partitions": 2})
+
+    def test_start_cli_requires_identity_for_partitions(self, tmp_path):
+        cfg_file = tmp_path / "config.yaml"
+        cfg_file.write_text("model:\n  path: /tmp/nope\nparams:\n"
+                            "  pipelined: true\n  partitions: 2\n")
+        from analytics_zoo_tpu.serving.cli import main
+        with pytest.raises(SystemExit, match="engine-id"):
+            main(["start", "--config", str(cfg_file)])
+
+    def test_gateway_cli_rejects_bad_partitions(self):
+        from analytics_zoo_tpu.serving.cli import main
+        with pytest.raises(SystemExit, match="partitions"):
+            main(["gateway", "--partitions", "0"])
+
+
+def _wait(pred, timeout_s=20.0, interval=0.02, msg="condition"):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# Partition lease table (ISSUE 16) — driven with explicit clocks
+# ---------------------------------------------------------------------------
+class TestPartitionLeases:
+    def _table(self, broker, owner, partitions=2, ttl_s=5.0,
+               registry=None):
+        return PartitionLeaseTable(broker, STREAM, partitions,
+                                   owner=owner, ttl_s=ttl_s,
+                                   registry=registry or MetricsRegistry())
+
+    def test_lone_engine_owns_every_partition(self):
+        broker = MemoryBroker()
+        t = self._table(broker, "eA", partitions=4)
+        assert t.poll(now=0.0) == [0, 1, 2, 3]
+        assert t.owned_streams() == [f"{STREAM}.p{i}" for i in range(4)]
+        # renewals keep ownership (content change is the heartbeat)
+        assert t.poll(now=1.0) == [0, 1, 2, 3]
+
+    def test_member_join_rebalances_to_fair_share(self):
+        broker = MemoryBroker()
+        a = self._table(broker, "eA")
+        b = self._table(broker, "eB")
+        assert a.poll(now=0.0) == [0, 1]
+        # B joins: nothing claimable yet (A's leases are live), but its
+        # member row is now visible
+        assert b.poll(now=0.0) == []
+        # A's next pass sees two members -> fair share 1 -> sheds its
+        # HIGHEST partition (deterministic steady state)
+        assert a.poll(now=0.1) == [0]
+        # the shed lease was deleted, so B claims it immediately
+        assert b.poll(now=0.2) == [1]
+        assert a.poll(now=0.3) == [0]     # stable: nobody flaps
+
+    def test_expiry_takeover_after_silence(self):
+        broker = MemoryBroker()
+        reg_b = MetricsRegistry()
+        a = self._table(broker, "eA", ttl_s=5.0)
+        b = self._table(broker, "eB", ttl_s=5.0, registry=reg_b)
+        assert a.poll(now=0.0) == [0, 1]
+        a.abandon()                       # SIGKILL analogue: rows stay
+        # B's first look starts the age clocks; nothing claimable yet
+        assert b.poll(now=0.0) == []
+        # past the ttl on B's OWN clock: leases and A's membership have
+        # both gone silent -> B takes over everything
+        assert b.poll(now=51.0) == [0, 1]
+        fam = reg_b.get("serving_partition_lease_changes_total")
+        assert fam.value(event="takeover", partition="0") == 1
+        assert fam.value(event="takeover", partition="1") == 1
+
+    def test_clean_release_hands_over_immediately(self):
+        broker = MemoryBroker()
+        a = self._table(broker, "eA")
+        assert a.poll(now=0.0) == [0, 1]
+        a.release()
+        # no ttl wait: the rows are GONE, a peer claims on first pass
+        b = self._table(broker, "eB")
+        assert b.poll(now=0.0) == [0, 1]
+
+    def test_reshard_gate_refuses_a_count_change(self):
+        broker = MemoryBroker()
+        a = self._table(broker, "eA", partitions=2)
+        a.ensure_meta()
+        a.poll(now=0.0)
+        b = self._table(broker, "eB", partitions=3)
+        with pytest.raises(ValueError, match="reshard"):
+            b.ensure_meta()
+        # the explicit flag rewrites the meta AND clears stale leases
+        assert b.ensure_meta(reshard=True) == 3
+        key = partitions_key(STREAM)
+        assert broker.hget(key, "p0") is None
+        assert json.loads(broker.hget(key, "meta"))["partitions"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Gateway leader lease (ISSUE 16)
+# ---------------------------------------------------------------------------
+class TestGatewayLeaderLease:
+    def _lease(self, broker, gid, ttl_s=1.0, registry=None):
+        return GatewayLeaderLease(broker, STREAM, gid, ttl_s=ttl_s,
+                                  registry=registry or MetricsRegistry())
+
+    def test_single_election_among_replicas(self):
+        broker = MemoryBroker()
+        g1 = self._lease(broker, "gw1")
+        g2 = self._lease(broker, "gw2")
+        assert g1.poll(now=0.0) and g1.is_leader()
+        assert not g2.poll(now=0.0) and not g2.is_leader()
+        assert g2.leader() == "gw1"
+        # a healthy (renewing) leader is never displaced
+        assert g1.poll(now=0.5)
+        assert not g2.poll(now=0.6)
+
+    def test_expiry_takeover_and_demotion(self):
+        broker = MemoryBroker()
+        reg2 = MetricsRegistry()
+        g1 = self._lease(broker, "gw1", ttl_s=1.0)
+        g2 = self._lease(broker, "gw2", ttl_s=1.0, registry=reg2)
+        assert g1.poll(now=0.0)
+        assert not g2.poll(now=0.0)       # age clock starts here
+        # gw1 dies (never polls again): past the ttl on gw2's clock the
+        # row has made no progress -> gw2 elects itself
+        assert g2.poll(now=1.5) and g2.leader() == "gw2"
+        assert reg2.get("gateway_leader_changes_total") \
+            .value(event="elected") == 1
+        # a resurrected gw1 observes the overwritten nonce and demotes
+        assert not g1.poll(now=2.0) and not g1.is_leader()
+
+    def test_clean_release_frees_the_row(self):
+        broker = MemoryBroker()
+        g1 = self._lease(broker, "gw1")
+        assert g1.poll(now=0.0)
+        g1.stop(release=True)
+        g2 = self._lease(broker, "gw2")
+        assert g2.poll(now=0.0)           # no ttl wait on a clean exit
+
+    def test_validation(self):
+        broker = MemoryBroker()
+        with pytest.raises(ValueError, match="gateway_id"):
+            GatewayLeaderLease(broker, STREAM, "",
+                               registry=MetricsRegistry())
+        with pytest.raises(ValueError, match="ttl_s"):
+            GatewayLeaderLease(broker, STREAM, "gw", ttl_s=0,
+                               registry=MetricsRegistry())
+
+
+# ---------------------------------------------------------------------------
+# Chaos: partition takeover mid-drain (ISSUE 16)
+# ---------------------------------------------------------------------------
+class TestPartitionChaos:
+    def test_killed_engine_partitions_and_records_move_over(self):
+        """SIGKILL analogue mid-drain: the dead engine's partition
+        leases expire to a live peer, its in-flight (delivered,
+        unacked) records redeliver through the claim sweep, and every
+        accepted record is committed EXACTLY once across both engines
+        (the served counters only count new result fields)."""
+        broker = MemoryBroker(redeliver_after_s=60.0)
+        knobs = dict(partitions=2, partition_lease_ttl_s=0.4,
+                     claim_min_idle_s=0.1, claim_interval_s=0.05,
+                     heartbeat_interval_s=0.05)
+        reg_b = MetricsRegistry()
+        ea = _identity_engine(broker, engine_id="eA", **knobs).start()
+        eb = None
+        try:
+            _wait(lambda: ea.lease_table.owned() == [0, 1],
+                  msg="eA owning both partitions")
+            inq = InputQueue(broker, partitions=2)
+            for i in range(6):
+                inq.enqueue(uri=f"live{i}",
+                            t=np.full(3, float(i), np.float32))
+            res = _wait_results(broker, 6)
+            assert sorted(res) == sorted(f"live{i}" for i in range(6))
+
+            ea.kill()    # stops everything, acks/releases NOTHING
+            # records enqueued after the crash, then delivered into the
+            # dead engine's PEL (in-flight at the moment of death)
+            uris = [f"dead{i}" for i in range(12)]
+            for i, uri in enumerate(uris):
+                inq.enqueue(uri=uri, t=np.full(3, float(i), np.float32))
+            dead0 = broker.read_group(f"{STREAM}.p0", GROUP, "eA", 100,
+                                      block_ms=50)
+            dead1 = broker.read_group(f"{STREAM}.p1", GROUP, "eA", 100,
+                                      block_ms=50)
+            assert len(dead0) + len(dead1) == 12
+            assert dead0 and dead1, "uris must span both partitions"
+
+            eb = _identity_engine(broker, engine_id="eB",
+                                  registry=reg_b, **knobs).start()
+            res = _wait_results(broker, 18)
+            assert sorted(res) == sorted(
+                [f"live{i}" for i in range(6)] + uris)
+            _wait(lambda: eb.lease_table.owned() == [0, 1],
+                  msg="eB taking over both partitions")
+            fam = reg_b.get("serving_partition_lease_changes_total")
+            assert fam.value(event="takeover", partition="0") == 1
+            assert fam.value(event="takeover", partition="1") == 1
+            # exactly-once commit: served counters only increment on
+            # NEW result fields, so dup commits would overshoot 18
+            _wait(lambda: ea.records_served + eb.records_served == 18,
+                  msg="served counters converging")
+            # nothing left in either partition's PEL
+            _wait(lambda: broker.pending_count(f"{STREAM}.p0", GROUP)
+                  + broker.pending_count(f"{STREAM}.p1", GROUP) == 0,
+                  msg="empty PELs")
+        finally:
+            if eb is not None:
+                eb.stop()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: kill the leader gateway (ISSUE 16)
+# ---------------------------------------------------------------------------
+class TestGatewayReplicationChaos:
+    @staticmethod
+    def _get(url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read())
+
+    @staticmethod
+    def _predict(port, values):
+        body = json.dumps({"instances": [values]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=body)
+        try:
+            with urllib.request.urlopen(req, timeout=15) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e)
+
+    def test_kill_leader_mid_traffic_survivor_serves_and_leads(self):
+        """Two gateway replicas over one fleet: kill the leader without
+        releasing its lease (SIGKILL analogue) while traffic flows.
+        The survivor must answer EVERY request correctly throughout the
+        handover (zero 503s, zero accepted-record loss — a 200 carries
+        the prediction, so acceptance IS the answer) and inherit the
+        leader role within ~one ttl."""
+        broker = MemoryBroker()
+        s = _identity_engine(broker, engine_id="e1",
+                             heartbeat_interval_s=0.05).start()
+        regs = [MetricsRegistry(), MetricsRegistry()]
+        fes = [FrontEnd(broker, None, host="127.0.0.1", port=0,
+                        timeout_s=15, fleet_stream=STREAM,
+                        engine_ttl_s=2.0, gateway_id=f"gw-{i}",
+                        leader_ttl_s=0.4, registry=regs[i]).start()
+               for i in range(2)]
+        live = list(fes)
+        try:
+            _wait(lambda: sum(fe.is_leader() for fe in fes) == 1,
+                  msg="exactly one elected leader")
+            _wait(lambda: self._get(
+                f"http://127.0.0.1:{fes[0].port}/healthz")[0] == 200,
+                msg="fleet visible through the gateway")
+            # both replicas serve reads AND predictions
+            for fe in fes:
+                code, body = self._predict(fe.port, [1.0, 2.0, 3.0])
+                assert code == 200
+                assert body["predictions"] == [[2.0, 4.0, 6.0]]
+                code, health = self._get(
+                    f"http://127.0.0.1:{fe.port}/healthz")
+                gw = health["gateway"]
+                assert gw["id"] == fe.gateway_id
+                assert gw["role"] == ("leader" if fe.is_leader()
+                                      else "follower")
+            leader = next(fe for fe in fes if fe.is_leader())
+            survivor = next(fe for fe in fes if fe is not leader)
+            leader.stop(release_lease=False)      # SIGKILL analogue
+            live.remove(leader)
+            # mid-handover traffic through the survivor: all 200s
+            deadline = time.time() + 1.5
+            n = 0
+            while time.time() < deadline:
+                code, body = self._predict(survivor.port, [float(n)])
+                assert code == 200, f"survivor answered {code}: {body}"
+                assert body["predictions"] == [[2.0 * n]]
+                n += 1
+            assert n > 0
+            _wait(lambda: survivor.is_leader(),
+                  msg="survivor inheriting the leader lease")
+            code, health = self._get(
+                f"http://127.0.0.1:{survivor.port}/healthz")
+            assert health["gateway"]["role"] == "leader"
+            assert health["gateway"]["leader"] == survivor.gateway_id
+            reg = regs[fes.index(survivor)]
+            assert reg.get("gateway_leader_changes_total") \
+                .value(event="elected") >= 1
+        finally:
+            for fe in live:
+                fe.stop()
+            s.stop()
+
+    def test_rollout_pin_survives_leader_kill(self, tmp_path):
+        """The operator pins a version through a FOLLOWER replica; the
+        pin persists in the broker control hash, the leader's tick
+        adopts it, and when the leader dies mid-campaign the newly
+        elected replica resumes the SAME campaign from broker state."""
+        from analytics_zoo_tpu.learn import checkpoint as ckpt
+        from analytics_zoo_tpu.serving.rollout import (RolloutController,
+                                                       rollout_key)
+        broker = MemoryBroker()
+        mgr = ckpt.CheckpointManager(str(tmp_path), keep=10)
+        for version, scale in ((1, 2.0), (2, 3.0)):
+            mgr.save(version, {"w": np.asarray(scale, np.float32)})
+            ckpt.write_publish_marker(mgr.run_dir, version)
+
+        def beat(version):
+            broker.hset(engines_key(STREAM), "e0", json.dumps(
+                {"engine_id": "e0", "ts": time.time(), "ready": True,
+                 "model_version": version}))
+
+        def tracker():
+            return FleetTracker(broker, STREAM, ttl_s=30.0,
+                                registry=MetricsRegistry(),
+                                poll_min_interval_s=0.0)
+
+        beat(2)                            # fleet already on newest v2
+        l1 = GatewayLeaderLease(broker, STREAM, "gw1", ttl_s=0.5,
+                                registry=MetricsRegistry())
+        l2 = GatewayLeaderLease(broker, STREAM, "gw2", ttl_s=0.5,
+                                registry=MetricsRegistry())
+        assert l1.poll(now=0.0)
+        assert not l2.poll(now=0.0)
+        mk = lambda lease: RolloutController(  # noqa: E731
+            broker, STREAM, str(tmp_path), tracker(),
+            poll_interval_s=0.5, engine_timeout_s=30.0,
+            leader_fn=lease.is_leader, registry=MetricsRegistry())
+        c1, c2 = mk(l1), mk(l2)
+        key = rollout_key(STREAM)
+        # leader idles: the fleet is already on the newest version
+        assert c1.tick(now=0.0) is None
+        # operator rolls BACK to v1 through the follower: the pin lands
+        # in the control hash but the follower itself never directs
+        status = c2.request(version=1)
+        assert status["pinned_version"] == 1
+        assert json.loads(broker.hget(key, "pin")) == 1
+        assert broker.hget(key, "directive") is None
+        # the leader's next tick adopts the cross-replica pin
+        assert c1.tick(now=1.0) == "direct"
+        d = json.loads(broker.hget(key, "directive"))
+        assert d["target"] == "e0" and d["version"] == 1
+        # leader dies mid-campaign (row just stops progressing)
+        l1.stop(release=False)
+        assert not c1.leader_fn()
+        assert l2.poll(now=2.0), "survivor must inherit the lease"
+        # the new leader re-derives the campaign: same pin, same target
+        assert c2.tick(now=3.0) == "direct"
+        d = json.loads(broker.hget(key, "directive"))
+        assert d["target"] == "e0" and d["version"] == 1
+        beat(1)                            # the engine converts
+        assert c2.tick(now=4.0) == "converged"
+        assert c2.state == "idle" and c2.active_version == 1
+        assert broker.hget(key, "directive") is None
+        # the pin is STICKY across the whole handover
+        assert json.loads(broker.hget(key, "pin")) == 1
+
+
+# ---------------------------------------------------------------------------
+# Client reconnect across a broker restart (ISSUE 16)
+# ---------------------------------------------------------------------------
+class TestClientReconnect:
+    def test_stop_severs_live_connections(self):
+        """A 'restarted' broker whose old sockets keep answering from
+        the old process would make reconnect tests a lie: stop() must
+        kill live connections, and the raw (retry-less) broker then
+        redials lazily on the NEXT call."""
+        srv = MiniRedisServer().start()
+        port, store = srv.port, srv.store
+        raw = RedisBroker(srv.host, port)
+        raw.hset("h", "f", "v")            # connection established
+        srv.stop()
+        srv2 = MiniRedisServer(port=port, store=store).start()
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                raw.hget("h", "f")         # severed socket surfaces
+            assert raw.hget("h", "f") == "v"   # lazy redial, same store
+        finally:
+            srv2.stop()
+
+    def test_input_queue_rides_out_a_broker_restart(self):
+        """The jittered-backoff retry (client.py `_Reconnecting`): an
+        enqueue issued while the broker is DOWN blocks through backoff
+        and lands once the broker returns on the same port with the
+        same store."""
+        srv = MiniRedisServer().start()
+        port, store = srv.port, srv.store
+        inq = InputQueue(RedisBroker(srv.host, port))
+        assert inq.enqueue(uri="r0", t=np.ones(3, np.float32)) == "r0"
+        srv.stop()
+        landed = []
+        t = threading.Thread(
+            target=lambda: landed.append(
+                inq.enqueue(uri="r1", t=np.ones(3, np.float32))),
+            daemon=True)
+        t.start()
+        time.sleep(0.3)                    # outage window mid-backoff
+        srv2 = MiniRedisServer(port=port, store=store).start()
+        try:
+            t.join(timeout=15)
+            assert landed == ["r1"], "enqueue did not survive restart"
+            poll = RedisBroker("127.0.0.1", port)
+            assert poll.stream_depth(STREAM) == 2
+        finally:
+            srv2.stop()
