@@ -47,7 +47,9 @@ from analytics_zoo_tpu.observability.roofline import (ExecCost,
                                                       set_session_roofline)
 from analytics_zoo_tpu.observability.slo import SLOObjectives, SLOTracker
 from analytics_zoo_tpu.observability.tracing import (Span, Tracer,
-                                                     span_coverage)
+                                                     span_coverage,
+                                                     span_from_dict,
+                                                     span_to_dict)
 
 __all__ = [
     "CONTENT_TYPE", "CaptureActiveError", "Counter", "DeviceMemoryLeak",
@@ -57,5 +59,6 @@ __all__ = [
     "Span", "StackSampler", "Tracer", "cost_of", "device_memory_snapshot",
     "digest", "get_accountant", "get_registry", "leak_check",
     "load_trace_events", "render_prometheus", "session_roofline",
-    "set_session_roofline", "span_coverage",
+    "set_session_roofline", "span_coverage", "span_from_dict",
+    "span_to_dict",
 ]

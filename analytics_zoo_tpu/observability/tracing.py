@@ -109,15 +109,34 @@ class _ScopedSpan:
 class Tracer:
     """Bounded span collector. `max_spans` caps memory: a serving
     process tracing forever keeps the most recent window (the Chrome
-    JSON is a debugging view, not an archive)."""
+    JSON is a debugging view, not an archive).
 
-    def __init__(self, max_spans: int = 20000):
+    `engine` names the producing process (engine id / gateway id) and
+    namespaces the Chrome-trace `tid` as ``engine:thread`` so merged
+    multi-process views never interleave unrelated stages onto one row.
+    `registry` mirrors ring overflow into
+    `observability_spans_dropped_total` so an unscraped long-running
+    engine's span loss is visible on a scrape, not only in `.dropped`.
+    `add_sink(fn)` registers a callable invoked with every finished span
+    (the fleet span exporter taps the flow here); sink errors are
+    swallowed — telemetry must never fail the serving path."""
+
+    def __init__(self, max_spans: int = 20000,
+                 registry=None, engine: Optional[str] = None):
         self._spans: "collections.deque[Span]" = collections.deque(
             maxlen=max_spans)
         self._lock = threading.Lock()
         self._local = threading.local()
         self.epoch = time.perf_counter()
         self.dropped = 0
+        self.engine = engine
+        self._sinks: List[Any] = []
+        self._dropped_counter = None
+        if registry is not None:
+            self._dropped_counter = registry.counter(
+                "observability_spans_dropped_total",
+                "finished spans evicted from the tracer's bounded ring "
+                "(the trace window is smaller than the traffic it saw)")
 
     def _stack(self) -> List[_ScopedSpan]:
         stack = getattr(self._local, "stack", None)
@@ -125,11 +144,29 @@ class Tracer:
             stack = self._local.stack = []
         return stack
 
+    def add_sink(self, fn) -> None:
+        """Register `fn(span)` to observe every finished span."""
+        self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        try:
+            self._sinks.remove(fn)
+        except ValueError:
+            pass
+
     def _emit(self, span: Span):
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
+                if self._dropped_counter is not None:
+                    labels = {"engine": self.engine} if self.engine else {}
+                    self._dropped_counter.inc(**labels)
             self._spans.append(span)
+        for sink in self._sinks:
+            try:
+                sink(span)
+            except Exception:  # noqa: BLE001 — a broken exporter must
+                pass           # never fail the traced code path
 
     # -- producing ---------------------------------------------------------
     def span(self, name: str, trace_id: Optional[str] = None,
@@ -188,7 +225,8 @@ class Tracer:
                 "ts": round((s.start - self.epoch) * 1e6, 3),
                 "dur": round(s.duration * 1e6, 3),
                 "pid": pid,
-                "tid": s.tid,
+                "tid": (f"{self.engine}:{s.tid}" if self.engine
+                        else s.tid),
                 "args": args,
             })
         events.sort(key=lambda e: e["ts"])
@@ -199,6 +237,36 @@ class Tracer:
         with open(path, "w") as fh:
             json.dump(self.chrome_trace(trace_id), fh)
         return path
+
+
+def span_to_dict(span: Span, epoch: float = 0.0) -> Dict[str, Any]:
+    """Wire form of a span: start rebased to `epoch` (the producing
+    tracer's epoch, so exported times are process-relative seconds),
+    empty fields omitted. Inverse of `span_from_dict`."""
+    d: Dict[str, Any] = {"name": span.name, "cat": span.cat,
+                         "s": round(span.start - epoch, 9),
+                         "d": round(span.duration, 9)}
+    if span.trace_id is not None:
+        d["id"] = span.trace_id
+    if span.trace_ids:
+        d["ids"] = list(span.trace_ids)
+    if span.tid:
+        d["tid"] = span.tid
+    if span.parent is not None:
+        d["parent"] = span.parent
+    if span.args:
+        d["args"] = span.args
+    return d
+
+
+def span_from_dict(d: Dict[str, Any]) -> Span:
+    ids = d.get("ids")
+    return Span(d.get("name", ""), d.get("cat", "serving"),
+                float(d.get("s", 0.0)), float(d.get("d", 0.0)),
+                trace_id=d.get("id"),
+                trace_ids=tuple(ids) if ids else None,
+                tid=d.get("tid", ""), parent=d.get("parent"),
+                args=d.get("args"))
 
 
 def span_coverage(spans: Iterable[Span], start: float, end: float) -> float:

@@ -91,7 +91,13 @@ def cmd_start(args) -> int:
             # partitioned request plane (ISSUE 16): /predict enqueues
             # hash-route across the same partition streams the engines
             # lease
-            partitions=cfg.partitions).start()
+            partitions=cfg.partitions,
+            # fleet trace plane (ISSUE 17): /trace/<request_id> serves
+            # merged cross-process timelines; trace_sample>0 also stamps
+            # trace context on enqueued records
+            trace_sample=cfg.trace_sample,
+            trace_buffer_spans=cfg.trace_buffer_spans,
+            trace_export_interval_s=cfg.trace_export_interval_s).start()
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     model = cfg.build_model(broker=broker)
@@ -132,9 +138,10 @@ def cmd_start(args) -> int:
                   f"compiled fresh ({s['entries']} entries, "
                   f"{s['bytes']} bytes in {s['path']})", flush=True)
     tracer = None
-    if cfg.trace or cfg.trace_path:
-        from analytics_zoo_tpu.observability import Tracer
-        tracer = Tracer()
+    if cfg.trace or cfg.trace_path or cfg.trace_sample > 0:
+        from analytics_zoo_tpu.observability import Tracer, get_registry
+        tracer = Tracer(max_spans=cfg.trace_buffer_spans,
+                        registry=get_registry())
     serving = ClusterServing(model, broker, stream=cfg.stream,
                              batch_size=cfg.batch_size,
                              batch_timeout_ms=cfg.batch_timeout_ms,
@@ -166,7 +173,13 @@ def cmd_start(args) -> int:
                              partitions=cfg.partitions,
                              reshard=cfg.reshard,
                              partition_lease_ttl_s=cfg
-                             .partition_lease_ttl_s).start()
+                             .partition_lease_ttl_s,
+                             trace_sample=cfg.trace_sample,
+                             trace_buffer_spans=cfg.trace_buffer_spans,
+                             trace_export_interval_s=cfg
+                             .trace_export_interval_s,
+                             fleet_metrics_interval_s=cfg
+                             .fleet_metrics_interval_s).start()
     if cfg.partitions > 1:
         print(f"partitioned request plane: {cfg.partitions} partition "
               f"streams, lease ttl {cfg.partition_lease_ttl_s:g}s "
@@ -187,6 +200,11 @@ def cmd_start(args) -> int:
     if engine_id:
         print(f"engine id {engine_id} (fleet member; claim window "
               f"{cfg.claim_min_idle_s:g}s)", flush=True)
+    if cfg.trace_sample > 0:
+        print(f"fleet trace plane: sampling {cfg.trace_sample:g} of "
+              f"requests (export every "
+              f"{cfg.trace_export_interval_s:g}s, span ring "
+              f"{cfg.trace_buffer_spans})", flush=True)
     rollout_agent = None
     if cfg.rollout_model_dir:
         # versioned rollout (ISSUE 14): this engine follows the
@@ -310,6 +328,8 @@ def cmd_gateway(args) -> int:
         import os as _os
         import uuid as _uuid
         gateway_id = f"gateway-{_os.getpid()}-{_uuid.uuid4().hex[:6]}"
+    trace_sample = args.trace_sample if args.trace_sample is not None \
+        else (engine_cfg.trace_sample if engine_cfg else 0.0)
     frontend = FrontEnd(
         broker, None, host=args.host,
         port=args.port, fleet_stream=args.stream,
@@ -319,10 +339,19 @@ def cmd_gateway(args) -> int:
         admission_header=admission_header,
         partitions=partitions,
         gateway_id=gateway_id,
-        leader_ttl_s=args.leader_ttl).start()
+        leader_ttl_s=args.leader_ttl,
+        trace_sample=trace_sample,
+        trace_buffer_spans=(engine_cfg.trace_buffer_spans
+                            if engine_cfg else 20000),
+        trace_export_interval_s=(engine_cfg.trace_export_interval_s
+                                 if engine_cfg else 0.5)).start()
     print(f"fleet gateway on :{frontend.port} "
           f"(stream {args.stream}, engine ttl {args.engine_ttl:g}s)",
           flush=True)
+    if trace_sample > 0:
+        print(f"fleet trace plane: sampling {trace_sample:g} of "
+              "requests; GET /trace/<request_id> serves merged "
+              "cross-process timelines", flush=True)
     if gateway_id:
         print(f"gateway replica {gateway_id} (leader lease ttl "
               f"{args.leader_ttl:g}s; control loops act only while "
@@ -573,6 +602,12 @@ def main(argv=None) -> int:
     pg.add_argument("--leader-ttl", type=float, default=3.0,
                     help="seconds without a renewal before the gateway "
                          "leader lease is up for takeover")
+    pg.add_argument("--trace-sample", type=float, default=None,
+                    help="fleet trace plane (ISSUE 17): head-sampling "
+                         "rate in [0, 1] for cross-process request "
+                         "traces (default: the engine config's "
+                         "params.trace_sample, else 0 = off); "
+                         "GET /trace/<request_id> works regardless")
     pg.set_defaults(fn=cmd_gateway)
     pb = sub.add_parser("broker", help="run a standalone TCP broker")
     pb.add_argument("--host", default="0.0.0.0")

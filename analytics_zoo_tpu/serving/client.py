@@ -80,20 +80,39 @@ class InputQueue(_Reconnecting):
     def __init__(self, broker: Union[Broker, str, None] = None,
                  stream: str = STREAM, partitions: int = 1,
                  pipelined: bool = True,
-                 reconnect_attempts: int = 8):
+                 reconnect_attempts: int = 8,
+                 trace_sample: float = 0.0,
+                 trace_parent: Optional[str] = None):
         """`partitions` must match the serving fleet's count — both
         sides compute the same uri hash, so a mismatch strands records
         on streams nobody reads (the engine's lease-table meta guard
         exists to catch exactly that drift at engine startup).
         `pipelined=False` restores the per-record XADD + per-uri HGET
         wire pattern — kept ONLY as the bench_serving ingest A/B
-        baseline."""
+        baseline.
+
+        `trace_sample` > 0 turns on trace-context propagation (ISSUE
+        17): every record is stamped with its ingest wall timestamp
+        (the record uri IS the trace id), so engines can continue the
+        trace with a "wire" span and export it for fleet assembly.
+        Sampling itself is decided deterministically from the uri in
+        every process — the stamp carries context, not the decision.
+        `trace_parent` names the span the engine-side trace should hang
+        under (the gateway sets "gateway_request")."""
         super().__init__(reconnect_attempts=reconnect_attempts)
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self.stream = stream
         self.partitions = validate_partitions(partitions)
         self.pipelined = pipelined
+        if not 0.0 <= float(trace_sample) <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in [0, 1], got {trace_sample}")
+        self.trace_sample = float(trace_sample)
+        self.trace_parent = trace_parent
+        # per-hop engine timing summaries from the most recent
+        # predict_batch (uri -> hop dict), populated by the OutputQueue
+        self.last_hops: Dict[str, Dict] = {}
 
     def _record(self, uri: Optional[str], tier: Optional[str],
                 data: Dict) -> tuple:
@@ -109,6 +128,11 @@ class InputQueue(_Reconnecting):
         record = {"uri": uri, "data": payload}
         if tier is not None:
             record["tier"] = str(tier)
+        if self.trace_sample > 0:
+            ctx: Dict = {"ts": time.time()}
+            if self.trace_parent:
+                ctx["parent"] = self.trace_parent
+            record["trace"] = ctx
         return uri, stream_for(self.stream, uri, self.partitions), record
 
     def enqueue(self, uri: Optional[str] = None, tier: Optional[str] = None,
@@ -152,13 +176,16 @@ class InputQueue(_Reconnecting):
         return encode_ndarray(arr.astype(np.float32))
 
     def predict(self, data: np.ndarray, timeout_s: float = 30.0,
-                tier: Optional[str] = None) -> np.ndarray:
+                tier: Optional[str] = None,
+                uri: Optional[str] = None) -> np.ndarray:
         """Sync path (`client.py:199`): enqueue then poll the result."""
         return self.predict_batch([np.asarray(data)], timeout_s,
-                                  tier=tier)[0]
+                                  tier=tier,
+                                  uris=[uri] if uri else None)[0]
 
     def predict_batch(self, samples, timeout_s: float = 30.0,
-                      tier: Optional[str] = None) -> list:
+                      tier: Optional[str] = None,
+                      uris: Optional[List[str]] = None) -> list:
         """Sync multi-record path: each sample is ONE serving record (the
         per-instance contract of the reference frontend — records batch up
         inside the serving loop, not inside one record). Results return in
@@ -179,10 +206,11 @@ class InputQueue(_Reconnecting):
         out = OutputQueue(self.broker, self.stream,
                           reconnect_attempts=self.reconnect_attempts)
         if self.pipelined:
-            uris = self.enqueue_batch(samples, tier=tier)
+            uris = self.enqueue_batch(samples, tier=tier, uris=uris)
         else:
-            uris = [self.enqueue(None, tier=tier, t=np.asarray(s))
-                    for s in samples]
+            uris = [self.enqueue(uris[i] if uris else None, tier=tier,
+                                 t=np.asarray(s))
+                    for i, s in enumerate(samples)]
         results: dict = {}
         backoff = 0.001
         while len(results) < len(uris):
@@ -215,6 +243,7 @@ class InputQueue(_Reconnecting):
             raise TimeoutError(
                 f"No prediction for {len(missing)}/{len(uris)} records "
                 f"within {timeout_s}s")
+        self.last_hops = dict(out.last_hops)
         return [results[u] for u in uris]
 
     def stream_session(self, max_inflight: int = 256) -> "StreamingSession":
@@ -309,12 +338,20 @@ class StreamingSession:
 
 
 class OutputQueue(_Reconnecting):
+    _MAX_HOPS = 1024
+
     def __init__(self, broker: Union[Broker, str, None] = None,
                  stream: str = STREAM, reconnect_attempts: int = 8):
         super().__init__(reconnect_attempts=reconnect_attempts)
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self.result_key = f"result:{stream}"
+        # per-hop engine timing summaries (ISSUE 17): when tracing is
+        # on, each writeback row carries a compact "hops" dict —
+        # stripped from the decoded result and kept here (bounded,
+        # most-recent window) so the client can attribute its own e2e
+        # latency: e2e minus hops["engine_ms"] = wire + broker time
+        self.last_hops: Dict[str, Dict] = {}
 
     def query(self, uri: str, delete: bool = False):
         raw = self._call(self.broker.hget, self.result_key, uri)
@@ -322,7 +359,7 @@ class OutputQueue(_Reconnecting):
             return None
         if delete:
             self._call(self.broker.hdel, self.result_key, uri)
-        return self._decode(raw)
+        return self._decode(raw, uri=uri)
 
     def query_many(self, uris, delete: bool = False,
                    deadline: Optional[float] = None) -> Dict[str, object]:
@@ -339,7 +376,7 @@ class OutputQueue(_Reconnecting):
         if delete and found:
             self._call(self.broker.hdel_many, self.result_key,
                        list(found), deadline=deadline)
-        return {u: self._decode(raw) for u, raw in found.items()}
+        return {u: self._decode(raw, uri=u) for u, raw in found.items()}
 
     def dequeue(self) -> Dict[str, np.ndarray]:
         """Drain all results (`client.py:203` semantics): one read plus
@@ -347,17 +384,23 @@ class OutputQueue(_Reconnecting):
         allr = self._call(self.broker.hgetall, self.result_key)
         out = {}
         for uri, raw in allr.items():
-            out[uri] = self._decode(raw)
+            out[uri] = self._decode(raw, uri=uri)
         if allr:
             self._call(self.broker.hdel_many, self.result_key, list(allr))
         return out
 
-    @staticmethod
-    def _decode(raw: str):
+    def _decode(self, raw: str, uri: Optional[str] = None):
         if raw == "NaN":   # per-record failure marker
             return float("nan")
         if raw == "SHED":  # admission shed (ISSUE 11): an answered
             return raw     # rejection — distinguishable from a failure
         if raw.startswith("["):  # filtered result string, e.g. topN(5)
             return raw
-        return decode_ndarray(json.loads(raw))
+        blob = json.loads(raw)
+        if isinstance(blob, dict) and "hops" in blob:
+            hops = blob.pop("hops")
+            if uri is not None and isinstance(hops, dict):
+                if len(self.last_hops) >= self._MAX_HOPS:
+                    self.last_hops.pop(next(iter(self.last_hops)))
+                self.last_hops[uri] = hops
+        return decode_ndarray(blob)

@@ -177,6 +177,20 @@ class ServingConfig:
     # dumps Chrome trace JSON (Perfetto-viewable) on shutdown
     trace: bool = False
     trace_path: Optional[str] = None
+    # fleet observability plane (ISSUE 17): trace_sample > 0 turns on
+    # cross-process span export — clients/gateways stamp trace context
+    # on every record, engines continue the trace per stage and publish
+    # head-sampled spans (plus force-sampled failures/SLO violations)
+    # into the traces:<stream> broker hash every
+    # trace_export_interval_s; trace_buffer_spans bounds the local span
+    # ring (overflow counted in observability_spans_dropped_total).
+    # fleet_metrics_interval_s paces each engine's registry snapshot
+    # into the metrics:<stream> hash for gateway-aggregated /metrics
+    # (0 disables publishing).
+    trace_sample: float = 0.0
+    trace_buffer_spans: int = 20000
+    trace_export_interval_s: float = 0.5
+    fleet_metrics_interval_s: float = 2.0
     # SLO objectives (ISSUE 6, `observability/slo.py`): a params.slo
     # block — latency_ms (target at latency_quantile), availability
     # (non-degraded fraction), window_s. Evaluated by the engine's
@@ -350,6 +364,14 @@ class ServingConfig:
         cfg.warmup_dtype = str(params.get("warmup_dtype", "float32"))
         cfg.trace = bool(params.get("trace", False))
         cfg.trace_path = params.get("trace_path")
+        cfg.trace_sample = float(params.get("trace_sample", 0.0))
+        cfg.trace_buffer_spans = int(
+            params.get("trace_buffer_spans", 20000))
+        cfg.trace_export_interval_s = float(
+            params.get("trace_export_interval_s", 0.5))
+        cfg.fleet_metrics_interval_s = float(
+            params.get("fleet_metrics_interval_s", 2.0))
+        cfg._validate_observability()
         slo = params.get("slo", {}) or {}
         if not isinstance(slo, dict):
             raise ValueError(
@@ -503,6 +525,28 @@ class ServingConfig:
         # engine_id is NOT required here: the fleet identity usually
         # arrives as the CLI --engine-id override — cmd_start enforces
         # the pairing after overrides land
+
+    def _validate_observability(self):
+        """Trace-plane knobs fail at config load like the rest (ISSUE
+        17): a sampling rate outside [0, 1] or a non-positive buffer /
+        cadence is an operator error, not an exporter-thread surprise."""
+        if not 0.0 <= self.trace_sample <= 1.0:
+            raise ValueError(
+                f"params.trace_sample={self.trace_sample:g} must be in "
+                "[0, 1] (the head-sampling rate)")
+        if self.trace_buffer_spans < 1:
+            raise ValueError(
+                f"params.trace_buffer_spans={self.trace_buffer_spans} "
+                "must be >= 1")
+        if self.trace_export_interval_s <= 0:
+            raise ValueError(
+                f"params.trace_export_interval_s="
+                f"{self.trace_export_interval_s:g} must be > 0")
+        if self.fleet_metrics_interval_s < 0:
+            raise ValueError(
+                f"params.fleet_metrics_interval_s="
+                f"{self.fleet_metrics_interval_s:g} must be >= 0 "
+                "(0 disables fleet metrics publishing)")
 
     def _validate_rollout(self):
         """Rollout knobs fail at config load like the rest (ISSUE 14):

@@ -29,6 +29,7 @@ import os
 import ssl
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Union
 from urllib.parse import parse_qs
@@ -101,6 +102,11 @@ class _Handler(BaseHTTPRequestHandler):
         counter = getattr(self.server, "http_requests", None)
         if counter is not None:
             route = self.path.split("?", 1)[0]
+            if route.startswith("/trace/"):
+                # per-request trace ids must not explode label
+                # cardinality — every /trace/<id>[/summary] hit counts
+                # as the one /trace route
+                route = "/trace"
             if route not in ROUTES_GET and route not in ROUTES_POST:
                 route = "other"   # bound label cardinality against scans
             counter.inc(route=route, code=str(code),
@@ -149,6 +155,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._metrics()
         elif path == "/trace":
             self._trace()
+        elif path.startswith("/trace/"):
+            self._trace_request(path)
         elif path == "/healthz":
             self._healthz()
         elif path == "/rollout/status":
@@ -234,6 +242,18 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001 — scrape must answer
                 pass
         if "text/plain" in accept or "openmetrics" in accept:
+            agg = self.server.fleet_metrics
+            if agg is not None:
+                # fleet scrape (ISSUE 17): merge every alive engine's
+                # published registry blob with the gateway's own —
+                # counters summed into scope="fleet" rollups, histograms
+                # merged bucket-wise, gauges engine-labeled. A merge
+                # failure degrades to the local registry: the scrape
+                # must always answer.
+                try:
+                    registry = agg.merged(registry)
+                except Exception:  # noqa: BLE001
+                    pass
             self._send_bytes(200, render_prometheus(registry).encode(),
                              PROMETHEUS_CONTENT_TYPE)
             return
@@ -246,6 +266,8 @@ class _Handler(BaseHTTPRequestHandler):
             # the alive/ready counts the `serving_engines_*` families
             # export to Prometheus
             timers["fleet"] = self.server.fleet.summary()
+        if self.server.fleet_metrics is not None:
+            timers["fleet_metrics"] = self.server.fleet_metrics.summary()
         timers["registry"] = registry.snapshot()
         self._send(200, timers)
 
@@ -363,6 +385,44 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(200, tracer.chrome_trace())
 
+    def _trace_request(self, path: str):
+        """`GET /trace/<request_id>` (ISSUE 17): ONE merged
+        cross-process Chrome timeline for the request, assembled from
+        every engine's published span blobs — served from broker state,
+        so ANY gateway replica answers identically.
+        `GET /trace/<request_id>/summary` instead returns the
+        critical-path breakdown (wire / queue / decode / device /
+        writeback milliseconds) plus span coverage of the request
+        window."""
+        from urllib.parse import unquote
+        collector = self.server.trace_collector
+        if collector is None:
+            self._send(404, {"error": "trace collection not available "
+                                      "on this frontend"})
+            return
+        rest = path[len("/trace/"):]
+        want_summary = False
+        if rest.endswith("/summary"):
+            want_summary = True
+            rest = rest[:-len("/summary")]
+        request_id = unquote(rest)
+        if not request_id:
+            self._send(400, {"error": "empty request id"})
+            return
+        try:
+            out = (collector.summary(request_id) if want_summary
+                   else collector.assemble(request_id))
+        except Exception as e:  # noqa: BLE001 — frontend must not die
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if out is None:
+            self._send(404, {
+                "error": f"no published spans cover request id "
+                         f"{request_id!r} (not sampled, expired from "
+                         "the export window, or not yet published)"})
+            return
+        self._send(200, out)
+
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", 0))
         return self.rfile.read(length)
@@ -459,8 +519,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # serving loop), or {"b64","dtype","shape"} raw tensor
                 if "instances" in req:
                     arr = np.asarray(req["instances"], np.float32)
+                    uris, t_ing, t0 = self._request_ids(len(arr))
                     results = self.server.input_queue.predict_batch(
-                        arr, timeout_s=self.server.timeout_s, tier=tier)
+                        arr, timeout_s=self.server.timeout_s, tier=tier,
+                        uris=uris)
+                    self._gateway_span(uris, t_ing, t0)
                     if any(r == "SHED" for r in results
                            if isinstance(r, str)):
                         self._shed_response(
@@ -471,22 +534,55 @@ class _Handler(BaseHTTPRequestHandler):
                              for r in results):
                         self._send(500, {"error": "inference failure (NaN)"})
                     else:
-                        self._send(200, {"predictions": np.asarray(results)
-                                         .tolist()})
+                        payload = {"predictions": np.asarray(results)
+                                   .tolist()}
+                        if uris is not None:
+                            payload["request_ids"] = uris
+                        self._send(200, payload)
                     return
                 from analytics_zoo_tpu.serving.broker import decode_ndarray
                 arr = decode_ndarray(req)
+                uris, t_ing, t0 = self._request_ids(1)
                 result = self.server.input_queue.predict(
-                    arr, timeout_s=self.server.timeout_s, tier=tier)
+                    arr, timeout_s=self.server.timeout_s, tier=tier,
+                    uri=uris[0] if uris else None)
+                self._gateway_span(uris, t_ing, t0)
                 if isinstance(result, str) and result == "SHED":
                     self._shed_response()
                 elif isinstance(result, float) and np.isnan(result):
                     self._send(500, {"error": "inference failure (NaN)"})
                 else:
-                    self._send(200, {"predictions": np.asarray(result)
-                                     .tolist()})
+                    payload = {"predictions": np.asarray(result)
+                               .tolist()}
+                    if uris is not None:
+                        payload["request_ids"] = uris
+                    self._send(200, payload)
             except Exception as e:  # noqa: BLE001 — frontend must not die
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    def _request_ids(self, n: int):
+        """Pre-generated request ids (= trace ids) for a traced
+        `/predict`: returned to the client as `request_ids` so
+        `GET /trace/<id>` is addressable, and used as the enqueued
+        records' uris so every engine span carries the same id.
+        `(None, ..)` when gateway tracing is off — the wire payload
+        stays byte-identical to the untraced frontend."""
+        t_ing = time.time()
+        t0 = time.perf_counter()
+        if self.server.gateway_tracer is None:
+            return None, t_ing, t0
+        return [str(uuid.uuid4()) for _ in range(n)], t_ing, t0
+
+    def _gateway_span(self, uris, t_ing: float, t0: float):
+        """The gateway's own hop on the request timeline: enqueue →
+        result readback, anchored on the ingest wall clock (`t_ingest`
+        is the collector's skew-safe anchor for this process)."""
+        tracer = self.server.gateway_tracer
+        if tracer is None or not uris:
+            return
+        tracer.add_span("gateway_request", t0, time.perf_counter(),
+                        cat="serving.gateway", trace_ids=uris,
+                        args={"t_ingest": t_ing})
 
     def _shed_response(self, shed=None, total=None):
         """The engine shed this record under overload (ISSUE 11): an
@@ -585,7 +681,10 @@ class FrontEnd:
                  rollout=None,
                  partitions: int = 1,
                  gateway_id: Optional[str] = None,
-                 leader_ttl_s: float = 3.0):
+                 leader_ttl_s: float = 3.0,
+                 trace_sample: float = 0.0,
+                 trace_buffer_spans: int = 20000,
+                 trace_export_interval_s: float = 0.5):
         """`fleet_stream` (ISSUE 10) turns the frontend into a fleet
         gateway: a `FleetTracker` watches engine heartbeats on
         `engines:<fleet_stream>`, `/healthz` answers for the FLEET
@@ -614,13 +713,28 @@ class FrontEnd:
         only the leader's control loops (rollout convergence,
         autoscaling) act — wire `leader_fn=frontend.is_leader` into
         `RolloutController`/`FleetAutoscaler`. Kill the leader and a
-        surviving replica takes the lease within ~`leader_ttl_s`."""
+        surviving replica takes the lease within ~`leader_ttl_s`.
+
+        `trace_sample` (ISSUE 17) turns on the fleet trace plane at
+        this gateway: `/predict` pre-generates request ids (returned as
+        `request_ids`), stamps trace context on every enqueued record,
+        and the gateway's own `gateway_request` spans export to the
+        broker alongside the engines'. `GET /trace/<request_id>` serves
+        the merged cross-process timeline from ANY replica (the
+        collector is broker-state only, so it works even with
+        `trace_sample=0` as long as engines sample)."""
+        if not 0.0 <= float(trace_sample) <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in [0, 1], got {trace_sample}")
+        self.trace_sample = float(trace_sample)
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
         self._srv = _FrontEndServer((host, port), _Handler)
         self._srv.daemon_threads = True
         self._srv.input_queue = InputQueue(self.broker,
-                                           partitions=partitions)
+                                           partitions=partitions,
+                                           trace_sample=self.trace_sample,
+                                           trace_parent="gateway_request")
         self._srv.broker = self.broker
         self._srv.serving = serving
         self._srv.request_timer = Timer("http_predict")
@@ -675,6 +789,47 @@ class FrontEnd:
                 lease_broker, fleet_stream or STREAM, gateway_id,
                 ttl_s=leader_ttl_s, registry=self.registry)
         self._srv.leader_lease = self.leader_lease
+        # fleet trace plane (ISSUE 17). The collector is UNCONDITIONAL:
+        # it reads only broker state, so any replica — even one started
+        # with tracing off — can serve GET /trace/<id> for requests the
+        # engines sampled.
+        from analytics_zoo_tpu.serving.trace_plane import (SpanExporter,
+                                                           TraceCollector)
+        # engines publish under their DATA stream's key; in a fleet
+        # deployment that is the same name the heartbeat plane uses
+        obs_stream = fleet_stream or self._srv.input_queue.stream
+        self._srv.trace_collector = TraceCollector(self.broker, obs_stream)
+        self.gateway_tracer = None
+        self.trace_exporter = None
+        self._te_broker = None
+        if self.trace_sample > 0:
+            from analytics_zoo_tpu.observability.tracing import Tracer
+            gw_name = gateway_id or f"gateway-{os.getpid()}"
+            self.gateway_tracer = Tracer(
+                max_spans=int(trace_buffer_spans),
+                registry=self.registry, engine=gw_name)
+            clone = getattr(self.broker, "clone", None)
+            if callable(clone):
+                # own connection: a publish must never queue behind a
+                # handler thread's blocking result poll
+                self._te_broker = clone()
+            self.trace_exporter = SpanExporter(
+                self._te_broker or self.broker, obs_stream, gw_name,
+                self.gateway_tracer, sample=self.trace_sample,
+                interval_s=float(trace_export_interval_s),
+                buffer_spans=int(trace_buffer_spans),
+                registry=self.registry)
+        self._srv.gateway_tracer = self.gateway_tracer
+        # fleet metrics aggregation (ISSUE 17): /metrics on any replica
+        # exposes the whole fleet's registry, not just this process
+        self.fleet_metrics = None
+        if fleet_stream:
+            from analytics_zoo_tpu.serving.fleet_metrics import \
+                FleetMetricsAggregator
+            self.fleet_metrics = FleetMetricsAggregator(
+                self.broker, fleet_stream, self.registry,
+                alive_fn=self._alive_engines)
+        self._srv.fleet_metrics = self.fleet_metrics
         self.admission = admission
         self._srv.admission = admission
         self._srv.admission_header = admission_header
@@ -705,6 +860,17 @@ class FrontEnd:
         self.rollout = rollout
         self._srv.rollout = rollout
 
+    def _alive_engines(self):
+        """Alive-engine id set for the fleet metrics merge; None (the
+        filter degrades open) while the broker view is unknown or no
+        fleet tracking is configured."""
+        if self.fleet is None:
+            return None
+        engines = self.fleet.poll()
+        if engines is None:
+            return None
+        return {eid for eid, row in engines.items() if row.get("alive")}
+
     def is_leader(self) -> bool:
         """True when this replica's control loops should act. A
         frontend started WITHOUT a gateway_id is the only gateway
@@ -715,6 +881,8 @@ class FrontEnd:
     def start(self) -> "FrontEnd":
         if self.leader_lease is not None:
             self.leader_lease.start()
+        if self.trace_exporter is not None:
+            self.trace_exporter.start()
         self._thread.start()
         return self
 
@@ -725,6 +893,13 @@ class FrontEnd:
         surviving replica must win it only by expiry."""
         self._srv.shutdown()
         self._srv.server_close()
+        if self.trace_exporter is not None:
+            self.trace_exporter.stop(flush=True)
+        if self._te_broker is not None:
+            try:
+                self._te_broker.close()
+            except Exception:  # noqa: BLE001 — stopping regardless
+                pass
         if self.leader_lease is not None:
             self.leader_lease.stop(release=release_lease)
         if self.fleet is not None:

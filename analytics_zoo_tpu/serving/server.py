@@ -52,7 +52,7 @@ import logging
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
 
@@ -142,7 +142,11 @@ class ClusterServing:
                  model_version: Optional[int] = None,
                  partitions: int = 1,
                  reshard: bool = False,
-                 partition_lease_ttl_s: float = 5.0):
+                 partition_lease_ttl_s: float = 5.0,
+                 trace_sample: float = 0.0,
+                 trace_buffer_spans: int = 20000,
+                 trace_export_interval_s: float = 0.5,
+                 fleet_metrics_interval_s: float = 2.0):
         """Fault-tolerance knobs (ISSUE 5; the rest is PR 1-4 surface):
         `supervise` starts a `ReplicaSupervisor` over a replica pool
         (quarantine after `failure_threshold` consecutive failures or
@@ -213,7 +217,22 @@ class ClusterServing:
         legacy single-stream behavior byte-identical. Changing the
         count against a live lease table is refused unless `reshard`
         is set (records already routed under the old count would
-        strand)."""
+        strand).
+
+        Fleet observability plane (ISSUE 17): `trace_sample` > 0 turns
+        on cross-process tracing — the engine continues each stamped
+        record's trace (a "wire" span from the client's ingest
+        timestamp to the reader claim, then the existing stage spans
+        plus "device"/"writeback"), embeds a compact per-hop timing
+        summary in every result row, and a `SpanExporter` ships the
+        head-sampled window (plus force-sampled failed / SLO-violating
+        requests) into the `traces:<stream>` broker hash every
+        `trace_export_interval_s` for gateway-side assembly. The local
+        span ring is bounded at `trace_buffer_spans`. Independently,
+        a fleet engine (`engine_id` set) publishes its full registry
+        snapshot into `metrics:<stream>` every
+        `fleet_metrics_interval_s` (0 disables) so a gateway scrape
+        aggregates the whole fleet."""
         self.model = model
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
@@ -410,6 +429,38 @@ class ClusterServing:
                 self._heartbeat_payload,
                 interval_s=self.heartbeat_interval_s,
                 registry=self.registry)
+        # fleet observability plane (ISSUE 17): span exporter + fleet
+        # metrics publisher, each on its OWN broker connection — the
+        # reader blocks in XREADGROUP windows and the sink may be
+        # mid-writeback; telemetry must never queue behind either
+        if not 0.0 <= float(trace_sample) <= 1.0:
+            raise ValueError(
+                f"trace_sample must be in [0, 1], got {trace_sample}")
+        self.trace_sample = float(trace_sample)
+        self.trace_exporter = None
+        self.fleet_metrics = None
+        obs_base = self.broker.inner \
+            if isinstance(self.broker, ResilientBroker) else self.broker
+        if self.trace_sample > 0:
+            if self.tracer is None:
+                self.tracer = Tracer(max_spans=int(trace_buffer_spans),
+                                     registry=self.registry,
+                                     engine=self.consumer)
+            elif self.tracer.engine is None:
+                self.tracer.engine = self.consumer
+            from analytics_zoo_tpu.serving.trace_plane import SpanExporter
+            self.trace_exporter = SpanExporter(
+                obs_base.clone(), self.stream, self.consumer,
+                self.tracer, sample=self.trace_sample,
+                interval_s=float(trace_export_interval_s),
+                buffer_spans=int(trace_buffer_spans),
+                registry=self.registry)
+        if engine_id is not None and float(fleet_metrics_interval_s) > 0:
+            from analytics_zoo_tpu.serving.fleet_metrics import \
+                FleetMetricsPublisher
+            self.fleet_metrics = FleetMetricsPublisher(
+                obs_base.clone(), self.stream, engine_id, self.registry,
+                interval_s=float(fleet_metrics_interval_s))
 
     def _heartbeat_payload(self) -> dict:
         """What each beat tells the gateway: readiness (the same
@@ -494,10 +545,14 @@ class ClusterServing:
         self.batch_timer.add_observer(
             lambda s: batch_hist.observe(s * 1e3, **labels))
         # the model (and its predict Timer) may outlive/be shared across
-        # ClusterServing instances — attach the mirror exactly once
+        # ClusterServing instances — attach the mirror exactly once.
+        # Fleet mode labels the predict series like every other stage
+        # (the fleet aggregator needs per-engine attribution); the
+        # standalone schema stays byte-identical.
         if not getattr(self.model.timer, "_registry_mirrored", False):
             self.model.timer.add_observer(
-                lambda s: stage_hist.observe(s * 1e3, stage="predict"))
+                lambda s, _l=dict(labels): stage_hist.observe(
+                    s * 1e3, stage="predict", **_l))
             self.model.timer._registry_mirrored = True
         qd = reg.gauge("serving_queue_depth",
                        "live depth of each inter-stage pipeline queue")
@@ -691,6 +746,10 @@ class ClusterServing:
             # after the stage threads: the first beat already reports
             # ready=True instead of a one-interval false negative
             self.heartbeat.start()
+        if self.trace_exporter is not None:
+            self.trace_exporter.start()
+        if self.fleet_metrics is not None:
+            self.fleet_metrics.start()
         return self
 
     def is_alive(self) -> bool:
@@ -744,8 +803,20 @@ class ClusterServing:
             t.join(timeout=10)
         self._threads = []
         self._unwire_gauges()
+        # observability plane: final flush AFTER the sink joined (the
+        # last batch's spans and counters are in), BEFORE the broker
+        # handles close
+        if self.trace_exporter is not None:
+            self.trace_exporter.stop(flush=True)
+        if self.fleet_metrics is not None:
+            self.fleet_metrics.stop(flush=True)
         hb_broker = self.heartbeat.broker if self.heartbeat else None
-        for br in (self.reader_broker, self.sink_broker, hb_broker):
+        te_broker = self.trace_exporter.broker \
+            if self.trace_exporter else None
+        fm_broker = self.fleet_metrics.broker \
+            if self.fleet_metrics else None
+        for br in (self.reader_broker, self.sink_broker, hb_broker,
+                   te_broker, fm_broker):
             if br is not None and br is not self.broker \
                     and hasattr(br, "close"):
                 try:
@@ -765,6 +836,12 @@ class ClusterServing:
         self._stop.set()
         if self.heartbeat is not None:
             self.heartbeat.stop(deregister=False)
+        # no flush: a SIGKILLed process publishes nothing on the way
+        # out — whatever the last interval shipped is what survives
+        if self.trace_exporter is not None:
+            self.trace_exporter.stop(flush=False)
+        if self.fleet_metrics is not None:
+            self.fleet_metrics.stop(flush=False)
         if self.slo is not None:
             self.slo.stop_auto()
         if self.supervisor is not None:
@@ -928,6 +1005,38 @@ class ClusterServing:
                 None, t0, shed=True, stream=src))
         return keep
 
+    def _trace_wire(self, records):
+        """Continue the client's trace context (ISSUE 17): a record
+        stamped with ``{"trace": {"ts": <wall>}}`` gets a "wire" span
+        from its client-side ingest to this reader's claim. Duration
+        comes from wall-clock DELTA on both ends (skew-bounded by
+        `max(0, ...)`); the collector re-anchors it against the
+        engine's minimum observed delta, so cross-host skew cancels
+        instead of corrupting the merged timeline."""
+        t_read = time.perf_counter()
+        wall = time.time()
+        for rid, rec in records:
+            if not isinstance(rec, dict):
+                continue
+            ctx = rec.get("trace")
+            if not isinstance(ctx, dict):
+                continue
+            try:
+                t_ing = float(ctx["ts"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            d = max(0.0, wall - t_ing)
+            args: Dict[str, Any] = {"t_ingest": t_ing,
+                                    "t_read_wall": wall}
+            if ctx.get("parent"):
+                args["parent"] = ctx["parent"]
+            if self._labels:
+                args.update(self._labels)
+            self.tracer.add_span(
+                "wire", t_read - d, t_read,
+                trace_id=rec.get("uri", str(rid)),
+                cat="serving.wire", args=args)
+
     # -- stage: reader -----------------------------------------------------
     def _reader_loop(self):
         # idle wait is LONG (an XADD wakes a blocked XREADGROUP
@@ -1082,6 +1191,8 @@ class ClusterServing:
                                                         src)
                     if not records:
                         continue
+                if self.tracer is not None:
+                    self._trace_wire(records)
                 item = (t_first, records, src)
                 while not self._stop.is_set():
                     try:
@@ -1238,6 +1349,11 @@ class ClusterServing:
                         [rid for rid, _ in failed],
                         [uri for _, uri in failed], None, t0, nan=True,
                         stream=src))
+                    if self.trace_exporter is not None:
+                        # failures export their traces regardless of
+                        # head sampling — the requests worth debugging
+                        self.trace_exporter.force(
+                            [uri for _, uri in failed])
                 if batches is not None:
                     for ids, uris, buf, n in batches:
                         self._enqueue(self._dispatch_q, _Batch(
@@ -1439,6 +1555,14 @@ class ClusterServing:
             return
         t_work = batch.t_enq
         values = self._materialize(batch)
+        if self.tracer is not None and not (batch.nan or batch.shed):
+            # the device wait + readback half of the sink: what the
+            # critical-path "device" column reads (dispatch only SUBMITS;
+            # this is where the batch's result actually lands on host)
+            self.tracer.add_span("device", t_work, time.perf_counter(),
+                                 cat="serving.device",
+                                 trace_ids=batch.uris,
+                                 args=dict(self._labels) or None)
         if batch.bucket is not None and batch.t_dispatch is not None \
                 and not (batch.nan or batch.shed):
             # feed the live cost model: dispatch → materialized is what
@@ -1470,6 +1594,7 @@ class ClusterServing:
         # pre-partition entries (tests, a buffer that survived an
         # upgrade) carry no stream element: they mean the base stream
         stream = entry[5] if len(entry) > 5 else self.stream
+        t_wb = time.perf_counter()
         try:
             # the whole batch commits as ONE broker interaction —
             # results + ack in a single (pipelined) round trip, not
@@ -1496,6 +1621,11 @@ class ClusterServing:
             tr_ids = list(mapping)
             self.tracer.add_span("sink", t_work, t_end,
                                  trace_ids=tr_ids,
+                                 args=dict(self._labels) or None)
+            # the broker-commit tail on its own row: the critical-path
+            # "writeback" column (results + ack round trip)
+            self.tracer.add_span("writeback", t_wb, t_end,
+                                 cat="serving.sink", trace_ids=tr_ids,
                                  args=dict(self._labels) or None)
         # idempotent writeback (ISSUE 10): HSET reports how many fields
         # were NEW. A redelivered record whose result another engine (or
@@ -1545,6 +1675,17 @@ class ClusterServing:
             self._records_total.inc(nan_n, outcome="failed",
                                     **self._labels)
         self.batch_timer.record(t_end - t0)
+        if self.trace_exporter is not None:
+            # forced sampling (ISSUE 17): failed and SLO-violating
+            # requests always ship their spans — head sampling decides
+            # the happy path, never the requests worth debugging
+            if self.slo is not None \
+                    and self.slo.objectives.latency_ms is not None \
+                    and (t_end - t0) * 1e3 > self.slo.objectives.latency_ms:
+                self.trace_exporter.force(list(mapping))
+            elif nan_n:
+                self.trace_exporter.force(
+                    [u for u, v in mapping.items() if v == "NaN"])
         return True
 
     def _buffer_writeback(self, entry):
@@ -1609,6 +1750,19 @@ class ClusterServing:
                       len(batch.uris), e)
             return ["NaN"] * len(batch.uris)
         values = []
+        hops = None
+        if self.trace_exporter is not None:
+            # per-hop timing summary riding the writeback row (ISSUE
+            # 17): engine-internal MONOTONIC durations only — a client
+            # on another host can attribute its e2e latency without any
+            # cross-clock arithmetic (e2e - engine_ms = wire + broker)
+            now = time.perf_counter()
+            t_disp = batch.t_dispatch if batch.t_dispatch is not None \
+                else now
+            hops = {"engine": self._labels.get("engine", self.consumer),
+                    "engine_ms": round((now - batch.t0) * 1e3, 3),
+                    "queue_ms": round((t_disp - batch.t0) * 1e3, 3),
+                    "device_ms": round((now - t_disp) * 1e3, 3)}
         for pred in list(preds)[:len(batch.uris)]:
             try:
                 if self.output_filter:
@@ -1617,8 +1771,10 @@ class ClusterServing:
                     values.append(apply_filter(np.asarray(pred),
                                                self.output_filter))
                 else:
-                    values.append(json.dumps(
-                        encode_ndarray(np.asarray(pred))))
+                    blob = encode_ndarray(np.asarray(pred))
+                    if hops is not None:
+                        blob["hops"] = hops
+                    values.append(json.dumps(blob))
             except Exception as e:  # noqa: BLE001 — degrade per record
                 log.warning("encode failure: %s", e)
                 values.append("NaN")
@@ -1779,4 +1935,6 @@ class ClusterServing:
             if src:
                 cc_info["warmup_source"] = dict(src)
             m["compile_cache"] = cc_info
+        if self.trace_exporter is not None:
+            m["trace"] = self.trace_exporter.stats()
         return m

@@ -818,6 +818,26 @@ def main():
                         out[dst] = r6.get(src)
             else:
                 out["serving_int8_vs_bf16_p50_ratio"] = None
+        # trace-overhead A/B (ISSUE 17): what fleet tracing costs the
+        # drain — the acceptance bound is ≤2% at 1% head sampling; full
+        # sampling and the /trace assembly latency ride along
+        if os.environ.get("BENCH_TRACE", "1") == "1":
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            r7, _ = _run_sub([sys.executable,
+                              os.path.join(here, "bench_serving.py"),
+                              "--trace-overhead"],
+                             timeout=900, env=env)
+            if r7:
+                out["serving_trace_off_rps"] = r7.get("trace_off_rps")
+                out["serving_trace_1pct_rps"] = r7.get("trace_1pct_rps")
+                out["serving_trace_full_rps"] = r7.get("trace_full_rps")
+                out["serving_trace_overhead_1pct_pct"] = \
+                    r7.get("trace_overhead_1pct_pct")
+                out["serving_trace_assembly_ms"] = \
+                    r7.get("trace_assembly_p50_ms")
+            else:
+                out["serving_trace_overhead_1pct_pct"] = None
 
     print(json.dumps(out))
 

@@ -277,6 +277,102 @@ def _measure_decode_ab(infer, total: int = 480, rounds: int = 3):
     return out
 
 
+def _measure_trace_overhead(infer, total: int = 480, rounds: int = 3):
+    """Trace-overhead A/B (ISSUE 17 satellite): engine-limited drain at
+    head-sampling 0 / 0.01 / 1.0, fresh brokers + engine per mode per
+    round so one mode's exporter thread can't ride in another's timing
+    window. Interleaved rounds + per-mode MEDIAN, same estimator as the
+    decode A/B — a single drain's rps rides host scheduling. The client
+    stamps trace context at the matching rate (`InputQueue
+    trace_sample`), so sampled drains pay the real wire cost too: the
+    extra dict per record, the engine's wire/device/writeback spans,
+    the hops row in each result, and the export thread. At full
+    sampling the collector assembles a few finished requests from the
+    published blobs — the `/trace/<id>` cost a debugging session
+    actually pays."""
+    from analytics_zoo_tpu.serving.client import RESULT_KEY, InputQueue
+    from analytics_zoo_tpu.serving.server import ClusterServing
+    from analytics_zoo_tpu.serving.trace_plane import TraceCollector
+
+    modes = (("off", 0.0), ("1pct", 0.01), ("full", 1.0))
+    runs = {label: [] for label, _ in modes}
+    assembly_ms = []
+    for _ in range(rounds):
+        for label, rate in modes:
+            serve_broker, (submit_br, poll_br), server = _setup_brokers(
+                "redis", 2)
+            inq = InputQueue(submit_br, trace_sample=rate)
+            img = np.random.rand(32, 32, 3).astype(np.float32)
+            uris = [inq.enqueue(t=img) for _ in range(total)]
+            serving = ClusterServing(infer, broker=serve_broker,
+                                     batch_size=32, batch_timeout_ms=2,
+                                     pipelined=True, trace_sample=rate,
+                                     trace_export_interval_s=0.2).start()
+            t0 = time.perf_counter()
+            ndone = 0
+            deadline = time.time() + 120
+            while ndone < total and time.time() < deadline:
+                allr = poll_br.hgetall(RESULT_KEY)
+                if allr:
+                    poll_br.hdel_many(RESULT_KEY, list(allr))
+                    ndone += len(allr)
+                else:
+                    time.sleep(0.001)
+            dt = time.perf_counter() - t0
+            serving.stop()        # flushes the exporter's final blob
+            if label == "full":
+                coll = TraceCollector(poll_br, "serving_stream")
+                for uri in uris[:8]:
+                    ta = time.perf_counter()
+                    doc = coll.assemble(uri)
+                    if doc.get("traceEvents"):
+                        assembly_ms.append(
+                            (time.perf_counter() - ta) * 1e3)
+            _teardown_brokers(serve_broker, [submit_br, poll_br], server)
+            runs[label].append(ndone / dt)
+    out = {label: {"drain_rps": round(float(np.median(r)), 1)}
+           for label, r in runs.items()}
+    off = out["off"]["drain_rps"]
+    out["overhead_1pct_pct"] = round(
+        100.0 * (1.0 - out["1pct"]["drain_rps"] / max(off, 1e-9)), 2)
+    out["overhead_full_pct"] = round(
+        100.0 * (1.0 - out["full"]["drain_rps"] / max(off, 1e-9)), 2)
+    if assembly_ms:
+        out["assembly_p50_ms"] = round(float(np.median(assembly_ms)), 3)
+    return out
+
+
+def _trace_overhead_main(args) -> int:
+    """--trace-overhead (ISSUE 17): the acceptance bound — 1% head
+    sampling costs ≤ 2% of engine-limited drain throughput vs tracing
+    off. Full (100%) sampling is reported beside it as the ceiling a
+    debug session pays, plus the collector's assembly latency."""
+    from analytics_zoo_tpu import init_orca_context, stop_orca_context
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context(cluster_mode="local")
+    model = _serving_model()
+    infer = InferenceModel(concurrent_num=2).load_keras(model)
+    infer.warmup(np.zeros((32, 32, 3), np.float32),
+                 buckets=[1, 2, 4, 8, 16, 32])
+    ab = _measure_trace_overhead(infer, total=int(args.total) or 480)
+    stop_orca_context()
+    print(json.dumps({
+        "metric": "serving_trace_overhead",
+        "target_overhead_1pct_pct": 2.0,
+        "trace_off_rps": ab["off"]["drain_rps"],
+        "trace_1pct_rps": ab["1pct"]["drain_rps"],
+        "trace_full_rps": ab["full"]["drain_rps"],
+        "trace_overhead_1pct_pct": ab["overhead_1pct_pct"],
+        "trace_overhead_full_pct": ab["overhead_full_pct"],
+        "trace_assembly_p50_ms": ab.get("assembly_p50_ms"),
+        "note": ("median of interleaved engine-limited drains per "
+                 "sampling rate; negative overhead = host-scheduling "
+                 "noise exceeded the tracing cost at this scale"),
+    }))
+    return 0
+
+
 def _warmup_probe(model, replicas: int = 3):
     """Fresh InferenceModel + warmup(): is the FIRST request's latency
     within noise of steady-state (i.e. no compile on the request path)?
@@ -2411,6 +2507,10 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--pin-core", type=int, default=None,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="ISSUE 17: drain-throughput A/B at trace "
+                         "sampling 0 / 0.01 / 1.0 + trace assembly "
+                         "latency")
     ap.add_argument("--int8-ab", action="store_true",
                     help="int8-vs-bf16 A/B through the full serving "
                          "path over one bucket set (ISSUE 12): pooled "
@@ -2453,6 +2553,8 @@ def main():
         return _chaos_rollout_main(args)
     if args.int8_ab:
         return _int8_ab_main(args)
+    if args.trace_overhead:
+        return _trace_overhead_main(args)
     if args.elastic:
         return _elastic_main(args)
     if args.chaos:

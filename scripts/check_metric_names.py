@@ -141,10 +141,34 @@ REQUIRED = {
     "serving_partition_depth": "gauge",
     "gateway_role": "gauge",
     "gateway_leader_changes_total": "counter",
+    # fleet observability plane (ISSUE 17): the trace-export health
+    # families and the fleet-scrape staleness gauge — the guards that
+    # make span loss and stale engine blobs visible on a scrape.
+    # Renaming any of these blinds the trace plane's own telemetry.
+    "observability_spans_dropped_total": "counter",
+    "serving_trace_spans_total": "counter",
+    "serving_trace_sampled_total": "counter",
+    "serving_trace_dropped_total": "counter",
+    "fleet_scrape_age_s": "gauge",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",
                                  "observability.md")
+
+# Serving span-name vocabulary (ISSUE 17): the cross-process trace
+# assembler keys its skew model and critical-path columns on these
+# literal stage names, so a misspelled span silently falls out of
+# /trace/<id>/summary. REQUEST_SPANS must carry a trace_id/trace_ids so
+# the request's merged timeline can find them; LIFECYCLE_SPANS are
+# engine-scoped events that legitimately have no request id.
+REQUEST_SPANS = frozenset({
+    "wire", "decode_q_wait", "decode", "dispatch_q_wait", "dispatch",
+    "device", "sink_q_wait", "sink", "writeback", "serve_once",
+    "gateway_request"})
+LIFECYCLE_SPANS = frozenset({"rollout_swap"})
+SERVING_SPAN_ROOT = os.path.join("analytics_zoo_tpu", "serving")
+SPAN_CALL_RE = re.compile(
+    r"\.\s*add_span\s*\(\s*(?:\n\s*)?['\"]([^'\"]+)['\"]", re.MULTILINE)
 
 
 def iter_sources(roots) -> List[str]:
@@ -216,6 +240,53 @@ def check(roots=DEFAULT_ROOTS) -> List[str]:
                     f"required metric {name!r} must be a {kind}, found "
                     f"{got[0]} at {got[1]}:{got[2]}")
         errors.extend(check_docs())
+        errors.extend(check_spans())
+    return errors
+
+
+def _call_window(src: str, start: int, limit: int = 4000) -> str:
+    """The balanced-paren argument window of the call starting at
+    `start` (bounded: lint, not a parser)."""
+    i = src.index("(", start)
+    depth = 0
+    for j in range(i, min(len(src), i + limit)):
+        ch = src[j]
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return src[i:j + 1]
+    return src[i:i + limit]
+
+
+def check_spans(root: str = SERVING_SPAN_ROOT) -> List[str]:
+    """Span-name lint (ISSUE 17): every literal `add_span("name", ...)`
+    in the serving package must use the stage vocabulary, and request
+    spans must propagate a trace_id/trace_ids — otherwise the span can
+    never join a request's merged cross-process timeline."""
+    errors: List[str] = []
+    vocab = REQUEST_SPANS | LIFECYCLE_SPANS
+    for path in iter_sources([root]):
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        for m in SPAN_CALL_RE.finditer(src):
+            name = m.group(1)
+            line = src.count("\n", 0, m.start()) + 1
+            where = f"{path}:{line}"
+            if name not in vocab:
+                errors.append(
+                    f"{where}: span {name!r} is not in the serving "
+                    f"stage vocabulary ({', '.join(sorted(vocab))}) — "
+                    "the trace assembler's critical-path columns key on "
+                    "these names")
+            elif name in REQUEST_SPANS:
+                window = _call_window(src, m.start())
+                if "trace_id" not in window:   # matches trace_ids too
+                    errors.append(
+                        f"{where}: request span {name!r} carries no "
+                        "trace_id/trace_ids — it can never join a "
+                        "request's merged timeline")
     return errors
 
 

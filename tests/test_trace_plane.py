@@ -1,0 +1,377 @@
+"""Fleet observability plane (ISSUE 17) unit tests.
+
+- `should_sample`: deterministic head sampling — every process reaches
+  the same keep/drop verdict from the id alone; edge rates are exact
+  and mid rates land near the nominal fraction.
+- Span wire form: `span_to_dict`/`span_from_dict` round-trip with epoch
+  rebasing, empty fields omitted on the wire.
+- Tracer ring: bounded eviction counts into
+  `observability_spans_dropped_total{engine=...}`, and Chrome export
+  namespaces tid as `engine:thread` so merged views never collide.
+- SpanExporter: retention is sampling-independent (an unsampled span
+  still sits in the ring, so a later `force()` for a failed request
+  exports it); sampled-span counting is once per span, not per publish;
+  ring overflow lands in `serving_trace_dropped_total`.
+- TraceCollector: the min-delta skew model places a +1h-skewed engine's
+  spans on the client timeline next to the gateway's (never a raw
+  cross-host wall-clock comparison); anchorless blobs fall back to the
+  blob's epoch_wall; the summary reduces to the
+  wire/queue/decode/device/writeback critical path over the
+  gateway-observed window.
+- Fleet metrics: counter and histogram blobs merge into `scope="fleet"`
+  rollups (engine label stripped, buckets merged), gauges stay
+  engine-labeled, dead engines' blobs are filtered by the alive set,
+  and the blob wins over a co-located gateway's local series for
+  engines that published.
+- hops: each result row's per-hop summary surfaces client-side via
+  `OutputQueue.last_hops` with no collector round-trip.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.observability.registry import MetricsRegistry
+from analytics_zoo_tpu.observability.tracing import (Span, Tracer,
+                                                     span_from_dict,
+                                                     span_to_dict)
+from analytics_zoo_tpu.serving.broker import MemoryBroker
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.fleet_metrics import (FleetMetricsAggregator,
+                                                     FleetMetricsPublisher,
+                                                     metrics_key,
+                                                     registry_blob)
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.server import ClusterServing
+from analytics_zoo_tpu.serving.trace_plane import (SpanExporter,
+                                                   TraceCollector,
+                                                   should_sample,
+                                                   traces_key)
+
+STREAM = "serving_stream"
+
+
+class TestShouldSample:
+    def test_deterministic_and_exact_edges(self):
+        for i in range(64):
+            uri = f"req-{i}"
+            assert should_sample(uri, 1.0)
+            assert not should_sample(uri, 0.0)
+            assert should_sample(uri, 0.5) == should_sample(uri, 0.5)
+
+    def test_mid_rate_lands_near_nominal(self):
+        ids = [f"id-{i}" for i in range(4000)]
+        frac = sum(should_sample(u, 0.1) for u in ids) / len(ids)
+        assert 0.06 < frac < 0.14
+
+    def test_monotone_in_rate(self):
+        # a request sampled at 1% stays sampled at every higher rate —
+        # raising trace_sample mid-incident never loses the ids already
+        # being followed
+        for i in range(256):
+            uri = f"mono-{i}"
+            if should_sample(uri, 0.01):
+                assert should_sample(uri, 0.1)
+                assert should_sample(uri, 0.5)
+
+
+class TestSpanWireForm:
+    def test_round_trip_with_epoch_rebase(self):
+        s = Span("decode", "serving.pipeline", 10.5, 0.25,
+                 trace_id="u1", tid="worker-0", parent="serve_once",
+                 args={"k": 1})
+        d = span_to_dict(s, epoch=10.0)
+        assert d["s"] == pytest.approx(0.5)
+        assert d["d"] == pytest.approx(0.25)
+        rt = span_from_dict(d)
+        assert (rt.name, rt.cat, rt.trace_id, rt.tid, rt.parent) == \
+            ("decode", "serving.pipeline", "u1", "worker-0",
+             "serve_once")
+        assert rt.args == {"k": 1}
+
+    def test_empty_fields_omitted(self):
+        d = span_to_dict(Span("sink", "serving", 1.0, 0.1))
+        for absent in ("id", "ids", "parent", "args"):
+            assert absent not in d
+
+
+class TestTracerRing:
+    def test_overflow_counts_dropped_with_engine_label(self):
+        reg = MetricsRegistry()
+        tr = Tracer(max_spans=16, registry=reg, engine="e9")
+        for i in range(24):
+            tr.add_span("decode", 0.0, 1.0, trace_id=f"u{i}")
+        fam = reg.get("observability_spans_dropped_total")
+        assert fam.value(engine="e9") == 8
+        assert len(tr.spans()) == 16
+
+    def test_chrome_tid_namespaced_by_engine(self):
+        tr = Tracer(engine="e3")
+        tr.add_span("decode", 0.0, 1.0, trace_id="u")
+        doc = tr.chrome_trace()
+        assert doc["traceEvents"]
+        assert all(e["tid"].startswith("e3:")
+                   for e in doc["traceEvents"])
+
+
+class TestSpanExporter:
+    def _exporter(self, sample, **kw):
+        broker = MemoryBroker()
+        reg = MetricsRegistry()
+        tracer = Tracer(engine="eX")
+        exp = SpanExporter(broker, STREAM, "eX", tracer, sample=sample,
+                           registry=reg, **kw)
+        return broker, reg, tracer, exp
+
+    def _blob(self, broker):
+        return json.loads(broker.hget(traces_key(STREAM), "eX"))
+
+    def test_retention_independent_of_sampling_then_force(self):
+        broker, reg, tracer, exp = self._exporter(sample=0.0)
+        tracer.add_span("decode", 0.0, 0.01, trace_id="u-fail")
+        assert exp.publish_once()
+        assert self._blob(broker)["spans"] == []
+        # the failure is detected later (at the sink) — the span must
+        # still be exportable from the ring
+        exp.force(["u-fail"])
+        assert exp.publish_once()
+        spans = self._blob(broker)["spans"]
+        assert [s["id"] for s in spans] == ["u-fail"]
+        assert reg.get("serving_trace_spans_total").value(engine="eX") \
+            == 1
+        assert reg.get("serving_trace_sampled_total").value(engine="eX") \
+            == 1
+
+    def test_sampled_counted_once_across_publishes(self):
+        broker, reg, tracer, exp = self._exporter(sample=1.0)
+        tracer.add_span("decode", 0.0, 0.01, trace_id="u1")
+        exp.publish_once()
+        exp.publish_once()
+        assert reg.get("serving_trace_sampled_total").value(engine="eX") \
+            == 1
+        assert self._blob(broker)["seq"] == 2
+
+    def test_trace_ids_batch_spans_head_sample(self):
+        broker, _, tracer, exp = self._exporter(sample=1.0)
+        tracer.add_span("device", 0.0, 0.01,
+                        trace_ids=("u1", "u2"))
+        exp.publish_once()
+        spans = self._blob(broker)["spans"]
+        assert spans and spans[0]["ids"] == ["u1", "u2"]
+
+    def test_ring_overflow_counts_dropped(self):
+        broker, reg, tracer, exp = self._exporter(sample=1.0,
+                                                  buffer_spans=16)
+        for i in range(20):
+            tracer.add_span("decode", 0.0, 0.01, trace_id=f"u{i}")
+        assert exp.stats()["dropped"] == 4
+        assert reg.get("serving_trace_dropped_total").value(engine="eX") \
+            == 4
+
+
+def _publish_blob(broker, engine, spans, epoch_wall=0.0):
+    broker.hset(traces_key(STREAM), engine, json.dumps(
+        {"engine": engine, "pid": 7, "seq": 1, "wall": 0.0,
+         "epoch_wall": epoch_wall, "dropped": 0, "spans": spans}))
+
+
+class TestTraceCollector:
+    SKEW = 3600.0   # engine clock one hour ahead of the client's
+
+    def _fleet_blobs(self, broker):
+        # gateway: its own process-relative clock, anchored by the
+        # gateway_request span's ingest wall time
+        _publish_blob(broker, "gw", [
+            {"name": "gateway_request", "cat": "serving.gateway",
+             "s": 100.0, "d": 0.2, "ids": ["r1"], "tid": "h0",
+             "args": {"t_ingest": 1000.0}},
+        ])
+        # engine: wall clock skewed a full hour; two wire spans in the
+        # window so the min-delta estimate comes from the OTHER request
+        # (r0, delta 3600.002), leaving r1 a 3 ms skew-free wire time
+        _publish_blob(broker, "e1", [
+            {"name": "wire", "cat": "serving.wire", "s": 49.0,
+             "d": 0.002, "id": "r0", "tid": "rd",
+             "args": {"t_ingest": 999.0,
+                      "t_read_wall": 999.0 + self.SKEW + 0.002}},
+            {"name": "wire", "cat": "serving.wire", "s": 50.0,
+             "d": 0.005, "id": "r1", "tid": "rd",
+             "args": {"t_ingest": 1000.0,
+                      "t_read_wall": 1000.0 + self.SKEW + 0.005}},
+            {"name": "decode", "cat": "serving.pipeline", "s": 50.01,
+             "d": 0.02, "id": "r1", "tid": "dec"},
+            {"name": "device", "cat": "serving.device", "s": 50.04,
+             "d": 0.1, "ids": ["r1"], "tid": "snk"},
+            {"name": "writeback", "cat": "serving.sink", "s": 50.15,
+             "d": 0.01, "ids": ["r1"], "tid": "snk"},
+        ])
+
+    def test_skewed_engine_lands_on_client_timeline(self):
+        broker = MemoryBroker()
+        self._fleet_blobs(broker)
+        doc = TraceCollector(broker, STREAM).assemble("r1")
+        assert doc is not None
+        assert doc["engines"] == ["e1", "gw"]
+        assert doc["anchor_wall"] == pytest.approx(1000.0, abs=0.01)
+        # one hour of skew absorbed: every event within the ~200 ms
+        # request, not offset by 3600 s
+        assert all(0.0 <= e["ts"] <= 0.3e6 for e in doc["traceEvents"])
+        wire = next(e for e in doc["traceEvents"]
+                    if e["name"] == "wire")
+        # delta_r - min_delta = 3 ms of skew-free wire estimate
+        assert wire["dur"] == pytest.approx(3000.0, rel=0.01)
+        tids = {e["tid"] for e in doc["traceEvents"]}
+        assert "gw:h0" in tids and "e1:dec" in tids
+
+    def test_summary_critical_path_and_coverage(self):
+        broker = MemoryBroker()
+        self._fleet_blobs(broker)
+        s = TraceCollector(broker, STREAM).summary("r1")
+        assert s["engines"] == ["e1", "gw"]
+        # gateway-observed window, not the span union
+        assert s["e2e_ms"] == pytest.approx(200.0, rel=0.01)
+        cp = s["critical_path_ms"]
+        assert cp["wire"] == pytest.approx(3.0, rel=0.05)
+        assert cp["decode"] == pytest.approx(20.0, rel=0.05)
+        assert cp["device"] == pytest.approx(100.0, rel=0.05)
+        assert cp["writeback"] == pytest.approx(10.0, rel=0.05)
+        assert 0.0 < s["coverage"] <= 1.0
+
+    def test_anchorless_blob_falls_back_to_epoch_wall(self):
+        broker = MemoryBroker()
+        _publish_blob(broker, "e2", [
+            {"name": "decode", "cat": "serving.pipeline", "s": 5.0,
+             "d": 0.01, "id": "rz", "tid": "dec"}], epoch_wall=2000.0)
+        doc = TraceCollector(broker, STREAM).assemble("rz")
+        assert doc["anchor_wall"] == pytest.approx(2005.0)
+
+    def test_unknown_id_and_garbage_blob(self):
+        broker = MemoryBroker()
+        assert TraceCollector(broker, STREAM).assemble("nope") is None
+        broker.hset(traces_key(STREAM), "bad", "not json")
+        self._fleet_blobs(broker)
+        assert TraceCollector(broker, STREAM).assemble("r1") is not None
+
+
+class TestFleetMetrics:
+    def _engine_registry(self, served, stage_ms):
+        reg = MetricsRegistry()
+        reg.counter("serving_records_total", "records").inc(
+            served, outcome="served")
+        h = reg.histogram("serving_stage_ms", "stage time")
+        for v in stage_ms:
+            h.observe(v, stage="decode")
+        reg.gauge("serving_queue_depth", "depth").set(
+            float(served), queue="decode")
+        return reg
+
+    def _publish(self, broker, engine, reg, seq=1):
+        broker.hset(metrics_key(STREAM), engine,
+                    json.dumps(registry_blob(reg, engine, seq)))
+
+    def test_counters_sum_into_fleet_scope(self):
+        broker = MemoryBroker()
+        self._publish(broker, "e1", self._engine_registry(5, [1.0]))
+        self._publish(broker, "e2", self._engine_registry(7, [2.0]))
+        gw = MetricsRegistry()
+        agg = FleetMetricsAggregator(broker, STREAM, gw)
+        m = agg.merged()
+        fam = m.get("serving_records_total")
+        assert fam.value(engine="e1", outcome="served") == 5
+        assert fam.value(engine="e2", outcome="served") == 7
+        assert fam.value(outcome="served", scope="fleet") == 12
+
+    def test_histograms_bucket_merge(self):
+        broker = MemoryBroker()
+        self._publish(broker, "e1",
+                      self._engine_registry(1, [1.0, 2.0, 3.0]))
+        self._publish(broker, "e2", self._engine_registry(1, [100.0]))
+        agg = FleetMetricsAggregator(broker, STREAM, MetricsRegistry())
+        hfam = agg.merged().get("serving_stage_ms")
+        fleet = hfam.child(stage="decode", scope="fleet")
+        assert fleet.count == 4
+        assert fleet.total == pytest.approx(106.0)
+        assert hfam.child(stage="decode", engine="e1").count == 3
+
+    def test_gauges_engine_labeled_never_summed(self):
+        broker = MemoryBroker()
+        self._publish(broker, "e1", self._engine_registry(5, []))
+        self._publish(broker, "e2", self._engine_registry(7, []))
+        agg = FleetMetricsAggregator(broker, STREAM, MetricsRegistry())
+        gfam = agg.merged().get("serving_queue_depth")
+        assert gfam.value(engine="e1", queue="decode") == 5.0
+        assert gfam.value(engine="e2", queue="decode") == 7.0
+        labels = [s["labels"] for s in gfam._series_snapshot()]
+        assert not any(lb.get("scope") == "fleet" for lb in labels)
+
+    def test_alive_filter_drops_dead_blob(self):
+        broker = MemoryBroker()
+        self._publish(broker, "e1", self._engine_registry(5, []))
+        self._publish(broker, "edead", self._engine_registry(100, []))
+        agg = FleetMetricsAggregator(broker, STREAM, MetricsRegistry(),
+                                     alive_fn=lambda: {"e1"})
+        fam = agg.merged().get("serving_records_total")
+        assert fam.value(outcome="served", scope="fleet") == 5
+        assert fam.value(engine="edead", outcome="served") == 0
+
+    def test_blob_wins_over_colocated_local_series(self):
+        # engine-and-gateway-in-one-process: the gateway's local
+        # registry already carries e1's series; the published blob must
+        # not be double-counted on top of it
+        broker = MemoryBroker()
+        ereg = self._engine_registry(5, [])
+        self._publish(broker, "e1", ereg)
+        gw = MetricsRegistry()
+        gw.counter("serving_records_total", "records").inc(
+            5, outcome="served", engine="e1")
+        agg = FleetMetricsAggregator(broker, STREAM, gw)
+        fam = agg.merged().get("serving_records_total")
+        assert fam.value(engine="e1", outcome="served") == 5
+        assert fam.value(outcome="served", scope="fleet") == 5
+
+    def test_scrape_age_tracks_seq_progress(self):
+        broker = MemoryBroker()
+        gw = MetricsRegistry()
+        reg = self._engine_registry(1, [])
+        pub = FleetMetricsPublisher(broker, STREAM, "e1", reg,
+                                    interval_s=30.0)
+        pub.publish_once()
+        agg = FleetMetricsAggregator(broker, STREAM, gw)
+        agg.merged()
+        age = gw.get("fleet_scrape_age_s")
+        assert age.value(engine="e1") < 1.0
+        assert agg.summary()["engines"]["e1"]["seq"] == 1
+        pub.publish_once()
+        agg.merged()
+        assert agg.summary()["engines"]["e1"]["seq"] == 2
+
+
+class TestHopsReadback:
+    @pytest.mark.filterwarnings("ignore")
+    def test_result_rows_carry_per_hop_timing(self):
+        broker = MemoryBroker()
+        im = InferenceModel().load_fn(lambda p, x: x * 2.0, params=())
+        srv = ClusterServing(im, broker=broker, engine_id="e1",
+                             registry=MetricsRegistry(), batch_size=4,
+                             batch_timeout_ms=2, trace_sample=1.0,
+                             trace_export_interval_s=0.1).start()
+        try:
+            inq = InputQueue(broker, trace_sample=1.0)
+            outq = OutputQueue(broker)
+            uri = inq.enqueue(t=np.ones(3, np.float32))
+            deadline = time.time() + 20
+            res = None
+            while res is None and time.time() < deadline:
+                res = outq.query(uri)
+                if res is None:
+                    time.sleep(0.005)
+            assert res is not None
+            hops = outq.last_hops[uri]
+            assert hops["engine"] == "e1"
+            # monotonic-clock durations, internally consistent
+            assert hops["engine_ms"] >= hops["device_ms"] >= 0.0
+            assert hops["engine_ms"] >= hops["queue_ms"] >= 0.0
+        finally:
+            srv.stop()
