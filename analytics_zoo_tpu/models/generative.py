@@ -1,0 +1,176 @@
+"""Tiny causal-LM decoder — the generative model contract decode mode serves.
+
+`serving/decode.py` and `InferenceModel.load_generative` are model-
+agnostic; what they need from a model is the functional triple this
+module defines (and any user model can supply):
+
+- ``init_params(seed)`` — a host pytree of weights.
+- ``init_kv(slots, max_kv_len)`` — the pooled KV cache: per layer a
+  ``{"k","v"}: [slots, heads, max_kv_len, head_dim]`` pair, ONE device
+  buffer per layer for the whole pool (the KVSlotPool leases rows of
+  it, never reallocates).
+- ``prefill_fn(params, kv, tokens, length, slot)`` — run the prompt
+  (padded to a static prompt bucket) through the stack, write its KV
+  into pool rows ``[slot, :, 0:len(tokens)]``, and return
+  ``(kv, logits)`` with logits taken at position ``length - 1`` — the
+  FIRST generated token comes out of prefill itself (that's what TTFT
+  measures).
+- ``step_fn(params, kv, tokens, positions, kv_bucket)`` — one decode
+  step for every slot at once: embed ``tokens[s]`` at ``positions[s]``,
+  append the new K/V at ``positions[s]``, attend over the first
+  ``positions[s] + 1`` cached positions (via the Pallas decode kernel
+  on TPU) and return ``(kv, logits[s])``. ``kv_bucket`` is a STATIC
+  int — the per-step serving bucket the scheduler picked — so each
+  bucket is its own executable (and its own compile-cache entry).
+
+Per-slot math is row-independent end to end (embedding, layernorm and
+matmuls act per row; attention only reads the slot's own KV rows), so a
+sequence's token stream is bitwise-identical whatever else occupies the
+other slots — the property the greedy-parity test asserts, and the
+reason continuous batching is a pure scheduling win. Writes for dead
+slots land in pool rows nobody reads (the engine passes position 0 and
+their KV is overwritten by the next prefill into that slot).
+
+The model itself is deliberately small (the serving stack is the
+subject, not the LM): GPT-style pre-LN blocks, learned positions, tied
+vocab kept untied for clarity, float32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pallas.decode_attention import (
+    _reference_decode_attention, decode_attention)
+
+
+def _layer_norm(x, g, b, eps: float = 1e-5):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+class TinyDecoder:
+    """Minimal functional causal LM exposing the decode-mode contract."""
+
+    def __init__(self, vocab: int = 64, n_layers: int = 2,
+                 n_heads: int = 2, head_dim: int = 8,
+                 max_len: int = 256, mlp_mult: int = 2,
+                 use_pallas: bool = True):
+        self.vocab = int(vocab)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.embed_dim = self.n_heads * self.head_dim
+        self.max_len = int(max_len)
+        self.mlp_dim = self.embed_dim * int(mlp_mult)
+        self.use_pallas = bool(use_pallas)
+
+    # -- weights / cache ---------------------------------------------------
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        rng = np.random.default_rng(seed)
+        E, M, V = self.embed_dim, self.mlp_dim, self.vocab
+
+        def w(*shape, scale=0.08):
+            return rng.normal(0.0, scale, shape).astype(np.float32)
+
+        layers: List[Dict[str, np.ndarray]] = []
+        for _ in range(self.n_layers):
+            layers.append({
+                "wq": w(E, E), "wk": w(E, E), "wv": w(E, E), "wo": w(E, E),
+                "w1": w(E, M), "b1": np.zeros(M, np.float32),
+                "w2": w(M, E), "b2": np.zeros(E, np.float32),
+                "ln1_g": np.ones(E, np.float32),
+                "ln1_b": np.zeros(E, np.float32),
+                "ln2_g": np.ones(E, np.float32),
+                "ln2_b": np.zeros(E, np.float32),
+            })
+        return {"embed": w(V, E, scale=0.5), "pos": w(self.max_len, E),
+                "layers": layers,
+                "lnf_g": np.ones(E, np.float32),
+                "lnf_b": np.zeros(E, np.float32),
+                "head": w(E, V, scale=0.3)}
+
+    def init_kv(self, slots: int, max_kv_len: int):
+        shape = (slots, self.n_heads, max_kv_len, self.head_dim)
+        return [{"k": jnp.zeros(shape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.float32)}
+                for _ in range(self.n_layers)]
+
+    # -- prefill -----------------------------------------------------------
+    def prefill_fn(self, params, kv, tokens, length, slot):
+        """tokens: int32 [P] (bucket-padded prompt), length/slot: int32
+        scalars. Returns (kv, logits[vocab]) — logits at the last REAL
+        prompt position."""
+        P = tokens.shape[0]
+        H, D = self.n_heads, self.head_dim
+        x = params["embed"][tokens] + params["pos"][:P]     # [P, E]
+        causal = jnp.tril(jnp.ones((P, P), jnp.float32))
+        mask = jnp.where(causal > 0, 0.0, -1e30)
+        new_kv = []
+        for lp, lkv in zip(params["layers"], kv):
+            h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+            q = (h @ lp["wq"]).reshape(P, H, D)
+            k = (h @ lp["wk"]).reshape(P, H, D)
+            v = (h @ lp["wv"]).reshape(P, H, D)
+            scores = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(D)
+            scores = scores.astype(jnp.float32) + mask[None]
+            w = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            att = jnp.einsum("hqk,khd->qhd", w, v).reshape(P, -1)
+            x = x + att @ lp["wo"]
+            h2 = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+            x = x + (jax.nn.gelu(h2 @ lp["w1"] + lp["b1"])
+                     @ lp["w2"] + lp["b2"])
+            # park this prompt's KV into the pool rows of `slot`
+            k_upd = jnp.transpose(k, (1, 0, 2))[None]        # [1,H,P,D]
+            v_upd = jnp.transpose(v, (1, 0, 2))[None]
+            zero = jnp.int32(0)
+            new_kv.append({
+                "k": jax.lax.dynamic_update_slice(
+                    lkv["k"], k_upd, (slot, zero, zero, zero)),
+                "v": jax.lax.dynamic_update_slice(
+                    lkv["v"], v_upd, (slot, zero, zero, zero))})
+        x_last = jax.lax.dynamic_index_in_dim(
+            x, length - 1, axis=0, keepdims=False)
+        x_last = _layer_norm(x_last, params["lnf_g"], params["lnf_b"])
+        return new_kv, x_last @ params["head"]
+
+    # -- decode step -------------------------------------------------------
+    def step_fn(self, params, kv, tokens, positions, kv_bucket: int):
+        """tokens/positions: int32 [S]. One token per slot; the KV write
+        lands at ``positions[s]`` and attention covers the first
+        ``positions[s] + 1`` positions, windowed to the static
+        ``kv_bucket``. Returns (kv, logits[S, vocab])."""
+        S = tokens.shape[0]
+        H, D = self.n_heads, self.head_dim
+        rows = jnp.arange(S)[:, None]                        # [S, 1]
+        heads = jnp.arange(H)[None, :]                       # [1, H]
+        x = params["embed"][tokens] + params["pos"][positions]   # [S, E]
+        lengths = positions.astype(jnp.int32) + 1
+        new_kv = []
+        for lp, lkv in zip(params["layers"], kv):
+            h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+            q = (h @ lp["wq"]).reshape(S, H, D)
+            k = (h @ lp["wk"]).reshape(S, H, D)
+            v = (h @ lp["wv"]).reshape(S, H, D)
+            k_pool = lkv["k"].at[rows, heads, positions[:, None]].set(k)
+            v_pool = lkv["v"].at[rows, heads, positions[:, None]].set(v)
+            if self.use_pallas:
+                att = decode_attention(q, k_pool, v_pool, lengths,
+                                       kv_bucket)
+            else:
+                att = _reference_decode_attention(q, k_pool, v_pool,
+                                                  lengths, kv_bucket)
+            x = x + att.reshape(S, -1) @ lp["wo"]
+            h2 = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+            x = x + (jax.nn.gelu(h2 @ lp["w1"] + lp["b1"])
+                     @ lp["w2"] + lp["b2"])
+            new_kv.append({"k": k_pool, "v": v_pool})
+        x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+        return new_kv, x @ params["head"]

@@ -1,0 +1,170 @@
+"""Decode attention — single-token queries against the pooled KV cache.
+
+The generative decode step (serving/decode.py) asks one question per
+leased slot: "given this slot's ONE new query vector, attend over the
+first `lengths[s]` cached positions of that slot's KV rows". Unlike
+flash attention (O(T²) work per call) decode attention is memory-bound:
+the arithmetic is two [1,D]×[D,L] products per head, but every byte of
+the live KV prefix streams from HBM each step. The kernel therefore
+reads the KV pool IN PLACE — `pallas_call` takes the full
+`[slots, H, max_kv_len, D]` pool buffers and the grid only visits the
+first `kv_bucket // block_k` key blocks, so no slice copy of the pool
+is ever materialized and the bytes actually moved scale with the
+serving bucket, not the pool capacity.
+
+Grid: (slots, heads, k-blocks) with the k axis innermost and
+"arbitrary", online-softmax state (acc, m, l) in VMEM scratch across k
+steps — the same canonical shape as `flash_attention`, degenerated to a
+1-row query block. Positions at or past `lengths[s]` are masked with a
+large negative additive constant (not -inf: a fully-masked first block
+would turn the running max into -inf and poison the rescale with
+inf-inf). `lengths` must be >= 1 per slot — the engine guarantees it
+(prefill writes at least one position before any step; dead slots are
+passed length 1 and their output rows are discarded host-side).
+
+Off-TPU the exact jnp gather path (`_reference_decode_attention`) runs
+instead — same math, no tiling — decided statically from the backend
+like `flash_attention._flash_supported`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pallas.dropout import _tpu_params
+
+
+def _reference_decode_attention(q, k_pool, v_pool, lengths, kv_bucket):
+    """Exact decode attention over the first `kv_bucket` pool positions.
+    q: [S, H, D]; k_pool/v_pool: [S, H, L, D]; lengths: int32 [S]."""
+    D = q.shape[-1]
+    k = jax.lax.slice_in_dim(k_pool, 0, kv_bucket, axis=2)
+    v = jax.lax.slice_in_dim(v_pool, 0, kv_bucket, axis=2)
+    scores = jnp.einsum("shd,shld->shl", q, k) / math.sqrt(D)
+    scores = scores.astype(jnp.float32)
+    pos = jnp.arange(kv_bucket, dtype=jnp.int32)
+    mask = pos[None, None, :] < lengths[:, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("shl,shld->shd", weights, v)
+
+
+def _decode_supported() -> bool:
+    """Static backend gate (no exception-driven fallback): the Mosaic
+    kernel runs on TPU; CPU tests take the exact reference path."""
+    return jax.default_backend() == "tpu"
+
+
+def _decode_cost(q, kv_bucket, n_heads, itemsize):
+    """Analytic roofline model (check_pallas_cost lint: HLO cost
+    analysis sees ~0 inside a Mosaic call). Decode is MEMORY-bound:
+    bytes are dominated by streaming the live K and V prefixes —
+    2 · S·H·kv_bucket·D — while flops are just the two bucket×D
+    products per (slot, head); the roofline accountant must see that
+    ratio or it would misread decode steps as idle compute."""
+    from jax.experimental import pallas as pl
+
+    S, H, D = q.shape[0], n_heads, q.shape[-1]
+    kv_bytes = 2.0 * S * H * kv_bucket * D * itemsize
+    qo_bytes = 2.0 * S * H * D * itemsize + 4.0 * S
+    return pl.CostEstimate(
+        flops=4.0 * S * H * kv_bucket * D,          # QKᵀ + PV
+        bytes_accessed=float(kv_bytes + qo_bytes),
+        transcendentals=float(S * H * kv_bucket))
+
+
+def _decode_kernel(scale, n_kb, q_ref, k_ref, v_ref, len_ref, o_ref,
+                   acc_sc, m_sc, l_sc):
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    ki = pl.program_id(2)
+    block_k = k_ref.shape[2]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, -1e30)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    qb = q_ref[0]                                          # [1, D]
+    kb = k_ref[0, 0]                                       # [bk, D]
+    vb = v_ref[0, 0]
+    scores = jnp.dot(qb, kb.T,
+                     preferred_element_type=jnp.float32) * scale  # [1, bk]
+    pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1)
+    scores = jnp.where(pos < len_ref[s, 0], scores, -1e30)
+    m_prev, l_prev = m_sc[...], l_sc[...]
+    m_new = jnp.maximum(m_prev, scores.max(axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)
+    acc_sc[...] = acc_sc[...] * alpha + jnp.dot(
+        p.astype(v_ref.dtype), vb, preferred_element_type=jnp.float32)
+    m_sc[...] = m_new
+    l_sc[...] = l_prev * alpha + p.sum(axis=1, keepdims=True)
+
+    @pl.when(ki == n_kb - 1)
+    def _flush():
+        o_ref[0] = (acc_sc[...] / l_sc[...]).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_pool, v_pool, lengths, kv_bucket: int,
+                     block_k: int = 128,
+                     interpret: Optional[bool] = None):
+    """One decode step of attention for every slot.
+
+    q: [S, H, D] — the current token's query per slot.
+    k_pool/v_pool: [S, H, L, D] — the FULL KV pool; only positions
+    [0, kv_bucket) are read (kv_bucket is the static serving bucket,
+    `<= L`, chosen per step by the DecodeScheduler).
+    lengths: int32 [S] — live KV length per slot, all >= 1; positions
+    >= lengths[s] are masked. Returns [S, H, D].
+    """
+    S, H, D = q.shape
+    L = k_pool.shape[2]
+    if not 1 <= kv_bucket <= L:
+        raise ValueError(f"kv_bucket {kv_bucket} outside [1, {L}]")
+    lengths = lengths.astype(jnp.int32)
+    if not (_decode_supported() or interpret):
+        return _reference_decode_attention(q, k_pool, v_pool, lengths,
+                                           kv_bucket)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block_k = min(block_k, kv_bucket)
+    if kv_bucket % block_k:
+        # bucket ladders are powers of two >= 1; a non-dividing block
+        # falls back to the exact path rather than padding the pool
+        return _reference_decode_attention(q, k_pool, v_pool, lengths,
+                                           kv_bucket)
+    n_kb = kv_bucket // block_k
+    scale = 1.0 / math.sqrt(D)
+    item = jnp.dtype(q.dtype).itemsize
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale, n_kb),
+        grid=(S, H, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, 1, D), lambda s, h, j: (s, h, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda s, h, j: (s, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda s, h, j: (s, h, j, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, D), lambda s, h, j: (s, h, 0)),
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, D), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+        compiler_params=_tpu_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=_decode_cost(q, kv_bucket, H, item),
+        interpret=bool(interpret) if interpret is not None else False,
+    )(q, k_pool, v_pool, lengths.reshape(S, 1))
+    return out

@@ -100,6 +100,11 @@ def cmd_start(args) -> int:
             trace_export_interval_s=cfg.trace_export_interval_s).start()
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
+    if cfg.generative:
+        # continuous-batching decode engine (ISSUE 18): replaces the
+        # request-batched dispatch path entirely — the frontend (if any)
+        # keeps serving /predict, now with ?stream=1 SSE token relay
+        return _start_generative(cfg, broker, frontend)
     model = cfg.build_model(broker=broker)
     mesh_note = ""
     if model.placement == "sharded" and model.mesh is not None:
@@ -248,6 +253,55 @@ def cmd_start(args) -> int:
             tracer.write_chrome_trace(cfg.trace_path)
             print(f"chrome trace written to {cfg.trace_path} "
                   "(open in ui.perfetto.dev)", flush=True)
+
+    return _run_until_signal(shutdown)
+
+
+def _start_generative(cfg, broker, frontend) -> int:
+    """Decode-mode tail of `cmd_start`: build + warm the generative
+    executables, start the continuous-batching engine, serve until
+    signalled. Warmup pre-compiles every (prompt bucket, kv bucket)
+    program so no XLA compile ever lands on the request path."""
+    from analytics_zoo_tpu.serving.decode import DecodeServing, _pow2_ladder
+    model, inst = cfg.build_generative_model()
+    kv_buckets = cfg.decode_kv_buckets or _pow2_ladder(
+        8, cfg.decode_max_kv_len)
+    prompt_buckets = cfg.decode_prompt_buckets or _pow2_ladder(
+        4, max(4, cfg.decode_max_kv_len // 2))
+    model.warmup_generative(inst.init_kv, slots=cfg.decode_slots,
+                            max_kv_len=cfg.decode_max_kv_len,
+                            prompt_buckets=prompt_buckets,
+                            kv_buckets=kv_buckets)
+    print(f"generative warmup: {json.dumps(model.warmup_report)}",
+          flush=True)
+    if model.compile_cache is not None:
+        src = model.warmup_source
+        s = model.compile_cache.stats()
+        print("compile cache: "
+              f"{sum(1 for v in src.values() if v == 'cached')} warmed "
+              f"from disk, "
+              f"{sum(1 for v in src.values() if v == 'compiled')} "
+              f"compiled fresh ({s['entries']} entries, {s['bytes']} "
+              f"bytes in {s['path']})", flush=True)
+    serving = DecodeServing(
+        model, inst.init_kv, broker=broker, stream=cfg.stream,
+        slots=cfg.decode_slots, max_kv_len=cfg.decode_max_kv_len,
+        kv_buckets=kv_buckets, prompt_buckets=prompt_buckets,
+        max_new_default=cfg.decode_max_new_tokens,
+        eos_id=cfg.decode_eos_id, deadline_ms=cfg.deadline_ms,
+        max_prefills_per_step=cfg.decode_max_prefills,
+        max_waiting=cfg.decode_max_waiting,
+        engine_id=cfg.resolve_engine_id()).start()
+    print(f"decode engine {serving.engine_id}: {cfg.decode_slots} KV "
+          f"slots x {cfg.decode_max_kv_len} positions, kv buckets "
+          f"{kv_buckets}, prompt buckets {prompt_buckets}", flush=True)
+    print("cluster serving started (generative)", flush=True)
+
+    def shutdown():
+        if frontend:
+            frontend.stop()
+        serving.stop()
+        print(json.dumps(serving.stats), flush=True)
 
     return _run_until_signal(shutdown)
 

@@ -353,12 +353,29 @@ class OutputQueue(_Reconnecting):
         # latency: e2e minus hops["engine_ms"] = wire + broker time
         self.last_hops: Dict[str, Dict] = {}
 
+    @staticmethod
+    def _token_row_fields(uri: str, raw: str) -> List[str]:
+        """Token rows a generative final result leaves behind
+        (decode-engine streaming, ISSUE 18): the final blob's
+        ``gen.rows`` counts its ``<uri>#<index>`` siblings, so a
+        deleting poll can clean them up in the same batched HDEL
+        instead of leaking them in the result hash."""
+        if not raw or raw[0] != "{":
+            return []
+        try:
+            rows = int(json.loads(raw).get("gen", {}).get("rows", 0))
+        except Exception:  # noqa: BLE001 — cleanup is best effort
+            return []
+        from analytics_zoo_tpu.serving.decode import token_row_field
+        return [token_row_field(uri, i) for i in range(rows)]
+
     def query(self, uri: str, delete: bool = False):
         raw = self._call(self.broker.hget, self.result_key, uri)
         if raw is None:
             return None
         if delete:
-            self._call(self.broker.hdel, self.result_key, uri)
+            self._call(self.broker.hdel_many, self.result_key,
+                       [uri] + self._token_row_fields(uri, raw))
         return self._decode(raw, uri=uri)
 
     def query_many(self, uris, delete: bool = False,
@@ -374,20 +391,100 @@ class OutputQueue(_Reconnecting):
                           deadline=deadline)
         found = {u: raw for u, raw in zip(uris, raws) if raw is not None}
         if delete and found:
+            fields = list(found)
+            for u, raw in found.items():
+                fields += self._token_row_fields(u, raw)
             self._call(self.broker.hdel_many, self.result_key,
-                       list(found), deadline=deadline)
+                       fields, deadline=deadline)
         return {u: self._decode(raw, uri=u) for u, raw in found.items()}
 
     def dequeue(self) -> Dict[str, np.ndarray]:
-        """Drain all results (`client.py:203` semantics): one read plus
-        one batched delete, not one round trip per field."""
+        """Drain all COMPLETED results (`client.py:203` semantics): one
+        read plus one batched delete, not one round trip per field.
+
+        Generative streaming (ISSUE 18) writes extra ``<uri>#<index>``
+        token rows before the final ``uri`` row lands; a result exists
+        only once its exact uri field does. Token rows whose final row
+        is present are consumed (deleted) with it; token rows of a
+        STILL-DECODING sequence are left in place — draining them would
+        misread a partial stream as a completed result."""
         allr = self._call(self.broker.hgetall, self.result_key)
-        out = {}
+        out, drop = {}, []
         for uri, raw in allr.items():
+            if "#" in uri:
+                base = uri.rsplit("#", 1)[0]
+                if base in allr:      # finished: consumed with its final
+                    drop.append(uri)
+                continue
             out[uri] = self._decode(raw, uri=uri)
-        if allr:
-            self._call(self.broker.hdel_many, self.result_key, list(allr))
+            drop.append(uri)
+        if drop:
+            self._call(self.broker.hdel_many, self.result_key, drop)
         return out
+
+    def stream_tokens(self, uri: str, timeout_s: float = 30.0,
+                      delete: bool = True):
+        """Incrementally consume one generative request's token stream.
+
+        Yields each token row ``{"i", "t", "ms"}`` as the decode engine
+        writes it, then one final ``{"done": True, "tokens": ndarray,
+        "gen": {...}}`` once the final row lands. Each poll sweep is ONE
+        HMGET asking for the next token row AND the final row; idle
+        polls back off exponentially (1 ms → 50 ms) like
+        `predict_batch`, and any progress resets the backoff. With
+        `delete` (default) the final row and every token row are
+        removed in one batched HDEL at completion. Raises TimeoutError
+        if the final row hasn't landed inside `timeout_s`."""
+        from analytics_zoo_tpu.serving.decode import token_row_field
+        deadline = time.monotonic() + timeout_s
+        nxt = 0
+        backoff = 0.001
+        while True:
+            fields = [token_row_field(uri, nxt), uri]
+            raws = self._call(self.broker.hmget, self.result_key, fields,
+                              deadline=deadline)
+            row, final = raws[0], raws[1]
+            if row is not None:
+                backoff = 0.001
+                nxt += 1
+                yield json.loads(row)
+                continue
+            if final is not None:
+                if final in ("NaN", "SHED"):
+                    if delete:
+                        self._call(self.broker.hdel, self.result_key, uri)
+                    yield {"done": True, "error": final, "tokens": None,
+                           "gen": {}}
+                    return
+                blob = json.loads(final)
+                gen = blob.get("gen", {})
+                # rows the engine wrote after our last sweep: the final
+                # row commits last, so any remaining token rows are
+                # already present — drain them in order before done
+                total = int(gen.get("rows", nxt))
+                while nxt < total:
+                    raw = self._call(self.broker.hget, self.result_key,
+                                     token_row_field(uri, nxt))
+                    if raw is None:     # non-streamed request: no rows
+                        break
+                    nxt += 1
+                    yield json.loads(raw)
+                if delete:
+                    self._call(
+                        self.broker.hdel_many, self.result_key,
+                        [uri] + [token_row_field(uri, i)
+                                 for i in range(total)])
+                blob.pop("hops", None)
+                yield {"done": True, "tokens": decode_ndarray(blob),
+                       "gen": gen}
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"no completed result for {uri} within {timeout_s}s "
+                    f"({nxt} token rows seen)")
+            time.sleep(min(backoff, remaining))
+            backoff = min(backoff * 2, 0.05)
 
     def _decode(self, raw: str, uri: Optional[str] = None):
         if raw == "NaN":   # per-record failure marker

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 
 def _load_yaml(path: str) -> Dict[str, Any]:
@@ -199,6 +199,21 @@ class ServingConfig:
     slo_latency_quantile: float = 0.95
     slo_availability: Optional[float] = None
     slo_window_s: float = 300.0
+    # generative decode mode (`serving/decode.py`): a params.generative
+    # block switches the engine from the request-batched dispatch path to
+    # the continuous-batching decode engine. slots sizes the pooled KV
+    # cache (one [slots, heads, max_kv_len, head_dim] buffer per layer);
+    # kv_buckets/prompt_buckets are the static shapes warmup pre-compiles
+    # (default: pow-2 ladders derived from max_kv_len).
+    generative: bool = False
+    decode_slots: int = 8
+    decode_max_kv_len: int = 256
+    decode_kv_buckets: Optional[List[int]] = None
+    decode_prompt_buckets: Optional[List[int]] = None
+    decode_max_new_tokens: int = 64
+    decode_eos_id: Optional[int] = None
+    decode_max_waiting: int = 256
+    decode_max_prefills: int = 4
     # on-demand profiler capture (POST /profile): artifact root +
     # rotation bound; profile_enabled: false turns the endpoint off
     # (404). Default root is <tmp>/zoo_profiles.
@@ -387,6 +402,28 @@ class ServingConfig:
             cfg.slo_window_s = float(slo["window_s"])
         cfg.build_slo()          # objective errors fail the load, like
         #                          placement — not the supervisor thread
+        gen = params.get("generative", None)
+        if gen is not None and not isinstance(gen, dict):
+            raise ValueError(
+                f"params.generative={gen!r} must be a map (slots, "
+                "max_kv_len, kv_buckets, prompt_buckets, max_new_tokens, "
+                "eos_id, max_waiting, max_prefills)")
+        if gen is not None:
+            cfg.generative = True
+            cfg.decode_slots = int(gen.get("slots", 8))
+            cfg.decode_max_kv_len = int(gen.get("max_kv_len", 256))
+            if gen.get("kv_buckets") is not None:
+                cfg.decode_kv_buckets = [
+                    int(b) for b in gen["kv_buckets"]]
+            if gen.get("prompt_buckets") is not None:
+                cfg.decode_prompt_buckets = [
+                    int(b) for b in gen["prompt_buckets"]]
+            cfg.decode_max_new_tokens = int(gen.get("max_new_tokens", 64))
+            if gen.get("eos_id") is not None:
+                cfg.decode_eos_id = int(gen["eos_id"])
+            cfg.decode_max_waiting = int(gen.get("max_waiting", 256))
+            cfg.decode_max_prefills = int(gen.get("max_prefills", 4))
+            cfg._validate_generative()
         cfg.profile_dir = params.get("profile_dir")
         cfg.profile_enabled = bool(params.get("profile_enabled", True))
         cfg.profile_max_artifacts = int(
@@ -633,6 +670,38 @@ class ServingConfig:
             return f"engine-{os.getpid()}-{uuid.uuid4().hex[:6]}"
         return str(self.engine_id)
 
+    def _validate_generative(self):
+        """Decode-mode sizing errors fail the load like placement: a KV
+        bucket larger than the pool, or a slot count < 1, would only
+        surface as a mid-warmup shape error otherwise."""
+        if self.decode_slots < 1:
+            raise ValueError(
+                f"params.generative.slots={self.decode_slots} must be >= 1")
+        if self.decode_max_kv_len < 2:
+            raise ValueError(
+                f"params.generative.max_kv_len={self.decode_max_kv_len} "
+                "must be >= 2")
+        for name, ladder in (("kv_buckets", self.decode_kv_buckets),
+                             ("prompt_buckets", self.decode_prompt_buckets)):
+            if ladder is None:
+                continue
+            if not ladder or any(int(b) < 1 for b in ladder):
+                raise ValueError(
+                    f"params.generative.{name}={ladder!r} must be a "
+                    "non-empty list of positive ints")
+            if max(ladder) > self.decode_max_kv_len:
+                raise ValueError(
+                    f"params.generative.{name} max {max(ladder)} exceeds "
+                    f"max_kv_len={self.decode_max_kv_len}")
+        if self.decode_max_new_tokens < 1:
+            raise ValueError(
+                f"params.generative.max_new_tokens="
+                f"{self.decode_max_new_tokens} must be >= 1")
+        if self.decode_max_prefills < 1:
+            raise ValueError(
+                f"params.generative.max_prefills="
+                f"{self.decode_max_prefills} must be >= 1")
+
     def _validate_compile_cache(self):
         """Cache-setting errors belong at config load, like placement:
         a bad path or a non-positive byte budget must fail the start
@@ -682,6 +751,33 @@ class ServingConfig:
         return CompileCache(self.compile_cache_dir,
                             max_bytes=self.compile_cache_max_bytes,
                             registry=registry)
+
+    def build_generative_model(self):
+        """Decode-mode model resolution: `model.class` must name a class
+        exposing the generative contract (`init_params`/`init_kv`/
+        `prefill_fn`/`step_fn` — see `models/generative.py`). Weights come
+        from the instance's own `init_params()` (a model that loads from
+        disk does so there); returns `(InferenceModel, instance)`."""
+        from analytics_zoo_tpu.serving.inference_model import InferenceModel
+        if not self.model_class:
+            raise ValueError(
+                "params.generative needs model.class naming a generative "
+                "model (init_params/init_kv/prefill_fn/step_fn)")
+        cls = _find_model_class(self.model_class)
+        kwargs = (self.extra.get("model", {}) or {}).get("config") or {}
+        inst = cls(**kwargs)
+        missing = [a for a in ("init_params", "init_kv",
+                               "prefill_fn", "step_fn")
+                   if not callable(getattr(inst, a, None))]
+        if missing:
+            raise ValueError(
+                f"model.class={self.model_class} lacks the generative "
+                f"contract: missing {', '.join(missing)}")
+        im = InferenceModel(placement="replicated", num_replicas=1,
+                            compile_cache=self.build_compile_cache())
+        im.load_generative(inst.prefill_fn, inst.step_fn,
+                           inst.init_params())
+        return im, inst
 
     def build_model(self, broker=None):
         """Model resolution (`ClusterServingHelper` model-type dispatch):
@@ -892,11 +988,11 @@ def wait_model_secret(broker, timeout_s: float = 60.0,
 
 
 def _find_model_class(name: str):
-    from analytics_zoo_tpu.models import (anomalydetection, bert, image,
-                                          recommendation, seq2seq,
+    from analytics_zoo_tpu.models import (anomalydetection, bert, generative,
+                                          image, recommendation, seq2seq,
                                           textclassification, textmatching)
     for mod in (recommendation, anomalydetection, textclassification,
-                textmatching, seq2seq, image, bert):
+                textmatching, seq2seq, image, bert, generative):
         if hasattr(mod, name):
             return getattr(mod, name)
     raise ValueError(f"Unknown model class {name!r}")

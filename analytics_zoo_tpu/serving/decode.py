@@ -1,0 +1,528 @@
+"""Continuous-batching decode engine — pooled KV slots, per-step planning.
+
+`ClusterServing` serves fixed-shape forwards: plan ONE dispatch, run it,
+write it back. Autoregressive generation breaks that shape — a request
+is now a prompt plus up to `max_new` dependent steps, and padding every
+sequence to the longest (then restarting the batch when all finish) is
+the pad-to-max baseline vLLM/Orca showed 2-10x worse than iteration-
+level scheduling. This module is that discipline on the existing rails:
+
+- ``KVSlotPool`` — the KV cache is pre-allocated ONCE as
+  ``[slots, heads, max_kv_len, head_dim]`` device buffers (one k/v pair
+  per layer, built by the model's ``init_kv``). A sequence leases a
+  slot row at admission and releases it at its final token — no
+  allocation, no reshape, no copy ever happens on the request path.
+  The ``serving_kv_slots_in_use`` gauge IS the admission signal: free
+  slots are the only capacity that matters in decode mode.
+- ``DecodeScheduler`` — generalizes the adaptive batch controller's
+  "plan one dispatch" to "plan EVERY step": at each step boundary
+  finished sequences free slots, queued prompts join (continuous
+  batching), and prefill admissions are budgeted under the same
+  deadline math — a prefill stalls every in-flight sequence for its
+  duration, so the scheduler admits only as many prompts per step as
+  the deadline budget covers (per-bucket EWMA costs, the PR 11 model,
+  one per phase).
+- ``DecodeServing`` — the engine loop: intake from the serving stream
+  (same record protocol — field ``t`` is the int32 prompt, plus
+  ``max_new``/``eos``/``stream``), prefill admitted prompts one at a
+  time, then ONE batched decode step for every leased slot at the kv
+  bucket covering the longest live sequence. Steps run on the AOT
+  executables `warmup_generative` pre-compiled — 0 XLA compiles on the
+  request path, the same contract the forward path enforces.
+
+Token streaming rides the existing result hash: each generated token of
+a ``stream``-flagged request is written as a row ``<uri>#<index>``
+(JSON ``{"i", "t", "ms"}``), and the FINAL row is the plain ``uri``
+field holding the standard b64 ndarray of all generated ids (plus a
+``gen`` summary) — so the non-streaming client path (exact-uri HMGET)
+is oblivious to the extra rows, completion is the presence of the exact
+uri field, and `OutputQueue.stream_tokens` polls rows incrementally.
+Final rows commit through the fused ``writeback`` (HSET+ACK) like the
+forward sink; token rows are a plain ``hset_many`` per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.broker import (Broker, connect_broker,
+                                              encode_ndarray)
+from analytics_zoo_tpu.serving.client import STREAM
+from analytics_zoo_tpu.serving.elastic import BucketCostModel
+from analytics_zoo_tpu.serving.inference_model import (InferenceModel,
+                                                       _next_bucket)
+
+log = logging.getLogger("analytics_zoo_tpu.serving.decode")
+
+GROUP = "serving_group"
+
+
+def _pow2_ladder(lo: int, hi: int) -> List[int]:
+    out, b = [], 1
+    while b < lo:
+        b *= 2
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return out
+
+
+def token_row_field(uri: str, index: int) -> str:
+    """Result-hash field name of one streamed token row. '#' never
+    appears in generated uris (uuid4 / frontend request ids), so the
+    exact-uri poll can never collide with a token row."""
+    return f"{uri}#{index:06d}"
+
+
+class KVSlotPool:
+    """Fixed pool of KV slots over ONE pre-allocated device buffer set.
+
+    The pytree in ``self.kv`` is threaded functionally through every
+    prefill/step call (the engine rebinds it to each call's returned
+    tree); the POOL object only tracks which rows are leased. Freed
+    rows are not zeroed — attention masks by live length and the next
+    prefill into the slot overwrites from position 0."""
+
+    def __init__(self, init_kv: Callable[[int, int], Any], slots: int,
+                 max_kv_len: int, registry=None,
+                 labels: Optional[Dict[str, str]] = None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.slots = int(slots)
+        self.max_kv_len = int(max_kv_len)
+        self.kv = init_kv(self.slots, self.max_kv_len)
+        self._free = list(range(self.slots - 1, -1, -1))   # lease 0 first
+        self._lock = threading.Lock()
+        self._labels = dict(labels or {})
+        if registry is None:
+            from analytics_zoo_tpu.observability.registry import get_registry
+            registry = get_registry()
+        self._gauge = registry.gauge(
+            "serving_kv_slots_in_use",
+            "KV-cache slots currently leased to in-flight sequences "
+            "(out of the engine's fixed slot pool) — the decode "
+            "engine's admission signal")
+        self._gauge.set(0.0, **self._labels)
+
+    def lease(self) -> Optional[int]:
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._gauge.set(self.slots - len(self._free), **self._labels)
+            return slot
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._free or not 0 <= slot < self.slots:
+                raise ValueError(f"release of unleased slot {slot}")
+            self._free.append(slot)
+            self._gauge.set(self.slots - len(self._free), **self._labels)
+
+    @property
+    def in_use(self) -> int:
+        with self._lock:
+            return self.slots - len(self._free)
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+@dataclasses.dataclass
+class StepPlan:
+    """One step's plan: how many waiting prompts board now, and the kv
+    bucket the step executable runs at."""
+    admit: int
+    kv_bucket: int
+    budget_ms: Optional[float]
+    reason: str
+
+
+class DecodeScheduler:
+    """Iteration-level planner — `AdaptiveBatchController` generalized
+    from "plan one dispatch" to "plan each decode step".
+
+    Two per-bucket EWMA cost models (the PR 11 `BucketCostModel`, one
+    labelled phase each) track what a decode step at kv bucket B and a
+    prefill at prompt bucket P actually cost on this host. With a
+    `deadline_ms`, admissions are budgeted: every prefill delays every
+    in-flight sequence's next token by its full cost, so the scheduler
+    admits prompts only while (step cost + admitted prefill costs)
+    stays inside the deadline — EXCEPT when no sequence is in flight,
+    where there is nothing to stall and the pool is the only limit.
+    Unknown costs (cold buckets) admit optimistically; the EWMA learns
+    from the very first observed step."""
+
+    def __init__(self, kv_buckets: Sequence[int],
+                 prompt_buckets: Sequence[int],
+                 registry=None, labels: Optional[Dict[str, str]] = None,
+                 deadline_ms: Optional[float] = None,
+                 margin_ms: float = 2.0, alpha: float = 0.2,
+                 max_prefills_per_step: Optional[int] = None):
+        labels = dict(labels or {})
+        self.kv_buckets = sorted(int(b) for b in kv_buckets)
+        self.prompt_buckets = sorted(int(b) for b in prompt_buckets)
+        self.deadline_ms = deadline_ms
+        self.margin_ms = float(margin_ms)
+        self.max_prefills_per_step = max_prefills_per_step
+        self.step_cost = BucketCostModel(
+            self.kv_buckets, registry, alpha=alpha,
+            labels={**labels, "phase": "decode_step"})
+        self.prefill_cost = BucketCostModel(
+            self.prompt_buckets, registry, alpha=alpha,
+            labels={**labels, "phase": "prefill"})
+
+    def prompt_bucket(self, n: int) -> int:
+        return _next_bucket(n, self.prompt_buckets)
+
+    def kv_bucket_for(self, needed: int) -> int:
+        return _next_bucket(needed, self.kv_buckets)
+
+    def plan_step(self, waiting_prompt_lens: Sequence[int],
+                  free_slots: int, active_lengths: Sequence[int]
+                  ) -> StepPlan:
+        """`waiting_prompt_lens`: prompt length per queued request, in
+        queue order. `active_lengths`: live KV length (pos + 1 of the
+        NEXT step) per in-flight sequence."""
+        cap = min(len(waiting_prompt_lens), int(free_slots))
+        if self.max_prefills_per_step is not None:
+            cap = min(cap, int(self.max_prefills_per_step))
+        needed = max(active_lengths) if active_lengths else 1
+        budget = None
+        reason = "free-slots" if cap else (
+            "pool-full" if waiting_prompt_lens else "no-waiting")
+        admit = cap
+        if cap and active_lengths and self.deadline_ms:
+            bucket = self.kv_bucket_for(needed)
+            step_ms = self.step_cost.cost_ms(bucket) or 0.0
+            budget = self.deadline_ms - self.margin_ms - step_ms
+            admit, spent = 0, 0.0
+            for n in waiting_prompt_lens[:cap]:
+                pb = self.prompt_bucket(n)
+                c = self.prefill_cost.cost_ms(pb)
+                spent += c if c is not None else 0.0
+                if admit and spent > budget:
+                    break
+                admit += 1
+            if admit < cap:
+                reason = "deadline"
+        for n in waiting_prompt_lens[:admit]:
+            needed = max(needed, n + 1)
+        return StepPlan(admit=admit,
+                        kv_bucket=self.kv_bucket_for(needed),
+                        budget_ms=budget, reason=reason)
+
+    def observe_step(self, kv_bucket: int, ms: float) -> None:
+        self.step_cost.observe(kv_bucket, ms)
+
+    def observe_prefill(self, prompt_bucket: int, ms: float) -> None:
+        self.prefill_cost.observe(prompt_bucket, ms)
+
+
+@dataclasses.dataclass
+class _Sequence:
+    uri: str
+    rid: str                       # stream record id (acked at finish)
+    prompt: np.ndarray             # int32 prompt ids
+    max_new: int
+    eos: Optional[int]
+    stream: bool
+    t_enqueue: float               # perf_counter at intake
+    slot: int = -1
+    pos: int = 0                   # live KV length
+    gen: List[int] = dataclasses.field(default_factory=list)
+    t_last: float = 0.0
+    rows: int = 0                  # token rows written so far
+    ttft_ms: Optional[float] = None
+    finish: str = ""
+
+
+class DecodeServing:
+    """The decode-mode engine. The model must already be
+    `load_generative()`-ed and `warmup_generative()`-ed with the SAME
+    slots/max_kv_len/bucket ladders — the engine never compiles."""
+
+    def __init__(self, model: InferenceModel,
+                 init_kv: Callable[[int, int], Any],
+                 broker: Optional[Broker] = None,
+                 stream: str = STREAM,
+                 slots: int = 8, max_kv_len: int = 128,
+                 kv_buckets: Optional[Sequence[int]] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 max_new_default: int = 32,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 max_prefills_per_step: Optional[int] = None,
+                 max_waiting: int = 256,
+                 engine_id: Optional[str] = None,
+                 registry=None,
+                 idle_block_ms: int = 50,
+                 drain_timeout_s: float = 10.0):
+        self.model = model
+        self.broker = broker if isinstance(broker, Broker) \
+            else connect_broker(broker)
+        self.stream = stream
+        self.result_key = f"result:{stream}"
+        self.max_kv_len = int(max_kv_len)
+        self.kv_buckets = sorted(kv_buckets) if kv_buckets \
+            else _pow2_ladder(8, self.max_kv_len)
+        self.prompt_buckets = sorted(prompt_buckets) if prompt_buckets \
+            else _pow2_ladder(4, max(4, self.max_kv_len // 2))
+        self.max_new_default = int(max_new_default)
+        self.eos_id = eos_id
+        self.max_waiting = int(max_waiting)
+        self.engine_id = engine_id or f"decode-{uuid.uuid4().hex[:8]}"
+        self.consumer = self.engine_id
+        self.idle_block_ms = int(idle_block_ms)
+        self.drain_timeout_s = float(drain_timeout_s)
+        if registry is None:
+            from analytics_zoo_tpu.observability.registry import get_registry
+            registry = get_registry()
+        self.registry = registry
+        labels = {"engine": self.engine_id}
+        self.pool = KVSlotPool(init_kv, slots, self.max_kv_len,
+                               registry=registry, labels=labels)
+        self.scheduler = DecodeScheduler(
+            self.kv_buckets, self.prompt_buckets, registry=registry,
+            labels=labels, deadline_ms=deadline_ms,
+            max_prefills_per_step=max_prefills_per_step)
+        self._tokens_total = registry.counter(
+            "serving_tokens_total",
+            "generated tokens written back by the decode engine")
+        self._ttft_hist = registry.histogram(
+            "serving_ttft_ms",
+            "time to first token: record enqueue to the first generated "
+            "token's writeback (prefill queue + prefill + first argmax) "
+            "— the generative SLO's latency input")
+        self._itl_hist = registry.histogram(
+            "serving_itl_ms",
+            "inter-token latency between consecutive generated tokens "
+            "of one sequence — the streaming smoothness SLO input")
+        self._waiting: deque = deque()
+        self._active: Dict[int, _Sequence] = {}     # slot -> sequence
+        self._stop = threading.Event()
+        self._drain_deadline: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self.stats: Dict[str, int] = {
+            "steps": 0, "slot_steps_active": 0, "slot_steps_total": 0,
+            "tokens": 0, "prefills": 0, "finished": 0, "shed": 0,
+            "failed": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "DecodeServing":
+        self._stop.clear()
+        self._drain_deadline = None
+        self._thread = threading.Thread(target=self.run,
+                                        name="decode-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        """Stop the loop; with `drain` (default) keep stepping until
+        every in-flight sequence finishes or `drain_timeout_s` runs
+        out. Un-drained records redeliver to a peer (at-least-once)."""
+        self._drain_deadline = time.monotonic() + (
+            self.drain_timeout_s if drain else 0.0)
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.drain_timeout_s + 10.0)
+        self._thread = None
+
+    def is_alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    # -- record intake -----------------------------------------------------
+    def _parse_record(self, rid, rec) -> Optional[_Sequence]:
+        from analytics_zoo_tpu.serving.pre_post import decode_record_field
+        data = rec["data"]
+        raw = data["t"] if "t" in data else data[next(iter(data))]
+        prompt = np.asarray(decode_record_field(raw)).astype(np.int32)
+        prompt = prompt.reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if prompt.size + 1 > self.max_kv_len:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no room to "
+                f"generate under max_kv_len={self.max_kv_len}")
+        max_new = int(data.get("max_new", self.max_new_default))
+        # a sequence can never outgrow its slot row
+        max_new = max(1, min(max_new, self.max_kv_len - prompt.size))
+        eos = data.get("eos", self.eos_id)
+        return _Sequence(
+            uri=rec["uri"], rid=rid, prompt=prompt, max_new=max_new,
+            eos=None if eos is None else int(eos),
+            stream=str(data.get("stream", "")) in ("1", "true", "True"),
+            t_enqueue=time.perf_counter())
+
+    def _intake(self):
+        if self._stop.is_set():
+            return
+        idle = not self._active and not self._waiting
+        count = max(1, self.pool.free_count + self.max_waiting
+                    - len(self._waiting))
+        records = self.broker.read_group(
+            self.stream, GROUP, self.consumer, count,
+            block_ms=self.idle_block_ms if idle else 0)
+        failed = []
+        for rid, rec in records:
+            try:
+                self._waiting.append(self._parse_record(rid, rec))
+            except Exception as e:  # noqa: BLE001 — degrade per record
+                uri = rec.get("uri", str(rid)) if isinstance(rec, dict) \
+                    else str(rid)
+                log.warning("decode intake failure for %s: %s", uri, e)
+                failed.append((rid, uri))
+        if failed:
+            self.stats["failed"] += len(failed)
+            self.broker.writeback(
+                self.result_key, {u: "NaN" for _, u in failed},
+                self.stream, GROUP, [r for r, _ in failed])
+        # overload: answer the newest arrivals with SHED (the oldest
+        # queued are closest to boarding — shedding them wastes wait)
+        shed = []
+        while len(self._waiting) > self.max_waiting:
+            shed.append(self._waiting.pop())
+        if shed:
+            self.stats["shed"] += len(shed)
+            self.broker.writeback(
+                self.result_key, {s.uri: "SHED" for s in shed},
+                self.stream, GROUP, [s.rid for s in shed])
+
+    # -- token emission ----------------------------------------------------
+    def _emit(self, seq: _Sequence, token: int, now: float,
+              token_rows: Dict[str, str]):
+        if not seq.gen:
+            seq.ttft_ms = (now - seq.t_enqueue) * 1e3
+            self._ttft_hist.observe(seq.ttft_ms, engine=self.engine_id)
+        else:
+            self._itl_hist.observe((now - seq.t_last) * 1e3,
+                                   engine=self.engine_id)
+        seq.t_last = now
+        seq.gen.append(int(token))
+        if seq.stream:
+            token_rows[token_row_field(seq.uri, seq.rows)] = json.dumps(
+                {"i": seq.rows, "t": int(token),
+                 "ms": round((now - seq.t_enqueue) * 1e3, 3)})
+            seq.rows += 1
+        self.stats["tokens"] += 1
+        if seq.eos is not None and int(token) == seq.eos:
+            seq.finish = "eos"
+        elif len(seq.gen) >= seq.max_new:
+            seq.finish = "length"
+        elif seq.pos >= self.max_kv_len:
+            seq.finish = "kv-full"
+
+    def _final_blob(self, seq: _Sequence) -> str:
+        blob = encode_ndarray(np.asarray(seq.gen, np.int32))
+        blob["gen"] = {"n": len(seq.gen), "rows": seq.rows,
+                       "finish": seq.finish,
+                       "ttft_ms": round(seq.ttft_ms or 0.0, 3)}
+        return json.dumps(blob)
+
+    # -- the step loop -----------------------------------------------------
+    def _run_step(self):
+        plan = self.scheduler.plan_step(
+            [s.prompt.size for s in self._waiting],
+            self.pool.free_count,
+            [s.pos + 1 for s in self._active.values()])
+        token_rows: Dict[str, str] = {}
+        finished: List[_Sequence] = []
+        for _ in range(plan.admit):
+            seq = self._waiting.popleft()
+            slot = self.pool.lease()
+            if slot is None:       # raced with nothing — defensive only
+                self._waiting.appendleft(seq)
+                break
+            pb = self.scheduler.prompt_bucket(seq.prompt.size)
+            padded = np.zeros(pb, np.int32)
+            padded[:seq.prompt.size] = seq.prompt
+            t0 = time.perf_counter()
+            self.pool.kv, logits = self.model.generative_prefill(
+                self.pool.kv, padded, seq.prompt.size, slot)
+            first = int(np.asarray(logits).argmax())   # forces the sync
+            dt = time.perf_counter() - t0
+            self.scheduler.observe_prefill(pb, dt * 1e3)
+            self.model.account_generative("prefill", pb, dt)
+            seq.slot, seq.pos = slot, int(seq.prompt.size)
+            self._active[slot] = seq
+            self.stats["prefills"] += 1
+            self._emit(seq, first, time.perf_counter(), token_rows)
+            if seq.finish:
+                finished.append(seq)
+        for seq in finished:       # finished straight out of prefill
+            del self._active[seq.slot]
+        if self._active:
+            slots_arr = np.zeros(self.pool.slots, np.int32)
+            pos_arr = np.zeros(self.pool.slots, np.int32)
+            for slot, seq in self._active.items():
+                slots_arr[slot] = seq.gen[-1]
+                pos_arr[slot] = seq.pos
+            bucket = self.scheduler.kv_bucket_for(
+                max(s.pos + 1 for s in self._active.values()))
+            t0 = time.perf_counter()
+            self.pool.kv, logits = self.model.generative_step(
+                self.pool.kv, slots_arr, pos_arr, bucket)
+            nxt = np.asarray(logits).argmax(axis=-1)   # forces the sync
+            dt = time.perf_counter() - t0
+            self.scheduler.observe_step(bucket, dt * 1e3)
+            self.model.account_generative("step", bucket, dt)
+            now = time.perf_counter()
+            self.stats["steps"] += 1
+            self.stats["slot_steps_total"] += self.pool.slots
+            self.stats["slot_steps_active"] += len(self._active)
+            for slot, seq in list(self._active.items()):
+                seq.pos += 1
+                self._emit(seq, int(nxt[slot]), now, token_rows)
+                if seq.finish:
+                    finished.append(seq)
+                    del self._active[slot]
+        if token_rows:
+            self.broker.hset_many(self.result_key, token_rows)
+        if finished:
+            self.broker.writeback(
+                self.result_key,
+                {s.uri: self._final_blob(s) for s in finished},
+                self.stream, GROUP, [s.rid for s in finished])
+            for seq in finished:
+                self.pool.release(seq.slot)
+            self.stats["finished"] += len(finished)
+
+    def run(self):
+        """The engine loop (inline-callable for tests; `start()` wraps
+        it in a thread). Every iteration: intake → plan → prefill
+        admissions → one batched decode step → writebacks."""
+        emitted_before = self.stats["tokens"]
+        while True:
+            if self._stop.is_set():
+                drained = not self._active and not self._waiting
+                if drained or (self._drain_deadline is not None
+                               and time.monotonic() > self._drain_deadline):
+                    break
+            self._intake()
+            before = self.stats["tokens"]
+            self._run_step()
+            delta = self.stats["tokens"] - before
+            if delta:
+                self._tokens_total.inc(delta, engine=self.engine_id)
+        if self.stats["tokens"] != emitted_before:
+            log.info("decode engine %s: %s", self.engine_id, self.stats)
+
+    def utilization(self) -> float:
+        """Useful slot-steps over total slot-steps — the bench's
+        headline ratio vs the pad-to-max baseline."""
+        total = self.stats["slot_steps_total"]
+        return self.stats["slot_steps_active"] / total if total else 0.0

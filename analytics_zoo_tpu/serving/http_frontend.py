@@ -41,7 +41,7 @@ from analytics_zoo_tpu.observability.prometheus import (
 from analytics_zoo_tpu.observability.registry import (MetricsRegistry,
                                                       get_registry)
 from analytics_zoo_tpu.serving.broker import Broker, connect_broker
-from analytics_zoo_tpu.serving.client import InputQueue
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.server import ClusterServing
 from analytics_zoo_tpu.serving.timer import Timer
 
@@ -506,6 +506,8 @@ class _Handler(BaseHTTPRequestHandler):
                            extra_headers={"Retry-After": str(
                                self.server.fleet.retry_after_s)})
                 return
+        qs = parse_qs(self.path.split("?", 1)[1]) \
+            if "?" in self.path else {}
         with self.server.request_timer.timing():
             try:
                 if req is None:
@@ -514,6 +516,10 @@ class _Handler(BaseHTTPRequestHandler):
                     # field spelling still rides to the engine's tiered
                     # scheduler even without gateway admission
                     tier = req.pop("tier", None)
+                if qs.get("stream", ["0"])[0] in ("1", "true"):
+                    # generative streaming (ISSUE 18): SSE per token
+                    self._predict_stream(req, tier)
+                    return
                 # {"instances": [[...], ...]} tf-serving-style (each
                 # instance is ONE serving record — they batch inside the
                 # serving loop), or {"b64","dtype","shape"} raw tensor
@@ -559,6 +565,66 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send(200, payload)
             except Exception as e:  # noqa: BLE001 — frontend must not die
                 self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+    def _predict_stream(self, req, tier):
+        """`POST /predict?stream=1` — server-sent events for one
+        generative request (decode-mode engines, ISSUE 18). The body
+        carries ``{"prompt": [token ids...], "max_new": N, "eos": id}``;
+        the record is enqueued with the ``stream`` flag so the engine
+        writes per-token rows, and this handler relays each row as one
+        ``data:`` event the moment its poll sweep sees it, closing with
+        an ``event: done`` carrying the full token array (exactly what
+        the non-streaming path would have returned). One request per
+        SSE response — batching streams would interleave sequences on
+        one ordered connection."""
+        prompt = req.get("prompt") if isinstance(req, dict) else None
+        if prompt is None and isinstance(req, dict) \
+                and len(req.get("instances") or []) == 1:
+            prompt = req["instances"][0]
+        if prompt is None:
+            self._send(400, {"error": "streaming /predict needs a "
+                                      "\"prompt\" token-id list "
+                                      "(or one-element \"instances\")"})
+            return
+        arr = np.asarray(prompt, np.int32).reshape(-1)
+        uris, t_ing, t0 = self._request_ids(1)
+        uri = uris[0] if uris else str(uuid.uuid4())
+        extra = {}
+        if isinstance(req, dict) and "max_new" in req:
+            extra["max_new"] = int(req["max_new"])
+        if isinstance(req, dict) and "eos" in req:
+            extra["eos"] = int(req["eos"])
+        self.server.input_queue.enqueue(uri, tier=tier, t=arr,
+                                        stream=1, **extra)
+        self._count_request(200)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        try:
+            for evt in self.server.output_queue.stream_tokens(
+                    uri, timeout_s=self.server.timeout_s):
+                if evt.get("done"):
+                    if evt.get("error"):
+                        payload = {"error": evt["error"],
+                                   "request_id": uri}
+                    else:
+                        payload = {"tokens":
+                                   np.asarray(evt["tokens"]).tolist(),
+                                   "gen": evt.get("gen", {}),
+                                   "request_id": uri}
+                    self.wfile.write(
+                        b"event: done\ndata: "
+                        + json.dumps(payload).encode() + b"\n\n")
+                else:
+                    self.wfile.write(b"data: "
+                                     + json.dumps(evt).encode() + b"\n\n")
+                self.wfile.flush()
+            self._gateway_span([uri] if uris else None, t_ing, t0)
+        except TimeoutError:
+            self.wfile.write(b"event: error\ndata: "
+                             b"{\"error\": \"timeout\"}\n\n")
+            self.wfile.flush()
 
     def _request_ids(self, n: int):
         """Pre-generated request ids (= trace ids) for a traced
@@ -736,6 +802,9 @@ class FrontEnd:
                                            trace_sample=self.trace_sample,
                                            trace_parent="gateway_request")
         self._srv.broker = self.broker
+        # generative streaming (ISSUE 18): SSE on /predict?stream=1
+        # polls token rows straight off the result hash
+        self._srv.output_queue = OutputQueue(self.broker)
         self._srv.serving = serving
         self._srv.request_timer = Timer("http_predict")
         self.registry = registry if registry is not None else get_registry()

@@ -39,6 +39,7 @@ task slot; here one per chip):
 
 from __future__ import annotations
 
+import functools
 import queue
 import threading
 import time
@@ -1348,6 +1349,207 @@ class InferenceModel:
             self.warmup_source[rkey] = "jit"
             self.warmed_buckets.add(b)
         return self
+
+    # -- generative decode mode (ISSUE 18) ---------------------------------
+    #
+    # Autoregressive serving replaces the single forward program with
+    # TWO program families: a PREFILL per prompt bucket (run the padded
+    # prompt, park its KV into one pool slot, emit the first token's
+    # logits) and a DECODE STEP per kv bucket (one token for every slot
+    # at once, windowed to the step's serving bucket). Both families go
+    # through the same persistent compile cache as the forward path —
+    # same `make_key` discipline (placement/sharding/dtype), with an
+    # `extra=("decode", kind, bucket)` discriminator because a step's
+    # INPUT signature is identical across kv buckets (the bucket is a
+    # static argument baked per executable, not a shape). Warmup
+    # pre-compiles every (prompt bucket × kv bucket) so the decode
+    # request path performs 0 XLA compiles — the same contract the
+    # compile-cache spy asserts for the forward path.
+
+    def load_generative(self, prefill_fn: Callable, step_fn: Callable,
+                        params) -> "InferenceModel":
+        """Load the decode-mode program pair (see models/generative.py
+        for the exact calling contract). Single-device placement only:
+        the KV pool is one device buffer threaded functionally through
+        every call — replicating or sharding it is a later PR's
+        problem, and silently ignoring the setting would serve from one
+        chip while claiming many."""
+        if self.placement != "replicated" or self.num_replicas != 1:
+            raise ValueError(
+                "load_generative supports single-device replicated "
+                f"placement only (got placement={self.placement!r}, "
+                f"num_replicas={self.num_replicas})")
+        self.close()
+        self._fn = None
+        self._jit = None
+        self._aot = {}
+        self.serving_dtype = self._infer_serving_dtype(params)
+        self._gen_prefill_fn = prefill_fn
+        self._gen_step_fn = step_fn
+        # one jit wrapper per program family; "step" wrappers are built
+        # per kv bucket (the bucket is static — each is its own program)
+        self._gen_jit = {"prefill": jax.jit(prefill_fn)}
+        self._gen_aot = {}
+        self._gen_cost = {}
+        self._gen_fp = None
+        if self.compile_cache is not None:
+            from analytics_zoo_tpu.compile_cache import model_fingerprint
+            # fingerprint BEFORE device placement, like load_fn
+            self._gen_fp = model_fingerprint((prefill_fn, step_fn), params)
+        if self._pin_single:
+            self._params = jax.device_put(params, self.devices[0])
+        else:
+            self._params = jax.device_put(params)
+        self.warmup_report = {}
+        self.warmup_source = {}
+        self.warmed_buckets = set()
+        try:
+            from analytics_zoo_tpu.observability.roofline import \
+                get_accountant
+            self._roofline = get_accountant()
+            self._roofline.reset("serving")
+        except Exception:  # noqa: BLE001 — telemetry only
+            self._roofline = None
+        return self
+
+    def _gen_step_jit(self, kv_bucket: int):
+        key = ("step", int(kv_bucket))
+        jitted = self._gen_jit.get(key)
+        if jitted is None:
+            jitted = jax.jit(functools.partial(self._gen_step_fn,
+                                               kv_bucket=int(kv_bucket)))
+            self._gen_jit[key] = jitted
+        return jitted
+
+    def _warm_gen(self, kind: str, bucket: int, jitted, args) -> str:
+        """Cache-backed warmup for one generative program — the decode
+        analogue of `_warm_executable` (same funnel: every fresh
+        compile goes through `serialization.compile_lowered`)."""
+        from analytics_zoo_tpu.compile_cache import make_key, serialization
+        tkey = (kind, int(bucket))
+        if tkey in self._gen_aot:
+            return "warm"
+        if not self._use_compile_cache():
+            # plain-jit fallback: run once so jax's own cache holds the
+            # executable; dispatch stays on the jit wrapper
+            jax.block_until_ready(jitted(*args))
+            try:
+                from analytics_zoo_tpu.observability.roofline import cost_of
+                c = cost_of(jitted.lower(*args))
+                if c is not None:
+                    self._gen_cost[tkey] = c
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+            return "jit"
+        sig = self._exec_sig(args)
+        key = make_key("serving", self._gen_fp or "", sig,
+                       placement=self.placement,
+                       dtype=self.serving_dtype
+                       if self.serving_dtype != "float32" else "",
+                       extra=("decode", kind, int(bucket)))
+        ex = self.compile_cache.load(key,
+                                     target_device_id=self.devices[0].id)
+        src = "cached"
+        if ex is not None:
+            stored = serialization.args_treedef(ex)
+            if stored != serialization.live_treedef(args):
+                ex = serialization.retree_call(ex, stored)
+        else:
+            t0 = time.perf_counter()
+            # module-attribute call: serialization.compile_lowered is
+            # THE fresh-compile funnel the 0-compile tests monkeypatch
+            ex = serialization.compile_lowered(jitted.lower(*args))
+            self.compile_cache.put(  # blocking-ok: disk cache write
+                key, ex, compile_ms=(time.perf_counter() - t0) * 1e3)
+            src = "compiled"
+        self._gen_aot[tkey] = ex
+        try:
+            from analytics_zoo_tpu.observability.roofline import cost_of
+            c = cost_of(ex)
+            if c is not None:
+                self._gen_cost[tkey] = c
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+        return src
+
+    def warmup_generative(self, init_kv: Callable, slots: int,
+                          max_kv_len: int, prompt_buckets: List[int],
+                          kv_buckets: List[int]) -> "InferenceModel":
+        """Pre-compile the whole decode program ladder: one prefill
+        executable per prompt bucket, one step executable per kv
+        bucket, each keyed (slots, bucket) through the persistent
+        cache. The scratch KV pool built here is warmup-only — the
+        engine allocates its own with identical shapes, so every
+        request-path call lands on a warmed executable."""
+        if getattr(self, "_gen_jit", None) is None:
+            raise RuntimeError("load_generative() first")
+        params = self._params
+        kv = init_kv(int(slots), int(max_kv_len))
+        for P in sorted({int(p) for p in prompt_buckets}):
+            args = (params, kv, np.zeros(P, np.int32),
+                    np.int32(1), np.int32(0))
+            t0 = time.perf_counter()
+            src = self._warm_gen("prefill", P, self._gen_jit["prefill"],
+                                 args)
+            ex = self._gen_aot.get(("prefill", P))
+            if ex is not None:
+                jax.block_until_ready(ex(*args))
+            rkey = f"gen-prefill:p{P}"
+            self.warmup_report[rkey] = round(time.perf_counter() - t0, 4)
+            self.warmup_source[rkey] = src
+        for b in sorted({int(b) for b in kv_buckets}):
+            if b > max_kv_len:
+                raise ValueError(f"kv bucket {b} exceeds max_kv_len "
+                                 f"{max_kv_len}")
+            args = (params, kv, np.zeros(slots, np.int32),
+                    np.zeros(slots, np.int32))
+            t0 = time.perf_counter()
+            src = self._warm_gen("step", b, self._gen_step_jit(b), args)
+            ex = self._gen_aot.get(("step", b))
+            if ex is not None:
+                jax.block_until_ready(ex(*args))
+            rkey = f"gen-step:kv{b}"
+            self.warmup_report[rkey] = round(time.perf_counter() - t0, 4)
+            self.warmup_source[rkey] = src
+        return self
+
+    def generative_prefill(self, kv, tokens, length, slot):
+        """One prompt through the warmed prefill executable for its
+        bucket (tokens MUST already be padded to a warmed bucket).
+        Returns (kv, logits)."""
+        tokens = np.ascontiguousarray(tokens, np.int32)
+        args = (self._params, kv, tokens, np.int32(length), np.int32(slot))
+        ex = self._gen_aot.get(("prefill", int(tokens.shape[-1])))
+        if ex is not None:
+            return ex(*args)
+        return self._gen_jit["prefill"](*args)
+
+    def generative_step(self, kv, tokens, positions, kv_bucket: int):
+        """One decode step for every slot under the static serving
+        bucket. Returns (kv, logits[slots, vocab])."""
+        args = (self._params, kv,
+                np.ascontiguousarray(tokens, np.int32),
+                np.ascontiguousarray(positions, np.int32))
+        ex = self._gen_aot.get(("step", int(kv_bucket)))
+        if ex is not None:
+            return ex(*args)
+        return self._gen_step_jit(int(kv_bucket))(*args)
+
+    def account_generative(self, kind: str, bucket: int, secs: float):
+        """Charge one generative call against the serving roofline with
+        the cost harvested at warmup — decode is memory-bound and the
+        Pallas kernel's analytic estimate is what makes the accountant
+        see that (HLO cost analysis is blind inside a Mosaic call)."""
+        if self._roofline is None:
+            return
+        cost = getattr(self, "_gen_cost", {}).get((kind, int(bucket)))
+        if cost is None:
+            return
+        try:
+            self._roofline.account("serving", cost.flops, cost.bytes,
+                                   secs, n_devices=1)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
 
     def compile_cache_size(self) -> int:
         """Number of in-process executables this model holds: AOT

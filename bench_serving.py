@@ -1618,6 +1618,205 @@ def _chaos_rollout_main(args) -> int:
 # -- elastic: diurnal + spike replay, static vs autoscaled fleet -----------
 # (ISSUE 11)
 
+def _generative_main(args) -> int:
+    """Continuous batching A/B (ISSUE 18): the decode engine vs a
+    pad-to-max-restart baseline on the SAME executables and the SAME
+    seeded Poisson arrival process with a short-skewed output-length
+    mix. The baseline is the naive generative server: seat up to
+    `slots` waiting prompts, decode the whole batch to its LONGEST
+    max_new, only then admit the next batch — every early finisher
+    holds its slot idle until the batch's straggler is done, and every
+    arrival mid-batch waits for the restart. Reports tokens/sec, TTFT
+    and inter-token-latency p50/p99 for both legs, the slot-utilization
+    ratio (active-slot-steps over pool-width-steps), and the fresh-XLA-
+    compile count on the continuous leg's request path (must be 0: the
+    compile funnel is spied after warmup)."""
+    import analytics_zoo_tpu.compile_cache.serialization as ccser
+    from analytics_zoo_tpu.models.generative import TinyDecoder
+    from analytics_zoo_tpu.serving.broker import MemoryBroker
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.decode import DecodeServing
+    from analytics_zoo_tpu.serving.inference_model import (InferenceModel,
+                                                           _next_bucket)
+
+    SLOTS, MAX_KV = 8, 128
+    KV_BUCKETS = [16, 32, 64, 128]
+    PROMPT_BUCKETS = [8, 16]
+    MAX_NEW_CAP = 48
+    n = int(os.environ.get("BENCH_GEN_REQUESTS", 64))
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, 64,
+                            size=int(rng.integers(2, 15))).astype(np.int32)
+               for _ in range(n)]
+    # bimodal output mix — mostly short (geometric, mean ~5) with every
+    # 8th request a full-length straggler (the chat + summarization mix
+    # of the Orca/vLLM evals): the regime where pad-to-max wastes the
+    # most slot-steps, because each straggler pins its whole batch
+    max_new = np.minimum(1 + rng.geometric(0.25, n),
+                         MAX_NEW_CAP).astype(int)
+    max_new[::8] = MAX_NEW_CAP
+    # arrival rate sized to SATURATE the slot pool (the regime the A/B
+    # is about: under light load both disciplines idle and tie)
+    arrivals = np.cumsum(rng.exponential(0.002, n))
+
+    # big enough that step COMPUTE dominates the engine's per-step
+    # bookkeeping (broker intake + token-row writes); a 2-layer toy
+    # makes the A/B measure engine overhead instead of scheduling
+    dec = TinyDecoder(vocab=128, n_layers=4, n_heads=4, head_dim=16,
+                      max_len=MAX_KV)
+    im = InferenceModel(placement="replicated", num_replicas=1)
+    im.load_generative(dec.prefill_fn, dec.step_fn, dec.init_params(0))
+    t0 = time.perf_counter()
+    im.warmup_generative(dec.init_kv, slots=SLOTS, max_kv_len=MAX_KV,
+                         prompt_buckets=PROMPT_BUCKETS,
+                         kv_buckets=KV_BUCKETS)
+    warmup_s = time.perf_counter() - t0
+
+    # ---- continuous leg: the decode engine over the broker rails ----
+    compile_calls = []
+    orig_compile = ccser.compile_lowered
+
+    def spy(lowered):
+        compile_calls.append(1)
+        return orig_compile(lowered)
+
+    ccser.compile_lowered = spy
+    broker = MemoryBroker()
+    srv = DecodeServing(im, dec.init_kv, broker=broker, slots=SLOTS,
+                        max_kv_len=MAX_KV, kv_buckets=KV_BUCKETS,
+                        prompt_buckets=PROMPT_BUCKETS,
+                        max_new_default=MAX_NEW_CAP).start()
+    inq = InputQueue(broker)
+    outq = OutputQueue(broker)
+    t0 = time.perf_counter()
+    uris = []
+    for i in range(n):
+        dt = t0 + arrivals[i] - time.perf_counter()
+        if dt > 0:
+            time.sleep(dt)
+        uris.append(inq.enqueue(t=prompts[i], max_new=int(max_new[i]),
+                                stream=1))
+    while srv.stats["finished"] < n:          # serving wall, not
+        time.sleep(0.001)                     # post-hoc drain time
+        if time.perf_counter() - t0 > 300:
+            raise SystemExit("continuous leg stalled")
+    cont_wall = time.perf_counter() - t0
+    cont_ttft, cont_itl = [], []
+    for u in uris:                            # post-hoc stream drain
+        ms = [e["ms"] for e in outq.stream_tokens(u, timeout_s=30)
+              if not e.get("done")]
+        cont_ttft.append(ms[0])
+        cont_itl += list(np.diff(ms))
+    srv.stop()
+    ccser.compile_lowered = orig_compile
+    cont = {
+        "tokens": srv.stats["tokens"],
+        "wall_s": round(cont_wall, 4),
+        "tokens_per_s": round(srv.stats["tokens"] / cont_wall, 1),
+        "ttft_ms": {"p50": round(_percentile(cont_ttft, 0.5), 3),
+                    "p99": round(_percentile(cont_ttft, 0.99), 3)},
+        "itl_ms": {"p50": round(_percentile(cont_itl, 0.5), 3),
+                   "p99": round(_percentile(cont_itl, 0.99), 3)},
+        "slot_utilization": round(srv.utilization(), 4),
+        "steps": srv.stats["steps"],
+    }
+
+    # ---- baseline leg: pad-to-max-restart on the same executables ----
+    kv = dec.init_kv(SLOTS, MAX_KV)
+    t0 = time.perf_counter()
+    base_ttft, base_itl = [], []
+    toks, pos, gen, last = {}, {}, {}, {}
+    slot_active = slot_total = steps = tokens = 0
+    arrived = finished = 0
+    from collections import deque
+    waiting: deque = deque()
+    while finished < n:
+        now = time.perf_counter() - t0
+        while arrived < n and arrivals[arrived] <= now:
+            waiting.append(arrived)
+            arrived += 1
+        if not waiting:
+            time.sleep(max(0.0, t0 + arrivals[arrived]
+                           - time.perf_counter()))
+            continue
+        batch = [waiting.popleft()
+                 for _ in range(min(SLOTS, len(waiting)))]
+        for s, idx in enumerate(batch):
+            p = prompts[idx]
+            pb = _next_bucket(len(p), PROMPT_BUCKETS)
+            padded = np.zeros(pb, np.int32)
+            padded[:len(p)] = p
+            kv, logits = im.generative_prefill(kv, padded, len(p), s)
+            toks[idx] = int(np.asarray(logits).argmax())
+            tnow = time.perf_counter() - t0
+            base_ttft.append((tnow - arrivals[idx]) * 1e3)
+            last[idx], gen[idx], pos[idx] = tnow, 1, len(p)
+            tokens += 1
+        # pad-to-max: the batch decodes until its LONGEST request is
+        # done; early finishers keep burning their slot
+        for _ in range(max(max_new[idx] for idx in batch) - 1):
+            toks_arr = np.zeros(SLOTS, np.int32)
+            pos_arr = np.zeros(SLOTS, np.int32)
+            for s, idx in enumerate(batch):
+                toks_arr[s] = toks[idx]
+                pos_arr[s] = pos[idx]
+            bucket = _next_bucket(
+                max(pos[idx] + 1 for idx in batch), KV_BUCKETS)
+            kv, logits = im.generative_step(kv, toks_arr, pos_arr, bucket)
+            nxt = np.asarray(logits).argmax(axis=-1)
+            tnow = time.perf_counter() - t0
+            steps += 1
+            slot_total += SLOTS
+            slot_active += sum(1 for idx in batch
+                               if gen[idx] < max_new[idx])
+            for s, idx in enumerate(batch):
+                pos[idx] += 1
+                if gen[idx] < max_new[idx]:
+                    toks[idx] = int(nxt[s])
+                    base_itl.append((tnow - last[idx]) * 1e3)
+                    last[idx] = tnow
+                    gen[idx] += 1
+                    tokens += 1
+        finished += len(batch)
+    base_wall = time.perf_counter() - t0
+    base_util = slot_active / slot_total if slot_total else 0.0
+    base = {
+        "tokens": tokens,
+        "wall_s": round(base_wall, 4),
+        "tokens_per_s": round(tokens / base_wall, 1),
+        "ttft_ms": {"p50": round(_percentile(base_ttft, 0.5), 3),
+                    "p99": round(_percentile(base_ttft, 0.99), 3)},
+        "itl_ms": {"p50": round(_percentile(base_itl, 0.5), 3),
+                   "p99": round(_percentile(base_itl, 0.99), 3)},
+        "slot_utilization": round(base_util, 4),
+        "steps": steps,
+    }
+
+    out = {
+        "mode": "generative",
+        "backend": jax.default_backend(),
+        "n_requests": n, "slots": SLOTS, "max_kv_len": MAX_KV,
+        "kv_buckets": KV_BUCKETS, "prompt_buckets": PROMPT_BUCKETS,
+        "output_len_mix": {"mean": round(float(max_new.mean()), 2),
+                           "max": int(max_new.max()),
+                           "cap": MAX_NEW_CAP},
+        "warmup_s": round(warmup_s, 3),
+        "cold_compiles": len(compile_calls),
+        "continuous": cont,
+        "baseline_pad_to_max": base,
+        "utilization_ratio": round(
+            cont["slot_utilization"] / base_util, 2) if base_util else None,
+        "tokens_per_s_speedup": round(
+            cont["tokens_per_s"] / base["tokens_per_s"], 2),
+        "ttft_p99_ratio": round(
+            base["ttft_ms"]["p99"] / cont["ttft_ms"]["p99"], 2),
+    }
+    assert out["cold_compiles"] == 0, \
+        "XLA compiled on the decode request path after warmup"
+    print(json.dumps(out))
+    return 0
+
+
 def _percentile(samples, q):
     """np.percentile, the same interpolated estimator every other
     p50/p99 in this file uses — a nearest-rank variant here would make
@@ -2539,6 +2738,12 @@ def main():
                     help=argparse.SUPPRESS)
     ap.add_argument("--partition-lease-ttl", type=float, default=5.0,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--generative", action="store_true",
+                    help="generative mode (ISSUE 18): continuous-"
+                         "batching decode engine vs pad-to-max-restart "
+                         "baseline on a seeded Poisson prompt/output "
+                         "mix; tokens/sec, TTFT/ITL p99, slot-"
+                         "utilization ratio, 0-compile assertion")
     args = ap.parse_args()
     if args.fleet_child:
         if not (args.broker_url and args.engine_id):
@@ -2555,6 +2760,8 @@ def main():
         return _int8_ab_main(args)
     if args.trace_overhead:
         return _trace_overhead_main(args)
+    if args.generative:
+        return _generative_main(args)
     if args.elastic:
         return _elastic_main(args)
     if args.chaos:

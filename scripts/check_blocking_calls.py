@@ -71,6 +71,11 @@ CONTROL_LOOP_FILES = (
     # here delays a lease renewal past its TTL and hands the partition
     # (or the gateway leadership) to a peer mid-drain
     os.path.join(SERVING_PKG, "partitions.py"),
+    # the continuous-batching decode engine (ISSUE 18): the step loop
+    # IS the serving latency — a sleep between steps inflates every
+    # active sequence's inter-token latency by its full duration; all
+    # pacing goes through broker block_ms and stop-event waits
+    os.path.join(SERVING_PKG, "decode.py"),
 )
 SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 BARE_EXCEPT_RE = re.compile(r"^\s*except\s*:", re.MULTILINE)

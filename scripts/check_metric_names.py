@@ -107,6 +107,14 @@ REQUIRED = {
     "serving_backlog_depth": "gauge",
     "serving_engines_target": "gauge",
     "serving_autoscaler_decisions_total": "counter",
+    # generative serving (ISSUE 18): per-token telemetry from the
+    # continuous-batching decode engine — tokens throughput, the two
+    # streaming SLO inputs (TTFT, inter-token latency), and the KV slot
+    # occupancy gauge that drives admission
+    "serving_tokens_total": "counter",
+    "serving_ttft_ms": "histogram",
+    "serving_itl_ms": "histogram",
+    "serving_kv_slots_in_use": "gauge",
     # big-model frontier (ISSUE 12): quantized serving + tensor-parallel
     # placement telemetry — the families the int8 A/B bench, the docs
     # tables and any capacity dashboard read. serving_weight_bytes is

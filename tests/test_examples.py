@@ -35,6 +35,7 @@ EXAMPLES = [
     ("streaming_text_classification.py", []),
     ("streaming_object_detection.py", []),
     ("quantized_serving.py", []),
+    ("generative_serving.py", []),
     ("inception_imagenet.py", ["--image-size", "32", "--batch", "8",
                                "--fixture-shards", "2",
                                "--fixture-per-shard", "16",

@@ -330,6 +330,48 @@ class TestLargeBlocks:
                                        rtol=5e-2, atol=5e-3)
 
 
+class TestDecodeAttentionOnChip:
+    """The generative decode-step kernel (`pallas/decode_attention.py`)
+    vs its exact reference — the CPU suite only ever runs the reference
+    path, so the Mosaic lowering (pool read in place, SMEM lengths,
+    online softmax across k-blocks) is exercised here only."""
+
+    def _pool(self, S=8, H=4, L=256, D=64, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (S, H, D), jnp.float32) * 0.3
+        k = jax.random.normal(ks[1], (S, H, L, D), jnp.float32) * 0.3
+        v = jax.random.normal(ks[2], (S, H, L, D), jnp.float32) * 0.3
+        return q, k, v
+
+    def test_matches_reference_mixed_lengths(self):
+        from analytics_zoo_tpu.pallas.decode_attention import (
+            _reference_decode_attention, decode_attention)
+        q, k, v = self._pool()
+        # spans both k-blocks; includes length 1 (single live position)
+        # and a fully-masked second block
+        lengths = jnp.asarray([1, 7, 64, 128, 129, 200, 255, 256],
+                              jnp.int32)
+        got = np.asarray(decode_attention(q, k, v, lengths, kv_bucket=256))
+        ref = np.asarray(_reference_decode_attention(q, k, v, lengths,
+                                                     kv_bucket=256))
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
+
+    def test_bucket_window_ignores_pool_tail(self):
+        from analytics_zoo_tpu.pallas.decode_attention import (
+            _reference_decode_attention, decode_attention)
+        q, k, v = self._pool(seed=1)
+        lengths = jnp.asarray([3, 9, 17, 33, 48, 64, 64, 64], jnp.int32)
+        # kv_bucket < L: positions >= 64 must never be read; poisoning
+        # the tail makes any out-of-window access visible as NaN
+        k = k.at[:, :, 64:].set(jnp.nan)
+        v = v.at[:, :, 64:].set(jnp.nan)
+        got = np.asarray(decode_attention(q, k, v, lengths, kv_bucket=64))
+        ref = np.asarray(_reference_decode_attention(q, k, v, lengths,
+                                                     kv_bucket=64))
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
+
+
 class TestFusedDropout:
     """Pallas in-kernel-RNG dropout (`pallas/dropout.py`): determinism,
     mask/grad bit-identity (the VJP regenerates, never stores), and the
