@@ -47,7 +47,8 @@ import jax
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.pallas.decode_attention import (
-    _reference_decode_attention, decode_attention)
+    _reference_decode_attention, _reference_paged_decode_attention,
+    decode_attention, gather_kv_window, paged_decode_attention)
 
 
 def _layer_norm(x, g, b, eps: float = 1e-5):
@@ -167,6 +168,148 @@ class TinyDecoder:
             else:
                 att = _reference_decode_attention(q, k_pool, v_pool,
                                                   lengths, kv_bucket)
+            x = x + att.reshape(S, -1) @ lp["wo"]
+            h2 = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+            x = x + (jax.nn.gelu(h2 @ lp["w1"] + lp["b1"])
+                     @ lp["w2"] + lp["b2"])
+            new_kv.append({"k": k_pool, "v": v_pool})
+        x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+        return new_kv, x @ params["head"]
+
+    # -- paged contract (ISSUE 19) -----------------------------------------
+    # Same math, block-pool memory layout: the cache is ONE pool of
+    # ref-counted [heads, block_len, head_dim] blocks per layer and each
+    # sequence owns an ordered block table. Greedy outputs stay bitwise
+    # identical to the contiguous contract because every numeric op is
+    # the same — only WHERE the KV bytes live changes.
+    def init_kv_blocks(self, num_blocks: int, block_len: int):
+        shape = (num_blocks, self.n_heads, block_len, self.head_dim)
+        return [{"k": jnp.zeros(shape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.float32)}
+                for _ in range(self.n_layers)]
+
+    def paged_prefill_fn(self, params, kv, tokens, table, pre_len,
+                         chunk_len, kv_bucket: int):
+        """One prefill CHUNK of a prompt, KV parked through the block
+        table.
+
+        tokens: int32 [Cb] — this chunk, padded to a static chunk
+        bucket. table: int32 [T] — the sequence's block table (covers
+        at least ``pre_len + Cb`` logical positions). pre_len: int32
+        scalar — tokens already in KV (adopted prefix blocks plus
+        earlier chunks). chunk_len: int32 scalar — real tokens in this
+        chunk. kv_bucket: STATIC context window covering ``pre_len``
+        (0 on the fresh first chunk — by construction ``pre_len == 0``
+        exactly when ``kv_bucket == 0``, since any cached or prior-chunk
+        context needs a window to attend over).
+
+        Returns (kv, logits[vocab]) at chunk position ``chunk_len - 1``
+        — meaningful on the FINAL chunk (first generated token), ignored
+        by the engine on intermediate ones.
+
+        The ``kv_bucket == 0`` branch is op-for-op the contiguous
+        ``prefill_fn`` (static ``pos[:Cb]`` slice, same causal-mask
+        einsum walk), so a fresh single-chunk prompt produces bitwise-
+        identical first-token logits — the paged-parity anchor."""
+        Cb = tokens.shape[0]
+        H, D = self.n_heads, self.head_dim
+        bl = kv[0]["k"].shape[2]
+        num_blocks = kv[0]["k"].shape[0]
+        heads = jnp.arange(H)[None, :]                       # [1, H]
+        pre_len = jnp.asarray(pre_len, jnp.int32)
+        chunk_len = jnp.asarray(chunk_len, jnp.int32)
+        table = table.astype(jnp.int32)
+        idx = jnp.arange(Cb, dtype=jnp.int32)
+        logical = pre_len + idx                              # [Cb]
+        if kv_bucket == 0:
+            x = params["embed"][tokens] + params["pos"][:Cb]
+        else:
+            # gather (not dynamic_slice) so real positions near max_len
+            # are never shifted by start-clamping
+            x = params["embed"][tokens] + params["pos"][
+                jnp.clip(logical, 0, self.max_len - 1)]
+        causal = jnp.tril(jnp.ones((Cb, Cb), jnp.float32))
+        cmask = jnp.where(causal > 0, 0.0, -1e30)
+        # KV scatter destinations: pad positions (idx >= chunk_len) are
+        # routed out of bounds — JAX drops OOB scatter updates — so a
+        # padded chunk never corrupts the next chunk's blocks
+        blk = table[jnp.clip(logical // bl, 0, table.shape[0] - 1)]
+        blk = jnp.where(idx < chunk_len, blk, num_blocks)    # [Cb]
+        off = logical % bl
+        new_kv = []
+        for lp, lkv in zip(params["layers"], kv):
+            h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+            q = (h @ lp["wq"]).reshape(Cb, H, D)
+            k = (h @ lp["wk"]).reshape(Cb, H, D)
+            v = (h @ lp["wv"]).reshape(Cb, H, D)
+            if kv_bucket == 0:
+                scores = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(D)
+                scores = scores.astype(jnp.float32) + cmask[None]
+                w = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+                att = jnp.einsum("hqk,khd->qhd", w, v).reshape(Cb, -1)
+            else:
+                # context (earlier logical positions, read through the
+                # table BEFORE this chunk's writes) ++ in-chunk causal
+                ctx_k = gather_kv_window(
+                    lkv["k"], table[None], kv_bucket)[0]     # [H,kvb,D]
+                ctx_v = gather_kv_window(lkv["v"], table[None],
+                                         kv_bucket)[0]
+                ctx_s = jnp.einsum("qhd,hkd->hqk", q, ctx_k) / math.sqrt(D)
+                cpos = jnp.arange(kv_bucket, dtype=jnp.int32)
+                ctx_s = jnp.where(cpos[None, None, :] < pre_len,
+                                  ctx_s.astype(jnp.float32), -1e30)
+                chn_s = jnp.einsum("qhd,khd->hqk", q, k) / math.sqrt(D)
+                chn_s = chn_s.astype(jnp.float32) + cmask[None]
+                scores = jnp.concatenate([ctx_s, chn_s], axis=-1)
+                w = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+                att = (jnp.einsum("hqk,hkd->qhd", w[..., :kv_bucket],
+                                  ctx_v)
+                       + jnp.einsum("hqk,khd->qhd", w[..., kv_bucket:],
+                                    v)).reshape(Cb, -1)
+            x = x + att @ lp["wo"]
+            h2 = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+            x = x + (jax.nn.gelu(h2 @ lp["w1"] + lp["b1"])
+                     @ lp["w2"] + lp["b2"])
+            new_kv.append({
+                "k": lkv["k"].at[blk[:, None], heads, off[:, None]].set(k),
+                "v": lkv["v"].at[blk[:, None], heads, off[:, None]].set(v)})
+        x_last = jax.lax.dynamic_index_in_dim(
+            x, chunk_len - 1, axis=0, keepdims=False)
+        x_last = _layer_norm(x_last, params["lnf_g"], params["lnf_b"])
+        return new_kv, x_last @ params["head"]
+
+    def paged_step_fn(self, params, kv, tokens, positions, tables,
+                      kv_bucket: int):
+        """One decode step for every LANE, KV routed through per-lane
+        block tables. tokens/positions: int32 [S]; tables: int32 [S, T].
+        Dead lanes carry all-scratch tables and position 0, so their
+        (discarded) KV write lands in the reserved scratch block and the
+        fixed-shape executable never touches live blocks."""
+        S = tokens.shape[0]
+        H, D = self.n_heads, self.head_dim
+        bl = kv[0]["k"].shape[2]
+        heads = jnp.arange(H)[None, :]                       # [1, H]
+        tables = tables.astype(jnp.int32)
+        positions = positions.astype(jnp.int32)
+        x = params["embed"][tokens] + params["pos"][positions]   # [S, E]
+        lengths = positions + 1
+        blk = jnp.take_along_axis(
+            tables, (positions // bl)[:, None], axis=1)[:, 0]    # [S]
+        off = positions % bl
+        new_kv = []
+        for lp, lkv in zip(params["layers"], kv):
+            h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+            q = (h @ lp["wq"]).reshape(S, H, D)
+            k = (h @ lp["wk"]).reshape(S, H, D)
+            v = (h @ lp["wv"]).reshape(S, H, D)
+            k_pool = lkv["k"].at[blk[:, None], heads, off[:, None]].set(k)
+            v_pool = lkv["v"].at[blk[:, None], heads, off[:, None]].set(v)
+            if self.use_pallas:
+                att = paged_decode_attention(q, k_pool, v_pool, tables,
+                                             lengths, kv_bucket)
+            else:
+                att = _reference_paged_decode_attention(
+                    q, k_pool, v_pool, tables, lengths, kv_bucket)
             x = x + att.reshape(S, -1) @ lp["wo"]
             h2 = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
             x = x + (jax.nn.gelu(h2 @ lp["w1"] + lp["b1"])

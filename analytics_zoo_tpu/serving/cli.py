@@ -268,10 +268,26 @@ def _start_generative(cfg, broker, frontend) -> int:
         8, cfg.decode_max_kv_len)
     prompt_buckets = cfg.decode_prompt_buckets or _pow2_ladder(
         4, max(4, cfg.decode_max_kv_len // 2))
-    model.warmup_generative(inst.init_kv, slots=cfg.decode_slots,
-                            max_kv_len=cfg.decode_max_kv_len,
-                            prompt_buckets=prompt_buckets,
-                            kv_buckets=kv_buckets)
+    if cfg.decode_paged:
+        bl = cfg.decode_block_len
+        table_len = cfg.decode_max_kv_len // bl
+        kv_blocks = cfg.decode_kv_blocks or (
+            cfg.decode_slots * table_len + 1)
+        if cfg.decode_prefill_chunk:
+            chunk_buckets = [b for b in prompt_buckets
+                             if b <= cfg.decode_prefill_chunk] \
+                or [prompt_buckets[0]]
+        else:
+            chunk_buckets = list(prompt_buckets)
+        model.warmup_generative_paged(
+            inst.init_kv_blocks, num_blocks=kv_blocks, block_len=bl,
+            lanes=cfg.decode_slots, table_len=table_len,
+            chunk_buckets=chunk_buckets, kv_buckets=kv_buckets)
+    else:
+        model.warmup_generative(inst.init_kv, slots=cfg.decode_slots,
+                                max_kv_len=cfg.decode_max_kv_len,
+                                prompt_buckets=prompt_buckets,
+                                kv_buckets=kv_buckets)
     print(f"generative warmup: {json.dumps(model.warmup_report)}",
           flush=True)
     if model.compile_cache is not None:
@@ -291,10 +307,25 @@ def _start_generative(cfg, broker, frontend) -> int:
         eos_id=cfg.decode_eos_id, deadline_ms=cfg.deadline_ms,
         max_prefills_per_step=cfg.decode_max_prefills,
         max_waiting=cfg.decode_max_waiting,
-        engine_id=cfg.resolve_engine_id()).start()
-    print(f"decode engine {serving.engine_id}: {cfg.decode_slots} KV "
-          f"slots x {cfg.decode_max_kv_len} positions, kv buckets "
-          f"{kv_buckets}, prompt buckets {prompt_buckets}", flush=True)
+        engine_id=cfg.resolve_engine_id(),
+        paged=cfg.decode_paged,
+        init_kv_blocks=getattr(inst, "init_kv_blocks", None),
+        block_len=cfg.decode_block_len,
+        kv_blocks=cfg.decode_kv_blocks,
+        prefill_chunk=cfg.decode_prefill_chunk,
+        prefix_cache=cfg.decode_prefix_cache,
+        prefix_cache_blocks=cfg.decode_prefix_cache_blocks).start()
+    if cfg.decode_paged:
+        print(f"decode engine {serving.engine_id} (paged): "
+              f"{serving.kv_blocks} KV blocks x {cfg.decode_block_len} "
+              f"tokens, {cfg.decode_slots} lanes, kv buckets "
+              f"{kv_buckets}, chunk buckets {serving.chunk_buckets}, "
+              f"prefix cache "
+              f"{'on' if cfg.decode_prefix_cache else 'off'}", flush=True)
+    else:
+        print(f"decode engine {serving.engine_id}: {cfg.decode_slots} KV "
+              f"slots x {cfg.decode_max_kv_len} positions, kv buckets "
+              f"{kv_buckets}, prompt buckets {prompt_buckets}", flush=True)
     print("cluster serving started (generative)", flush=True)
 
     def shutdown():

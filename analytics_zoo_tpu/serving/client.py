@@ -429,25 +429,37 @@ class OutputQueue(_Reconnecting):
         Yields each token row ``{"i", "t", "ms"}`` as the decode engine
         writes it, then one final ``{"done": True, "tokens": ndarray,
         "gen": {...}}`` once the final row lands. Each poll sweep is ONE
-        HMGET asking for the next token row AND the final row; idle
-        polls back off exponentially (1 ms → 50 ms) like
-        `predict_batch`, and any progress resets the backoff. With
-        `delete` (default) the final row and every token row are
-        removed in one batched HDEL at completion. Raises TimeoutError
-        if the final row hasn't landed inside `timeout_s`."""
+        HMGET asking for a WINDOW of upcoming token rows plus the final
+        row, so tokens that accumulated while the client slept (or
+        between fused per-step writebacks) drain in a single sweep
+        instead of one round trip each. Idle sweeps back off
+        exponentially (1 ms → 50 ms) like `predict_batch`; ANY sweep
+        that returns new tokens resets the backoff to the floor, so an
+        idle pause never inflates client-observed inter-token latency
+        once the stream resumes. With `delete` (default) the final row
+        and every token row are removed in one batched HDEL at
+        completion. Raises TimeoutError if the final row hasn't landed
+        inside `timeout_s`."""
         from analytics_zoo_tpu.serving.decode import token_row_field
         deadline = time.monotonic() + timeout_s
         nxt = 0
         backoff = 0.001
+        window = 8
         while True:
-            fields = [token_row_field(uri, nxt), uri]
+            fields = [token_row_field(uri, nxt + j)
+                      for j in range(window)] + [uri]
             raws = self._call(self.broker.hmget, self.result_key, fields,
                               deadline=deadline)
-            row, final = raws[0], raws[1]
-            if row is not None:
-                backoff = 0.001
+            final = raws[window]
+            progressed = False
+            for raw in raws[:window]:
+                if raw is None:
+                    break
+                progressed = True
                 nxt += 1
-                yield json.loads(row)
+                yield json.loads(raw)
+            if progressed:
+                backoff = 0.001
                 continue
             if final is not None:
                 if final in ("NaN", "SHED"):
@@ -462,13 +474,16 @@ class OutputQueue(_Reconnecting):
                 # row commits last, so any remaining token rows are
                 # already present — drain them in order before done
                 total = int(gen.get("rows", nxt))
-                while nxt < total:
-                    raw = self._call(self.broker.hget, self.result_key,
-                                     token_row_field(uri, nxt))
-                    if raw is None:     # non-streamed request: no rows
-                        break
-                    nxt += 1
-                    yield json.loads(raw)
+                if nxt < total:
+                    raws = self._call(
+                        self.broker.hmget, self.result_key,
+                        [token_row_field(uri, i)
+                         for i in range(nxt, total)], deadline=deadline)
+                    for raw in raws:
+                        if raw is None:  # non-streamed request: no rows
+                            break
+                        nxt += 1
+                        yield json.loads(raw)
                 if delete:
                     self._call(
                         self.broker.hdel_many, self.result_key,

@@ -214,6 +214,18 @@ class ServingConfig:
     decode_eos_id: Optional[int] = None
     decode_max_waiting: int = 256
     decode_max_prefills: int = 4
+    # paged KV (ISSUE 19): paged: true swaps the stripe pool for the
+    # block pool + prefix cache + chunked prefill. block_len sizes one
+    # KV block in tokens; kv_blocks the pool (default: slots ×
+    # max_kv_len/block_len + scratch — byte parity with the stripes);
+    # prefill_chunk bounds tokens per prefill chunk (null = whole
+    # prompt); prefix_cache_blocks caps the trie (null = unbounded).
+    decode_paged: bool = False
+    decode_block_len: int = 16
+    decode_kv_blocks: Optional[int] = None
+    decode_prefill_chunk: Optional[int] = None
+    decode_prefix_cache: bool = True
+    decode_prefix_cache_blocks: Optional[int] = None
     # on-demand profiler capture (POST /profile): artifact root +
     # rotation bound; profile_enabled: false turns the endpoint off
     # (404). Default root is <tmp>/zoo_profiles.
@@ -423,6 +435,16 @@ class ServingConfig:
                 cfg.decode_eos_id = int(gen["eos_id"])
             cfg.decode_max_waiting = int(gen.get("max_waiting", 256))
             cfg.decode_max_prefills = int(gen.get("max_prefills", 4))
+            cfg.decode_paged = bool(gen.get("paged", False))
+            cfg.decode_block_len = int(gen.get("block_len", 16))
+            if gen.get("kv_blocks") is not None:
+                cfg.decode_kv_blocks = int(gen["kv_blocks"])
+            if gen.get("prefill_chunk") is not None:
+                cfg.decode_prefill_chunk = int(gen["prefill_chunk"])
+            cfg.decode_prefix_cache = bool(gen.get("prefix_cache", True))
+            if gen.get("prefix_cache_blocks") is not None:
+                cfg.decode_prefix_cache_blocks = int(
+                    gen["prefix_cache_blocks"])
             cfg._validate_generative()
         cfg.profile_dir = params.get("profile_dir")
         cfg.profile_enabled = bool(params.get("profile_enabled", True))
@@ -701,6 +723,40 @@ class ServingConfig:
             raise ValueError(
                 f"params.generative.max_prefills="
                 f"{self.decode_max_prefills} must be >= 1")
+        if self.decode_paged:
+            if self.decode_block_len < 1:
+                raise ValueError(
+                    f"params.generative.block_len={self.decode_block_len} "
+                    "must be >= 1")
+            if self.decode_max_kv_len % self.decode_block_len:
+                raise ValueError(
+                    f"params.generative.max_kv_len="
+                    f"{self.decode_max_kv_len} must be a multiple of "
+                    f"block_len={self.decode_block_len} (the block table "
+                    "covers the pool in whole blocks)")
+            if self.decode_kv_buckets is not None:
+                bad = [b for b in self.decode_kv_buckets
+                       if int(b) % self.decode_block_len]
+                if bad:
+                    raise ValueError(
+                        f"params.generative.kv_buckets {bad} must be "
+                        f"multiples of block_len={self.decode_block_len} "
+                        "(a paged attention window reads whole blocks)")
+            if (self.decode_kv_blocks is not None
+                    and self.decode_kv_blocks < 2):
+                raise ValueError(
+                    f"params.generative.kv_blocks={self.decode_kv_blocks} "
+                    "must be >= 2 (scratch + one usable block)")
+            if (self.decode_prefill_chunk is not None
+                    and self.decode_prefill_chunk < 1):
+                raise ValueError(
+                    f"params.generative.prefill_chunk="
+                    f"{self.decode_prefill_chunk} must be >= 1")
+            if (self.decode_prefix_cache_blocks is not None
+                    and self.decode_prefix_cache_blocks < 1):
+                raise ValueError(
+                    f"params.generative.prefix_cache_blocks="
+                    f"{self.decode_prefix_cache_blocks} must be >= 1")
 
     def _validate_compile_cache(self):
         """Cache-setting errors belong at config load, like placement:
@@ -766,17 +822,25 @@ class ServingConfig:
         cls = _find_model_class(self.model_class)
         kwargs = (self.extra.get("model", {}) or {}).get("config") or {}
         inst = cls(**kwargs)
-        missing = [a for a in ("init_params", "init_kv",
-                               "prefill_fn", "step_fn")
+        needed = ["init_params", "init_kv", "prefill_fn", "step_fn"]
+        if self.decode_paged:
+            needed += ["init_kv_blocks", "paged_prefill_fn",
+                       "paged_step_fn"]
+        missing = [a for a in needed
                    if not callable(getattr(inst, a, None))]
         if missing:
             raise ValueError(
-                f"model.class={self.model_class} lacks the generative "
+                f"model.class={self.model_class} lacks the "
+                f"{'paged ' if self.decode_paged else ''}generative "
                 f"contract: missing {', '.join(missing)}")
         im = InferenceModel(placement="replicated", num_replicas=1,
                             compile_cache=self.build_compile_cache())
-        im.load_generative(inst.prefill_fn, inst.step_fn,
-                           inst.init_params())
+        im.load_generative(
+            inst.prefill_fn, inst.step_fn, inst.init_params(),
+            paged_prefill_fn=getattr(inst, "paged_prefill_fn", None)
+            if self.decode_paged else None,
+            paged_step_fn=getattr(inst, "paged_step_fn", None)
+            if self.decode_paged else None)
         return im, inst
 
     def build_model(self, broker=None):

@@ -1367,7 +1367,9 @@ class InferenceModel:
     # compile-cache spy asserts for the forward path.
 
     def load_generative(self, prefill_fn: Callable, step_fn: Callable,
-                        params) -> "InferenceModel":
+                        params, paged_prefill_fn: Optional[Callable] = None,
+                        paged_step_fn: Optional[Callable] = None,
+                        ) -> "InferenceModel":
         """Load the decode-mode program pair (see models/generative.py
         for the exact calling contract). Single-device placement only:
         the KV pool is one device buffer threaded functionally through
@@ -1386,6 +1388,8 @@ class InferenceModel:
         self.serving_dtype = self._infer_serving_dtype(params)
         self._gen_prefill_fn = prefill_fn
         self._gen_step_fn = step_fn
+        self._gen_paged_prefill_fn = paged_prefill_fn
+        self._gen_paged_step_fn = paged_step_fn
         # one jit wrapper per program family; "step" wrappers are built
         # per kv bucket (the bucket is static — each is its own program)
         self._gen_jit = {"prefill": jax.jit(prefill_fn)}
@@ -1394,8 +1398,13 @@ class InferenceModel:
         self._gen_fp = None
         if self.compile_cache is not None:
             from analytics_zoo_tpu.compile_cache import model_fingerprint
-            # fingerprint BEFORE device placement, like load_fn
-            self._gen_fp = model_fingerprint((prefill_fn, step_fn), params)
+            # fingerprint BEFORE device placement, like load_fn; the
+            # paged fns join the fingerprint only when supplied so a
+            # non-paged deployment keeps its existing cache keys
+            fns = (prefill_fn, step_fn)
+            if paged_prefill_fn is not None or paged_step_fn is not None:
+                fns = fns + (paged_prefill_fn, paged_step_fn)
+            self._gen_fp = model_fingerprint(fns, params)
         if self._pin_single:
             self._params = jax.device_put(params, self.devices[0])
         else:
@@ -1421,12 +1430,39 @@ class InferenceModel:
             self._gen_jit[key] = jitted
         return jitted
 
-    def _warm_gen(self, kind: str, bucket: int, jitted, args) -> str:
+    def _gen_paged_step_jit(self, kv_bucket: int):
+        key = ("paged_step", int(kv_bucket))
+        jitted = self._gen_jit.get(key)
+        if jitted is None:
+            jitted = jax.jit(functools.partial(
+                self._gen_paged_step_fn, kv_bucket=int(kv_bucket)))
+            self._gen_jit[key] = jitted
+        return jitted
+
+    def _gen_paged_prefill_jit(self, kv_bucket: int):
+        key = ("paged_prefill", int(kv_bucket))
+        jitted = self._gen_jit.get(key)
+        if jitted is None:
+            jitted = jax.jit(functools.partial(
+                self._gen_paged_prefill_fn, kv_bucket=int(kv_bucket)))
+            self._gen_jit[key] = jitted
+        return jitted
+
+    @staticmethod
+    def _gen_bucket_key(bucket):
+        """Normalize a bucket discriminator: plain int for the PR 18
+        families, (chunk_bucket, kv_bucket) tuple for paged prefill."""
+        if isinstance(bucket, (tuple, list)):
+            return tuple(int(b) for b in bucket)
+        return int(bucket)
+
+    def _warm_gen(self, kind: str, bucket, jitted, args) -> str:
         """Cache-backed warmup for one generative program — the decode
         analogue of `_warm_executable` (same funnel: every fresh
         compile goes through `serialization.compile_lowered`)."""
         from analytics_zoo_tpu.compile_cache import make_key, serialization
-        tkey = (kind, int(bucket))
+        bkey = self._gen_bucket_key(bucket)
+        tkey = (kind, bkey)
         if tkey in self._gen_aot:
             return "warm"
         if not self._use_compile_cache():
@@ -1446,7 +1482,8 @@ class InferenceModel:
                        placement=self.placement,
                        dtype=self.serving_dtype
                        if self.serving_dtype != "float32" else "",
-                       extra=("decode", kind, int(bucket)))
+                       extra=("decode", kind) + (bkey if isinstance(
+                           bkey, tuple) else (bkey,)))
         ex = self.compile_cache.load(key,
                                      target_device_id=self.devices[0].id)
         src = "cached"
@@ -1513,6 +1550,87 @@ class InferenceModel:
             self.warmup_source[rkey] = src
         return self
 
+    def warmup_generative_paged(self, init_kv_blocks: Callable,
+                                num_blocks: int, block_len: int,
+                                lanes: int, table_len: int,
+                                chunk_buckets: List[int],
+                                kv_buckets: List[int]) -> "InferenceModel":
+        """Pre-compile the PAGED decode ladder: one chunked-prefill
+        executable per (chunk bucket × context kv bucket) — the context
+        window is 0 on a fresh first chunk and a kv bucket covering the
+        adopted prefix plus earlier chunks otherwise — and one paged
+        step executable per kv bucket, block tables in the signature.
+        Same persistent-cache funnel as `warmup_generative`; the engine
+        then performs 0 request-path compiles with the table in the
+        loop."""
+        if getattr(self, "_gen_paged_prefill_fn", None) is None:
+            raise RuntimeError(
+                "load_generative(..., paged_prefill_fn=, paged_step_fn=) "
+                "first")
+        params = self._params
+        kv = init_kv_blocks(int(num_blocks), int(block_len))
+        ctx_buckets = [0] + sorted({int(b) for b in kv_buckets})
+        for Cb in sorted({int(c) for c in chunk_buckets}):
+            for kvb in ctx_buckets:
+                args = (params, kv, np.zeros(Cb, np.int32),
+                        np.zeros(table_len, np.int32),
+                        np.int32(0), np.int32(1))
+                t0 = time.perf_counter()
+                src = self._warm_gen("paged_prefill", (Cb, kvb),
+                                     self._gen_paged_prefill_jit(kvb),
+                                     args)
+                ex = self._gen_aot.get(("paged_prefill", (Cb, kvb)))
+                if ex is not None:
+                    jax.block_until_ready(ex(*args))
+                rkey = f"gen-paged-prefill:c{Cb}:kv{kvb}"
+                self.warmup_report[rkey] = round(
+                    time.perf_counter() - t0, 4)
+                self.warmup_source[rkey] = src
+        for b in sorted({int(b) for b in kv_buckets}):
+            if b % int(block_len):
+                raise ValueError(f"kv bucket {b} not a multiple of "
+                                 f"block_len {block_len}")
+            args = (params, kv, np.zeros(lanes, np.int32),
+                    np.zeros(lanes, np.int32),
+                    np.zeros((lanes, table_len), np.int32))
+            t0 = time.perf_counter()
+            src = self._warm_gen("paged_step", b,
+                                 self._gen_paged_step_jit(b), args)
+            ex = self._gen_aot.get(("paged_step", b))
+            if ex is not None:
+                jax.block_until_ready(ex(*args))
+            rkey = f"gen-paged-step:kv{b}"
+            self.warmup_report[rkey] = round(time.perf_counter() - t0, 4)
+            self.warmup_source[rkey] = src
+        return self
+
+    def generative_prefill_paged(self, kv, tokens, table, pre_len,
+                                 chunk_len, kv_bucket: int):
+        """One prompt CHUNK through the warmed paged-prefill executable
+        for its (chunk bucket, context bucket). Returns (kv, logits)."""
+        tokens = np.ascontiguousarray(tokens, np.int32)
+        args = (self._params, kv, tokens,
+                np.ascontiguousarray(table, np.int32),
+                np.int32(pre_len), np.int32(chunk_len))
+        ex = self._gen_aot.get(
+            ("paged_prefill", (int(tokens.shape[-1]), int(kv_bucket))))
+        if ex is not None:
+            return ex(*args)
+        return self._gen_paged_prefill_jit(int(kv_bucket))(*args)
+
+    def generative_step_paged(self, kv, tokens, positions, tables,
+                              kv_bucket: int):
+        """One decode step for every lane through the block tables.
+        Returns (kv, logits[lanes, vocab])."""
+        args = (self._params, kv,
+                np.ascontiguousarray(tokens, np.int32),
+                np.ascontiguousarray(positions, np.int32),
+                np.ascontiguousarray(tables, np.int32))
+        ex = self._gen_aot.get(("paged_step", int(kv_bucket)))
+        if ex is not None:
+            return ex(*args)
+        return self._gen_paged_step_jit(int(kv_bucket))(*args)
+
     def generative_prefill(self, kv, tokens, length, slot):
         """One prompt through the warmed prefill executable for its
         bucket (tokens MUST already be padded to a warmed bucket).
@@ -1535,14 +1653,15 @@ class InferenceModel:
             return ex(*args)
         return self._gen_step_jit(int(kv_bucket))(*args)
 
-    def account_generative(self, kind: str, bucket: int, secs: float):
+    def account_generative(self, kind: str, bucket, secs: float):
         """Charge one generative call against the serving roofline with
         the cost harvested at warmup — decode is memory-bound and the
         Pallas kernel's analytic estimate is what makes the accountant
         see that (HLO cost analysis is blind inside a Mosaic call)."""
         if self._roofline is None:
             return
-        cost = getattr(self, "_gen_cost", {}).get((kind, int(bucket)))
+        cost = getattr(self, "_gen_cost", {}).get(
+            (kind, self._gen_bucket_key(bucket)))
         if cost is None:
             return
         try:

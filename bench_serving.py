@@ -1817,6 +1817,280 @@ def _generative_main(args) -> int:
     return 0
 
 
+def _generative_paged_main(args) -> int:
+    """Paged-KV A/B (ISSUE 19) on a prefix-heavy Poisson mix, three
+    legs over the SAME model and warmed executables:
+
+    1. capacity — a burst of short shared-prefix prompts through the
+       contiguous engine (4 stripes of max_kv_len) and the paged engine
+       holding the SAME pool bytes (4*table_len blocks + scratch) but
+       4x the lanes: peak concurrent sequences, target >= 2x.
+    2. prefix TTFT — cold prompts with distinct 96-token prefixes vs
+       prompts re-using them (the cache adopts 6 of 7 chunks copy-
+       free): TTFT p50 ratio, target >= 3x.
+    3. ITL under a long-prompt join — 4 live streams, then a 104-token
+       prompt joins, chunked prefill ON (16-token chunks interleave
+       with decode) vs OFF (one monolithic prefill): live streams'
+       ITL p99 during the join vs steady state, ON target <= 2x.
+
+    Asserts in-process: zero accepted-record loss (every uri's final
+    lands with exactly max_new tokens) and 0 request-path compiles
+    across ALL legs (the serialization.compile_lowered funnel is spied
+    from the moment warmup ends)."""
+    import analytics_zoo_tpu.compile_cache.serialization as ccser
+    from analytics_zoo_tpu.models.generative import TinyDecoder
+    from analytics_zoo_tpu.serving.broker import MemoryBroker
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.decode import DecodeServing
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    MAX_KV, BL = 128, 16
+    KV_BUCKETS = [32, 64, 128]
+    TABLE_LEN = MAX_KV // BL
+    dec = TinyDecoder(vocab=128, n_layers=4, n_heads=4, head_dim=16,
+                      max_len=MAX_KV)
+    rng = np.random.default_rng(11)
+    warmup_s = 0.0
+
+    def new_im(paged=True):
+        im = InferenceModel(placement="replicated", num_replicas=1)
+        im.load_generative(
+            dec.prefill_fn, dec.step_fn, dec.init_params(0),
+            paged_prefill_fn=dec.paged_prefill_fn if paged else None,
+            paged_step_fn=dec.paged_step_fn if paged else None)
+        return im
+
+    def paged_engine(broker, lanes, kv_blocks, prompt_buckets,
+                     prefill_chunk, prefix_cache=True):
+        nonlocal warmup_s
+        im = new_im()
+        chunk_buckets = [b for b in prompt_buckets
+                         if prefill_chunk is None or b <= prefill_chunk] \
+            or [prompt_buckets[0]]
+        t0 = time.perf_counter()
+        im.warmup_generative_paged(
+            dec.init_kv_blocks, num_blocks=kv_blocks, block_len=BL,
+            lanes=lanes, table_len=TABLE_LEN,
+            chunk_buckets=chunk_buckets, kv_buckets=KV_BUCKETS)
+        warmup_s += time.perf_counter() - t0
+        return DecodeServing(
+            im, dec.init_kv, broker=broker, slots=lanes,
+            max_kv_len=MAX_KV, kv_buckets=KV_BUCKETS,
+            prompt_buckets=prompt_buckets, max_new_default=8,
+            paged=True, init_kv_blocks=dec.init_kv_blocks,
+            block_len=BL, kv_blocks=kv_blocks,
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache), im
+
+    def drain(srv, outq, uris, expect, wall_cap=300.0):
+        t0 = time.perf_counter()
+        peak = 0
+        while srv.stats["finished"] < expect:
+            peak = max(peak, len(srv._active))
+            time.sleep(0.001)
+            if time.perf_counter() - t0 > wall_cap:
+                raise SystemExit("paged leg stalled")
+        finals = outq.query_many(uris, deadline=time.monotonic() + 30)
+        assert len(finals) == len(uris), \
+            f"record loss: {len(uris) - len(finals)} finals missing"
+        return peak, finals
+
+    compile_calls = []
+    orig_compile = ccser.compile_lowered
+
+    def spy(lowered):
+        compile_calls.append(1)
+        return orig_compile(lowered)
+
+    # ---- leg 1: capacity at fixed pool bytes --------------------------
+    # 24 short prompts (16-token shared prefix + 4-token tail, 8 new),
+    # all enqueued at once. Contiguous: 4 stripes of 128 = the whole
+    # pool seats 4. Paged: the SAME 512 KV rows = 32 blocks seat every
+    # 2-block sequence the 16 lanes can carry.
+    CAP_N, STRIPES = 24, 4
+    cap_prefix = rng.integers(1, 128, BL).astype(np.int32)
+    cap_prompts = [np.concatenate(
+        [cap_prefix, rng.integers(1, 128, 4).astype(np.int32)])
+        for _ in range(CAP_N)]
+
+    im_c = new_im(paged=False)
+    t0 = time.perf_counter()
+    im_c.warmup_generative(dec.init_kv, slots=STRIPES, max_kv_len=MAX_KV,
+                           prompt_buckets=[32], kv_buckets=KV_BUCKETS)
+    warmup_s += time.perf_counter() - t0
+    ccser.compile_lowered = spy
+    try:
+        broker = MemoryBroker()
+        srv = DecodeServing(im_c, dec.init_kv, broker=broker,
+                            slots=STRIPES, max_kv_len=MAX_KV,
+                            kv_buckets=KV_BUCKETS, prompt_buckets=[32],
+                            max_new_default=8).start()
+        inq, outq = InputQueue(broker), OutputQueue(broker)
+        t0 = time.perf_counter()
+        uris = [inq.enqueue(t=p, max_new=8) for p in cap_prompts]
+        peak_contig, finals = drain(srv, outq, uris, CAP_N)
+        contig_wall = time.perf_counter() - t0
+        srv.stop()
+
+        broker = MemoryBroker()
+        srv, _ = paged_engine(broker, lanes=4 * STRIPES,
+                              kv_blocks=STRIPES * TABLE_LEN + 1,
+                              prompt_buckets=[16, 32], prefill_chunk=16)
+        srv.start()
+        inq, outq = InputQueue(broker), OutputQueue(broker)
+        t0 = time.perf_counter()
+        uris = [inq.enqueue(t=p, max_new=8) for p in cap_prompts]
+        peak_paged, finals = drain(srv, outq, uris, CAP_N)
+        paged_wall = time.perf_counter() - t0
+        cap_hits = srv.stats["prefix_hit_tokens"]
+        srv.stop()
+        capacity = {
+            "pool_kv_rows": STRIPES * MAX_KV,
+            "requests": CAP_N,
+            "contiguous": {"slots": STRIPES, "peak_concurrent":
+                           peak_contig, "wall_s": round(contig_wall, 4)},
+            "paged": {"lanes": 4 * STRIPES,
+                      "kv_blocks": STRIPES * TABLE_LEN + 1,
+                      "peak_concurrent": peak_paged,
+                      "wall_s": round(paged_wall, 4),
+                      "prefix_hit_tokens": cap_hits},
+            "concurrency_ratio": round(peak_paged / peak_contig, 2),
+        }
+
+        # ---- leg 2: prefix-hit vs cold TTFT ---------------------------
+        # 8 distinct 96-token prefixes, sequentially (each publishes its
+        # blocks before the next arrives), then 8 re-users: a hit adopts
+        # (104-1)//16 = 6 blocks and prefills ONE 16-token chunk instead
+        # of seven.
+        PFX_N, PFX_LEN = 8, 6 * BL
+        broker = MemoryBroker()
+        srv, _ = paged_engine(broker, lanes=8,
+                              kv_blocks=8 * TABLE_LEN + 1,
+                              prompt_buckets=[16], prefill_chunk=16)
+        srv.start()
+        inq, outq = InputQueue(broker), OutputQueue(broker)
+        prefixes = [rng.integers(1, 128, PFX_LEN).astype(np.int32)
+                    for _ in range(PFX_N)]
+        ttft = {"cold": [], "hit": []}
+        done = 0
+        for phase in ("cold", "hit"):
+            for pfx in prefixes:
+                tail = rng.integers(1, 128, 8).astype(np.int32)
+                u = inq.enqueue(t=np.concatenate([pfx, tail]),
+                                max_new=4, stream=1)
+                while srv.stats["finished"] < done + 1:
+                    time.sleep(0.001)
+                done += 1
+                ms = [e["ms"] for e in
+                      outq.stream_tokens(u, timeout_s=30)
+                      if not e.get("done")]
+                ttft[phase].append(ms[0])
+        hit_tokens = srv.stats["prefix_hit_tokens"]
+        srv.stop()
+        assert hit_tokens >= PFX_N * PFX_LEN, \
+            "prefix cache missed re-used prefixes"
+        prefix_leg = {
+            "prefix_len": PFX_LEN, "prompt_len": PFX_LEN + 8,
+            "requests_per_phase": PFX_N,
+            "cold_ttft_ms": {
+                "p50": round(_percentile(ttft["cold"], 0.5), 3),
+                "p99": round(_percentile(ttft["cold"], 0.99), 3)},
+            "hit_ttft_ms": {
+                "p50": round(_percentile(ttft["hit"], 0.5), 3),
+                "p99": round(_percentile(ttft["hit"], 0.99), 3)},
+            "prefix_hit_tokens": hit_tokens,
+            "ttft_p50_ratio": round(
+                _percentile(ttft["cold"], 0.5)
+                / _percentile(ttft["hit"], 0.5), 2),
+        }
+
+        # ---- leg 3: ITL p99 while a near-max prompt joins -------------
+        # 4 live streams decode; a 104-token prompt joins mid-flight.
+        # ON: 16-token chunks interleave with decode steps. OFF: one
+        # 112-bucket monolithic prefill stalls every stream for its
+        # full duration.
+        itl_leg = {}
+        for chunk in (16, None):
+            broker = MemoryBroker()
+            srv, _ = paged_engine(broker, lanes=8,
+                                  kv_blocks=8 * TABLE_LEN + 1,
+                                  prompt_buckets=[16, 112],
+                                  prefill_chunk=chunk,
+                                  prefix_cache=False)
+            srv.start()
+            inq, outq = InputQueue(broker), OutputQueue(broker)
+            enq_wall = {}
+            uris = []
+            for _ in range(5):
+                p = rng.integers(1, 128, 12).astype(np.int32)
+                u = inq.enqueue(t=p, max_new=110, stream=1)
+                enq_wall[u] = time.perf_counter()
+                uris.append(u)
+            while srv.stats["prefills"] < 5:
+                time.sleep(0.001)
+            # FOUR join events pooled: a single joiner's window holds a
+            # handful of ITL samples, so its p99 is the sample max —
+            # noise-dominated on a 1-core host
+            JOINS = 4
+            joiner_uris = []
+            for j in range(JOINS):
+                time.sleep(0.02)              # steady-state gap
+                joiner = rng.integers(1, 128, 104).astype(np.int32)
+                ju = inq.enqueue(t=joiner, max_new=4, stream=1)
+                enq_wall[ju] = time.perf_counter()
+                joiner_uris.append(ju)
+                while srv.stats["finished"] < j + 1:
+                    time.sleep(0.001)
+            peak, finals = drain(srv, outq, uris + joiner_uris,
+                                 5 + JOINS)
+            windows = []
+            for ju in joiner_uris:
+                j_ms = [e["ms"] for e in
+                        outq.stream_tokens(ju, timeout_s=30)
+                        if not e.get("done")]
+                windows.append((enq_wall[ju],
+                                enq_wall[ju] + j_ms[0] / 1e3))
+            steady, during = [], []
+            for u in uris:
+                ms = [e["ms"] for e in
+                      outq.stream_tokens(u, timeout_s=30)
+                      if not e.get("done")]
+                walls = [enq_wall[u] + m / 1e3 for m in ms]
+                for prev, cur in zip(walls, walls[1:]):
+                    (during if any(w0 <= cur <= w1 + 0.005
+                                   for w0, w1 in windows)
+                     else steady).append((cur - prev) * 1e3)
+            srv.stop()
+            itl_leg["chunked_on" if chunk else "chunked_off"] = {
+                "join_events": JOINS,
+                "prefill_chunks": srv.stats["prefill_chunks"],
+                "steady_itl_ms_p99": round(_percentile(steady, 0.99), 3),
+                "join_itl_ms_p99": round(_percentile(during, 0.99), 3),
+                "join_over_steady_p99": round(
+                    _percentile(during, 0.99)
+                    / _percentile(steady, 0.99), 2),
+                "join_window_ms": round(sum(
+                    (w1 - w0) for w0, w1 in windows) * 1e3 / JOINS, 3),
+            }
+    finally:
+        ccser.compile_lowered = orig_compile
+
+    out = {
+        "mode": "generative_paged",
+        "backend": jax.default_backend(),
+        "max_kv_len": MAX_KV, "block_len": BL,
+        "kv_buckets": KV_BUCKETS,
+        "warmup_s": round(warmup_s, 3),
+        "cold_compiles": len(compile_calls),
+        "capacity_fixed_pool_bytes": capacity,
+        "prefix_cache_ttft": prefix_leg,
+        "long_prompt_join_itl": itl_leg,
+    }
+    assert out["cold_compiles"] == 0, \
+        "XLA compiled on the paged decode request path after warmup"
+    print(json.dumps(out))
+    return 0
+
+
 def _percentile(samples, q):
     """np.percentile, the same interpolated estimator every other
     p50/p99 in this file uses — a nearest-rank variant here would make
@@ -2744,6 +3018,13 @@ def main():
                          "baseline on a seeded Poisson prompt/output "
                          "mix; tokens/sec, TTFT/ITL p99, slot-"
                          "utilization ratio, 0-compile assertion")
+    ap.add_argument("--paged", action="store_true",
+                    help="with --generative (ISSUE 19): paged-KV legs "
+                         "on a prefix-heavy Poisson mix — capacity "
+                         "multiplier at fixed pool bytes, prefix-hit "
+                         "vs cold TTFT, ITL p99 while a near-max "
+                         "prompt joins with chunked prefill on vs off, "
+                         "zero-loss + 0-compile assertions")
     args = ap.parse_args()
     if args.fleet_child:
         if not (args.broker_url and args.engine_id):
@@ -2760,6 +3041,8 @@ def main():
         return _int8_ab_main(args)
     if args.trace_overhead:
         return _trace_overhead_main(args)
+    if args.generative and args.paged:
+        return _generative_paged_main(args)
     if args.generative:
         return _generative_main(args)
     if args.elastic:

@@ -76,6 +76,10 @@ CONTROL_LOOP_FILES = (
     # active sequence's inter-token latency by its full duration; all
     # pacing goes through broker block_ms and stop-event waits
     os.path.join(SERVING_PKG, "decode.py"),
+    # the paged KV pool + prefix cache (ISSUE 19): alloc/evict sit on
+    # the decode step's critical path under the pool lock — a sleep
+    # while holding it would stall every lane's next token
+    os.path.join(SERVING_PKG, "paged_kv.py"),
 )
 SLEEP_RE = re.compile(r"\btime\.sleep\s*\(")
 BARE_EXCEPT_RE = re.compile(r"^\s*except\s*:", re.MULTILINE)

@@ -115,6 +115,15 @@ REQUIRED = {
     "serving_ttft_ms": "histogram",
     "serving_itl_ms": "histogram",
     "serving_kv_slots_in_use": "gauge",
+    # paged KV + prefix cache + chunked prefill (ISSUE 19): the block-
+    # pool occupancy gauge that replaces the slot gauge as the paged
+    # admission signal, the cache hit-rate pair, and the chunk counter
+    # the ITL-protection accounting reads — renaming any of these
+    # silently blinds the paged bench JSON and the docs tables
+    "serving_kv_blocks_in_use": "gauge",
+    "serving_prefix_cache_hits_total": "counter",
+    "serving_prefix_cache_misses_total": "counter",
+    "serving_prefill_chunks_total": "counter",
     # big-model frontier (ISSUE 12): quantized serving + tensor-parallel
     # placement telemetry — the families the int8 A/B bench, the docs
     # tables and any capacity dashboard read. serving_weight_bytes is

@@ -82,14 +82,42 @@ def check_file(path: str) -> List[str]:
     return errors
 
 
+# Presence manifest (ISSUE 19 satellite): kernels the roofline layer
+# KNOWS about must keep at least this many costed `pallas_call` sites
+# in place — decode_attention carries TWO (the contiguous decode-step
+# kernel and the paged block-table kernel), so a refactor that drops
+# one (or moves it somewhere the analytic cost no longer reaches)
+# fails CI instead of silently zeroing that kernel's roofline bytes.
+EXPECTED_MIN_CALLS = {
+    os.path.join("pallas", "decode_attention.py"): 2,
+    os.path.join("pallas", "flash_attention.py"): 1,
+    os.path.join("pallas", "fused_adam.py"): 1,
+    os.path.join("pallas", "dropout.py"): 1,
+    os.path.join("pallas", "segment_update.py"): 1,
+}
+
+
 def check(root: str = ".") -> List[str]:
     errors: List[str] = []
     pkg = os.path.join(root, PKG)
+    counts = {}
     for dirpath, dirnames, filenames in os.walk(pkg):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for name in sorted(filenames):
             if name.endswith(".py"):
-                errors.extend(check_file(os.path.join(dirpath, name)))
+                path = os.path.join(dirpath, name)
+                errors.extend(check_file(path))
+                with open(path, encoding="utf-8") as fh:
+                    counts[os.path.relpath(path, pkg)] = len(
+                        CALL_RE.findall(fh.read()))
+    for rel, want in sorted(EXPECTED_MIN_CALLS.items()):
+        have = counts.get(rel, 0)
+        if have < want:
+            errors.append(
+                f"{os.path.join(pkg, rel)}: expected >= {want} "
+                f"pallas_call site(s), found {have} (a known kernel "
+                "went missing — update EXPECTED_MIN_CALLS if this is "
+                "an intentional removal)")
     return errors
 
 

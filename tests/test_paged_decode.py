@@ -1,0 +1,535 @@
+"""Paged KV decode engine (ISSUE 19): block pool + prefix cache unit
+behavior, paged-vs-contiguous greedy BITWISE parity through the live
+engines (including a prefix-cache hit mid-flight), chunked prefill
+interleaving decode steps (the ITL bound's mechanism), the zero-compile
+guarantee with block tables in the loop, the fused per-step writeback,
+the windowed `stream_tokens` sweep, the paged scheduler's budget, and
+the capacity multiplier at fixed pool bytes.
+
+All on the conftest CPU backend; tier-1 fast."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import analytics_zoo_tpu.compile_cache.serialization as ccser
+from analytics_zoo_tpu.compile_cache import CompileCache
+from analytics_zoo_tpu.models.generative import TinyDecoder
+from analytics_zoo_tpu.observability.registry import MetricsRegistry
+from analytics_zoo_tpu.pallas.decode_attention import (
+    _reference_decode_attention, paged_decode_attention)
+from analytics_zoo_tpu.serving.broker import MemoryBroker
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.decode import DecodeScheduler, DecodeServing
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.paged_kv import KVBlockPool, PrefixCache
+
+BL = 8          # block_len used throughout (divides every kv bucket)
+
+
+def tiny(**kw):
+    kw.setdefault("vocab", 32)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("n_heads", 2)
+    kw.setdefault("head_dim", 8)
+    kw.setdefault("max_len", 64)
+    return TinyDecoder(**kw)
+
+
+def load_im(dec, cache_dir=None, paged=True):
+    im = InferenceModel(
+        placement="replicated", num_replicas=1,
+        compile_cache=CompileCache(str(cache_dir)) if cache_dir else None)
+    im.load_generative(
+        dec.prefill_fn, dec.step_fn, dec.init_params(0),
+        paged_prefill_fn=dec.paged_prefill_fn if paged else None,
+        paged_step_fn=dec.paged_step_fn if paged else None)
+    return im
+
+
+def make_engine(dec, im, broker, paged, **kw):
+    """Build (and pre-warm) one engine. Contiguous and paged engines get
+    the SAME bucket ladders so parity runs share every numeric shape."""
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_kv_len", 64)
+    kw.setdefault("kv_buckets", [16, 32, 64])
+    kw.setdefault("prompt_buckets", [8, 16])
+    kw.setdefault("max_new_default", 6)
+    if paged:
+        table_len = kw["max_kv_len"] // BL
+        kv_blocks = kw.pop("kv_blocks", None) or \
+            kw["slots"] * table_len + 1
+        chunk = kw.get("prefill_chunk")
+        chunk_buckets = [b for b in kw["prompt_buckets"]
+                         if chunk is None or b <= chunk] \
+            or [kw["prompt_buckets"][0]]
+        im.warmup_generative_paged(
+            dec.init_kv_blocks, num_blocks=kv_blocks, block_len=BL,
+            lanes=kw["slots"], table_len=table_len,
+            chunk_buckets=chunk_buckets, kv_buckets=kw["kv_buckets"])
+        return DecodeServing(
+            im, dec.init_kv, broker=broker, registry=MetricsRegistry(),
+            paged=True, init_kv_blocks=dec.init_kv_blocks, block_len=BL,
+            kv_blocks=kv_blocks, **kw)
+    im.warmup_generative(dec.init_kv, slots=kw["slots"],
+                         max_kv_len=kw["max_kv_len"],
+                         prompt_buckets=kw["prompt_buckets"],
+                         kv_buckets=kw["kv_buckets"])
+    return DecodeServing(im, dec.init_kv, broker=broker,
+                         registry=MetricsRegistry(), **kw)
+
+
+def collect(outq, uris, timeout_s=20.0):
+    out, deadline = {}, time.monotonic() + timeout_s
+    while len(out) < len(uris):
+        assert time.monotonic() < deadline, \
+            f"missing {set(uris) - set(out)}"
+        got = outq.query_many([u for u in uris if u not in out])
+        out.update(got)
+        time.sleep(0.002)
+    return {u: list(np.asarray(v).reshape(-1)) for u, v in out.items()}
+
+
+class TestKVBlockPool:
+    def test_alloc_release_refcount_and_gauge(self):
+        reg = MetricsRegistry()
+        pool = KVBlockPool(tiny().init_kv_blocks, num_blocks=5,
+                           block_len=BL, registry=reg,
+                           labels={"engine": "e1"})
+
+        def gauge():
+            (s,) = reg.snapshot()[
+                "serving_kv_blocks_in_use"]["series"]
+            return s["value"]
+
+        assert pool.capacity == 4 and pool.free_count == 4
+        a, b = pool.alloc(), pool.alloc()
+        assert 0 not in (a, b)          # scratch never leased
+        assert gauge() == 2 and pool.in_use == 2
+        pool.retain(a)
+        pool.release(a)                 # still owned once
+        assert pool.refcount(a) == 1 and gauge() == 2
+        pool.release(a)
+        assert pool.refcount(a) == 0 and gauge() == 1
+        assert [pool.alloc() for _ in range(3)].count(None) == 0
+        assert pool.alloc() is None     # exhausted
+        try:
+            pool.release(b)
+            pool.release(b)
+            assert False, "double release must raise"
+        except ValueError:
+            pass
+
+    def test_kv_shape_is_block_pool(self):
+        dec = tiny()
+        pool = KVBlockPool(dec.init_kv_blocks, num_blocks=6, block_len=BL,
+                           registry=MetricsRegistry())
+        assert pool.kv[0]["k"].shape == (6, dec.n_heads, BL, dec.head_dim)
+
+
+class TestPrefixCache:
+    def _pool(self, blocks=10):
+        return KVBlockPool(tiny().init_kv_blocks, num_blocks=blocks,
+                           block_len=BL, registry=MetricsRegistry())
+
+    def test_match_adopts_published_blocks_copy_free(self):
+        pool = self._pool()
+        cache = PrefixCache(pool, registry=MetricsRegistry())
+        prompt = list(range(20))                 # 2 full blocks + 4
+        blocks = [pool.alloc(), pool.alloc(), pool.alloc()]
+        cache.insert(prompt, blocks[:20 // BL])
+        # identical prompt adopts both full blocks — no new allocation
+        free_before = pool.free_count
+        adopted = cache.match(prompt)
+        assert adopted == blocks[:2]
+        assert pool.free_count == free_before    # copy-free
+        assert pool.refcount(blocks[0]) == 3     # seq + cache + adopter
+
+    def test_match_caps_below_full_prompt(self):
+        """At least one prompt token must stay un-cached so prefill has
+        a real query: a 16-token prompt matches at most 1 block."""
+        pool = self._pool()
+        cache = PrefixCache(pool, registry=MetricsRegistry())
+        prompt = list(range(16))
+        b = [pool.alloc(), pool.alloc()]
+        cache.insert(prompt, b)                  # publishes both
+        assert len(cache.match(prompt)) == 1     # (16-1)//8 == 1
+
+    def test_evict_frees_only_sole_owner_leaves(self):
+        pool = self._pool(blocks=4)              # 3 usable
+        cache = PrefixCache(pool, registry=MetricsRegistry())
+        p1, p2 = list(range(9)), list(range(100, 109))
+        b1, b2 = pool.alloc(), pool.alloc()
+        cache.insert(p1, [b1])
+        cache.insert(p2, [b2])
+        pool.release(b1)                         # cache now sole owner
+        pool.release(b2)
+        adopted = cache.match(p1)                # b1 shared again
+        assert adopted == [b1]
+        assert pool.free_count == 1
+        cache.evict_for(2)                       # wants 2 free blocks
+        # only b2 (sole-owner) could be freed; b1 survives its adopter
+        assert pool.free_count == 2
+        assert pool.refcount(b1) == 2
+
+    def test_hit_and_miss_counters(self):
+        reg = MetricsRegistry()
+        pool = self._pool()
+        cache = PrefixCache(pool, registry=reg)
+        prompt = list(range(12))
+        cache.match(prompt)                      # miss (empty trie)
+        b = pool.alloc()
+        cache.insert(prompt, [b])
+        cache.match(prompt)                      # hit
+        snap = reg.snapshot()
+        (h,) = snap["serving_prefix_cache_hits_total"]["series"]
+        (m,) = snap["serving_prefix_cache_misses_total"]["series"]
+        assert h["value"] == 1 and m["value"] == 1
+
+
+class TestPagedKernelParity:
+    def _scattered(self, kc, vc, S, n_kb):
+        """The contiguous pools' bytes re-homed into a shuffled block
+        pool + tables — same values, different physical addresses."""
+        H, D = kc.shape[1], kc.shape[3]
+        num_blocks = S * n_kb + 2
+        perm = np.random.RandomState(7).permutation(
+            np.arange(1, num_blocks))[:S * n_kb].reshape(S, n_kb)
+        kp = jnp.zeros((num_blocks, H, BL, D), jnp.float32)
+        vp = jnp.zeros((num_blocks, H, BL, D), jnp.float32)
+        for s in range(S):
+            for j in range(n_kb):
+                blk = int(perm[s, j])
+                kp = kp.at[blk].set(kc[s, :, j * BL:(j + 1) * BL])
+                vp = vp.at[blk].set(vc[s, :, j * BL:(j + 1) * BL])
+        return kp, vp, jnp.asarray(perm, jnp.int32)
+
+    def test_reference_paged_is_bitwise_contiguous(self):
+        S, H, D, L = 4, 2, 8, 32
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (S, H, D), jnp.float32)
+        kc = jax.random.normal(ks[1], (S, H, L, D), jnp.float32)
+        vc = jax.random.normal(ks[2], (S, H, L, D), jnp.float32)
+        lengths = jnp.array([5, 17, 32, 1], jnp.int32)
+        kp, vp, tables = self._scattered(kc, vc, S, L // BL)
+        ref = _reference_decode_attention(q, kc, vc, lengths, L)
+        pag = paged_decode_attention(q, kp, vp, tables, lengths, L)
+        assert bool(jnp.all(ref == pag))         # bitwise
+        # the Mosaic kernel body, via interpret mode
+        pag_i = paged_decode_attention(q, kp, vp, tables, lengths, L,
+                                       interpret=True)
+        assert bool(jnp.allclose(ref, pag_i, atol=1e-5))
+
+    def test_kernel_rejects_bad_shapes(self):
+        S, H, D = 2, 2, 8
+        q = jnp.zeros((S, H, D))
+        pool = jnp.zeros((4, H, BL, D))
+        tables = jnp.zeros((S, 2), jnp.int32)
+        lengths = jnp.ones((S,), jnp.int32)
+        for bad_bucket in (12, 0):               # not a multiple / zero
+            try:
+                paged_decode_attention(q, pool, pool, tables, lengths,
+                                       bad_bucket)
+                assert False, "must reject"
+            except ValueError:
+                pass
+        try:
+            paged_decode_attention(q, pool, pool, tables, lengths, 32)
+            assert False, "table too short must reject"
+        except ValueError:
+            pass
+
+
+class TestPagedEngineParity:
+    def test_paged_bitwise_equals_contiguous_engine(self):
+        """Identical prompts through the PR 18 contiguous engine and the
+        paged engine (same warmed ladders, mixed lengths, mid-flight
+        join) must emit IDENTICAL token streams — block indirection
+        relocates KV bytes, it must not change one logit."""
+        dec = tiny()
+        prompts = [[3, 5, 7], [2, 4, 6, 8, 10, 12],
+                   [1, 9, 11, 13, 3, 2, 7, 8, 9, 4], [21] * 14]
+        streams = {}
+        for paged in (False, True):
+            im = load_im(dec)
+            broker = MemoryBroker()
+            srv = make_engine(dec, im, broker, paged,
+                              max_new_default=8)
+            inq, outq = InputQueue(broker), OutputQueue(broker)
+            srv.start()
+            try:
+                uris = [inq.enqueue(t=np.asarray(p, np.int32),
+                                    max_new=8) for p in prompts[:2]]
+                deadline = time.monotonic() + 10
+                while srv.stats["prefills"] < 2:   # join mid-flight
+                    assert time.monotonic() < deadline
+                    time.sleep(0.002)
+                uris += [inq.enqueue(t=np.asarray(p, np.int32),
+                                     max_new=8) for p in prompts[2:]]
+                streams[paged] = list(
+                    collect(outq, uris).values())
+            finally:
+                srv.stop()
+        assert streams[False] == streams[True]
+
+    def test_prefix_cache_hit_mid_flight_keeps_parity(self):
+        """A prompt that adopts cached prefix blocks while another
+        sequence decodes must emit the same tokens as the contiguous
+        engine running it cold — adoption skips compute, not math."""
+        dec = tiny()
+        shared = [5, 3, 8, 2, 9, 1, 4, 7]        # one full block
+        tail_a = shared + [11, 12]
+        tail_b = shared + [13, 14, 15, 16]
+        # contiguous oracle, no cache anywhere
+        im_c = load_im(dec)
+        broker_c = MemoryBroker()
+        srv_c = make_engine(dec, im_c, broker_c, False,
+                            max_new_default=8)
+        inq, outq = InputQueue(broker_c), OutputQueue(broker_c)
+        srv_c.start()
+        try:
+            u1 = inq.enqueue(t=np.asarray(tail_a, np.int32), max_new=8)
+            u2 = inq.enqueue(t=np.asarray(tail_b, np.int32), max_new=8)
+            cold = collect(outq, [u1, u2])
+            cold_a, cold_b = cold[u1], cold[u2]
+        finally:
+            srv_c.stop()
+        # paged engine: prompt A publishes the shared block, then B
+        # adopts it while a long filler sequence keeps lanes busy
+        im_p = load_im(dec)
+        broker_p = MemoryBroker()
+        srv_p = make_engine(dec, im_p, broker_p, True,
+                            max_new_default=8)
+        inq, outq = InputQueue(broker_p), OutputQueue(broker_p)
+        srv_p.start()
+        try:
+            filler = inq.enqueue(t=np.asarray([17] * 12, np.int32),
+                                 max_new=16)
+            ua = inq.enqueue(t=np.asarray(tail_a, np.int32), max_new=8)
+            got_a = collect(outq, [ua])[ua]
+            # A finished → its prompt blocks are published; B now hits
+            ub = inq.enqueue(t=np.asarray(tail_b, np.int32), max_new=8)
+            got_b = collect(outq, [ub])[ub]
+            collect(outq, [filler])
+        finally:
+            srv_p.stop()
+        assert srv_p.stats["prefix_hit_tokens"] >= len(shared)
+        assert got_a == cold_a
+        assert got_b == cold_b
+
+
+class TestChunkedPrefill:
+    def _drive(self, prefill_chunk):
+        """Manually-stepped engine: a short sequence decodes while a
+        near-max-length prompt joins; returns (iterations the long
+        prompt's prefill spanned, tokens the short sequence emitted
+        during those iterations, chunks executed, outputs)."""
+        dec = tiny()
+        im = load_im(dec)
+        broker = MemoryBroker()
+        srv = make_engine(dec, im, broker, True, max_kv_len=64,
+                          prompt_buckets=[8, 16, 64],
+                          prefill_chunk=prefill_chunk,
+                          max_new_default=24)
+        inq, outq = InputQueue(broker), OutputQueue(broker)
+        u_short = inq.enqueue(t=np.asarray([4, 2, 6], np.int32),
+                              max_new=24)
+        srv._intake()
+        srv._run_paged_step()                    # short seq boards
+        assert len(srv._active) == 1
+        long_prompt = list(np.arange(48) % 30 + 1)
+        u_long = inq.enqueue(t=np.asarray(long_prompt, np.int32),
+                             max_new=4)
+        srv._intake()
+        iters, short_tokens = 0, 0
+        long_seq_started = srv.stats["prefill_chunks"]
+        while srv.stats["prefills"] < 2:         # until long prefill done
+            before = sum(
+                len(s.gen) for s in srv._active.values()
+                if s.uri == u_short)
+            srv._run_paged_step()
+            after = sum(
+                len(s.gen) for s in srv._active.values()
+                if s.uri == u_short)
+            short_tokens += max(0, after - before)
+            iters += 1
+            assert iters < 50
+        chunks = srv.stats["prefill_chunks"] - long_seq_started
+        while srv._active or srv._waiting or srv._prefilling:
+            srv._run_paged_step()
+        out = collect(outq, [u_short, u_long], timeout_s=5.0)
+        return iters, short_tokens, chunks, out
+
+    def test_long_prompt_interleaves_decode_when_chunked(self):
+        """Chunked ON: a 48-token prompt runs as 3 chunks of <=16 and
+        the live sequence keeps emitting BETWEEN chunks — the bounded-
+        ITL mechanism. OFF: the whole prefill lands in one iteration."""
+        iters_on, short_on, chunks_on, out_on = self._drive(16)
+        assert chunks_on == 3                    # 48 / 16
+        assert iters_on >= 3                     # spread across steps
+        assert short_on >= 2                     # decode interleaved
+        iters_off, _, chunks_off, out_off = self._drive(None)
+        assert chunks_off == 1                   # single-shot prefill
+        assert iters_off == 1
+        # chunking changes scheduling, never tokens
+        assert sorted(map(tuple, out_on.values())) == \
+            sorted(map(tuple, out_off.values()))
+
+
+class TestZeroCompilePaged:
+    def test_no_compiles_with_block_tables_in_loop(self, tmp_path,
+                                                   monkeypatch):
+        """After paged warmup, a mixed run — chunked prefill, prefix-
+        cache adoption, block-table decode steps across kv buckets —
+        performs ZERO fresh XLA compiles (spy on the one funnel)."""
+        dec = tiny()
+        im = load_im(dec, cache_dir=tmp_path)
+        broker = MemoryBroker()
+        srv = make_engine(dec, im, broker, True, prefill_chunk=16,
+                          max_new_default=5)
+        assert set(im.warmup_source.values()) == {"compiled"}
+        calls = []
+        orig = ccser.compile_lowered
+
+        def spy(lowered):
+            calls.append(1)
+            return orig(lowered)
+
+        monkeypatch.setattr(ccser, "compile_lowered", spy)
+        inq, outq = InputQueue(broker), OutputQueue(broker)
+        srv.start()
+        try:
+            prompts = ([3, 5, 7], [2, 4], [1] * 12,
+                       list(range(1, 41)), [3, 5, 7, 9])
+            uris = [inq.enqueue(t=np.asarray(p, np.int32), max_new=5)
+                    for p in prompts]
+            collect(outq, uris)
+        finally:
+            srv.stop()
+        assert calls == []          # zero fresh XLA compiles
+
+
+class SpyBroker(MemoryBroker):
+    def __init__(self):
+        super().__init__()
+        self.write_calls = []
+        self.hmget_calls = 0
+
+    def hset_many(self, key, mapping):
+        self.write_calls.append(("hset_many", dict(mapping)))
+        return super().hset_many(key, mapping)
+
+    def writeback(self, key, mapping, stream, group, ids):
+        self.write_calls.append(("writeback", dict(mapping)))
+        return super().writeback(key, mapping, stream, group, ids)
+
+    def hmget(self, key, fields):
+        self.hmget_calls += 1
+        return super().hmget(key, fields)
+
+
+class TestFusedWriteback:
+    def test_step_rows_and_finals_share_one_interaction(self):
+        """The finishing step's token rows AND its final blob must land
+        in ONE `writeback` — never a separate hset_many + writeback."""
+        dec = tiny()
+        im = load_im(dec)
+        broker = SpyBroker()
+        srv = make_engine(dec, im, broker, True, max_new_default=4)
+        inq, outq = InputQueue(broker), OutputQueue(broker)
+        uri = inq.enqueue(t=np.asarray([3, 5, 7], np.int32),
+                          max_new=4, stream=1)
+        srv._intake()
+        while srv._active or srv._waiting or srv._prefilling:
+            srv._run_paged_step()
+        finals = [(kind, m) for kind, m in broker.write_calls
+                  if uri in m]
+        assert len(finals) == 1
+        kind, mapping = finals[0]
+        assert kind == "writeback"
+        # the final token's row rode in the same HSET as the final blob
+        assert any(f.startswith(f"{uri}#") for f in mapping)
+        # and every step made at most ONE result-hash write
+        gen = collect(outq, [uri], timeout_s=5.0)[uri]
+        assert len(gen) == 4
+
+
+class TestStreamTokensWindow:
+    def test_backlog_drains_in_windowed_sweeps(self):
+        """A fully-landed 10-row stream must drain in ~3 HMGET sweeps
+        (window 8 + remainder + final), not one round trip per row."""
+        dec = tiny()
+        im = load_im(dec)
+        broker = SpyBroker()
+        srv = make_engine(dec, im, broker, True, max_new_default=10)
+        inq, outq = InputQueue(broker), OutputQueue(broker)
+        uri = inq.enqueue(t=np.asarray([3, 5, 7], np.int32),
+                          max_new=10, stream=1)
+        srv._intake()
+        while srv._active or srv._waiting or srv._prefilling:
+            srv._run_paged_step()
+        broker.hmget_calls = 0
+        events = list(outq.stream_tokens(uri, timeout_s=5.0))
+        assert [e["i"] for e in events[:-1]] == list(range(10))
+        assert events[-1]["done"] and len(events[-1]["tokens"]) == 10
+        assert broker.hmget_calls <= 4
+
+
+class TestPagedScheduler:
+    def test_prefilling_budgeted_before_admissions(self):
+        sch = DecodeScheduler([16, 64], [8, 16],
+                              registry=MetricsRegistry(),
+                              deadline_ms=10.0, chunk_buckets=[8])
+        sch.step_cost.observe(16, 2.0)
+        sch.prefill_cost.observe(8, 6.0)
+        plan = sch.plan_paged_step([8, 8], free_lanes=4,
+                                   prefilling_remaining=[24],
+                                   active_lengths=[5], chunk_cap=8)
+        # budget 10-2-2=6ms: the pending chunk (6ms) fits, the first
+        # admission (12ms total) does not
+        assert plan.chunks == 1 and plan.admit == 0
+        assert plan.reason == "deadline"
+
+    def test_starvation_guard_always_advances_one_chunk(self):
+        sch = DecodeScheduler([16], [8], registry=MetricsRegistry(),
+                              deadline_ms=1.0, chunk_buckets=[8])
+        sch.step_cost.observe(16, 5.0)           # step alone > deadline
+        sch.prefill_cost.observe(8, 5.0)
+        plan = sch.plan_paged_step([], free_lanes=4,
+                                   prefilling_remaining=[40, 40],
+                                   active_lengths=[9], chunk_cap=8)
+        assert plan.chunks == 1                  # never starves
+
+    def test_no_deadline_admits_all(self):
+        sch = DecodeScheduler([16], [8, 16], registry=MetricsRegistry())
+        plan = sch.plan_paged_step([8, 8, 8], free_lanes=2,
+                                   prefilling_remaining=[],
+                                   active_lengths=[], chunk_cap=16)
+        assert plan.admit == 2 and plan.chunks == 0
+        assert plan.reason == "free-lanes"
+
+
+class TestCapacityMultiplier:
+    def test_2x_concurrency_at_fixed_pool_bytes(self):
+        """4 contiguous stripes of 64 positions = 32 blocks of 8. The
+        SAME bytes as a block pool run 8 short sequences concurrently —
+        the paged capacity claim at engine level."""
+        dec = tiny()
+        im = load_im(dec)
+        broker = MemoryBroker()
+        srv = make_engine(dec, im, broker, True, slots=8,
+                          kv_blocks=4 * 8 + 1,    # 4 stripes' bytes
+                          max_new_default=4)
+        inq, outq = InputQueue(broker), OutputQueue(broker)
+        uris = [inq.enqueue(t=np.asarray([i + 1] * 8, np.int32),
+                            max_new=4) for i in range(8)]
+        srv._intake()
+        srv._run_paged_step()
+        assert len(srv._active) == 8             # 2x the stripe ceiling
+        while srv._active or srv._waiting or srv._prefilling:
+            srv._run_paged_step()
+        out = collect(outq, uris, timeout_s=5.0)
+        assert all(len(v) == 4 for v in out.values())
