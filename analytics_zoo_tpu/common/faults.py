@@ -30,6 +30,18 @@ Well-known injection points (grep for `faults.fire` for the live list):
 - ``checkpoint.write``  a checkpoint artifact about to be committed
                         (``path=<temp file>``) — the truncate mode
                         simulates a crash mid-write
+- ``decode.prefill``    one generative prefill (contiguous or one paged
+                        chunk) about to dispatch
+                        (``engine=<id>, uri=<uri>``) — raise simulates
+                        an engine crash mid-admission, stall a wedged
+                        prefill (the per-sequence watchdog's quarry)
+- ``decode.step``       one batched decode step about to dispatch
+                        (``engine=<id>``) — raise kills the engine loop
+                        mid-decode, leaving records for the claim sweep
+- ``decode.writeback``  the decode engine's fused row/final flush
+                        (``engine=<id>``) — raise exercises the bounded
+                        pending buffer (rows retained, loop keeps
+                        stepping, drains on recovery)
 
 Fault modes: ``raise`` (throw ``exc``), ``stall`` (sleep ``delay_s``
 then proceed), ``truncate`` (cut the file at ``ctx["path"]`` to

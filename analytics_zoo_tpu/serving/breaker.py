@@ -242,6 +242,11 @@ class ResilientBroker(Broker):
     def hget(self, key, field):
         return self._guard("hget", key, field)
 
+    def hmget(self, key, fields):
+        # the decode engine's recovery path reads a dead peer's token
+        # rows through its resilient connection
+        return self._guard("hmget", key, fields)
+
     def hgetall(self, key):
         return self._guard("hgetall", key)
 

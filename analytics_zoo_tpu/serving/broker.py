@@ -657,13 +657,21 @@ class RedisBroker(Broker):
 
     def read_group(self, stream, group, consumer, count, block_ms=100):
         self._ensure_group(stream, group)
-        # socket deadline must outlast the server-side BLOCK window
-        # (block_ms=0 blocks forever server-side: wait a day, not 10s)
-        wait_s = 86400.0 if block_ms == 0 else block_ms / 1000.0 + 10.0
-        resp = self._r.command(
-            "XREADGROUP", "GROUP", group, consumer, "COUNT", count,
-            "BLOCK", block_ms, "STREAMS", stream, ">",
-            timeout_s=wait_s)
+        if block_ms <= 0:
+            # block_ms<=0 means NON-blocking here (the decode loop
+            # polls between steps with live sequences seated) — omit
+            # BLOCK entirely: passing "BLOCK 0" upstream means block
+            # FOREVER and would wedge a live engine loop behind an
+            # empty stream
+            resp = self._r.command(
+                "XREADGROUP", "GROUP", group, consumer, "COUNT", count,
+                "STREAMS", stream, ">")
+        else:
+            # socket deadline must outlast the server-side BLOCK window
+            resp = self._r.command(
+                "XREADGROUP", "GROUP", group, consumer, "COUNT", count,
+                "BLOCK", block_ms, "STREAMS", stream, ">",
+                timeout_s=block_ms / 1000.0 + 10.0)
         out = []
         for _, entries in resp or []:
             for rid, fields in entries:

@@ -97,7 +97,13 @@ def cmd_start(args) -> int:
             # trace context on enqueued records
             trace_sample=cfg.trace_sample,
             trace_buffer_spans=cfg.trace_buffer_spans,
-            trace_export_interval_s=cfg.trace_export_interval_s).start()
+            trace_export_interval_s=cfg.trace_export_interval_s,
+            # streaming continuity (ISSUE 20): keepalive comments hold
+            # proxies open; a stalled stream with flatlined engine
+            # heartbeats closes with an explicit error event
+            stream_keepalive_s=cfg.decode_keepalive_s,
+            stream_stall_timeout_s=(cfg.engine_ttl_s * 2
+                                    if cfg.generative else None)).start()
         scheme = "https" if frontend.tls else "http"
         print(f"{scheme} frontend on :{frontend.port}", flush=True)
     if cfg.generative:
@@ -314,7 +320,18 @@ def _start_generative(cfg, broker, frontend) -> int:
         kv_blocks=cfg.decode_kv_blocks,
         prefill_chunk=cfg.decode_prefill_chunk,
         prefix_cache=cfg.decode_prefix_cache,
-        prefix_cache_blocks=cfg.decode_prefix_cache_blocks).start()
+        prefix_cache_blocks=cfg.decode_prefix_cache_blocks,
+        # crash safety (ISSUE 20): claim/resume a dead peer's in-flight
+        # generative records (resume: false opts out), heartbeat for the
+        # peers' stall detection, watchdog + preemption + writeback
+        # buffering knobs
+        claim_min_idle_s=(cfg.claim_min_idle_s
+                          if cfg.decode_resume else None),
+        claim_interval_s=cfg.claim_interval_s,
+        heartbeat_interval_s=cfg.heartbeat_interval_s,
+        max_seq_wall_s=cfg.decode_max_seq_wall_s,
+        preempt_max=cfg.decode_preempt_max,
+        writeback_buffer_rows=cfg.decode_writeback_buffer).start()
     if cfg.decode_paged:
         print(f"decode engine {serving.engine_id} (paged): "
               f"{serving.kv_blocks} KV blocks x {cfg.decode_block_len} "
@@ -429,7 +446,15 @@ def cmd_gateway(args) -> int:
         trace_buffer_spans=(engine_cfg.trace_buffer_spans
                             if engine_cfg else 20000),
         trace_export_interval_s=(engine_cfg.trace_export_interval_s
-                                 if engine_cfg else 0.5)).start()
+                                 if engine_cfg else 0.5),
+        # streaming continuity (ISSUE 20): the gateway relays SSE for a
+        # generative fleet — keepalives + heartbeat-aware stall cutoff
+        stream_keepalive_s=(engine_cfg.decode_keepalive_s
+                            if engine_cfg else None),
+        stream_stall_timeout_s=(args.engine_ttl * 2
+                                if engine_cfg is not None
+                                and engine_cfg.generative
+                                else None)).start()
     print(f"fleet gateway on :{frontend.port} "
           f"(stream {args.stream}, engine ttl {args.engine_ttl:g}s)",
           flush=True)

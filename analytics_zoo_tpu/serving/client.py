@@ -345,6 +345,7 @@ class OutputQueue(_Reconnecting):
         super().__init__(reconnect_attempts=reconnect_attempts)
         self.broker = broker if isinstance(broker, Broker) \
             else connect_broker(broker)
+        self.stream = stream
         self.result_key = f"result:{stream}"
         # per-hop engine timing summaries (ISSUE 17): when tracing is
         # on, each writeback row carries a compact "hops" dict —
@@ -423,7 +424,9 @@ class OutputQueue(_Reconnecting):
         return out
 
     def stream_tokens(self, uri: str, timeout_s: float = 30.0,
-                      delete: bool = True):
+                      delete: bool = True, start: int = 0,
+                      keepalive_s: Optional[float] = None,
+                      stall_timeout_s: Optional[float] = None):
         """Incrementally consume one generative request's token stream.
 
         Yields each token row ``{"i", "t", "ms"}`` as the decode engine
@@ -439,12 +442,32 @@ class OutputQueue(_Reconnecting):
         once the stream resumes. With `delete` (default) the final row
         and every token row are removed in one batched HDEL at
         completion. Raises TimeoutError if the final row hasn't landed
-        inside `timeout_s`."""
+        inside `timeout_s`.
+
+        Crash-safe streaming (ISSUE 20): the cursor only ever moves
+        forward, so every token index is yielded EXACTLY once per call
+        — and `start` skips rows a previous (disconnected) call already
+        delivered, which is how the frontend honors ``Last-Event-ID``
+        (replay only the missing rows; the rows are durable in the
+        result hash until the final is consumed). `keepalive_s` yields
+        ``{"keepalive": True}`` markers during idle gaps so an SSE
+        writer can emit comment frames that hold proxies open.
+        `stall_timeout_s` arms heartbeat-aware death detection: when no
+        row lands for that long AND the fleet's heartbeat rows
+        (`engines:<stream>`) show zero timestamp progress between two
+        consecutive checks, the stream ends with ``{"done": True,
+        "error": "engine-dead"}`` instead of hanging until the
+        deadline — a live-but-slow engine keeps beating and is given
+        the full `timeout_s`."""
         from analytics_zoo_tpu.serving.decode import token_row_field
+        from analytics_zoo_tpu.serving.fleet import engines_key
         deadline = time.monotonic() + timeout_s
-        nxt = 0
+        nxt = max(0, int(start))
         backoff = 0.001
         window = 8
+        t_progress = time.monotonic()
+        last_keep = time.monotonic()
+        last_beats: Optional[Dict[str, str]] = None
         while True:
             fields = [token_row_field(uri, nxt + j)
                       for j in range(window)] + [uri]
@@ -460,6 +483,8 @@ class OutputQueue(_Reconnecting):
                 yield json.loads(raw)
             if progressed:
                 backoff = 0.001
+                t_progress = time.monotonic()
+                last_beats = None
                 continue
             if final is not None:
                 if final in ("NaN", "SHED"):
@@ -493,7 +518,32 @@ class OutputQueue(_Reconnecting):
                 yield {"done": True, "tokens": decode_ndarray(blob),
                        "gen": gen}
                 return
-            remaining = deadline - time.monotonic()
+            now = time.monotonic()
+            if keepalive_s is not None and now - last_keep >= keepalive_s:
+                last_keep = now
+                yield {"keepalive": True}
+            if (stall_timeout_s is not None
+                    and now - t_progress >= stall_timeout_s):
+                try:
+                    beats = self._call(self.broker.hgetall,
+                                       engines_key(self.stream),
+                                       deadline=deadline)
+                except (ConnectionError, OSError):
+                    beats = None      # can't tell: keep waiting
+                if beats is not None:
+                    if last_beats is not None and beats == last_beats:
+                        # one full stall window with zero heartbeat
+                        # progress (ts values are inside the row JSON,
+                        # so ANY beat changes its row): the fleet is
+                        # dead, not slow — answered failure, no hang
+                        yield {"done": True, "error": "engine-dead",
+                               "tokens": None, "gen": {}}
+                        return
+                    # first check (or progress seen): baseline and give
+                    # the fleet one more full stall window
+                    last_beats = beats
+                    t_progress = now
+            remaining = deadline - now
             if remaining <= 0:
                 raise TimeoutError(
                     f"no completed result for {uri} within {timeout_s}s "

@@ -226,6 +226,20 @@ class ServingConfig:
     decode_prefill_chunk: Optional[int] = None
     decode_prefix_cache: bool = True
     decode_prefix_cache_blocks: Optional[int] = None
+    # crash-safe serving (ISSUE 20): max_seq_wall_s arms the
+    # per-sequence watchdog (null = off); preempt_max bounds how often
+    # one sequence may be preempted under KV pressure before it must
+    # complete ahead of new admissions (anti-thrash); writeback_buffer_
+    # rows bounds the pending row buffer held through a broker outage
+    # (oldest-step rows shed first — the final blob stays
+    # authoritative); resume: false opts this engine out of claiming
+    # and resuming a dead peer's in-flight generative records;
+    # keepalive_s sets the SSE keepalive-comment cadence (null = none).
+    decode_max_seq_wall_s: Optional[float] = None
+    decode_preempt_max: int = 3
+    decode_writeback_buffer: int = 512
+    decode_resume: bool = True
+    decode_keepalive_s: Optional[float] = None
     # on-demand profiler capture (POST /profile): artifact root +
     # rotation bound; profile_enabled: false turns the endpoint off
     # (404). Default root is <tmp>/zoo_profiles.
@@ -445,6 +459,14 @@ class ServingConfig:
             if gen.get("prefix_cache_blocks") is not None:
                 cfg.decode_prefix_cache_blocks = int(
                     gen["prefix_cache_blocks"])
+            if gen.get("max_seq_wall_s") is not None:
+                cfg.decode_max_seq_wall_s = float(gen["max_seq_wall_s"])
+            cfg.decode_preempt_max = int(gen.get("preempt_max", 3))
+            cfg.decode_writeback_buffer = int(
+                gen.get("writeback_buffer_rows", 512))
+            cfg.decode_resume = bool(gen.get("resume", True))
+            if gen.get("keepalive_s") is not None:
+                cfg.decode_keepalive_s = float(gen["keepalive_s"])
             cfg._validate_generative()
         cfg.profile_dir = params.get("profile_dir")
         cfg.profile_enabled = bool(params.get("profile_enabled", True))
@@ -757,6 +779,25 @@ class ServingConfig:
                 raise ValueError(
                     f"params.generative.prefix_cache_blocks="
                     f"{self.decode_prefix_cache_blocks} must be >= 1")
+        if (self.decode_max_seq_wall_s is not None
+                and self.decode_max_seq_wall_s <= 0):
+            raise ValueError(
+                f"params.generative.max_seq_wall_s="
+                f"{self.decode_max_seq_wall_s} must be > 0 (or null to "
+                "disable the per-sequence watchdog)")
+        if self.decode_preempt_max < 0:
+            raise ValueError(
+                f"params.generative.preempt_max={self.decode_preempt_max} "
+                "must be >= 0 (0 disables KV-pressure preemption)")
+        if self.decode_writeback_buffer < 1:
+            raise ValueError(
+                f"params.generative.writeback_buffer_rows="
+                f"{self.decode_writeback_buffer} must be >= 1")
+        if (self.decode_keepalive_s is not None
+                and self.decode_keepalive_s <= 0):
+            raise ValueError(
+                f"params.generative.keepalive_s={self.decode_keepalive_s} "
+                "must be > 0 (or null for no keepalive comments)")
 
     def _validate_compile_cache(self):
         """Cache-setting errors belong at config load, like placement:

@@ -576,55 +576,112 @@ class _Handler(BaseHTTPRequestHandler):
         an ``event: done`` carrying the full token array (exactly what
         the non-streaming path would have returned). One request per
         SSE response — batching streams would interleave sequences on
-        one ordered connection."""
-        prompt = req.get("prompt") if isinstance(req, dict) else None
-        if prompt is None and isinstance(req, dict) \
-                and len(req.get("instances") or []) == 1:
-            prompt = req["instances"][0]
-        if prompt is None:
-            self._send(400, {"error": "streaming /predict needs a "
-                                      "\"prompt\" token-id list "
-                                      "(or one-element \"instances\")"})
-            return
-        arr = np.asarray(prompt, np.int32).reshape(-1)
-        uris, t_ing, t0 = self._request_ids(1)
-        uri = uris[0] if uris else str(uuid.uuid4())
-        extra = {}
-        if isinstance(req, dict) and "max_new" in req:
-            extra["max_new"] = int(req["max_new"])
-        if isinstance(req, dict) and "eos" in req:
-            extra["eos"] = int(req["eos"])
-        self.server.input_queue.enqueue(uri, tier=tier, t=arr,
-                                        stream=1, **extra)
+        one ordered connection.
+
+        Streaming continuity (ISSUE 20): every token frame carries an
+        SSE ``id:`` line (the token index), idle gaps emit periodic
+        ``: keepalive`` comments so proxies hold the connection open,
+        and a dropped client reconnects by POSTing its ``request_id``
+        with a ``Last-Event-ID`` header (or ``last_event_id`` body
+        field) — the record is NOT re-enqueued; the relay resumes from
+        the durable token rows at ``last + 1``, so every index is
+        observed exactly once across connections. When no row lands for
+        the stall window AND the fleet's heartbeats flatline, the relay
+        closes with ``event: error`` (``engine-dead``) instead of
+        hanging to the timeout."""
+        last_id = self.headers.get("Last-Event-ID")
+        if last_id is None and isinstance(req, dict):
+            last_id = req.get("last_event_id")
+        resume_uri = req.get("request_id") if isinstance(req, dict) \
+            else None
+        start = 0
+        if resume_uri is not None:
+            # reconnect: the stream already exists under this uri —
+            # re-enqueueing would decode the prompt a second time
+            if last_id is not None:
+                try:
+                    start = int(last_id) + 1
+                except (TypeError, ValueError):
+                    self._send(400, {
+                        "error": "Last-Event-ID must be the integer "
+                                 "index of the last token frame "
+                                 "received"})
+                    return
+            uri = str(resume_uri)
+            uris, t_ing, t0 = None, 0.0, 0.0
+        else:
+            prompt = req.get("prompt") if isinstance(req, dict) else None
+            if prompt is None and isinstance(req, dict) \
+                    and len(req.get("instances") or []) == 1:
+                prompt = req["instances"][0]
+            if prompt is None:
+                self._send(400, {"error": "streaming /predict needs a "
+                                          "\"prompt\" token-id list "
+                                          "(or one-element \"instances\")"})
+                return
+            arr = np.asarray(prompt, np.int32).reshape(-1)
+            uris, t_ing, t0 = self._request_ids(1)
+            uri = uris[0] if uris else str(uuid.uuid4())
+            extra = {}
+            if isinstance(req, dict) and "max_new" in req:
+                extra["max_new"] = int(req["max_new"])
+            if isinstance(req, dict) and "eos" in req:
+                extra["eos"] = int(req["eos"])
+            self.server.input_queue.enqueue(uri, tier=tier, t=arr,
+                                            stream=1, **extra)
         self._count_request(200)
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
+        # the reconnect handle, known BEFORE any frame arrives (the
+        # done payload repeats it, but a dropped connection never saw
+        # that)
+        self.send_header("X-Request-Id", uri)
         self.end_headers()
+        replayed = 0
         try:
             for evt in self.server.output_queue.stream_tokens(
-                    uri, timeout_s=self.server.timeout_s):
-                if evt.get("done"):
+                    uri, timeout_s=self.server.timeout_s, start=start,
+                    keepalive_s=self.server.stream_keepalive_s,
+                    stall_timeout_s=self.server.stream_stall_timeout_s):
+                if evt.get("keepalive"):
+                    # SSE comment: ignored by clients, resets proxy
+                    # idle timers, never advances Last-Event-ID
+                    self.wfile.write(b": keepalive\n\n")
+                elif evt.get("done"):
                     if evt.get("error"):
                         payload = {"error": evt["error"],
                                    "request_id": uri}
+                        name = b"error" if evt["error"] == "engine-dead" \
+                            else b"done"
+                        self.wfile.write(
+                            b"event: " + name + b"\ndata: "
+                            + json.dumps(payload).encode() + b"\n\n")
                     else:
                         payload = {"tokens":
                                    np.asarray(evt["tokens"]).tolist(),
                                    "gen": evt.get("gen", {}),
                                    "request_id": uri}
-                    self.wfile.write(
-                        b"event: done\ndata: "
-                        + json.dumps(payload).encode() + b"\n\n")
+                        self.wfile.write(
+                            b"event: done\ndata: "
+                            + json.dumps(payload).encode() + b"\n\n")
                 else:
-                    self.wfile.write(b"data: "
-                                     + json.dumps(evt).encode() + b"\n\n")
+                    if resume_uri is not None:
+                        replayed += 1
+                    self.wfile.write(
+                        b"id: " + str(evt["i"]).encode() + b"\ndata: "
+                        + json.dumps(evt).encode() + b"\n\n")
                 self.wfile.flush()
-            self._gateway_span([uri] if uris else None, t_ing, t0)
+            if uris:
+                self._gateway_span(uris, t_ing, t0)
         except TimeoutError:
             self.wfile.write(b"event: error\ndata: "
                              b"{\"error\": \"timeout\"}\n\n")
             self.wfile.flush()
+        finally:
+            if replayed:
+                self.server.token_replays.inc(replayed,
+                                              surface="frontend")
 
     def _request_ids(self, n: int):
         """Pre-generated request ids (= trace ids) for a traced
@@ -750,7 +807,9 @@ class FrontEnd:
                  leader_ttl_s: float = 3.0,
                  trace_sample: float = 0.0,
                  trace_buffer_spans: int = 20000,
-                 trace_export_interval_s: float = 0.5):
+                 trace_export_interval_s: float = 0.5,
+                 stream_keepalive_s: Optional[float] = None,
+                 stream_stall_timeout_s: Optional[float] = None):
         """`fleet_stream` (ISSUE 10) turns the frontend into a fleet
         gateway: a `FleetTracker` watches engine heartbeats on
         `engines:<fleet_stream>`, `/healthz` answers for the FLEET
@@ -805,6 +864,11 @@ class FrontEnd:
         # generative streaming (ISSUE 18): SSE on /predict?stream=1
         # polls token rows straight off the result hash
         self._srv.output_queue = OutputQueue(self.broker)
+        # streaming continuity (ISSUE 20): keepalive comment cadence and
+        # heartbeat-aware stall cutoff for the SSE relay, plus the
+        # counter the Last-Event-ID reconnect path bumps
+        self._srv.stream_keepalive_s = stream_keepalive_s
+        self._srv.stream_stall_timeout_s = stream_stall_timeout_s
         self._srv.serving = serving
         self._srv.request_timer = Timer("http_predict")
         self.registry = registry if registry is not None else get_registry()
@@ -812,6 +876,13 @@ class FrontEnd:
         self._srv.http_requests = self.registry.counter(
             "http_requests_total",
             "frontend responses by route, method and status code")
+        self._srv.token_replays = self.registry.counter(
+            "serving_token_replays_total",
+            "token rows replayed instead of served fresh — surface="
+            "engine: deterministic re-decode of already-durable tokens "
+            "when a resume context outruns the prefill ladder; surface="
+            "frontend: rows re-sent to a reconnecting SSE client "
+            "honoring Last-Event-ID")
         req_hist = self.registry.histogram(
             "http_request_ms", "frontend /predict round-trip duration")
         self._srv.request_timer.add_observer(

@@ -151,9 +151,12 @@ class PrefixCache:
     prompt block — so a lookup is pure token-id comparison and a hit
     adopts the PHYSICAL blocks an earlier identical prefix already
     computed (copy-free: the adopter only gains references). Only fully
-    written prompt blocks are ever published; a block that could still
-    receive decode writes never enters the trie, so shared blocks are
-    immutable by construction.
+    written blocks are ever published — prompt spans at prefill
+    completion, and (ISSUE 20) full CONTEXT spans (prompt ⊕ generated)
+    when a sequence is preempted or resumes, since decode writes land
+    strictly beyond a full block; a block that could still receive
+    writes never enters the trie, so shared blocks are immutable by
+    construction.
 
     Eviction (`evict_for`) is LRU over leaves, preferring blocks whose
     only owner is the cache itself — evicting a block a live sequence
@@ -185,6 +188,13 @@ class PrefixCache:
         self._blocks_gauge = registry.gauge(
             "serving_prefix_cache_blocks",
             "KV blocks currently published in the prefix-cache trie")
+        self._pressure_evictions = registry.counter(
+            "serving_kv_pressure_evictions_total",
+            "prefix-cache blocks evicted under allocation pressure "
+            "(`evict_for`: the pool ran dry and cold cached prefixes "
+            "were dropped to make room for live sequences) — sustained "
+            "growth means the block pool is undersized for the offered "
+            "load")
         self._labels = labels
         self._blocks_gauge.set(0.0, **labels)
 
@@ -289,4 +299,6 @@ class PrefixCache:
                 self._drop_locked(min(sole, key=lambda n: n.last_use))
                 dropped += 1
             self._blocks_gauge.set(float(len(self._nodes)), **self._labels)
+        if dropped:
+            self._pressure_evictions.inc(dropped, **self._labels)
         return dropped

@@ -2091,6 +2091,411 @@ def _generative_paged_main(args) -> int:
     return 0
 
 
+def _generative_chaos_child(args) -> int:
+    """One paged decode engine in its own process for the generative
+    chaos leg: warm through the SHARED compile cache, park at the
+    fleet start gate, then serve with the claim sweep armed. SIGKILL
+    is the exercise: no cleanup runs, the PEL keeps this engine's
+    unacked generative records, and the surviving peer's sweep adopts
+    and RESUMES them from their durable token rows. The compile funnel
+    is spied AFTER warmup, so the exit report's `cold_compiles` counts
+    request-path compiles only — resume must not add any."""
+    import signal
+
+    import analytics_zoo_tpu.compile_cache.serialization as ccser
+    from analytics_zoo_tpu import init_orca_context
+    from analytics_zoo_tpu.compile_cache import CompileCache
+    from analytics_zoo_tpu.models.generative import TinyDecoder
+    from analytics_zoo_tpu.serving.broker import connect_broker
+    from analytics_zoo_tpu.serving.decode import DecodeServing
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+
+    init_orca_context(cluster_mode="local")
+    dec = TinyDecoder(vocab=64, n_layers=4, n_heads=4, head_dim=16,
+                      max_len=64)
+    cache = CompileCache(args.compile_cache_dir) \
+        if args.compile_cache_dir else None
+    im = InferenceModel(placement="replicated", num_replicas=1,
+                        compile_cache=cache)
+    im.load_generative(dec.prefill_fn, dec.step_fn, dec.init_params(0),
+                       paged_prefill_fn=dec.paged_prefill_fn,
+                       paged_step_fn=dec.paged_step_fn)
+    im.warmup_generative_paged(
+        dec.init_kv_blocks, num_blocks=33, block_len=8, lanes=4,
+        table_len=8, chunk_buckets=[8, 16], kv_buckets=[16, 32, 64])
+
+    compiles = []
+    orig_compile = ccser.compile_lowered
+
+    def spy(lowered):
+        compiles.append(1)
+        return orig_compile(lowered)
+
+    ccser.compile_lowered = spy
+    if args.step_stall_ms > 0:
+        # stretch every decode step (the parent sizes this so the
+        # SIGKILL reliably lands MID-generation instead of racing a
+        # sub-second drain on fast hosts) — the ISSUE-20 stall mode on
+        # the decode.step injection point, permanently armed
+        from analytics_zoo_tpu.common import faults
+        faults.inject("decode.step",
+                      faults.Fault(mode="stall",
+                                   delay_s=args.step_stall_ms / 1e3))
+    broker = connect_broker(args.broker_url)
+    srv = DecodeServing(
+        im, dec.init_kv, broker=broker, stream=args.stream,
+        slots=4, max_kv_len=64, kv_buckets=[16, 32, 64],
+        prompt_buckets=[8, 16], max_new_default=40,
+        # the queue bound must exceed the whole burst: every prompt
+        # must be ACCEPTED (the leg asserts bitwise completion for
+        # each), so overload shedding must never fire. The burst still
+        # splits between the engines — records land over ~100ms while
+        # both loops read every ~step, so neither can hoover the
+        # stream in one XREADGROUP
+        max_waiting=64,
+        engine_id=args.engine_id, paged=True,
+        init_kv_blocks=dec.init_kv_blocks, block_len=8, kv_blocks=33,
+        claim_min_idle_s=args.claim_min_idle,
+        claim_interval_s=max(args.claim_min_idle / 4.0, 0.05),
+        heartbeat_interval_s=0.25)
+    broker.hset(f"fleet:ready:{args.stream}", args.engine_id, "1")
+    gate_deadline = time.time() + 600
+    while not broker.hget(f"fleet:gate:{args.stream}", "go"):
+        if time.time() > gate_deadline:
+            raise SystemExit("chaos start gate never opened")
+        time.sleep(0.02)
+    srv.start()
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.05)
+    srv.stop()
+    print(json.dumps({"engine_id": args.engine_id,
+                      "cold_compiles": len(compiles),
+                      "stats": srv.stats}))
+    return 0
+
+
+def _generative_chaos_main(args) -> int:
+    """`--generative --chaos` (ISSUE 20): crash-safe generative
+    serving. Two paged decode engines in their own processes drain a
+    seeded Poisson prompt mix over one MiniRedis; one engine is
+    SIGKILLed mid-generation. The survivor's claim sweep must adopt
+    the dead engine's records and resume each from its durable token
+    rows, so every completion lands bitwise equal to an uninterrupted
+    single-engine oracle on the SAME executables (greedy decode is
+    deterministic — zero token loss, zero divergence), a client that
+    reconnects mid-stream sees every token index exactly once, and the
+    survivor's request path stays at 0 fresh XLA compiles. A second,
+    in-process pair then runs the SAME pressure mix with preemption on
+    vs off: preemption must complete every sequence under KV-pool
+    exhaustion where the disabled leg degrades to answered blocks-full
+    truncations — and neither leg may deadlock."""
+    import shutil
+    import tempfile
+
+    from analytics_zoo_tpu.compile_cache import CompileCache
+    from analytics_zoo_tpu.models.generative import TinyDecoder
+    from analytics_zoo_tpu.serving.broker import MemoryBroker, RedisBroker
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.decode import GROUP, DecodeServing
+    from analytics_zoo_tpu.serving.inference_model import InferenceModel
+    from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+
+    LANES, MAX_KV, BL, BLOCKS = 4, 64, 8, 33
+    KV_BUCKETS, PROMPT_BUCKETS = [16, 32, 64], [8, 16]
+    n = int(os.environ.get("BENCH_GEN_CHAOS_REQUESTS", 48))
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(1, 64,
+                            size=int(rng.integers(3, 9))).astype(np.int32)
+               for _ in range(n)]
+    max_new = np.minimum(4 + rng.geometric(0.06, n), 40).astype(int)
+    # arrival rate sized to SATURATE both engines (the _generative_main
+    # regime): the kill must land while a deep backlog keeps 8 lanes
+    # busy, or the dead engine has nothing in flight worth recovering
+    arrivals = np.cumsum(rng.exponential(0.002, n))
+
+    cache_dir = args.compile_cache_dir or tempfile.mkdtemp(
+        prefix="genchaos-cache-")
+    own_cache = args.compile_cache_dir is None
+    dec = TinyDecoder(vocab=64, n_layers=4, n_heads=4, head_dim=16,
+                      max_len=MAX_KV)
+    im = InferenceModel(placement="replicated", num_replicas=1,
+                        compile_cache=CompileCache(cache_dir))
+    im.load_generative(dec.prefill_fn, dec.step_fn, dec.init_params(0),
+                       paged_prefill_fn=dec.paged_prefill_fn,
+                       paged_step_fn=dec.paged_step_fn)
+    t0 = time.perf_counter()
+    # the parent warms FIRST: children then load every executable from
+    # the shared cache dir instead of compiling 2x in parallel
+    im.warmup_generative_paged(
+        dec.init_kv_blocks, num_blocks=BLOCKS, block_len=BL, lanes=LANES,
+        table_len=MAX_KV // BL, chunk_buckets=PROMPT_BUCKETS,
+        kv_buckets=KV_BUCKETS)
+    warmup_s = time.perf_counter() - t0
+
+    def engine(broker, **kw):
+        return DecodeServing(
+            im, dec.init_kv, broker=broker, slots=LANES,
+            max_kv_len=MAX_KV, kv_buckets=KV_BUCKETS,
+            prompt_buckets=PROMPT_BUCKETS, max_new_default=40,
+            paged=True, init_kv_blocks=dec.init_kv_blocks,
+            block_len=BL, kv_blocks=BLOCKS, **kw)
+
+    # ---- uninterrupted oracle: one engine, same executables --------------
+    ref_broker = MemoryBroker()
+    ref = engine(ref_broker).start()
+    rin, rout = InputQueue(ref_broker), OutputQueue(ref_broker)
+    ref_uris = [rin.enqueue(t=p, max_new=int(m), stream=1)
+                for p, m in zip(prompts, max_new)]
+    got = {}
+    deadline = time.time() + 240
+    while len(got) < n:
+        if time.time() > deadline:
+            raise SystemExit(f"oracle leg stalled: {len(got)}/{n}")
+        got.update(rout.query_many([u for u in ref_uris if u not in got],
+                                   delete=True))
+        time.sleep(0.005)
+    ref.stop()
+    expected = [list(np.asarray(got[u]).reshape(-1)) for u in ref_uris]
+    total_tokens = sum(len(e) for e in expected)
+
+    # ---- the chaos fleet: 2 engines, kill one mid-generation -------------
+    redis_srv = MiniRedisServer().start()
+    stream = args.stream
+    broker = RedisBroker("127.0.0.1", redis_srv.port)
+    result_key = f"result:{stream}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--generative-child",
+         "--broker-url", f"redis://127.0.0.1:{redis_srv.port}",
+         "--stream", stream, "--engine-id", f"engine-{i}",
+         "--compile-cache-dir", cache_dir,
+         "--claim-min-idle", "0.75", "--step-stall-ms", "8"],
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for i in range(2)]
+    _fleet_wait_ready(broker, stream, procs, 2)
+    broker.hset(f"fleet:gate:{stream}", "go", "1")
+
+    def finals_landed(uris):
+        return sum(1 for r in broker.hmget(result_key, uris)
+                   if r is not None)
+
+    inq, outq = InputQueue(broker), OutputQueue(broker)
+    t_start = time.perf_counter()
+    uris = []
+    for i in range(n):
+        dt = t_start + arrivals[i] - time.perf_counter()
+        if dt > 0:
+            time.sleep(dt)
+        uris.append(inq.enqueue(t=prompts[i], max_new=int(max_new[i]),
+                                stream=1))
+
+    kill_at = max(2, n // 12)
+    deadline = time.time() + 240
+    while finals_landed(uris) < kill_at \
+            or broker.pending_count(stream, GROUP) < 6:
+        if time.time() > deadline:
+            raise SystemExit("chaos fleet never reached the kill point")
+        if finals_landed(uris) >= n - 2:
+            raise SystemExit("load finished before the kill point: "
+                             "raise BENCH_GEN_CHAOS_REQUESTS")
+        time.sleep(0.002)
+    # kill the engine that is ACTIVELY generating (heartbeat token
+    # counter grew over one beat window) — killing an idle peer would
+    # leave the survivor nothing to recover
+    from analytics_zoo_tpu.serving.fleet import engines_key
+
+    def beat_tokens():
+        return {eid: json.loads(v).get("tokens", 0)
+                for eid, v in broker.hgetall(engines_key(stream)).items()}
+
+    b0 = beat_tokens()
+    time.sleep(0.3)
+    b1 = beat_tokens()
+    target_id = max(b1, key=lambda eid: b1[eid] - b0.get(eid, 0))
+    target = int(target_id.rsplit("-", 1)[1])
+    pending_at_kill = broker.pending_count(stream, GROUP)
+    finals_at_kill = finals_landed(uris)
+    assert finals_at_kill < n, "everything finished before the kill"
+    t_kill = time.perf_counter()
+    procs[target].kill()                          # SIGKILL: no cleanup
+    procs[target].wait(timeout=30)
+    while finals_landed(uris) < n:
+        if time.time() > deadline:
+            missing = n - finals_landed(uris)
+            raise SystemExit(
+                f"token loss: {missing} request(s) never completed "
+                f"after the kill")
+        time.sleep(0.01)
+    recovery_s = time.perf_counter() - t_kill
+
+    # ---- streaming continuity: reconnect replays only missing rows ------
+    victim_i = max(i for i in range(n) if max_new[i] >= 8)
+    victim = uris[victim_i]
+    seen1, seen2, done_ev = [], [], None
+    first_conn = outq.stream_tokens(victim, timeout_s=60.0, delete=False)
+    for ev in first_conn:                         # "dropped" connection:
+        if ev.get("done"):                        # close after 3 frames
+            break
+        seen1.append(ev)
+        if len(seen1) >= 3:
+            break
+    first_conn.close()
+    for ev in outq.stream_tokens(victim, timeout_s=60.0, delete=False,
+                                 start=len(seen1)):
+        if ev.get("done"):
+            done_ev = ev
+            break
+        seen2.append(ev)
+    rows = seen1 + seen2
+    assert done_ev is not None and not done_ev.get("error"), done_ev
+    assert [ev["i"] for ev in rows] == list(range(len(rows))), \
+        "reconnect replayed or skipped a token index"
+    assert [ev["t"] for ev in rows] == expected[victim_i], \
+        "streamed tokens diverged from the uninterrupted oracle"
+
+    # ---- bitwise parity for every request --------------------------------
+    results = {}
+    while len(results) < n:
+        if time.time() > deadline:
+            raise SystemExit("finals landed but would not read back")
+        results.update(outq.query_many([u for u in uris
+                                        if u not in results], delete=True))
+        time.sleep(0.005)
+    def _diverge(i, u):
+        got = list(np.asarray(results[u]).reshape(-1))
+        if got == expected[i]:
+            return None
+        d = next((j for j, (a, b) in enumerate(zip(got, expected[i]))
+                  if a != b), min(len(got), len(expected[i])))
+        return (i, len(got), len(expected[i]), d)
+
+    mismatches = [m for m in (_diverge(i, u) for i, u in enumerate(uris))
+                  if m is not None]
+    assert not mismatches, \
+        f"{len(mismatches)} completion(s) diverged from the oracle " \
+        f"(idx, got_len, want_len, first_diff): {mismatches[:8]}"
+
+    reports = _fleet_reports(procs)   # SIGTERMs the survivor; the
+    assert len(reports) == 1, \
+        "expected exactly the survivor's report"   # killed child is silent
+    surv = reports[0]["stats"]
+    assert reports[0]["cold_compiles"] == 0, \
+        "survivor compiled on the resume path"
+    assert surv["resumed"] + surv["duplicates"] >= 1, \
+        "the kill left no records for the survivor to claim " \
+        f"(pending_at_kill={pending_at_kill})"
+    redis_srv.stop()
+
+    # ---- preemption vs stall under KV-pool exhaustion --------------------
+    # a SMALL pool needs its own warmup (the kv-block buffer's leading
+    # dim is baked into the executables); still served from the shared
+    # on-disk cache across reruns
+    im2 = InferenceModel(placement="replicated", num_replicas=1,
+                         compile_cache=CompileCache(cache_dir))
+    im2.load_generative(dec.prefill_fn, dec.step_fn, dec.init_params(0),
+                        paged_prefill_fn=dec.paged_prefill_fn,
+                        paged_step_fn=dec.paged_step_fn)
+    im2.warmup_generative_paged(
+        dec.init_kv_blocks, num_blocks=13, block_len=BL, lanes=4,
+        table_len=4, chunk_buckets=PROMPT_BUCKETS, kv_buckets=[16, 32])
+    pressure_prompts = [((np.arange(8) * (i + 3)) % 63 + 1)
+                        .astype(np.int32) for i in range(8)]
+
+    def pressure_leg(preempt_max):
+        # 8 seqs x 24 new tokens -> 4 blocks each at full context; 4
+        # lanes x 4 = 16 demanded vs 12 usable: guaranteed mid-decode
+        # exhaustion
+        b = MemoryBroker()
+        srv = DecodeServing(
+            im2, dec.init_kv, broker=b, slots=4, max_kv_len=32,
+            kv_buckets=[16, 32], prompt_buckets=PROMPT_BUCKETS,
+            max_new_default=24, paged=True,
+            init_kv_blocks=dec.init_kv_blocks, block_len=BL,
+            kv_blocks=13, preempt_max=preempt_max).start()
+        q, o = InputQueue(b), OutputQueue(b)
+        t0 = time.perf_counter()
+        us = [q.enqueue(t=p, max_new=24, stream=1)
+              for p in pressure_prompts]
+        gaps, finals = [], {}
+        lock = threading.Lock()
+
+        def consume(u):
+            last = None
+            for ev in o.stream_tokens(u, timeout_s=120.0):
+                if ev.get("done"):
+                    with lock:
+                        finals[u] = ev
+                    return
+                now = time.perf_counter()
+                if last is not None:
+                    with lock:
+                        gaps.append((now - last) * 1e3)
+                last = now
+
+        threads = [threading.Thread(target=consume, args=(u,),
+                                    daemon=True) for u in us]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.perf_counter() - t0
+        srv.stop()
+        assert len(finals) == len(us), \
+            f"pressure leg (preempt_max={preempt_max}) deadlocked"
+        full = sum(1 for ev in finals.values()
+                   if ev.get("tokens") is not None
+                   and np.asarray(ev["tokens"]).reshape(-1).size == 24)
+        return {"preempt_max": preempt_max,
+                "itl_ms_p99": round(_percentile(gaps, 0.99), 3),
+                "full_completions": full, "requests": len(us),
+                "preempted": srv.stats["preempted"],
+                "aborted": srv.stats["aborted"],
+                "prefix_hit_tokens": srv.stats["prefix_hit_tokens"],
+                "wall_s": round(wall, 3)}
+
+    preempt_on = pressure_leg(3)
+    preempt_off = pressure_leg(0)
+    assert preempt_on["aborted"] == 0 \
+        and preempt_on["full_completions"] == len(pressure_prompts), \
+        f"preemption failed to complete the pressure mix: {preempt_on}"
+    assert preempt_on["preempted"] >= 1, \
+        "the pressure mix never actually preempted"
+
+    if own_cache:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    out = {
+        "mode": "generative_chaos",
+        "backend": jax.default_backend(),
+        "requests": n, "engines": 2,
+        "warmup_s": round(warmup_s, 3),
+        "total_tokens": total_tokens,
+        "kill": {"finals_at_kill": finals_at_kill,
+                 "pending_at_kill": pending_at_kill},
+        "recovery": {"all_finals_after_kill_s": round(recovery_s, 3),
+                     "resumed": surv["resumed"],
+                     "recovered_tokens": surv["recovered_tokens"],
+                     "replayed_tokens": surv["replayed_tokens"],
+                     "duplicates": surv["duplicates"],
+                     "survivor_preempted": surv["preempted"]},
+        "survivor_cold_compiles": reports[0]["cold_compiles"],
+        "bitwise_identical": n - len(mismatches),
+        "token_loss": 0,
+        "streaming_reconnect": {
+            "first_conn_rows": len(seen1),
+            "second_conn_rows": len(seen2),
+            "indices_exactly_once": True,
+            "bitwise": True},
+        "preemption_vs_stall": {"on": preempt_on, "off": preempt_off},
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def _percentile(samples, q):
     """np.percentile, the same interpolated estimator every other
     p50/p99 in this file uses — a nearest-rank variant here would make
@@ -2961,6 +3366,10 @@ def main():
                          "processes behind one MiniRedis, report the "
                          "drain scaling curve, and SIGKILL one engine "
                          "mid-drain to prove zero-loss redelivery")
+    ap.add_argument("--generative-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--step-stall-ms", type=float, default=0.0,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--fleet-child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--broker-url", default=None, help=argparse.SUPPRESS)
@@ -3017,7 +3426,12 @@ def main():
                          "batching decode engine vs pad-to-max-restart "
                          "baseline on a seeded Poisson prompt/output "
                          "mix; tokens/sec, TTFT/ITL p99, slot-"
-                         "utilization ratio, 0-compile assertion")
+                         "utilization ratio, 0-compile assertion; "
+                         "with --chaos (ISSUE 20): SIGKILL one of two "
+                         "decode engines mid-generation — bitwise-"
+                         "identical resume from durable token rows, "
+                         "exactly-once streaming across a reconnect, "
+                         "preemption-vs-stall under KV exhaustion")
     ap.add_argument("--paged", action="store_true",
                     help="with --generative (ISSUE 19): paged-KV legs "
                          "on a prefix-heavy Poisson mix — capacity "
@@ -3031,6 +3445,11 @@ def main():
             raise SystemExit("--fleet-child needs --broker-url and "
                              "--engine-id")
         return _fleet_child(args)
+    if args.generative_child:
+        if not (args.broker_url and args.engine_id):
+            raise SystemExit("--generative-child needs --broker-url and "
+                             "--engine-id")
+        return _generative_chaos_child(args)
     if args.engines:
         return _fleet_main(args)
     if args.request_plane:
@@ -3041,6 +3460,8 @@ def main():
         return _int8_ab_main(args)
     if args.trace_overhead:
         return _trace_overhead_main(args)
+    if args.generative and args.chaos:
+        return _generative_chaos_main(args)
     if args.generative and args.paged:
         return _generative_paged_main(args)
     if args.generative:

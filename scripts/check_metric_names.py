@@ -167,6 +167,15 @@ REQUIRED = {
     "serving_trace_sampled_total": "counter",
     "serving_trace_dropped_total": "counter",
     "fleet_scrape_age_s": "gauge",
+    # crash-safe generative serving (ISSUE 20): the recovery/preemption
+    # audit trail the chaos bench JSON and the fault-tolerance docs
+    # matrix read — renaming any of these silently blinds the
+    # zero-token-loss accounting
+    "serving_decode_resumes_total": "counter",
+    "serving_preemptions_total": "counter",
+    "serving_sequence_aborts_total": "counter",
+    "serving_token_replays_total": "counter",
+    "serving_kv_pressure_evictions_total": "counter",
 }
 
 OBSERVABILITY_DOC = os.path.join("docs", "ProgrammingGuide",

@@ -1,0 +1,691 @@
+"""Crash-safe generative serving (ISSUE 20): decode-session recovery
+from a dead peer's durable token rows (bitwise-identical resume, no
+re-emitted rows), the contiguous replay-from-scratch fallback when a
+resume context outruns the prefill ladder, KV-pressure preemption with
+prefix-cache re-admission and the blocks-full answered abort, the
+per-sequence watchdog, the bounded writeback buffer across a broker
+outage, token-row redelivery idempotence on all three broker
+transports, exactly-once streaming across reconnects (client cursor +
+SSE Last-Event-ID), and the new config knobs.
+
+All on the conftest CPU backend; tier-1 fast."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu.compile_cache.serialization as ccser
+from analytics_zoo_tpu.common import faults
+from analytics_zoo_tpu.models.generative import TinyDecoder
+from analytics_zoo_tpu.observability.registry import MetricsRegistry
+from analytics_zoo_tpu.serving.broker import (MemoryBroker, RedisBroker,
+                                              TCPBroker, TCPBrokerServer,
+                                              encode_ndarray)
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.config import ServingConfig
+from analytics_zoo_tpu.serving.decode import (GROUP, STREAM, DecodeServing,
+                                              token_row_field)
+from analytics_zoo_tpu.serving.inference_model import InferenceModel
+from analytics_zoo_tpu.serving.redis_server import MiniRedisServer
+
+BL = 8            # block_len (divides every kv bucket below)
+LANES = 3
+MAX_KV = 64
+KV_BLOCKS = 13    # 12 usable + scratch — three 36-token contexts don't fit
+KV_BUCKETS = [16, 32, 64]
+PROMPT_BUCKETS = [8, 16]
+RESULT_KEY = f"result:{STREAM}"
+
+
+def tiny(**kw):
+    kw.setdefault("vocab", 32)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("n_heads", 2)
+    kw.setdefault("head_dim", 8)
+    kw.setdefault("max_len", MAX_KV)
+    return TinyDecoder(**kw)
+
+
+@pytest.fixture(scope="module")
+def paged_env():
+    """One decoder + InferenceModel warmed ONCE for the geometry every
+    paged engine in this module uses — engines share the executables
+    (they're stateless; KV threads through per-engine pools)."""
+    dec = tiny()
+    im = InferenceModel(placement="replicated", num_replicas=1)
+    im.load_generative(dec.prefill_fn, dec.step_fn, dec.init_params(0),
+                       paged_prefill_fn=dec.paged_prefill_fn,
+                       paged_step_fn=dec.paged_step_fn)
+    im.warmup_generative_paged(
+        dec.init_kv_blocks, num_blocks=KV_BLOCKS, block_len=BL,
+        lanes=LANES, table_len=MAX_KV // BL,
+        chunk_buckets=PROMPT_BUCKETS, kv_buckets=KV_BUCKETS)
+    return dec, im
+
+
+@pytest.fixture(scope="module")
+def contig_env():
+    dec = tiny()
+    im = InferenceModel(placement="replicated", num_replicas=1)
+    im.load_generative(dec.prefill_fn, dec.step_fn, dec.init_params(0))
+    im.warmup_generative(dec.init_kv, slots=2, max_kv_len=MAX_KV,
+                         prompt_buckets=PROMPT_BUCKETS,
+                         kv_buckets=KV_BUCKETS)
+    return dec, im
+
+
+def paged_engine(dec, im, broker, **kw):
+    kw.setdefault("slots", LANES)
+    kw.setdefault("max_kv_len", MAX_KV)
+    kw.setdefault("kv_buckets", KV_BUCKETS)
+    kw.setdefault("prompt_buckets", PROMPT_BUCKETS)
+    kw.setdefault("max_new_default", 6)
+    kw.setdefault("idle_block_ms", 1)
+    return DecodeServing(im, dec.init_kv, broker=broker,
+                         registry=MetricsRegistry(), paged=True,
+                         init_kv_blocks=dec.init_kv_blocks, block_len=BL,
+                         kv_blocks=KV_BLOCKS, **kw)
+
+
+def contig_engine(dec, im, broker, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_kv_len", MAX_KV)
+    kw.setdefault("kv_buckets", KV_BUCKETS)
+    kw.setdefault("prompt_buckets", PROMPT_BUCKETS)
+    kw.setdefault("max_new_default", 6)
+    kw.setdefault("idle_block_ms", 1)
+    return DecodeServing(im, dec.init_kv, broker=broker,
+                         registry=MetricsRegistry(), **kw)
+
+
+def drive(srv, until, max_iters=400):
+    """Run the engine loop INLINE (deterministic single thread): the
+    exact watchdog -> intake -> step order `run()` uses."""
+    step = srv._run_paged_step if srv.paged else srv._run_step
+    for _ in range(max_iters):
+        srv._watchdog()
+        srv._intake()
+        step()
+        if srv._pending:
+            srv._flush_pending()
+        if until():
+            return
+    raise AssertionError(
+        f"engine did not converge in {max_iters} steps: {srv.stats}")
+
+
+def collect(outq, uris, timeout_s=30.0):
+    out, deadline = {}, time.monotonic() + timeout_s
+    while len(out) < len(uris):
+        assert time.monotonic() < deadline, \
+            f"missing {set(uris) - set(out)}"
+        out.update(outq.query_many([u for u in uris if u not in out]))
+        time.sleep(0.002)
+    return {u: list(np.asarray(v).reshape(-1)) for u, v in out.items()}
+
+
+def reference_run(make, dec, im, jobs):
+    """Uninterrupted oracle: each job decoded alone on a FRESH engine
+    (greedy is deterministic, so any crash-free schedule must match)."""
+    out = []
+    for prompt, max_new in jobs:
+        broker = MemoryBroker()
+        srv = make(dec, im, broker)
+        uri = InputQueue(broker).enqueue(t=prompt, max_new=max_new,
+                                         stream=1)
+        drive(srv, until=lambda: srv.stats["finished"] >= 1)
+        out.append(collect(OutputQueue(broker), [uri])[uri])
+    return out
+
+
+def counter_value(reg, name, **labels):
+    series = reg.snapshot().get(name, {}).get("series", [])
+    for s in series:
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
+
+
+class TestClaimResume:
+    def test_paged_resume_bitwise_identical_no_reemit(self, paged_env,
+                                                      monkeypatch):
+        dec, im = paged_env
+        prompt = (np.arange(8, dtype=np.int32) % 29) + 1
+        (expected,) = reference_run(paged_engine, dec, im, [(prompt, 10)])
+        assert len(expected) == 10
+
+        broker = MemoryBroker()
+        e1 = paged_engine(dec, im, broker, engine_id="e1")
+        uri = InputQueue(broker).enqueue(t=prompt, max_new=10, stream=1)
+        e1._intake()
+        for _ in range(4):                # prefill + steps, rows flush
+            e1._run_paged_step()
+        k = e1.stats["tokens"]
+        assert 0 < k < 10
+        # e1 "dies" here: record delivered but never acked, k rows and
+        # no final are durable in the result hash
+        rows_before = broker.hmget(
+            RESULT_KEY, [token_row_field(uri, i) for i in range(k)])
+        assert all(r is not None for r in rows_before)
+        assert broker.hmget(RESULT_KEY, [uri]) == [None]
+        time.sleep(0.08)
+
+        # the survivor must resume on WARMED executables only
+        def no_compiles(*a, **kw):
+            raise AssertionError("resume path compiled an executable")
+        monkeypatch.setattr(ccser, "compile_lowered", no_compiles)
+        e2 = paged_engine(dec, im, broker, engine_id="e2",
+                          claim_min_idle_s=0.05, claim_interval_s=0.0)
+        drive(e2, until=lambda: e2.stats["finished"] >= 1)
+
+        assert e2.stats["resumed"] == 1
+        assert e2.stats["recovered_tokens"] == k
+        assert e2.stats["tokens"] == 10 - k      # fresh tokens only
+        assert counter_value(e2.registry, "serving_decode_resumes_total",
+                             engine="e2") == 1
+        got = collect(OutputQueue(broker), [uri])[uri]
+        assert got == expected                   # bitwise-identical
+        # the already-durable rows were never rewritten (a rewrite
+        # would stamp a different "ms"), and the rest landed exactly
+        rows_after = broker.hmget(
+            RESULT_KEY, [token_row_field(uri, i) for i in range(10)])
+        assert rows_after[:k] == rows_before
+        assert all(r is not None for r in rows_after)
+        gen = json.loads(broker.hmget(RESULT_KEY, [uri])[0])["gen"]
+        assert gen["n"] == 10 and gen["rows"] == 10
+        assert gen["finish"] == "length"
+        assert broker.pending_count(STREAM, GROUP) == 0   # acked
+
+    def test_contiguous_resume_replays_from_scratch(self, contig_env):
+        """A resume context beyond the prefill ladder re-decodes from
+        the prompt; `presented` suppresses every already-durable row —
+        the survivor's output is still bitwise-identical and no row is
+        emitted twice."""
+        dec, im = contig_env
+        prompt = (np.arange(8, dtype=np.int32) % 23) + 2
+        (expected,) = reference_run(contig_engine, dec, im, [(prompt, 12)])
+        assert len(expected) == 12
+
+        broker = MemoryBroker()
+        e1 = contig_engine(dec, im, broker, engine_id="c1")
+        uri = InputQueue(broker).enqueue(t=prompt, max_new=12, stream=1)
+        e1._intake()
+        for _ in range(9):
+            e1._run_step()
+        k = e1.stats["tokens"]
+        assert k == 10                     # ctx 8 + 10 = 18 > ladder 16
+        rows_before = broker.hmget(
+            RESULT_KEY, [token_row_field(uri, i) for i in range(k)])
+        time.sleep(0.08)
+
+        e2 = contig_engine(dec, im, broker, engine_id="c2",
+                           claim_min_idle_s=0.05, claim_interval_s=0.0)
+        drive(e2, until=lambda: e2.stats["finished"] >= 1)
+        assert e2.stats["resumed"] == 1
+        assert e2.stats["recovered_tokens"] == k
+        assert e2.stats["replayed_tokens"] == k
+        assert e2.stats["tokens"] == 12 - k       # replays don't count
+        assert counter_value(e2.registry, "serving_token_replays_total",
+                             engine="c2", surface="engine") == k
+        got = collect(OutputQueue(broker), [uri])[uri]
+        assert got == expected
+        rows_after = broker.hmget(
+            RESULT_KEY, [token_row_field(uri, i) for i in range(12)])
+        assert rows_after[:k] == rows_before       # no re-emits
+        assert all(r is not None for r in rows_after)
+
+    def test_final_present_counts_duplicate_not_served(self, paged_env):
+        """Ack-lost redelivery: the final is already committed, so the
+        claim sweep only acks — nothing re-decodes, nothing rewrites."""
+        dec, im = paged_env
+        broker = MemoryBroker()
+        uri = InputQueue(broker).enqueue(
+            t=np.asarray([4, 5, 6], np.int32), max_new=3, stream=1)
+        recs = broker.read_group(STREAM, GROUP, "dead-peer", 10,
+                                 block_ms=0)
+        assert len(recs) == 1              # delivered, never acked
+        blob = encode_ndarray(np.asarray([7, 8, 9], np.int32))
+        blob["gen"] = {"n": 3, "rows": 3, "finish": "length",
+                       "ttft_ms": 1.0}
+        mapping = {token_row_field(uri, i):
+                   json.dumps({"i": i, "t": 7 + i, "ms": 1.0})
+                   for i in range(3)}
+        mapping[uri] = json.dumps(blob)
+        broker.hset_many(RESULT_KEY, mapping)
+        before = dict(broker.hgetall(RESULT_KEY))
+
+        srv = paged_engine(dec, im, broker, claim_min_idle_s=0.0,
+                           claim_interval_s=0.0)
+        time.sleep(0.005)
+        srv._claim_sweep()
+        assert srv.stats["duplicates"] == 1
+        assert srv.stats["resumed"] == 0
+        assert srv.stats["finished"] == 0          # not served again
+        assert broker.hgetall(RESULT_KEY) == before
+        assert broker.pending_count(STREAM, GROUP) == 0
+
+
+class TestRedeliveryIdempotence:
+    """Satellite (c): the conformance contract on EVERY broker
+    transport — a re-delivered record whose token rows exist resumes
+    without duplicating a single row."""
+
+    @pytest.fixture(params=["memory", "tcp", "redis"])
+    def any_broker(self, request):
+        if request.param == "memory":
+            yield MemoryBroker()
+        elif request.param == "tcp":
+            srv = TCPBrokerServer("127.0.0.1", 0).start()
+            yield TCPBroker("127.0.0.1", srv.port)
+            srv.stop()
+        else:
+            srv = MiniRedisServer().start()
+            yield RedisBroker("127.0.0.1", srv.port)
+            srv.stop()
+
+    def test_rows_exist_resume_no_duplicate_rows(self, paged_env,
+                                                 any_broker):
+        dec, im = paged_env
+        broker = any_broker
+        prompt = np.asarray([3, 9, 4, 1, 5, 9, 2, 6], np.int32)
+        uri = InputQueue(broker).enqueue(t=prompt, max_new=6, stream=1)
+        recs = broker.read_group(STREAM, GROUP, "dead-peer", 10,
+                                 block_ms=0)
+        assert len(recs) == 1
+        # the dead peer committed 3 rows (tokens must be < vocab so the
+        # resume prefill can embed them) but no final
+        rows = {token_row_field(uri, i):
+                json.dumps({"i": i, "t": 5 + i, "ms": 1.0})
+                for i in range(3)}
+        broker.hset_many(RESULT_KEY, rows)
+
+        srv = paged_engine(dec, im, broker, claim_min_idle_s=0.0,
+                           claim_interval_s=0.0)
+        time.sleep(0.005)
+        drive(srv, until=lambda: srv.stats["finished"] >= 1)
+        assert srv.stats["resumed"] == 1
+        assert srv.stats["recovered_tokens"] == 3
+        assert srv.stats["duplicates"] == 0
+        got = broker.hmget(RESULT_KEY,
+                           [token_row_field(uri, i) for i in range(6)])
+        assert got[:3] == [rows[token_row_field(uri, i)]
+                           for i in range(3)]      # untouched, not rewritten
+        assert all(r is not None for r in got[3:])  # continued from i=3
+        final = json.loads(broker.hmget(RESULT_KEY, [uri])[0])
+        assert final["gen"]["n"] == 6 and final["gen"]["rows"] == 6
+        assert [int(json.loads(r)["t"]) for r in got] == \
+            list(np.asarray(OutputQueue(broker).query(uri)).reshape(-1))
+        assert broker.pending_count(STREAM, GROUP) == 0
+
+    def test_final_present_counts_duplicate(self, paged_env, any_broker):
+        dec, im = paged_env
+        broker = any_broker
+        uri = InputQueue(broker).enqueue(
+            t=np.asarray([2, 4], np.int32), max_new=2, stream=1)
+        assert len(broker.read_group(STREAM, GROUP, "dead-peer", 10,
+                                     block_ms=0)) == 1
+        blob = encode_ndarray(np.asarray([6, 7], np.int32))
+        blob["gen"] = {"n": 2, "rows": 2, "finish": "length",
+                       "ttft_ms": 1.0}
+        broker.hset_many(RESULT_KEY, {uri: json.dumps(blob)})
+        srv = paged_engine(dec, im, broker, claim_min_idle_s=0.0,
+                           claim_interval_s=0.0)
+        time.sleep(0.005)
+        srv._claim_sweep()
+        srv._flush_pending()
+        assert srv.stats["duplicates"] == 1
+        assert srv.stats["finished"] == 0
+        assert broker.pending_count(STREAM, GROUP) == 0
+
+
+class TestPreemption:
+    def test_pressure_preempts_youngest_all_complete_bitwise(self,
+                                                             paged_env):
+        """Three 36-token contexts need 15 blocks against 12 usable:
+        admission must preempt (not stall), the victim must re-admit
+        off its published prefix, and every output must match an
+        uninterrupted run bitwise."""
+        dec, im = paged_env
+        jobs = [((np.arange(8, dtype=np.int32) % 13) + 1 + 2 * j, 28)
+                for j in range(3)]
+        expected = reference_run(paged_engine, dec, im, jobs)
+
+        broker = MemoryBroker()
+        srv = paged_engine(dec, im, broker, engine_id="pp")
+        inq = InputQueue(broker)
+        uris = [inq.enqueue(t=p, max_new=n, stream=1) for p, n in jobs]
+        drive(srv, until=lambda: srv.stats["finished"] >= 3)
+
+        got = collect(OutputQueue(broker), uris)
+        for uri, want in zip(uris, expected):
+            assert got[uri] == want
+        assert srv.stats["aborted"] == 0
+        assert srv.stats["preempted"] >= 1
+        # anti-thrash bound: nobody cycles forever
+        assert srv.stats["preempted"] <= 3 * srv.preempt_max
+        # the victim re-boarded via its published prefix, copy-free
+        assert srv.stats["prefix_hit_tokens"] > 0
+        assert counter_value(srv.registry, "serving_preemptions_total",
+                             engine="pp") == srv.stats["preempted"]
+
+    def test_blocks_full_abort_answers_with_generated_tokens(self,
+                                                             paged_env):
+        """A lone sequence that outgrows the pool (no victims to
+        preempt) is ANSWERED with what it generated — never a stall,
+        never NaN."""
+        dec, im = paged_env
+        (expected,) = reference_run(
+            paged_engine, dec, im,
+            [(np.asarray([5, 3, 5, 3, 5, 3, 5, 3], np.int32), 20)])
+        broker = MemoryBroker()
+        srv = paged_engine(dec, im, broker)
+        # drain the pool to 2 free blocks: one for the prompt, one for
+        # growth — the third grab has nowhere to go
+        held = []
+        while srv.block_pool.free_count > 2:
+            held.append(srv.block_pool.alloc())
+        uri = InputQueue(broker).enqueue(
+            t=np.asarray([5, 3, 5, 3, 5, 3, 5, 3], np.int32),
+            max_new=20, stream=1)
+        drive(srv, until=lambda: srv.stats["finished"] >= 1
+              or srv.stats["aborted"] >= 1, max_iters=100)
+        assert srv.stats["aborted"] == 1
+        assert counter_value(srv.registry, "serving_sequence_aborts_total",
+                             reason="blocks-full") == 1
+        final = json.loads(broker.hmget(RESULT_KEY, [uri])[0])
+        assert final["gen"]["finish"] == "blocks-full"
+        n = final["gen"]["n"]
+        assert 0 < n < 20
+        got = list(np.asarray(OutputQueue(broker).query(uri)).reshape(-1))
+        assert got == expected[:n]         # a correct PREFIX, answered
+        for b in held:
+            srv.block_pool.release(b)
+
+    def test_preempt_max_zero_disables_preemption(self, paged_env):
+        dec, im = paged_env
+        broker = MemoryBroker()
+        srv = paged_engine(dec, im, broker, preempt_max=0)
+        held = []
+        while srv.block_pool.free_count > 2:
+            held.append(srv.block_pool.alloc())
+        InputQueue(broker).enqueue(
+            t=np.asarray([1, 2, 3, 4, 5, 6, 7, 8], np.int32),
+            max_new=20, stream=1)
+        drive(srv, until=lambda: srv.stats["aborted"] >= 1,
+              max_iters=100)
+        assert srv.stats["preempted"] == 0
+        for b in held:
+            srv.block_pool.release(b)
+
+
+class TestWatchdog:
+    def test_wall_clock_abort_releases_and_answers_nan(self, paged_env):
+        dec, im = paged_env
+        broker = MemoryBroker()
+        srv = paged_engine(dec, im, broker, max_seq_wall_s=0.05)
+        uri = InputQueue(broker).enqueue(
+            t=np.asarray([9, 8, 7], np.int32), max_new=40, stream=1)
+        srv._intake()
+        srv._run_paged_step()              # prompt boards, decode starts
+        assert srv._active
+        time.sleep(0.06)
+        srv._watchdog()
+        assert srv.stats["aborted"] == 1
+        assert counter_value(srv.registry, "serving_sequence_aborts_total",
+                             reason="wall") == 1
+        assert not srv._active
+        assert len(srv._free_lanes) == LANES     # lane released
+        assert broker.hmget(RESULT_KEY, [uri]) == ["NaN"]
+        assert broker.pending_count(STREAM, GROUP) == 0
+        r = OutputQueue(broker).query(uri)
+        assert isinstance(r, float) and np.isnan(r)
+
+    def test_watchdog_reaches_waiting_sequences(self, paged_env):
+        dec, im = paged_env
+        broker = MemoryBroker()
+        srv = paged_engine(dec, im, broker, max_seq_wall_s=0.03)
+        uri = InputQueue(broker).enqueue(
+            t=np.asarray([1, 2], np.int32), max_new=4)
+        srv._intake()                      # parsed into waiting
+        assert srv._waiting
+        time.sleep(0.04)
+        srv._watchdog()
+        assert srv.stats["aborted"] == 1 and not srv._waiting
+        assert broker.hmget(RESULT_KEY, [uri]) == ["NaN"]
+
+
+class TestWritebackResilience:
+    def test_outage_buffers_rows_decode_keeps_stepping(self, paged_env):
+        dec, im = paged_env
+        (expected,) = reference_run(
+            paged_engine, dec, im,
+            [(np.asarray([7, 7, 2, 2], np.int32), 10)])
+        broker = MemoryBroker()
+        srv = paged_engine(dec, im, broker)
+        uri = InputQueue(broker).enqueue(
+            t=np.asarray([7, 7, 2, 2], np.int32), max_new=10, stream=1)
+        srv._intake()
+        with faults.injected("decode.writeback", mode="raise") as fault:
+            for _ in range(4):
+                srv._run_paged_step()
+            assert fault.trips == 4
+            # the broker blip did NOT kill the decode: tokens kept
+            # accumulating, rows buffered engine-side
+            assert srv.stats["tokens"] >= 5
+            assert srv._pending
+            assert broker.hmget(
+                RESULT_KEY, [token_row_field(uri, 0)]) == [None]
+        drive(srv, until=lambda: srv.stats["finished"] >= 1)
+        assert srv.stats["rows_shed"] == 0
+        rows = broker.hmget(RESULT_KEY,
+                            [token_row_field(uri, i) for i in range(10)])
+        assert all(r is not None for r in rows)     # backlog drained
+        assert collect(OutputQueue(broker), [uri])[uri] == expected
+        assert broker.pending_count(STREAM, GROUP) == 0
+
+    def test_buffer_bound_sheds_oldest_final_stays_authoritative(
+            self, paged_env):
+        dec, im = paged_env
+        broker = MemoryBroker()
+        srv = paged_engine(dec, im, broker, writeback_buffer_rows=4)
+        uri = InputQueue(broker).enqueue(
+            t=np.asarray([6, 1, 6, 1], np.int32), max_new=12, stream=1)
+        srv._intake()
+        with faults.injected("decode.writeback", mode="raise"):
+            for _ in range(30):
+                srv._run_paged_step()
+                if srv.stats["finished"]:
+                    break
+        assert srv.stats["finished"] == 1
+        assert srv.stats["rows_shed"] == 12 - 4
+        srv._flush_pending()               # broker back: one fused drain
+        rows = broker.hmget(RESULT_KEY,
+                            [token_row_field(uri, i) for i in range(12)])
+        assert rows[:8] == [None] * 8      # oldest steps shed
+        assert all(r is not None for r in rows[8:])   # newest kept
+        final = json.loads(broker.hmget(RESULT_KEY, [uri])[0])
+        assert final["gen"]["n"] == 12     # the final answers for ALL 12
+        assert len(OutputQueue(broker).query(uri)) == 12
+
+
+class TestStreamingContinuity:
+    def _seed(self, broker, uri, n=6, with_final=True):
+        rows = {token_row_field(uri, i):
+                json.dumps({"i": i, "t": 10 + i, "ms": float(i)})
+                for i in range(n)}
+        broker.hset_many(RESULT_KEY, rows)
+        if with_final:
+            blob = encode_ndarray(np.asarray(
+                [10 + i for i in range(n)], np.int32))
+            blob["gen"] = {"n": n, "rows": n, "finish": "length",
+                           "ttft_ms": 1.0}
+            broker.hset_many(RESULT_KEY, {uri: json.dumps(blob)})
+
+    def test_start_cursor_replays_only_missing_rows(self):
+        broker = MemoryBroker()
+        self._seed(broker, "j1")
+        outq = OutputQueue(broker)
+        first = []
+        gen = outq.stream_tokens("j1", timeout_s=5, delete=False)
+        for evt in gen:
+            first.append(evt["i"])
+            if len(first) == 3:
+                gen.close()                # connection drops mid-stream
+                break
+        events = list(outq.stream_tokens("j1", timeout_s=5, start=3))
+        assert first == [0, 1, 2]
+        assert [e["i"] for e in events[:-1]] == [3, 4, 5]
+        assert events[-1]["done"]
+        # exactly-once across the reconnect, and nothing left behind
+        assert broker.hgetall(RESULT_KEY) == {}
+
+    def test_keepalive_markers_during_idle_gap(self):
+        broker = MemoryBroker()
+        outq = OutputQueue(broker)
+        keeps = 0
+        try:
+            for evt in outq.stream_tokens("j2", timeout_s=0.15,
+                                          keepalive_s=0.02):
+                assert evt.get("keepalive")
+                keeps += 1
+        except TimeoutError:
+            pass
+        assert keeps >= 2
+
+    def test_stall_with_dead_heartbeats_ends_with_error(self):
+        """No rows, no heartbeat progress: the stream must END with an
+        answered engine-dead error instead of hanging to the deadline."""
+        broker = MemoryBroker()
+        outq = OutputQueue(broker)
+        t0 = time.monotonic()
+        events = list(outq.stream_tokens("j3", timeout_s=30,
+                                         stall_timeout_s=0.05))
+        assert time.monotonic() - t0 < 5.0
+        assert events == [{"done": True, "error": "engine-dead",
+                           "tokens": None, "gen": {}}]
+
+
+class TestSSEReconnect:
+    def test_last_event_id_reconnect_each_index_once(self, paged_env):
+        from analytics_zoo_tpu.serving.http_frontend import FrontEnd
+        dec, im = paged_env
+        broker = MemoryBroker()
+        srv = paged_engine(dec, im, broker)
+        reg = MetricsRegistry()
+        srv.start()
+        fe = FrontEnd(broker, None, port=0, registry=reg,
+                      stream_keepalive_s=5.0).start()
+        seen = []
+        try:
+            # slow each decode step down so the generation outlives the
+            # first (dropped) connection deterministically
+            with faults.injected("decode.step", mode="stall",
+                                 delay_s=0.05):
+                url = f"http://127.0.0.1:{fe.port}/predict?stream=1"
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps({"prompt": [3, 1, 4, 1, 5],
+                                     "max_new": 24}).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = urllib.request.urlopen(req, timeout=30)
+                request_id = resp.headers["X-Request-Id"]
+                assert request_id
+                buf = b""
+                while buf.count(b"\n\n") < 2:      # a couple of frames
+                    buf += resp.read(1)
+                resp.close()                        # client vanishes
+                for frame in buf.split(b"\n\n"):
+                    if b"data: " in frame:
+                        seen.append(json.loads(
+                            frame.split(b"data: ", 1)[1])["i"])
+                assert seen                         # got at least one row
+            last_id = max(seen)
+            req2 = urllib.request.Request(
+                url, data=json.dumps({"request_id": request_id}).encode(),
+                headers={"Content-Type": "application/json",
+                         "Last-Event-ID": str(last_id)})
+            with urllib.request.urlopen(req2, timeout=30) as resp2:
+                raw = resp2.read().decode()
+        finally:
+            fe.stop()
+            srv.stop()
+        events = [e for e in raw.split("\n\n") if e.strip()]
+        tokens = [json.loads(e.split("data: ", 1)[1]) for e in events
+                  if not e.startswith("event:")
+                  and not e.startswith(":")]
+        ids = [t["i"] for t in tokens]
+        # the replay starts EXACTLY after Last-Event-ID and the union
+        # covers every index exactly once
+        assert ids == list(range(last_id + 1, 24))
+        assert sorted(seen + ids) == list(range(24))
+        done = [e for e in events if e.startswith("event: done")]
+        assert len(done) == 1
+        payload = json.loads(done[0].split("data: ", 1)[1])
+        assert len(payload["tokens"]) == 24
+        # frames carry SSE ids, and the frontend counted the replays
+        assert any(e.startswith("id: ") for e in events)
+        assert counter_value(reg, "serving_token_replays_total",
+                             surface="frontend") == len(ids)
+
+    def test_reconnect_requires_integer_last_event_id(self):
+        from analytics_zoo_tpu.serving.http_frontend import FrontEnd
+        fe = FrontEnd(MemoryBroker(), None, port=0,
+                      registry=MetricsRegistry()).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fe.port}/predict?stream=1",
+                data=json.dumps({"request_id": "u-1"}).encode(),
+                headers={"Content-Type": "application/json",
+                         "Last-Event-ID": "nope"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+        finally:
+            fe.stop()
+
+
+class TestConfigKnobs:
+    def _load(self, tmp_path, extra=""):
+        f = tmp_path / "c.yaml"
+        f.write_text(
+            "model:\n  path: /m\n"
+            "params:\n"
+            "  generative:\n"
+            "    slots: 2\n"
+            "    max_kv_len: 32\n" + extra)
+        return ServingConfig.load(str(f))
+
+    def test_crash_safety_knobs_parse(self, tmp_path):
+        cfg = self._load(
+            tmp_path,
+            "    max_seq_wall_s: 12.5\n"
+            "    preempt_max: 5\n"
+            "    writeback_buffer_rows: 64\n"
+            "    resume: false\n"
+            "    keepalive_s: 7.0\n")
+        assert cfg.decode_max_seq_wall_s == 12.5
+        assert cfg.decode_preempt_max == 5
+        assert cfg.decode_writeback_buffer == 64
+        assert cfg.decode_resume is False
+        assert cfg.decode_keepalive_s == 7.0
+
+    def test_defaults(self, tmp_path):
+        cfg = self._load(tmp_path)
+        assert cfg.decode_max_seq_wall_s is None
+        assert cfg.decode_preempt_max == 3
+        assert cfg.decode_writeback_buffer == 512
+        assert cfg.decode_resume is True
+        assert cfg.decode_keepalive_s is None
+
+    @pytest.mark.parametrize("bad", [
+        "    max_seq_wall_s: 0\n",
+        "    preempt_max: -1\n",
+        "    writeback_buffer_rows: 0\n",
+        "    keepalive_s: 0\n",
+    ])
+    def test_invalid_values_fail_the_load(self, tmp_path, bad):
+        with pytest.raises(ValueError):
+            self._load(tmp_path, bad)
